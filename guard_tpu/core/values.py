@@ -315,9 +315,22 @@ class PV:
 # Regex compilation cache. The reference uses fancy-regex (lookaround +
 # backreference support); Python `re` covers the same feature class.
 # ---------------------------------------------------------------------------
+_GLOBAL_FLAGS_RE = re.compile(r"\(\?([aiLmsux]+)\)")
+
+
 @lru_cache(maxsize=4096)
 def compiled_regex(pattern: str):
-    return re.compile(pattern)
+    try:
+        return re.compile(pattern)
+    except re.error:
+        # Rust regex crates allow inline global flags anywhere in the
+        # pattern (e.g. `^(?i)name$`); Python requires them at the start.
+        # Hoist them to the front and retry.
+        flags = "".join(sorted(set("".join(_GLOBAL_FLAGS_RE.findall(pattern)))))
+        if not flags:
+            raise
+        stripped = _GLOBAL_FLAGS_RE.sub("", pattern)
+        return re.compile(f"(?{flags})" + stripped)
 
 
 def regex_matches(pattern: str, s: str) -> bool:
