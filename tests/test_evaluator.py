@@ -1,0 +1,244 @@
+"""Evaluator semantics, pinned to eval.rs / operators.rs behavior, plus
+the full guard-examples expectation corpus as a golden suite."""
+
+import pathlib
+
+import pytest
+import yaml
+
+from guard_tpu.core.loader import load_document
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.core.qresult import Status
+from guard_tpu.core.scopes import RootScope
+from guard_tpu.core.evaluator import eval_rule, eval_rules_file
+from guard_tpu.core.values import from_plain
+
+
+def run(rules: str, doc, rule_name=None) -> Status:
+    rf = parse_rules_file(rules, "")
+    root = from_plain(doc) if not isinstance(doc, str) else load_document(doc)
+    scope = RootScope(rf, root)
+    if rule_name is None:
+        return eval_rules_file(rf, scope, None)
+    return scope.rule_status(rule_name)
+
+
+def test_missing_key_fails_clause():
+    assert run("Resources.x.Type == 'T'\n", {"Resources": {}}) == Status.FAIL
+
+
+def test_empty_on_missing_property_passes():
+    # docs/CLAUSES.md: empty evaluates true for missing property keys
+    assert run("Resources.S3.Properties.Tags empty\n", {"Resources": {"S3": {}}}) == Status.PASS
+
+
+def test_exists_and_not_exists():
+    doc = {"Resources": {"b": {"Type": "T"}}}
+    assert run("Resources.b.Type exists\n", doc) == Status.PASS
+    assert run("Resources.b.Missing !exists\n", doc) == Status.PASS
+    assert run("Resources.b.Missing exists\n", doc) == Status.FAIL
+
+
+def test_filter_empty_result_skips_block():
+    rules = "Resources.*[ Type == 'AWS::EC2::Volume' ] {\n  Properties exists\n}\n"
+    doc = {"Resources": {"b": {"Type": "Other"}}}
+    assert run(rules, doc) == Status.SKIP
+
+
+def test_filter_no_resources_fails():
+    # QUERY_AND_FILTERING.md: {} or {Resources:{}} -> query FAILs the block
+    rules = "Resources.*[ Type == 'AWS::EC2::Volume' ] {\n  Properties exists\n}\n"
+    assert run(rules, {}) == Status.FAIL
+    assert run(rules, {"Resources": {}}) == Status.FAIL
+
+
+def test_some_vs_match_all():
+    doc = {
+        "Resources": {
+            "r": {
+                "Properties": {
+                    "Tags": [
+                        {"Key": "EndPROD", "Value": "NotAppStart"},
+                        {"Key": "NotPRODEnd", "Value": "AppStart"},
+                    ]
+                }
+            }
+        }
+    }
+    independent = (
+        "let resources = Resources.*\n"
+        "rule r {\n"
+        "  some %resources.Properties.Tags[*].Key == /PROD$/\n"
+        "  some %resources.Properties.Tags[*].Value == /^App/\n"
+        "}\n"
+    )
+    assert run(independent, doc, "r") == Status.PASS
+    block_form = (
+        "let resources = Resources.*\n"
+        "rule r {\n"
+        "  some %resources.Properties.Tags[*] {\n"
+        "    Key == /PROD$/\n"
+        "    Value == /^App/\n"
+        "  }\n"
+        "}\n"
+    )
+    assert run(block_form, doc, "r") == Status.FAIL
+
+
+def test_in_operator_with_list():
+    doc = {"Resources": {"v": {"Properties": {"VolumeType": "io1"}}}}
+    assert (
+        run("Resources.v.Properties.VolumeType IN ['io1','io2','gp3']\n", doc)
+        == Status.PASS
+    )
+    assert (
+        run("Resources.v.Properties.VolumeType IN ['gp2']\n", doc) == Status.FAIL
+    )
+    assert (
+        run("Resources.v.Properties.VolumeType not IN ['gp2']\n", doc) == Status.PASS
+    )
+
+
+def test_range_in():
+    doc = {"Resources": {"v": {"Properties": {"Size": 100}}}}
+    assert run("Resources.v.Properties.Size IN r[50,200]\n", doc) == Status.PASS
+    assert run("Resources.v.Properties.Size IN r(100,200]\n", doc) == Status.FAIL
+
+
+def test_when_skip_gating():
+    rules = (
+        "rule gated when Resources.b.Missing exists {\n  Resources.b.Type == 'T'\n}\n"
+    )
+    assert run(rules, {"Resources": {"b": {"Type": "T"}}}, "gated") == Status.SKIP
+
+
+def test_named_rule_dependency_and_negation():
+    rules = (
+        "rule a {\n  Resources exists\n}\n"
+        "rule b when a {\n  Resources.x.T == 1\n}\n"
+        "rule c {\n  not a\n}\n"
+    )
+    doc = {"Resources": {"x": {"T": 1}}}
+    assert run(rules, doc, "b") == Status.PASS
+    assert run(rules, doc, "c") == Status.FAIL
+
+
+def test_parameterized_rule():
+    rules = (
+        "rule check_len(items) {\n  %items !empty\n}\n"
+        "rule main {\n  check_len(Resources.*)\n}\n"
+    )
+    assert run(rules, {"Resources": {"a": {"x": 1}}}, "main") == Status.PASS
+    assert run(rules, {"Resources": {}}, "main") == Status.FAIL
+
+
+def test_type_block():
+    rules = "AWS::S3::Bucket {\n  Properties.BucketName exists\n}\n"
+    doc = {
+        "Resources": {
+            "b1": {"Type": "AWS::S3::Bucket", "Properties": {"BucketName": "x"}},
+            "other": {"Type": "AWS::EC2::Instance"},
+        }
+    }
+    assert run(rules, doc) == Status.PASS
+    doc2 = {"Resources": {"other": {"Type": "AWS::EC2::Instance"}}}
+    assert run(rules, doc2) == Status.SKIP
+
+
+def test_scalar_equals_single_element_list():
+    # UNIT_TESTING.md: Types: "PRIVATE" matches == against [*] projection
+    rules = 'Resources.a.Types[*] == "PRIVATE"\n'
+    assert run(rules, {"Resources": {"a": {"Types": "PRIVATE"}}}) == Status.PASS
+
+
+def test_string_in_string_containment():
+    doc = {"a": "10.0.0.0/24"}
+    assert run("a IN '10.0.0.0/24,192.168.0.0/16'\n", doc) == Status.PASS
+
+
+def test_variables_with_loops():
+    rules = (
+        "let ports = InputParameter.TcpBlockedPorts[*]\n"
+        "rule ports_check {\n"
+        "  %ports !empty\n"
+        "  %ports {\n    this IN r[0,65535]\n  }\n"
+        "}\n"
+    )
+    doc = {"InputParameter": {"TcpBlockedPorts": [21, 22, 110]}}
+    assert run(rules, doc, "ports_check") == Status.PASS
+
+
+def test_count_function():
+    rules = (
+        "let all = Resources.*\n"
+        "let n = count(%all)\n"
+        "rule r {\n  %n == 2\n}\n"
+    )
+    doc = {"Resources": {"a": {"x": 1}, "b": {"x": 2}}}
+    assert run(rules, doc, "r") == Status.PASS
+
+
+def test_join_and_to_upper():
+    rules = (
+        "let items = Resources.c.Collection[*]\n"
+        "let joined = join(%items, ',')\n"
+        "let upper = to_upper(%joined)\n"
+        "rule r {\n  %upper == 'A,B,C'\n}\n"
+    )
+    doc = {"Resources": {"c": {"Collection": ["a", "b", "c"]}}}
+    assert run(rules, doc, "r") == Status.PASS
+
+
+def test_json_parse():
+    rules = (
+        "let raw = Resources.s.Policy\n"
+        "let parsed = json_parse(%raw)\n"
+        "rule r {\n  %parsed.Principal == '*'\n}\n"
+    )
+    doc = {"Resources": {"s": {"Policy": '{"Principal": "*"}'}}}
+    assert run(rules, doc, "r") == Status.PASS
+
+
+def test_keys_projection():
+    rules = "Resources.x.Condition[ keys == /aws:[sS]ourceVpc/ ] !empty\n"
+    doc = {"Resources": {"x": {"Condition": {"aws:sourceVpc": ["vpc-1"]}}}}
+    assert run(rules, doc) == Status.PASS
+
+
+def test_not_in_reverse_diff():
+    doc = {"ports": [10, 20]}
+    assert run("ports.* not IN [30, 40]\n", doc) == Status.PASS
+    assert run("ports.* not IN [10, 40]\n", doc) == Status.FAIL
+
+
+# ---------------------------------------------------------------------------
+# golden corpus: every guard-examples test spec
+# ---------------------------------------------------------------------------
+def _example_cases():
+    cases = []
+    base = pathlib.Path("/root/reference/guard-examples")
+    for guard in sorted(base.rglob("*.guard")):
+        tests = guard.with_name(guard.stem + "-tests.yaml")
+        if not tests.exists():
+            continue
+        specs = yaml.safe_load(tests.read_text()) or []
+        for i, spec in enumerate(specs):
+            rules = (spec.get("expectations", {}) or {}).get("rules", {}) or {}
+            for rule_name, expected in rules.items():
+                cases.append(
+                    pytest.param(
+                        guard,
+                        spec.get("input"),
+                        rule_name,
+                        expected,
+                        id=f"{guard.stem}-{i}-{rule_name}",
+                    )
+                )
+    return cases
+
+
+@pytest.mark.parametrize("guard,input_doc,rule_name,expected", _example_cases())
+def test_reference_example_expectations(guard, input_doc, rule_name, expected):
+    rf = parse_rules_file(guard.read_text(), guard.name)
+    scope = RootScope(rf, from_plain(input_doc))
+    assert scope.rule_status(rule_name).value == expected

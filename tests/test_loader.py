@@ -1,0 +1,58 @@
+"""Loader semantics: locations, scalar typing, CFN intrinsic short forms."""
+
+import pytest
+
+from guard_tpu.core.errors import ParseError
+from guard_tpu.core.loader import load_document
+from guard_tpu.core.values import BOOL, FLOAT, INT, MAP, NULL, STRING
+
+
+def test_scalar_typing_plain():
+    doc = load_document(
+        "a: 10\nb: 1.5\nc: yes\nd: Null\ne: hello\nf: '10'\ng: True\n"
+    )
+    v = doc.val.values
+    assert v["a"].kind == INT and v["a"].val == 10
+    assert v["b"].kind == FLOAT
+    assert v["c"].kind == BOOL and v["c"].val is True
+    assert v["d"].kind == NULL
+    assert v["e"].kind == STRING
+    # quoted scalars stay strings (loader.rs:83-84)
+    assert v["f"].kind == STRING and v["f"].val == "10"
+    # 'True' (capital T, plain) is NOT a bool in the reference loader
+    assert v["g"].kind == STRING
+
+
+def test_locations_are_zero_based_marks():
+    doc = load_document("Resources:\n  Bucket:\n    Type: T\n")
+    bucket = doc.val.values["Resources"].val.values["Bucket"]
+    t = bucket.val.values["Type"]
+    assert t.self_path().s == "/Resources/Bucket/Type"
+    assert t.self_path().loc.line == 2  # 0-based third line
+    assert t.self_path().loc.col == 10
+
+
+def test_cfn_short_form_scalar():
+    doc = load_document("Value: !Ref MyParam\n")
+    ref = doc.val.values["Value"]
+    assert ref.kind == MAP
+    assert ref.val.values["Ref"].val == "MyParam"
+
+
+def test_cfn_short_form_getatt_sequence():
+    doc = load_document("Value: !GetAtt [iamRole, Arn]\n")
+    ga = doc.val.values["Value"]
+    assert ga.kind == MAP
+    inner = ga.val.values["Fn::GetAtt"]
+    assert [e.val for e in inner.val] == ["iamRole", "Arn"]
+
+
+def test_aliases_rejected():
+    with pytest.raises(ParseError):
+        load_document("a: &x 1\nb: *x\n")
+
+
+def test_json_through_yaml_path():
+    doc = load_document('{"Resources": {"b": {"Type": "T", "n": 3}}}')
+    b = doc.val.values["Resources"].val.values["b"]
+    assert b.val.values["n"].kind == INT

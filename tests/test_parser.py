@@ -1,0 +1,170 @@
+"""Parser grammar coverage, pinned against parser.rs productions."""
+
+import pathlib
+
+import pytest
+
+from guard_tpu.core.errors import ParseError
+from guard_tpu.core.exprs import (
+    BlockGuardClause,
+    CmpOperator,
+    GuardAccessClause,
+    GuardNamedRuleClause,
+    ParameterizedNamedRuleClause,
+    QAllIndices,
+    QAllValues,
+    QFilter,
+    QIndex,
+    QKey,
+    QMapKeyFilter,
+    TypeBlock,
+    WhenBlockClause,
+)
+from guard_tpu.core.parser import Parser, parse_rules_file
+from guard_tpu.core.values import RANGE_INT, REGEX
+
+
+def parse_clause(text):
+    return Parser(text, "t").clause()
+
+
+def test_basic_binary_clause():
+    c = parse_clause("Properties.BucketName != /(?i)encrypt/")
+    assert isinstance(c, GuardAccessClause)
+    assert c.access_clause.comparator == CmpOperator.Eq
+    assert c.access_clause.comparator_inverse is True
+    assert c.access_clause.compare_with.kind == REGEX
+
+
+def test_unary_with_custom_message():
+    c = parse_clause("Resources !empty <<no resources>>")
+    assert c.access_clause.comparator == CmpOperator.Empty
+    assert c.access_clause.comparator_inverse is True
+    assert c.access_clause.custom_message == "no resources"
+
+
+def test_some_keyword_sets_match_all_false():
+    c = parse_clause("some Tags[*].Key == /PROD$/")
+    assert c.access_clause.query.match_all is False
+
+
+def test_variable_gets_implicit_all_indices():
+    c = parse_clause("%resources.Properties exists")
+    q = c.access_clause.query.query
+    assert isinstance(q[0], QKey) and q[0].name == "%resources"
+    assert isinstance(q[1], QAllIndices)
+    assert isinstance(q[2], QKey) and q[2].name == "Properties"
+
+
+def test_filter_query():
+    c = parse_clause("Resources.*[ Type == 'AWS::S3::Bucket' ] exists")
+    q = c.access_clause.query.query
+    assert isinstance(q[1], QAllValues)
+    assert isinstance(q[2], QFilter)
+
+
+def test_map_keys_match():
+    c = parse_clause("Condition.*[ keys == /aws:[sS]ourceVpc/ ] !empty")
+    q = c.access_clause.query.query
+    assert isinstance(q[2], QMapKeyFilter)
+
+
+def test_range_literal():
+    c = parse_clause("Properties.Size IN r[50,200]")
+    assert c.access_clause.compare_with.kind == RANGE_INT
+
+
+def test_bracket_variants():
+    p = Parser("a[*].b[0].c['key-name'].d[ x ]", "t")
+    q = p.access().query
+    kinds = [type(part).__name__ for part in q]
+    assert kinds == [
+        "QKey", "QAllIndices", "QKey", "QIndex", "QKey", "QKey", "QKey",
+        "QAllValues",
+    ]
+    assert q[4].name == "c"
+    assert q[5].name == "key-name"
+    assert q[7].name == "x"  # [ x ] -> AllValues capture
+
+
+def test_block_clause_not_empty():
+    c = parse_clause("Properties.Tags !empty {\n  Key exists\n}")
+    assert isinstance(c, BlockGuardClause)
+    assert c.not_empty is True
+
+
+def test_when_block_clause():
+    c = parse_clause("when a == 1 {\n  b == 2\n}")
+    assert isinstance(c, WhenBlockClause)
+
+
+def test_parameterized_call():
+    c = parse_clause("check_sse(%buckets, 'aws:kms')")
+    assert isinstance(c, ParameterizedNamedRuleClause)
+    assert c.named_rule.dependent_rule == "check_sse"
+    assert len(c.parameters) == 2
+
+
+def test_cnf_or_joins():
+    rf = parse_rules_file(
+        "rule r {\n  a == 1 OR\n  b == 2\n  c == 3\n}\n", ""
+    )
+    conj = rf.guard_rules[0].block.conjunctions
+    assert len(conj) == 2
+    assert len(conj[0]) == 2  # a OR b
+    assert len(conj[1]) == 1  # c
+
+
+def test_type_block_desugars_to_resources_query():
+    rf = parse_rules_file("AWS::S3::Bucket {\n  Properties exists\n}\n", "")
+    tb = rf.guard_rules[0].block.conjunctions[0][0]
+    assert isinstance(tb, TypeBlock)
+    assert tb.type_name == "AWS::S3::Bucket"
+    assert isinstance(tb.query[2], QFilter)
+
+
+def test_default_rule_name_with_file():
+    rf = parse_rules_file("a == 1\n", "my.guard")
+    assert rf.guard_rules[0].rule_name == "my.guard/default"
+    rf2 = parse_rules_file("a == 1\n", "")
+    assert rf2.guard_rules[0].rule_name == "default"
+
+
+def test_empty_file_returns_none():
+    assert parse_rules_file("", "x") is None
+    assert parse_rules_file("# comments only\n", "x") is None
+
+
+def test_named_rule_reference():
+    rf = parse_rules_file(
+        "rule a {\n  x == 1\n}\nrule b {\n  a\n}\n", ""
+    )
+    ref = rf.guard_rules[1].block.conjunctions[0][0]
+    assert isinstance(ref, GuardNamedRuleClause)
+    assert ref.dependent_rule == "a"
+
+
+def test_assignment_forms():
+    rf = parse_rules_file(
+        "let a = 10\nlet b := Resources.*\nlet c = count(%b)\n"
+        "rule r { %a == 10 }\n",
+        "",
+    )
+    assert len(rf.assignments) == 3
+
+
+def test_invalid_rule_rejected():
+    with pytest.raises(ParseError):
+        parse_rules_file('"">/\\\n', "bad")
+
+
+@pytest.mark.parametrize(
+    "path",
+    sorted(
+        p
+        for p in pathlib.Path("/root/reference/guard-examples").rglob("*.guard")
+    ),
+    ids=lambda p: p.name,
+)
+def test_reference_examples_parse(path):
+    assert parse_rules_file(path.read_text(), path.name) is not None

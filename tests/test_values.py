@@ -1,0 +1,70 @@
+"""Value model comparison semantics, pinned to path_value.rs behavior."""
+
+import pytest
+
+from guard_tpu.core.errors import NotComparableError
+from guard_tpu.core.values import (
+    LOWER_INCLUSIVE,
+    RANGE_INT,
+    UPPER_INCLUSIVE,
+    Path,
+    PV,
+    Range,
+    compare_eq,
+    compare_ge,
+    compare_lt,
+    from_plain,
+    loose_eq,
+)
+
+P = Path.root()
+
+
+def test_string_regex_eq_both_directions():
+    s = PV.string(P, "aws:kms")
+    r = PV.regex(P, "^aws:")
+    assert compare_eq(s, r)
+    assert compare_eq(r, s)
+    assert not compare_eq(PV.string(P, "AES256"), r)
+
+
+def test_int_float_not_comparable():
+    # path_value.rs compare_values: int vs float is NotComparable
+    with pytest.raises(NotComparableError):
+        compare_eq(PV.int_(P, 1), PV.float_(P, 1.0))
+    assert not loose_eq(PV.int_(P, 1), PV.float_(P, 1.0))
+
+
+def test_range_membership():
+    r = PV(P, RANGE_INT, Range(50, 200, LOWER_INCLUSIVE | UPPER_INCLUSIVE))
+    assert compare_eq(PV.int_(P, 50), r)
+    assert compare_eq(PV.int_(P, 200), r)
+    assert not compare_eq(PV.int_(P, 201), r)
+    half_open = PV(P, RANGE_INT, Range(100, 400, UPPER_INCLUSIVE))
+    assert not compare_eq(PV.int_(P, 100), half_open)
+    assert compare_eq(PV.int_(P, 101), half_open)
+
+
+def test_deep_map_list_equality():
+    a = from_plain({"a": [1, {"b": "x"}]})
+    b = from_plain({"a": [1, {"b": "x"}]})
+    c = from_plain({"a": [1, {"b": "y"}]})
+    assert compare_eq(a, b)
+    assert not compare_eq(a, c)
+
+
+def test_list_order_matters():
+    assert not compare_eq(from_plain([1, 2]), from_plain([2, 1]))
+
+
+def test_ordering():
+    assert compare_lt(PV.int_(P, 3), PV.int_(P, 5))
+    assert compare_ge(PV.string(P, "b"), PV.string(P, "a"))
+    with pytest.raises(NotComparableError):
+        compare_lt(PV.string(P, "3"), PV.int_(P, 5))
+
+
+def test_paths_from_plain():
+    doc = from_plain({"Resources": {"b": {"Type": "T"}}})
+    t = doc.val.values["Resources"].val.values["b"].val.values["Type"]
+    assert t.self_path().s == "/Resources/b/Type"
