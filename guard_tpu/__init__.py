@@ -1,0 +1,34 @@
+"""guard-tpu: a TPU-native policy-as-code framework.
+
+A from-scratch rebuild of AWS CloudFormation Guard's capabilities
+(reference at /root/reference): Guard-DSL parser, location-aware
+JSON/YAML document model, a CPU reference evaluator with the full
+clause/query/variable/function semantics, the validate/test/parse-tree/
+rulegen/completions command surface and console/JSON/YAML/SARIF/JUnit
+reporters — plus a JAX/XLA batch-evaluation backend that lowers rules to
+a flat predicate IR and evaluates (documents x rules) batches sharded
+across a TPU mesh (`validate --backend=tpu`).
+"""
+
+from .api import (
+    CommandBuilder,
+    ParseTreeBuilder,
+    RulegenBuilder,
+    TestBuilder,
+    ValidateBuilder,
+    run_checks,
+)
+from .core.qresult import Status
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "run_checks",
+    "CommandBuilder",
+    "ValidateBuilder",
+    "TestBuilder",
+    "ParseTreeBuilder",
+    "RulegenBuilder",
+    "Status",
+    "__version__",
+]

@@ -1,0 +1,150 @@
+"""guard-tpu command-line interface.
+
+Equivalent of the reference's clap-derived CLI
+(`/root/reference/guard/src/commands/mod.rs:83-120`, `main.rs:13-44`):
+subcommands validate / test / parse-tree / rulegen / completions with the
+same flags and exit-code protocol (validate 0/19/5, test 0/7/1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .commands.completions import Completions
+from .commands.parse_tree import ParseTree
+from .commands.rulegen import Rulegen
+from .commands.test import Test
+from .commands.validate import Validate
+from .core.errors import GuardError
+from .utils.io import Reader, Writer
+
+VERSION = "0.1.0"
+PROG = "guard-tpu"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog=PROG,
+        description=(
+            "Guard is a general-purpose tool that provides a simple declarative "
+            "syntax to define policy-as-code rules and validate JSON/YAML data "
+            "against them — with a TPU-native batch evaluation backend."
+        ),
+    )
+    p.add_argument("--version", action="version", version=f"{PROG} {VERSION}")
+    sub = p.add_subparsers(dest="command")
+
+    v = sub.add_parser("validate", help="Evaluates rules against data files")
+    v.add_argument("--rules", "-r", nargs="*", default=[])
+    v.add_argument("--data", "-d", nargs="*", default=[])
+    v.add_argument("--input-params", "-i", nargs="*", default=[])
+    v.add_argument("--type", "-t", dest="template_type", default=None)
+    v.add_argument(
+        "--output-format",
+        "-o",
+        default="single-line-summary",
+        choices=["single-line-summary", "json", "yaml", "junit", "sarif"],
+    )
+    v.add_argument("--show-summary", "-S", default="fail")
+    v.add_argument("--alphabetical", "-a", action="store_true")
+    v.add_argument("--last-modified", "-m", action="store_true")
+    v.add_argument("--verbose", "-v", action="store_true")
+    v.add_argument("--print-json", "-p", action="store_true")
+    v.add_argument("--payload", "-P", action="store_true")
+    v.add_argument("--structured", "-z", action="store_true")
+    v.add_argument("--backend", default="cpu", choices=["cpu", "tpu"])
+
+    t = sub.add_parser("test", help="Test rules against expectations")
+    t.add_argument("--rules-file", "-r", dest="rules", default=None)
+    t.add_argument("--test-data", "-t", dest="test_data", default=None)
+    t.add_argument("--dir", "-d", dest="directory", default=None)
+    t.add_argument("--alphabetical", "-a", action="store_true")
+    t.add_argument("--last-modified", "-m", action="store_true")
+    t.add_argument("--verbose", "-v", action="store_true")
+    t.add_argument(
+        "--output-format",
+        "-o",
+        default="single-line-summary",
+        choices=["single-line-summary", "json", "yaml", "junit"],
+    )
+
+    pt = sub.add_parser("parse-tree", help="Prints the parse tree for a rules file")
+    pt.add_argument("--rules", "-r", default=None)
+    pt.add_argument("--output", "-o", default=None)
+    pt.add_argument("--print-json", "-p", action="store_true")
+    pt.add_argument("--print-yaml", "-y", action="store_true")
+
+    rg = sub.add_parser("rulegen", help="Autogenerate rules from a CFN template")
+    rg.add_argument("--template", "-t", required=True)
+    rg.add_argument("--output", "-o", default=None)
+
+    c = sub.add_parser("completions", help="Generate shell completions")
+    c.add_argument("--shell", "-s", default="bash", choices=["bash", "zsh", "fish"])
+
+    return p
+
+
+def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reader: Optional[Reader] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    writer = writer or Writer()
+    reader = reader or Reader()
+
+    if args.command is None:
+        parser.print_help()
+        return 0
+
+    try:
+        if args.command == "validate":
+            cmd = Validate(
+                rules=args.rules,
+                data=args.data,
+                input_params=args.input_params,
+                output_format=args.output_format,
+                show_summary=args.show_summary.split(","),
+                alphabetical=args.alphabetical,
+                last_modified=args.last_modified,
+                verbose=args.verbose,
+                print_json=args.print_json,
+                payload=args.payload,
+                structured=args.structured,
+                backend=args.backend,
+            )
+            return cmd.execute(writer, reader)
+        if args.command == "test":
+            return Test(
+                rules=args.rules,
+                test_data=args.test_data,
+                directory=args.directory,
+                alphabetical=args.alphabetical,
+                last_modified=args.last_modified,
+                verbose=args.verbose,
+                output_format=args.output_format,
+            ).execute(writer, reader)
+        if args.command == "parse-tree":
+            return ParseTree(
+                rules=args.rules,
+                output=args.output,
+                print_json=args.print_json,
+                print_yaml=args.print_yaml,
+            ).execute(writer, reader)
+        if args.command == "rulegen":
+            return Rulegen(template=args.template, output=args.output).execute(
+                writer, reader
+            )
+        if args.command == "completions":
+            return Completions(shell=args.shell).execute(writer, reader)
+    except GuardError as e:
+        writer.writeln_err(f"Error: {e}")
+        return 5
+    return 0
+
+
+def main() -> None:
+    sys.exit(run())
+
+
+if __name__ == "__main__":
+    main()

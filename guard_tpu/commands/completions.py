@@ -1,0 +1,90 @@
+"""The `completions` command: shell completion scripts.
+
+Equivalent of `/root/reference/guard/src/commands/completions.rs:31-41`
+(clap_complete): emits bash / zsh / fish completion definitions for the
+`guard-tpu` CLI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..utils.io import Reader, Writer
+
+SUBCOMMANDS = ["validate", "test", "parse-tree", "rulegen", "completions", "help"]
+
+_COMMON_FLAGS = {
+    "validate": [
+        "--rules", "--data", "--input-params", "--output-format", "--show-summary",
+        "--alphabetical", "--last-modified", "--verbose", "--print-json",
+        "--payload", "--structured", "--backend", "--type", "--help",
+    ],
+    "test": [
+        "--rules-file", "--test-data", "--dir", "--alphabetical",
+        "--last-modified", "--verbose", "--output-format", "--help",
+    ],
+    "parse-tree": ["--rules", "--output", "--print-json", "--print-yaml", "--help"],
+    "rulegen": ["--template", "--output", "--help"],
+    "completions": ["--shell", "--help"],
+}
+
+
+def _bash(prog: str) -> str:
+    cases = []
+    for cmd, flags in _COMMON_FLAGS.items():
+        cases.append(
+            f'        {cmd})\n            COMPREPLY=( $(compgen -W "{" ".join(flags)}" -- "$cur") )\n            return 0;;'
+        )
+    return f"""_guard_tpu() {{
+    local cur prev cmd
+    COMPREPLY=()
+    cur="${{COMP_WORDS[COMP_CWORD]}}"
+    cmd="${{COMP_WORDS[1]}}"
+    if [ "$COMP_CWORD" -eq 1 ]; then
+        COMPREPLY=( $(compgen -W "{" ".join(SUBCOMMANDS)}" -- "$cur") )
+        return 0
+    fi
+    case "$cmd" in
+{chr(10).join(cases)}
+    esac
+}}
+complete -F _guard_tpu {prog}
+"""
+
+
+def _zsh(prog: str) -> str:
+    lines = [f"#compdef {prog}", "_arguments -C \\"]
+    lines.append('  "1: :(' + " ".join(SUBCOMMANDS) + ')" \\')
+    lines.append('  "*::arg:->args"')
+    return "\n".join(lines) + "\n"
+
+
+def _fish(prog: str) -> str:
+    out = []
+    for cmd in SUBCOMMANDS:
+        out.append(
+            f"complete -c {prog} -n '__fish_use_subcommand' -a {cmd}"
+        )
+        for flag in _COMMON_FLAGS.get(cmd, []):
+            out.append(
+                f"complete -c {prog} -n '__fish_seen_subcommand_from {cmd}' -l {flag.lstrip('-')}"
+            )
+    return "\n".join(out) + "\n"
+
+
+@dataclass
+class Completions:
+    shell: str = "bash"
+
+    def execute(self, writer: Writer, reader: Reader) -> int:
+        prog = "guard-tpu"
+        if self.shell == "bash":
+            writer.write(_bash(prog))
+        elif self.shell == "zsh":
+            writer.write(_zsh(prog))
+        elif self.shell == "fish":
+            writer.write(_fish(prog))
+        else:
+            writer.writeln_err(f"unsupported shell {self.shell}")
+            return 1
+        return 0

@@ -1,0 +1,53 @@
+"""File iteration helpers.
+
+Equivalent of `/root/reference/guard/src/commands/files.rs:16-115` and
+the extension filters in `commands/mod.rs:65-67`: walk directories with
+alphabetical (default) or last-modified ordering and collect rule/data
+files by extension.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Callable, List
+
+RULE_FILE_EXTENSIONS = (".guard", ".ruleset")
+DATA_FILE_EXTENSIONS = (".json", ".jsn", ".yaml", ".yml", ".template")
+
+
+def alphabetical(a: Path, b: Path):
+    return str(a) < str(b)
+
+
+def walk_files(
+    base: str,
+    extensions: tuple,
+    last_modified_order: bool = False,
+) -> List[Path]:
+    """Collect matching files; single files are returned as-is
+    (reference accepts both files and directories, validate.rs:274-315)."""
+    p = Path(base)
+    if p.is_file():
+        return [p]
+    if not p.exists():
+        raise FileNotFoundError(base)
+    found: List[Path] = []
+    for dirpath, dirnames, filenames in os.walk(p):
+        dirnames.sort()
+        for fn in filenames:
+            fp = Path(dirpath) / fn
+            if fp.suffix.lower() in extensions:
+                found.append(fp)
+    if last_modified_order:
+        found.sort(key=lambda f: f.stat().st_mtime)
+    else:
+        found.sort(key=str)
+    return found
+
+
+def gather(paths: List[str], extensions: tuple, last_modified: bool = False) -> List[Path]:
+    out: List[Path] = []
+    for each in paths:
+        out.extend(walk_files(each, extensions, last_modified))
+    return out

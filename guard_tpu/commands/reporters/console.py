@@ -1,0 +1,140 @@
+"""Console reporters: single-line summary, summary table and the
+verbose event-tree printer.
+
+Mirrors the output structure of the reference's console path —
+per-data-file `"<file> Status = PASS|FAIL"` header, PASS/SKIP/FAIL rule
+lists, then per-clause diagnostics (`generic_summary.rs`,
+`summary_table.rs`, verbose printer `validate.rs:670-687`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ...core.qresult import Status
+from ...core.records import EventRecord
+from ...utils.io import Writer
+from ..report import iter_clause_failures, rule_statuses_from_root
+
+SHOW_PASS = "pass"
+SHOW_FAIL = "fail"
+SHOW_SKIP = "skip"
+
+
+def single_line_summary(
+    writer: Writer,
+    data_file: str,
+    rules_file: str,
+    status: Status,
+    report: dict,
+    rule_statuses: Dict[str, Status],
+) -> None:
+    writer.writeln(f"{data_file} Status = {status.value}")
+    passed = sorted(n for n, s in rule_statuses.items() if s == Status.PASS)
+    skipped = sorted(n for n, s in rule_statuses.items() if s == Status.SKIP)
+    failed = sorted(n for n, s in rule_statuses.items() if s == Status.FAIL)
+    if skipped:
+        writer.writeln("SKIP rules")
+        for n in skipped:
+            writer.writeln(f"{n}    SKIP")
+    if passed:
+        writer.writeln("PASS rules")
+        for n in passed:
+            writer.writeln(f"{n}    PASS")
+    if failed:
+        writer.writeln("FAILED rules")
+        for n in failed:
+            writer.writeln(f"{n}    FAIL")
+    writer.writeln("---")
+    writer.writeln(f"Evaluation of rules {rules_file} against data {data_file}")
+    writer.writeln("--")
+    for rule_name, clause in iter_clause_failures(report):
+        msgs = clause.get("messages", {})
+        err = msgs.get("error_message") or ""
+        custom = msgs.get("custom_message") or ""
+        prop = _property_path(clause)
+        writer.writeln(
+            f"Property [{prop}] in data [{data_file}] is not compliant with "
+            f"[{rule_name}] because {err} Error Message [{custom}]"
+        )
+    writer.writeln("--")
+
+
+def _property_path(clause: dict) -> str:
+    check = clause.get("check", {})
+    if "Resolved" in check:
+        r = check["Resolved"]
+        if "from" in r:
+            return r["from"]["path"]
+        if "value" in r:
+            return r["value"]["path"]
+    if "InResolved" in check:
+        return check["InResolved"]["from"]["path"]
+    if "UnResolved" in check:
+        return check["UnResolved"]["value"]["traversed_to"]["path"]
+    if "unresolved" in clause and clause["unresolved"]:
+        return clause["unresolved"]["traversed_to"]["path"]
+    return ""
+
+
+def summary_table(
+    writer: Writer,
+    rules_file: str,
+    data_file: str,
+    rule_statuses: Dict[str, Status],
+    show: set,
+) -> None:
+    """summary_table.rs: per-rule status table filtered by --show-summary."""
+    longest = max((len(n) for n in rule_statuses), default=0)
+    shown = []
+    for name, status in sorted(rule_statuses.items()):
+        if status == Status.PASS and SHOW_PASS in show:
+            shown.append((name, status))
+        elif status == Status.FAIL and SHOW_FAIL in show:
+            shown.append((name, status))
+        elif status == Status.SKIP and SHOW_SKIP in show:
+            shown.append((name, status))
+    if not shown:
+        return
+    writer.writeln(f"{rules_file} Status = {_overall(rule_statuses).value}")
+    for name, status in shown:
+        writer.writeln(f"{name.ljust(longest + 4)}{status.value}")
+    writer.writeln("---")
+
+
+def _overall(rule_statuses: Dict[str, Status]) -> Status:
+    if any(s == Status.FAIL for s in rule_statuses.values()):
+        return Status.FAIL
+    if any(s == Status.PASS for s in rule_statuses.values()):
+        return Status.PASS
+    return Status.SKIP
+
+
+def print_verbose_tree(writer: Writer, record: EventRecord, indent: int = 0) -> None:
+    """validate.rs:670-687 — indented context/status tree."""
+    pad = "  " * indent
+    container = record.container
+    if container is not None:
+        status = container.status()
+        status_s = f", {status.value}" if status is not None else ""
+        writer.writeln(f"{pad}{container.kind}({record.context}{status_s})")
+    else:
+        writer.writeln(f"{pad}{record.context}")
+    for child in record.children:
+        print_verbose_tree(writer, child, indent + 1)
+
+
+def record_to_json(record: EventRecord):
+    """--print-json: full serde-style dump of the event tree."""
+    container = None
+    if record.container is not None:
+        status = record.container.status()
+        container = {
+            "kind": record.container.kind,
+            "status": status.value if status is not None else None,
+        }
+    return {
+        "context": record.context,
+        "container": container,
+        "children": [record_to_json(c) for c in record.children],
+    }

@@ -1,0 +1,64 @@
+"""JUnit XML output.
+
+Equivalent of `reporters/mod.rs:26-86` + `reporters/validate/xml.rs`:
+one <testsuite> per rules-file with a <testcase> per (rule, data-file);
+failures carry the clause message.
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from typing import Dict, List, Tuple
+
+from ...core.qresult import Status
+from ...utils.io import Writer
+
+
+class JunitTestCase:
+    def __init__(self, name: str, status: Status, message: str = "", time: float = 0.0):
+        self.name = name
+        self.status = status
+        self.message = message
+        self.time = time
+
+
+def write_junit(
+    writer: Writer,
+    suites: Dict[str, List[JunitTestCase]],
+    name: str = "cfn-guard validate report",
+) -> None:
+    total = sum(len(cases) for cases in suites.values())
+    failures = sum(
+        1 for cases in suites.values() for c in cases if c.status == Status.FAIL
+    )
+    root = ET.Element(
+        "testsuites",
+        name=name,
+        tests=str(total),
+        failures=str(failures),
+        errors="0",
+    )
+    for suite_name, cases in suites.items():
+        suite = ET.SubElement(
+            root,
+            "testsuite",
+            name=suite_name,
+            errors="0",
+            time=f"{sum(c.time for c in cases):.3f}",
+            tests=str(len(cases)),
+            failures=str(sum(1 for c in cases if c.status == Status.FAIL)),
+        )
+        for case in cases:
+            tc = ET.SubElement(
+                suite, "testcase", name=case.name, time=f"{case.time:.3f}"
+            )
+            if case.status == Status.FAIL:
+                f = ET.SubElement(tc, "failure")
+                if case.message:
+                    f.text = case.message
+            elif case.status == Status.SKIP:
+                ET.SubElement(tc, "skipped")
+    ET.indent(root)
+    writer.write('<?xml version="1.0" encoding="UTF-8"?>\n')
+    writer.write(ET.tostring(root, encoding="unicode"))
+    writer.writeln()

@@ -1,0 +1,73 @@
+"""SARIF 2.1.0 output for code-scanning integrations.
+
+Equivalent of `reporters/validate/sarif.rs:23-60`: one SARIF run with a
+result per non-compliant clause, ruleId = rule name, location = data
+file + line/col of the offending value.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from ...utils.io import Writer
+from ..report import iter_clause_failures
+
+SARIF_SCHEMA = "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/Schemata/sarif-schema-2.1.0.json"
+TOOL_NAME = "cfn-guard"
+ORGANIZATION = "Amazon Web Services"
+
+
+def build_sarif(file_reports: List[dict]) -> dict:
+    results = []
+    for report in file_reports:
+        data_file = report["name"]
+        for rule_name, clause in iter_clause_failures(report):
+            msgs = clause.get("messages", {}) or {}
+            text = msgs.get("custom_message") or msgs.get("error_message") or ""
+            loc = msgs.get("location") or {}
+            line = int(loc.get("line") or 0) + 1
+            col = int(loc.get("col") or 0) + 1
+            results.append(
+                {
+                    "ruleId": rule_name,
+                    "level": "error",
+                    "message": {"text": text.strip() or "Rule check failed"},
+                    "locations": [
+                        {
+                            "physicalLocation": {
+                                "artifactLocation": {"uri": data_file},
+                                "region": {
+                                    "startLine": line,
+                                    "startColumn": col,
+                                },
+                            }
+                        }
+                    ],
+                }
+            )
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": TOOL_NAME,
+                        "organization": ORGANIZATION,
+                        "semanticVersion": "3.1.2",
+                        "informationUri": "https://github.com/aws-cloudformation/cloudformation-guard",
+                    }
+                },
+                "results": results,
+                "artifacts": [
+                    {"location": {"uri": report["name"]}} for report in file_reports
+                ],
+            }
+        ],
+    }
+
+
+def write_sarif(writer: Writer, file_reports: List[dict]) -> None:
+    writer.write(json.dumps(build_sarif(file_reports), indent=2))
+    writer.writeln()

@@ -1,0 +1,250 @@
+"""The `test` command: the built-in unit-test runner for rules.
+
+Equivalent of `/root/reference/guard/src/commands/test.rs`: YAML/JSON
+test-spec files with per-rule PASS/FAIL/SKIP expectations, in
+single-file mode (`--rules-file` + `--test-data`) or directory mode
+(`--dir`, pairing `x.guard` with `dir/tests/x*.yaml` by prefix,
+test.rs:486-570). Exit codes: 0 ok / 7 test failures / 1 error
+(commands/mod.rs:72-73). Output format mirrors
+`reporters/test/generic.rs` (`Test Case #N` / `PASS Rules:` blocks).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import yaml
+
+from ..core.errors import GuardError, ParseError
+from ..core.evaluator import eval_rules_file
+from ..core.parser import get_rule_name, parse_rules_file
+from ..core.qresult import Status
+from ..core.records import RecordType
+from ..core.scopes import RootScope
+from ..core.values import from_plain
+from ..utils.io import Reader, Writer
+from .reporters.console import print_verbose_tree
+from .reporters.junit import JunitTestCase, write_junit
+
+TEST_SUCCESS_STATUS_CODE = 0  # commands/mod.rs:72
+TEST_FAILURE_STATUS_CODE = 7  # commands/mod.rs:72
+TEST_ERROR_STATUS_CODE = 1  # commands/mod.rs:73
+
+
+@dataclass
+class TestSpec:
+    name: Optional[str]
+    input: object
+    expectations: Dict[str, str]
+
+
+def _load_specs(path: Path) -> List[TestSpec]:
+    content = path.read_text()
+    from ..core.loader import yaml_load_with_intrinsics
+
+    try:
+        data = yaml_load_with_intrinsics(content)
+    except yaml.YAMLError:
+        try:
+            data = json.loads(content)
+        except json.JSONDecodeError as e:
+            raise ParseError(f"Unable to process data in file {path}, Error {e},")
+    if not isinstance(data, list):
+        raise ParseError(f"Test file {path} must contain a list of test specs")
+    specs = []
+    for entry in data:
+        if entry is None:
+            continue
+        specs.append(
+            TestSpec(
+                name=entry.get("name"),
+                input=entry.get("input"),
+                expectations=(entry.get("expectations", {}) or {}).get("rules", {}) or {},
+            )
+        )
+    return specs
+
+
+def _rule_statuses(root_record, rule_file_name: str) -> Dict[str, List[Status]]:
+    """get_by_rules: group top-level RuleCheck records by (prefix-stripped)
+    rule name."""
+    out: Dict[str, List[Status]] = {}
+    for each in root_record.children:
+        c = each.container
+        if c is not None and c.kind == RecordType.RULE_CHECK:
+            name = get_rule_name(rule_file_name, c.payload.name)
+            out.setdefault(name, []).append(c.payload.status)
+    return out
+
+
+@dataclass
+class Test:
+    rules: Optional[str] = None
+    test_data: Optional[str] = None
+    directory: Optional[str] = None
+    alphabetical: bool = False
+    last_modified: bool = False
+    verbose: bool = False
+    output_format: str = "single-line-summary"
+
+    def execute(self, writer: Writer, reader: Reader) -> int:
+        if self.directory is not None and (self.rules or self.test_data):
+            writer.writeln_err("directory conflicts with rules-file/test-data")
+            return TEST_ERROR_STATUS_CODE
+        if self.directory is None and not (self.rules and self.test_data):
+            writer.writeln_err(
+                "must specify either --dir or both --rules-file and --test-data"
+            )
+            return TEST_ERROR_STATUS_CODE
+
+        if self.directory is not None:
+            pairs = self._ordered_test_directory(Path(self.directory))
+        else:
+            pairs = [(Path(self.rules), [Path(self.test_data)])]
+
+        exit_code = TEST_SUCCESS_STATUS_CODE
+        junit_suites = {}
+        structured_reports = []
+        for rules_path, test_files in pairs:
+            if self.directory is not None and not test_files:
+                continue
+            try:
+                rf = parse_rules_file(rules_path.read_text(), rules_path.name)
+            except ParseError as e:
+                writer.writeln_err(f"Error processing {e}")
+                exit_code = TEST_ERROR_STATUS_CODE
+                continue
+            if rf is None:
+                continue
+            if self.directory is not None:
+                writer.writeln(f"Testing Guard File {rules_path}")
+            code, cases, reports = self._run_specs(writer, rf, rules_path.name, test_files)
+            junit_suites[str(rules_path)] = cases
+            structured_reports.extend(reports)
+            if code == TEST_ERROR_STATUS_CODE:
+                exit_code = TEST_ERROR_STATUS_CODE
+            elif code == TEST_FAILURE_STATUS_CODE and exit_code == TEST_SUCCESS_STATUS_CODE:
+                exit_code = TEST_FAILURE_STATUS_CODE
+
+        if self.output_format in ("json", "yaml"):
+            out = structured_reports
+            if self.output_format == "json":
+                writer.writeln(json.dumps(out, indent=2))
+            else:
+                writer.write(yaml.safe_dump(out, sort_keys=False))
+        elif self.output_format == "junit":
+            write_junit(writer, junit_suites, name="cfn-guard test report")
+        return exit_code
+
+    # -- directory pairing (test.rs:486-570) --------------------------
+    def _ordered_test_directory(self, base: Path) -> List[Tuple[Path, List[Path]]]:
+        guard_files: List[Path] = []
+        test_candidates: List[Path] = []
+        for p in sorted(base.rglob("*")):
+            if not p.is_file():
+                continue
+            if p.suffix in (".guard", ".ruleset"):
+                guard_files.append(p)
+            elif p.suffix in (".yaml", ".yml", ".json", ".jsn"):
+                if p.parent.name == "tests":
+                    test_candidates.append(p)
+        pairs: List[Tuple[Path, List[Path]]] = []
+        by_dir: Dict[str, List[Tuple[str, Path, List[Path]]]] = {}
+        for g in guard_files:
+            prefix = g.name[: -len(g.suffix)]
+            by_dir.setdefault(str(g.parent), []).append((prefix, g, []))
+        for t in test_candidates:
+            grand = str(t.parent.parent)
+            for prefix, g, tests in by_dir.get(grand, []):
+                if t.name.startswith(prefix):
+                    tests.append(t)
+                    break
+        for dir_entries in by_dir.values():
+            for _prefix, g, tests in dir_entries:
+                pairs.append((g, tests))
+        pairs.sort(key=lambda pair: str(pair[0]))
+        return pairs
+
+    # -- spec execution (reporters/test/generic.rs:24-137) ------------
+    def _run_specs(self, writer: Writer, rf, rule_file_name: str, test_files):
+        exit_code = TEST_SUCCESS_STATUS_CODE
+        counter = 1
+        cases: List[JunitTestCase] = []
+        reports: List[dict] = []
+        for tf in test_files:
+            try:
+                specs = _load_specs(tf)
+            except ParseError as e:
+                writer.writeln(f"Error processing {e}")
+                exit_code = TEST_ERROR_STATUS_CODE
+                continue
+            for spec in specs:
+                if self.output_format == "single-line-summary":
+                    writer.writeln(f"Test Case #{counter}")
+                    if spec.name:
+                        writer.writeln(f"Name: {spec.name}")
+                try:
+                    root = from_plain(spec.input)
+                    scope = RootScope(rf, root)
+                    eval_rules_file(rf, scope, None)
+                except GuardError as e:
+                    writer.writeln(f"Error processing {e}")
+                    exit_code = TEST_ERROR_STATUS_CODE
+                    counter += 1
+                    continue
+                top = scope.reset_recorder().extract()
+                by_rules = _rule_statuses(top, rule_file_name)
+                passed_lines: List[str] = []
+                failed_lines: List[str] = []
+                spec_report = {"name": spec.name or "", "rules": []}
+                for rule_name, statuses in by_rules.items():
+                    expected = spec.expectations.get(rule_name)
+                    if expected is None:
+                        if self.output_format == "single-line-summary":
+                            writer.writeln(
+                                f"  No Test expectation was set for Rule {rule_name}"
+                            )
+                        continue
+                    matched = next(
+                        (s for s in statuses if s.value == expected), None
+                    )
+                    if matched is not None:
+                        passed_lines.append(f"{rule_name}: Expected = {expected}")
+                        cases.append(JunitTestCase(name=rule_name, status=Status.PASS))
+                        spec_report["rules"].append(
+                            {"name": rule_name, "expected": expected, "evaluated": [s.value for s in statuses], "passed": True}
+                        )
+                    else:
+                        failed_lines.append(
+                            f"{rule_name}: Expected = {expected}, Evaluated = "
+                            f"{[s.value for s in statuses]}"
+                        )
+                        cases.append(
+                            JunitTestCase(
+                                name=rule_name,
+                                status=Status.FAIL,
+                                message=f"Expected = {expected}, Evaluated = {[s.value for s in statuses]}",
+                            )
+                        )
+                        spec_report["rules"].append(
+                            {"name": rule_name, "expected": expected, "evaluated": [s.value for s in statuses], "passed": False}
+                        )
+                        exit_code = max(exit_code, TEST_FAILURE_STATUS_CODE)
+                if self.output_format == "single-line-summary":
+                    if failed_lines:
+                        writer.writeln("  FAIL Rules:")
+                        for line in failed_lines:
+                            writer.writeln(f"    {line}")
+                    if passed_lines:
+                        writer.writeln("  PASS Rules:")
+                        for line in passed_lines:
+                            writer.writeln(f"    {line}")
+                    if self.verbose:
+                        print_verbose_tree(writer, top)
+                    writer.writeln()
+                reports.append(spec_report)
+                counter += 1
+        return exit_code, cases, reports

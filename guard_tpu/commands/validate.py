@@ -1,0 +1,280 @@
+"""The `validate` command: evaluate rules against data files.
+
+Equivalent of `/root/reference/guard/src/commands/validate.rs:253-505`:
+walks rule/data paths (or a stdin JSON payload `{rules, data}`), merges
+`--input-parameters` documents into each data file, evaluates every
+(rule-file x data-file) pair, dispatches the reporter chain and returns
+the reference exit codes (0 pass / 19 fail / 5 error,
+commands/mod.rs:69-71).
+
+Extension over the reference: `--backend=tpu` batch-evaluates all
+(doc x rule) statuses on the JAX/TPU engine (guard_tpu/ops), falling
+back to the CPU oracle per failing document for rich reports.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import List, Optional
+
+from ..core.errors import GuardError, ParseError
+from ..core.evaluator import eval_rules_file
+from ..core.loader import load_document, load_payload
+from ..core.parser import parse_rules_file
+from ..core.qresult import Status
+from ..core.scopes import RootScope
+from ..core.values import PV
+from ..utils.io import Reader, Writer
+from .files import DATA_FILE_EXTENSIONS, RULE_FILE_EXTENSIONS, gather
+from .report import rule_statuses_from_root, simplified_report_from_root
+from .reporters.console import (
+    print_verbose_tree,
+    record_to_json,
+    single_line_summary,
+    summary_table,
+)
+from .reporters.junit import JunitTestCase, write_junit
+from .reporters.sarif import write_sarif
+from .reporters.structured import write_structured
+
+SUCCESS_STATUS_CODE = 0  # commands/mod.rs:69
+FAILURE_STATUS_CODE = 19  # commands/mod.rs:70
+ERROR_STATUS_CODE = 5  # commands/mod.rs:71
+
+OUTPUT_FORMATS = ("single-line-summary", "json", "yaml", "junit", "sarif")
+SHOW_SUMMARY_TYPES = ("all", "pass", "fail", "skip", "none")
+
+
+@dataclass
+class DataFile:
+    name: str
+    content: str
+    path_value: PV
+
+
+@dataclass
+class RuleFile:
+    name: str
+    full_name: str
+    content: str
+    rules: object  # RulesFile
+
+
+@dataclass
+class Validate:
+    rules: List[str] = field(default_factory=list)
+    data: List[str] = field(default_factory=list)
+    input_params: List[str] = field(default_factory=list)
+    output_format: str = "single-line-summary"
+    show_summary: List[str] = field(default_factory=lambda: ["fail"])
+    alphabetical: bool = False
+    last_modified: bool = False
+    verbose: bool = False
+    print_json: bool = False
+    payload: bool = False
+    structured: bool = False
+    backend: str = "cpu"  # cpu | tpu
+
+    # -- argument validation (validate.rs:205-232) --------------------
+    def _validate_args(self) -> None:
+        show = set(self.show_summary)
+        if self.structured and show != {"none"} and show != set():
+            raise GuardError(
+                "Cannot provide a summary-type other than `none` when the "
+                "`structured` flag is present"
+            )
+        if self.structured and self.output_format == "single-line-summary":
+            raise GuardError(
+                "single-line-summary is not able to be used when the "
+                "`structured` flag is present"
+            )
+        if self.output_format == "junit" and not self.structured:
+            raise GuardError("the structured flag must be set when output is set to junit")
+        if self.output_format == "sarif" and not self.structured:
+            raise GuardError("the structured flag must be set when output is set to sarif")
+        if self.payload and (self.rules or self.data):
+            raise GuardError("cannot specify rules or data with payload")
+        if not self.payload and not self.rules:
+            raise GuardError("must specify rules or payload")
+        if self.alphabetical and self.last_modified:
+            raise GuardError("alphabetical conflicts with last-modified")
+
+    # -- input loading ------------------------------------------------
+    def _load_data_files(self, reader: Reader, writer: Writer) -> List[DataFile]:
+        data_files: List[DataFile] = []
+        if self.payload:
+            rules, data = load_payload(reader.read())
+            for i, content in enumerate(data):
+                c = content if isinstance(content, str) else json.dumps(content)
+                data_files.append(
+                    DataFile(name=f"DATA_STDIN[{i + 1}]", content=c, path_value=load_document(c))
+                )
+            return data_files
+        if self.data:
+            for f in gather(self.data, DATA_FILE_EXTENSIONS, self.last_modified):
+                content = f.read_text()
+                data_files.append(
+                    DataFile(
+                        name=f.name, content=content, path_value=load_document(content, f.name)
+                    )
+                )
+        else:
+            content = reader.read()
+            data_files.append(
+                DataFile(name="STDIN", content=content, path_value=load_document(content))
+            )
+        return data_files
+
+    def _load_rule_files(self, reader: Reader, writer: Writer):
+        rule_files: List[RuleFile] = []
+        errors = 0
+        if self.payload:
+            rules, _data = load_payload(reader.read())
+            sources = [(f"RULES_STDIN[{i + 1}]", r, f"RULES_STDIN[{i + 1}]") for i, r in enumerate(rules)]
+        else:
+            sources = []
+            for f in gather(self.rules, RULE_FILE_EXTENSIONS, self.last_modified):
+                sources.append((f.name, f.read_text(), str(f)))
+        for name, content, full in sources:
+            try:
+                rf = parse_rules_file(content, name)
+            except ParseError as e:
+                # per-file error isolation (validate.rs:406-434)
+                writer.writeln_err(f"Parse Error on ruleset file {name}")
+                writer.writeln_err(str(e))
+                errors += 1
+                continue
+            if rf is None:
+                continue
+            rule_files.append(RuleFile(name=name, full_name=full, content=content, rules=rf))
+        return rule_files, errors
+
+    def _merged_input_params(self) -> Optional[PV]:
+        if not self.input_params:
+            return None
+        merged: Optional[PV] = None
+        for f in gather(self.input_params, DATA_FILE_EXTENSIONS, self.last_modified):
+            doc = load_document(f.read_text(), f.name)
+            merged = doc if merged is None else merged.merge(doc)
+        return merged
+
+    # -- execution ----------------------------------------------------
+    def execute(self, writer: Writer, reader: Reader) -> int:
+        self._validate_args()
+
+        if self.payload:
+            payload_content = reader.read()
+            rules_strs, data_strs = load_payload(payload_content)
+            data_files = [
+                DataFile(
+                    name=f"DATA_STDIN[{i + 1}]",
+                    content=d if isinstance(d, str) else json.dumps(d),
+                    path_value=load_document(d if isinstance(d, str) else json.dumps(d)),
+                )
+                for i, d in enumerate(data_strs)
+            ]
+            rule_files = []
+            errors = 0
+            for i, content in enumerate(rules_strs):
+                name = f"RULES_STDIN[{i + 1}]"
+                try:
+                    rf = parse_rules_file(content, name)
+                except ParseError as e:
+                    writer.writeln_err(f"Parse Error on ruleset file {name}")
+                    writer.writeln_err(str(e))
+                    errors += 1
+                    continue
+                if rf is not None:
+                    rule_files.append(
+                        RuleFile(name=name, full_name=name, content=content, rules=rf)
+                    )
+        else:
+            try:
+                data_files = self._load_data_files(reader, writer)
+            except (GuardError, FileNotFoundError, OSError) as e:
+                writer.writeln_err(str(e))
+                return ERROR_STATUS_CODE
+            rule_files, errors = self._load_rule_files(reader, writer)
+
+        try:
+            input_params = self._merged_input_params()
+        except (GuardError, FileNotFoundError, OSError) as e:
+            writer.writeln_err(str(e))
+            return ERROR_STATUS_CODE
+
+        if input_params is not None:
+            for df in data_files:
+                merged = _clone_pv(input_params).merge(df.path_value)
+                df.path_value = merged
+
+        if self.backend == "tpu":
+            from ..ops.backend import tpu_validate
+
+            return tpu_validate(self, rule_files, data_files, writer)
+
+        overall = Status.SKIP
+        had_fail = False
+        all_reports: List[dict] = []
+        junit_suites = {}
+
+        for rule_file in rule_files:
+            cases: List[JunitTestCase] = []
+            for data_file in data_files:
+                try:
+                    scope = RootScope(rule_file.rules, data_file.path_value)
+                    status = eval_rules_file(rule_file.rules, scope, data_file.name)
+                except GuardError as e:
+                    writer.writeln_err(str(e))
+                    errors += 1
+                    continue
+                root_record = scope.reset_recorder().extract()
+                report = simplified_report_from_root(root_record, data_file.name)
+                rule_statuses = rule_statuses_from_root(root_record)
+                all_reports.append(report)
+                for rn, rs in rule_statuses.items():
+                    cases.append(JunitTestCase(name=f"{rn}-{data_file.name}", status=rs))
+                if status == Status.FAIL:
+                    had_fail = True
+                overall = overall.and_(status)
+
+                if not self.structured:
+                    single_line_summary(
+                        writer,
+                        data_file.name,
+                        rule_file.name,
+                        status,
+                        report,
+                        rule_statuses,
+                    )
+                    show = set(self.show_summary)
+                    if "all" in show:
+                        show = {"pass", "fail", "skip"}
+                    if show and show != {"none"}:
+                        summary_table(writer, rule_file.name, data_file.name, rule_statuses, show)
+                    if self.verbose:
+                        print_verbose_tree(writer, root_record)
+                    if self.print_json:
+                        writer.writeln(json.dumps(record_to_json(root_record), indent=2))
+            junit_suites[rule_file.name] = cases
+
+        if self.structured:
+            if self.output_format in ("json", "yaml"):
+                write_structured(writer, all_reports, self.output_format)
+            elif self.output_format == "sarif":
+                write_sarif(writer, all_reports)
+            elif self.output_format == "junit":
+                write_junit(writer, junit_suites)
+
+        if errors > 0:
+            return ERROR_STATUS_CODE
+        if had_fail:
+            return FAILURE_STATUS_CODE
+        return SUCCESS_STATUS_CODE
+
+
+def _clone_pv(pv: PV) -> PV:
+    import copy
+
+    return copy.deepcopy(pv)

@@ -249,6 +249,36 @@ def load_document(content: str, file_name: str = "") -> PV:
         return from_plain(data)
 
 
+class _IntrinsicsSafeLoader(yaml.SafeLoader):
+    """SafeLoader that rewrites CFN short-form tags to long forms when
+    loading plain python data (test specs, rulegen templates) — the
+    analogue of serde_yaml's Tagged handling + `handle_tagged_value`
+    (values.rs:324-336)."""
+
+
+def _intrinsic_multi_constructor(loader, tag_suffix, node):
+    name = tag_suffix
+    if isinstance(node, yaml.ScalarNode):
+        value = loader.construct_scalar(node)
+        if name in SINGLE_VALUE_FUNC_REF:
+            return {SHORT_FORM_TO_LONG[name]: value}
+        return value
+    if isinstance(node, yaml.SequenceNode):
+        value = loader.construct_sequence(node, deep=True)
+        if name in SEQUENCE_VALUE_FUNC_REF:
+            return {SHORT_FORM_TO_LONG[name]: value}
+        return value
+    return loader.construct_mapping(node, deep=True)
+
+
+yaml.add_multi_constructor("!", _intrinsic_multi_constructor, Loader=_IntrinsicsSafeLoader)
+
+
+def yaml_load_with_intrinsics(content: str):
+    """yaml.safe_load that tolerates CFN short-form intrinsic tags."""
+    return yaml.load(content, Loader=_IntrinsicsSafeLoader)
+
+
 def load_payload(content: str) -> Tuple[list, list]:
     """Parse a stdin payload `{"rules": [...], "data": [...]}`
     (validate.rs:507-513)."""

@@ -1,0 +1,58 @@
+"""Reader/Writer IO abstraction.
+
+Equivalent of the reference's `utils/reader.rs:4-37` / `utils/writer.rs:
+9-49`: every command takes an injectable Reader (stdin / file / in-memory
+buffer) and Writer with separate out/err channels, so the CLI, library
+API, tests and FFI all share one code path.
+"""
+
+from __future__ import annotations
+
+import io
+import sys
+from typing import Optional, TextIO
+
+
+class Reader:
+    def __init__(self, source: Optional[TextIO] = None):
+        self._source = source if source is not None else sys.stdin
+
+    @staticmethod
+    def from_string(content: str) -> "Reader":
+        return Reader(io.StringIO(content))
+
+    @staticmethod
+    def from_file(path: str) -> "Reader":
+        return Reader(open(path, "r"))
+
+    def read(self) -> str:
+        return self._source.read()
+
+
+class Writer:
+    def __init__(self, out: Optional[TextIO] = None, err: Optional[TextIO] = None):
+        self.out = out if out is not None else sys.stdout
+        self.err = err if err is not None else sys.stderr
+
+    @staticmethod
+    def buffered() -> "Writer":
+        return Writer(io.StringIO(), io.StringIO())
+
+    def write(self, s: str) -> None:
+        self.out.write(s)
+
+    def writeln(self, s: str = "") -> None:
+        self.out.write(s + "\n")
+
+    def write_err(self, s: str) -> None:
+        self.err.write(s)
+
+    def writeln_err(self, s: str = "") -> None:
+        self.err.write(s + "\n")
+
+    def stripped(self) -> str:
+        """Captured stdout contents (buffered writers only)."""
+        return self.out.getvalue()
+
+    def err_to_stripped(self) -> str:
+        return self.err.getvalue()
