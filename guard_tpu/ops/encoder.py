@@ -1,0 +1,271 @@
+"""Columnar document encoder: PV trees -> padded int32 arrays.
+
+The TPU evaluation path never touches Python objects: a batch of parsed
+documents is flattened into fixed-shape arrays (SURVEY.md §7, north-star
+"documents -> padded columnar arrays"):
+
+  * node columns: kind, parent, scalar-id, numeric value;
+  * edge columns (parent -> child): parent, child, key-id (interned map
+    key), list index;
+  * one shared string-intern table across the batch, so string equality
+    becomes integer equality and each regex in the rule set is matched
+    ONCE per unique string on the host — the kernel just gathers bits.
+
+Documents are padded to the batch maxima (buckets are handled a level
+up), so the whole batch is a single `vmap`-able pytree of arrays.
+
+Replaces the pointer-chasing recursive walk of the reference's
+`PathAwareValue` traversal (`/root/reference/guard/src/rules/
+eval_context.rs:337-924`) with data-parallel scatter/gather over these
+arrays (see guard_tpu/ops/kernels.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.values import (
+    BOOL,
+    CHAR,
+    FLOAT,
+    INT,
+    LIST,
+    MAP,
+    NULL,
+    REGEX,
+    STRING,
+    PV,
+    compiled_regex,
+)
+
+
+class Interner:
+    """Shared string table. Key ids and scalar-string ids share one
+    namespace so `keys ==` filters work on the same table."""
+
+    def __init__(self):
+        self._ids: Dict[str, int] = {}
+        self._strings: List[str] = []
+
+    def intern(self, s: str) -> int:
+        i = self._ids.get(s)
+        if i is None:
+            i = len(self._strings)
+            self._ids[s] = i
+            self._strings.append(s)
+        return i
+
+    def lookup(self, s: str) -> int:
+        """-1 when the string is absent from the corpus (a literal that
+        can never match by equality)."""
+        return self._ids.get(s, -1)
+
+    @property
+    def strings(self) -> List[str]:
+        return self._strings
+
+    def __len__(self) -> int:
+        return len(self._strings)
+
+    def regex_match_bits(self, pattern: str) -> np.ndarray:
+        """(S,) bool: does `pattern` match each interned string —
+        host-precomputed so the TPU kernel only gathers."""
+        rx = compiled_regex(pattern)
+        return np.array(
+            [rx.search(s) is not None for s in self._strings], dtype=bool
+        )
+
+    def substring_bits(self, needle_id_unused: int, needle: str) -> np.ndarray:
+        """(S,) bool: `needle in s` for each interned string (the IN
+        operator's string-containment case, operators.rs:218-230)."""
+        return np.array([needle in s for s in self._strings], dtype=bool)
+
+
+@dataclass
+class EncodedDoc:
+    """Flat columnar form of one document."""
+
+    node_kind: np.ndarray  # (n,) int32, PV kind; -1 padding
+    node_parent: np.ndarray  # (n,) int32, -1 for root
+    scalar_id: np.ndarray  # (n,) int32 intern id for STRING/REGEX/CHAR else -1
+    num_val: np.ndarray  # (n,) float64 numeric value (int/float/bool)
+    child_count: np.ndarray  # (n,) int32 (len of list / size of map)
+    edge_parent: np.ndarray  # (e,) int32
+    edge_child: np.ndarray  # (e,) int32
+    edge_key_id: np.ndarray  # (e,) int32 interned key, -1 for list elems
+    edge_index: np.ndarray  # (e,) int32 list index, -1 for map entries
+    n_nodes: int
+    n_edges: int
+
+
+def encode_document(doc: PV, interner: Interner) -> EncodedDoc:
+    kinds: List[int] = []
+    parents: List[int] = []
+    scalar_ids: List[int] = []
+    num_vals: List[float] = []
+    child_counts: List[int] = []
+    e_parent: List[int] = []
+    e_child: List[int] = []
+    e_key: List[int] = []
+    e_index: List[int] = []
+
+    def visit(pv: PV, parent: int) -> int:
+        idx = len(kinds)
+        kinds.append(pv.kind)
+        parents.append(parent)
+        k = pv.kind
+        if k in (STRING, REGEX, CHAR):
+            scalar_ids.append(interner.intern(pv.val))
+            num_vals.append(0.0)
+            child_counts.append(0)
+        elif k == INT or k == FLOAT:
+            scalar_ids.append(-1)
+            num_vals.append(float(pv.val))
+            child_counts.append(0)
+        elif k == BOOL:
+            scalar_ids.append(-1)
+            num_vals.append(1.0 if pv.val else 0.0)
+            child_counts.append(0)
+        elif k == NULL:
+            scalar_ids.append(-1)
+            num_vals.append(0.0)
+            child_counts.append(0)
+        elif k == LIST:
+            scalar_ids.append(-1)
+            num_vals.append(0.0)
+            child_counts.append(len(pv.val))
+            for i, item in enumerate(pv.val):
+                ci = visit(item, idx)
+                e_parent.append(idx)
+                e_child.append(ci)
+                e_key.append(-1)
+                e_index.append(i)
+        elif k == MAP:
+            mv = pv.val
+            scalar_ids.append(-1)
+            num_vals.append(0.0)
+            child_counts.append(len(mv.values))
+            for key_node in mv.keys:
+                child = mv.values.get(key_node.val)
+                if child is None:
+                    continue
+                ci = visit(child, idx)
+                e_parent.append(idx)
+                e_child.append(ci)
+                e_key.append(interner.intern(key_node.val))
+                e_index.append(-1)
+        else:  # ranges never appear in documents
+            scalar_ids.append(-1)
+            num_vals.append(0.0)
+            child_counts.append(0)
+        return idx
+
+    visit(doc, -1)
+    return EncodedDoc(
+        node_kind=np.array(kinds, dtype=np.int32),
+        node_parent=np.array(parents, dtype=np.int32),
+        scalar_id=np.array(scalar_ids, dtype=np.int32),
+        num_val=np.array(num_vals, dtype=np.float64),
+        child_count=np.array(child_counts, dtype=np.int32),
+        edge_parent=np.array(e_parent, dtype=np.int32),
+        edge_child=np.array(e_child, dtype=np.int32),
+        edge_key_id=np.array(e_key, dtype=np.int32),
+        edge_index=np.array(e_index, dtype=np.int32),
+        n_nodes=len(kinds),
+        n_edges=len(e_parent),
+    )
+
+
+@dataclass
+class DocBatch:
+    """Batch of encoded documents padded to common (N, E) shapes.
+
+    All arrays have a leading doc axis — the axis that gets DP-sharded
+    across the TPU mesh (guard_tpu/parallel/mesh.py).
+    """
+
+    node_kind: np.ndarray  # (D, N) int32; -1 padding
+    node_parent: np.ndarray  # (D, N)
+    scalar_id: np.ndarray  # (D, N)
+    num_val: np.ndarray  # (D, N) float32 (f64 values saturate; see below)
+    child_count: np.ndarray  # (D, N)
+    edge_parent: np.ndarray  # (D, E); padding edges point at node N-? no: -1
+    edge_child: np.ndarray  # (D, E)
+    edge_key_id: np.ndarray  # (D, E)
+    edge_index: np.ndarray  # (D, E)
+    edge_valid: np.ndarray  # (D, E) bool
+    n_docs: int
+    n_nodes: int
+    n_edges: int
+
+    def arrays(self) -> dict:
+        return {
+            "node_kind": self.node_kind,
+            "node_parent": self.node_parent,
+            "scalar_id": self.scalar_id,
+            "num_val": self.num_val,
+            "child_count": self.child_count,
+            "edge_parent": self.edge_parent,
+            "edge_child": self.edge_child,
+            "edge_key_id": self.edge_key_id,
+            "edge_index": self.edge_index,
+            "edge_valid": self.edge_valid,
+        }
+
+
+def _round_up(n: int, multiple: int = 8) -> int:
+    return max(multiple, ((n + multiple - 1) // multiple) * multiple)
+
+
+def encode_batch(docs: List[PV], interner: Optional[Interner] = None,
+                 pad_nodes: Optional[int] = None, pad_edges: Optional[int] = None
+                 ) -> Tuple[DocBatch, Interner]:
+    """Encode + pad a list of documents into one batch.
+
+    Pads node/edge axes to bucket sizes (multiples of 8) so XLA sees a
+    small number of distinct shapes across batches.
+    """
+    interner = interner if interner is not None else Interner()
+    encoded = [encode_document(d, interner) for d in docs]
+    n = pad_nodes or _round_up(max((e.n_nodes for e in encoded), default=1))
+    e_max = pad_edges or _round_up(max((e.n_edges for e in encoded), default=1))
+    d = len(encoded)
+
+    def pad_node(attr, fill):
+        out = np.full((d, n), fill, dtype=getattr(encoded[0], attr).dtype if encoded else np.int32)
+        for i, enc in enumerate(encoded):
+            arr = getattr(enc, attr)
+            out[i, : len(arr)] = arr
+        return out
+
+    def pad_edge(attr, fill):
+        out = np.full((d, e_max), fill, dtype=np.int32)
+        for i, enc in enumerate(encoded):
+            arr = getattr(enc, attr)
+            out[i, : len(arr)] = arr
+        return out
+
+    edge_valid = np.zeros((d, e_max), dtype=bool)
+    for i, enc in enumerate(encoded):
+        edge_valid[i, : enc.n_edges] = True
+
+    batch = DocBatch(
+        node_kind=pad_node("node_kind", -1),
+        node_parent=pad_node("node_parent", -1),
+        scalar_id=pad_node("scalar_id", -1),
+        num_val=pad_node("num_val", 0.0).astype(np.float32),
+        child_count=pad_node("child_count", 0),
+        # padding edges self-loop on node 0 but are masked by edge_valid
+        edge_parent=pad_edge("edge_parent", 0),
+        edge_child=pad_edge("edge_child", 0),
+        edge_key_id=pad_edge("edge_key_id", -2),
+        edge_index=pad_edge("edge_index", -2),
+        edge_valid=edge_valid,
+        n_docs=d,
+        n_nodes=n,
+        n_edges=e_max,
+    )
+    return batch, interner

@@ -1,0 +1,475 @@
+"""Lowering: Guard AST -> flat predicate/path-query IR.
+
+Compiles a parsed `RulesFile` into per-rule straight-line programs over
+the columnar document encoding (guard_tpu/ops/encoder.py). This is the
+TPU analogue of the reference's recursive evaluator
+(`/root/reference/guard/src/rules/eval.rs` + `eval_context.rs`): queries
+become step lists (key / all-values / all-indices / index / filter /
+keys-match), clauses become leaf comparisons against pre-resolved
+literals (string equality via intern ids, regex and substring matches
+via host-precomputed bit tables), and block/when/CNF structure becomes
+tri-state combinator nodes.
+
+Lowering is *exact or refused*: any construct whose semantics the kernel
+cannot reproduce bit-for-bit (function calls, query-to-query compares,
+parameterized rules, map literals, variable captures) raises
+`Unlowerable`, and the backend falls back to the CPU oracle for that
+rule. Coverage is wide enough for the dominant registry rule shapes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+from ..core.exprs import (
+    AccessQuery,
+    Block,
+    BlockGuardClause,
+    CmpOperator,
+    FunctionExpr,
+    GuardAccessClause,
+    GuardNamedRuleClause,
+    ParameterizedNamedRuleClause,
+    QAllIndices,
+    QAllValues,
+    QFilter,
+    QIndex,
+    QKey,
+    QMapKeyFilter,
+    QThis,
+    Rule,
+    RulesFile,
+    TypeBlock,
+    WhenBlockClause,
+    part_is_variable,
+    part_variable,
+)
+from ..core.scopes import CONVERTERS
+from ..core.values import (
+    BOOL,
+    CHAR,
+    FLOAT,
+    INT,
+    NULL,
+    RANGE_CHAR,
+    RANGE_FLOAT,
+    RANGE_INT,
+    REGEX,
+    STRING,
+    PV,
+)
+from .encoder import Interner
+
+PASS, FAIL, SKIP = 0, 1, 2
+
+
+class Unlowerable(Exception):
+    """Raised when a rule uses semantics outside the kernel's coverage."""
+
+
+# ---------------------------------------------------------------------------
+# Step IR
+# ---------------------------------------------------------------------------
+@dataclass
+class StepKey:
+    key_ids: List[int]  # original key id + case-converted aliases
+    drop_unres: bool = False  # `some`-marked variable splice
+
+
+@dataclass
+class StepAllValues:
+    pass
+
+
+@dataclass
+class StepAllIndices:
+    pass
+
+
+@dataclass
+class StepIndex:
+    index: int  # already abs()'d (eval_context.rs:119-140)
+
+
+@dataclass
+class StepFilter:
+    conjunctions: List[List["CClause"]]
+
+
+@dataclass
+class StepKeysMatch:
+    rhs: "RhsSpec"
+    op: CmpOperator
+    op_not: bool
+
+
+Step = Union[StepKey, StepAllValues, StepAllIndices, StepIndex, StepFilter, StepKeysMatch]
+
+
+# ---------------------------------------------------------------------------
+# RHS literal specs — everything pre-resolved against the intern table
+# ---------------------------------------------------------------------------
+@dataclass
+class RhsSpec:
+    kind: str  # 'str' | 'regex' | 'num' | 'bool' | 'null' | 'range' | 'list' | 'substr'
+    str_id: int = -1
+    bits: Optional[np.ndarray] = None  # (S,) bool for regex/substr
+    num: float = 0.0
+    num_kind: int = INT  # INT or FLOAT for numeric literals
+    range_lo: float = 0.0
+    range_hi: float = 0.0
+    range_incl: int = 0
+    range_kind: int = RANGE_INT
+    items: Optional[List["RhsSpec"]] = None  # for 'list'
+
+
+@dataclass
+class CClause:
+    """One guard access clause over a relative query."""
+
+    steps: List[Step]
+    op: CmpOperator
+    op_not: bool
+    negation: bool
+    match_all: bool
+    rhs: Optional[RhsSpec]
+    empty_on_expr: bool  # eval.rs:193-196 special EMPTY handling
+    lhs_starts_at_root: bool = False  # absolute query inside value scope? no: relative
+
+
+@dataclass
+class CBlockClause:
+    query_steps: List[Step]
+    match_all: bool
+    not_empty: bool
+    inner: List[List["CNode"]]  # conjunctions of CNodes
+
+
+@dataclass
+class CWhenBlock:
+    conditions: List[List["CNode"]]
+    inner: List[List["CNode"]]
+
+
+@dataclass
+class CNamedRef:
+    rule_index: int  # index into the compiled-rules list
+    negation: bool
+
+
+CNode = Union[CClause, CBlockClause, CWhenBlock, CNamedRef]
+
+
+@dataclass
+class CRule:
+    name: str
+    conditions: Optional[List[List[CNode]]]
+    conjunctions: List[List[CNode]]
+
+
+@dataclass
+class CompiledRules:
+    rules: List[CRule]
+    # rules that could not be lowered: (index in original file order, Rule)
+    host_rules: List[Rule]
+    interner: Interner
+    # empty-string bit table for the EMPTY check on strings
+    str_empty_bits: np.ndarray
+
+
+# ---------------------------------------------------------------------------
+# Lowering
+# ---------------------------------------------------------------------------
+class _RuleLowering:
+    def __init__(self, rules_file: RulesFile, interner: Interner):
+        self.rf = rules_file
+        self.interner = interner
+        self.var_queries = {}
+        self.var_literals = {}
+        for let in rules_file.assignments:
+            if isinstance(let.value, AccessQuery):
+                self.var_queries[let.var] = let.value
+            elif isinstance(let.value, PV):
+                self.var_literals[let.var] = let.value
+            else:
+                # function-call assignment: rules touching it go host-side
+                self.var_queries[let.var] = None
+        self.rule_index = {}
+
+    # -- query lowering ------------------------------------------------
+    def lower_query(self, parts: List, block_vars: dict) -> List[Step]:
+        steps: List[Step] = []
+        idx = 0
+        if parts and part_is_variable(parts[0]):
+            var = part_variable(parts[0])
+            vq = self._lookup_var(var, block_vars)
+            if vq is None:
+                raise Unlowerable(f"variable {var} is not a plain query")
+            inner = self.lower_query(vq.query, block_vars)
+            if not vq.match_all:
+                for s in inner:
+                    if isinstance(s, StepKey):
+                        s.drop_unres = True
+            steps.extend(inner)
+            idx = 1
+            # skip the implicit [*] the parser inserted after the variable
+            if idx < len(parts) and isinstance(parts[idx], QAllIndices):
+                idx += 1
+        for part in parts[idx:]:
+            steps.append(self.lower_part(part, block_vars))
+        return steps
+
+    def _lookup_var(self, var: str, block_vars: dict):
+        if var in block_vars:
+            v = block_vars[var]
+        elif var in self.var_queries:
+            v = self.var_queries[var]
+        elif var in self.var_literals:
+            raise Unlowerable(f"literal variable {var} used as query head")
+        else:
+            raise Unlowerable(f"unknown variable {var}")
+        if v is None or not isinstance(v, AccessQuery):
+            return None
+        return v
+
+    def lower_part(self, part, block_vars) -> Step:
+        if isinstance(part, QThis):
+            raise Unlowerable("`this` inside query")
+        if isinstance(part, QKey):
+            if part_is_variable(part):
+                raise Unlowerable("variable key interpolation")
+            try:
+                return StepIndex(abs(int(part.name)))
+            except ValueError:
+                pass
+            kid = self.interner.lookup(part.name)
+            ids = [kid] if kid >= 0 else []
+            for conv in CONVERTERS:
+                alias = self.interner.lookup(conv(part.name))
+                if alias >= 0 and alias not in ids:
+                    ids.append(alias)
+            if not ids:
+                ids = [-99]  # key absent from corpus: always unresolved
+            return StepKey(key_ids=ids)
+        if isinstance(part, QAllValues):
+            if part.name is not None:
+                raise Unlowerable("variable capture in projection")
+            return StepAllValues()
+        if isinstance(part, QAllIndices):
+            if part.name is not None:
+                raise Unlowerable("variable capture in projection")
+            return StepAllIndices()
+        if isinstance(part, QIndex):
+            return StepIndex(abs(part.index))
+        if isinstance(part, QFilter):
+            if part.name is not None:
+                raise Unlowerable("variable capture in filter")
+            return StepFilter(
+                conjunctions=[
+                    [self.lower_guard_clause(c, block_vars) for c in disj]
+                    for disj in part.conjunctions
+                ]
+            )
+        if isinstance(part, QMapKeyFilter):
+            if part.name is not None:
+                raise Unlowerable("variable capture in keys filter")
+            rhs = self.lower_rhs(part.clause.compare_with, block_vars)
+            return StepKeysMatch(
+                rhs=rhs, op=part.clause.comparator, op_not=part.clause.comparator_inverse
+            )
+        raise Unlowerable(f"query part {part!r}")
+
+    # -- rhs lowering --------------------------------------------------
+    def lower_rhs(self, cw, block_vars=None) -> RhsSpec:
+        if isinstance(cw, AccessQuery):
+            # `x IN %allowed` where %allowed is a literal assignment:
+            # resolve at compile time (a Literal RHS in the reference,
+            # eval_context.rs:1117-1119)
+            parts = cw.query
+            if parts and part_is_variable(parts[0]):
+                var = part_variable(parts[0])
+                lit = None
+                if block_vars and var in block_vars and isinstance(block_vars[var], PV):
+                    lit = block_vars[var]
+                elif var in self.var_literals:
+                    lit = self.var_literals[var]
+                rest = parts[1:]
+                if rest and isinstance(rest[0], QAllIndices):
+                    rest = rest[1:]
+                if lit is not None and not rest:
+                    return self.lower_rhs(lit)
+            raise Unlowerable("non-literal RHS (query or function call)")
+        if not isinstance(cw, PV):
+            raise Unlowerable("non-literal RHS (query or function call)")
+        k = cw.kind
+        if k == STRING:
+            return RhsSpec(
+                kind="str",
+                str_id=self.interner.lookup(cw.val),
+                bits=self.interner.substring_bits(-1, cw.val),
+            )
+        if k == REGEX:
+            return RhsSpec(kind="regex", bits=self.interner.regex_match_bits(cw.val))
+        if k == CHAR:
+            return RhsSpec(kind="str", str_id=self.interner.lookup(cw.val))
+        if k == INT:
+            return RhsSpec(kind="num", num=float(cw.val), num_kind=INT)
+        if k == FLOAT:
+            return RhsSpec(kind="num", num=float(cw.val), num_kind=FLOAT)
+        if k == BOOL:
+            return RhsSpec(kind="bool", num=1.0 if cw.val else 0.0)
+        if k == NULL:
+            return RhsSpec(kind="null")
+        if k in (RANGE_INT, RANGE_FLOAT, RANGE_CHAR):
+            if k == RANGE_CHAR:
+                raise Unlowerable("char range literal")
+            r = cw.val
+            return RhsSpec(
+                kind="range",
+                range_lo=float(r.lower),
+                range_hi=float(r.upper),
+                range_incl=r.inclusive,
+                range_kind=k,
+                num_kind=INT if k == RANGE_INT else FLOAT,
+            )
+        if k == 7:  # LIST
+            items = [self.lower_rhs(e) for e in cw.val]
+            for it in items:
+                if it.kind not in ("str", "regex", "num", "bool", "null", "range"):
+                    raise Unlowerable("nested list in RHS list literal")
+            return RhsSpec(kind="list", items=items)
+        raise Unlowerable(f"RHS literal kind {cw.type_info()}")
+
+    # -- clause lowering ----------------------------------------------
+    def lower_guard_clause_as_cclause(self, clause, block_vars) -> "CClause":
+        if not isinstance(clause, GuardAccessClause):
+            raise Unlowerable(f"filter clause {type(clause).__name__}")
+        return self.lower_access_clause(clause, block_vars)
+
+    def lower_access_clause(self, gac: GuardAccessClause, block_vars) -> CClause:
+        ac = gac.access_clause
+        parts = ac.query.query
+        # the `empty`-on-expression special case (eval.rs:193-196)
+        last = parts[-1]
+        empty_on_expr = isinstance(last, (QFilter, QMapKeyFilter)) or (
+            part_is_variable(last) and len(parts) == 1
+        )
+        steps = self.lower_query(parts, block_vars)
+        rhs = None
+        if not ac.comparator.is_unary():
+            rhs = self.lower_rhs(ac.compare_with, block_vars)
+        return CClause(
+            steps=steps,
+            op=ac.comparator,
+            op_not=ac.comparator_inverse,
+            negation=gac.negation,
+            match_all=ac.query.match_all,
+            rhs=rhs,
+            empty_on_expr=empty_on_expr,
+        )
+
+    def lower_guard_clause(self, clause, block_vars) -> CNode:
+        if isinstance(clause, GuardAccessClause):
+            return self.lower_access_clause(clause, block_vars)
+        if isinstance(clause, BlockGuardClause):
+            inner_vars = self._merge_block_vars(block_vars, clause.block)
+            return CBlockClause(
+                query_steps=self.lower_query(clause.query.query, block_vars),
+                match_all=clause.query.match_all,
+                not_empty=clause.not_empty,
+                inner=[
+                    [self.lower_guard_clause(c, inner_vars) for c in disj]
+                    for disj in clause.block.conjunctions
+                ],
+            )
+        if isinstance(clause, WhenBlockClause):
+            inner_vars = self._merge_block_vars(block_vars, clause.block)
+            return CWhenBlock(
+                conditions=[
+                    [self.lower_guard_clause(c, block_vars) for c in disj]
+                    for disj in clause.conditions
+                ],
+                inner=[
+                    [self.lower_guard_clause(c, inner_vars) for c in disj]
+                    for disj in clause.block.conjunctions
+                ],
+            )
+        if isinstance(clause, GuardNamedRuleClause):
+            target = self.rule_index.get(clause.dependent_rule)
+            if target is None:
+                raise Unlowerable(f"named rule {clause.dependent_rule} not lowerable")
+            return CNamedRef(rule_index=target, negation=clause.negation)
+        if isinstance(clause, ParameterizedNamedRuleClause):
+            raise Unlowerable("parameterized rule call")
+        if isinstance(clause, TypeBlock):
+            inner_vars = self._merge_block_vars(block_vars, clause.block)
+            if clause.conditions is not None:
+                raise Unlowerable("type block with when conditions")
+            return CBlockClause(
+                query_steps=self.lower_query(clause.query, block_vars),
+                match_all=True,
+                not_empty=False,
+                inner=[
+                    [self.lower_guard_clause(c, inner_vars) for c in disj]
+                    for disj in clause.block.conjunctions
+                ],
+            )
+        raise Unlowerable(f"clause {type(clause).__name__}")
+
+    def _merge_block_vars(self, outer: dict, block: Block) -> dict:
+        merged = dict(outer)
+        for let in block.assignments:
+            if isinstance(let.value, (AccessQuery, PV)):
+                merged[let.var] = let.value
+            else:
+                merged[let.var] = None  # function call: bail if used
+        return merged
+
+    def lower_rule(self, rule: Rule) -> CRule:
+        block_vars = self._merge_block_vars({}, rule.block)
+        conditions = None
+        if rule.conditions is not None:
+            conditions = [
+                [self.lower_guard_clause(c, block_vars) for c in disj]
+                for disj in rule.conditions
+            ]
+        conjunctions = [
+            [self.lower_guard_clause(c, block_vars) for c in disj]
+            for disj in rule.block.conjunctions
+        ]
+        return CRule(name=rule.rule_name, conditions=conditions, conjunctions=conjunctions)
+
+
+def compile_rules_file(rules_file: RulesFile, interner: Interner) -> CompiledRules:
+    """Lower every rule; rules that refuse lowering are returned in
+    `host_rules` for CPU-oracle evaluation (the fail-rerun design)."""
+    lowering = _RuleLowering(rules_file, interner)
+    compiled: List[CRule] = []
+    host: List[Rule] = []
+    # duplicate rule names can't use CNamedRef's first-non-SKIP semantics
+    names_seen = {}
+    for r in rules_file.guard_rules:
+        names_seen[r.rule_name] = names_seen.get(r.rule_name, 0) + 1
+    for rule in rules_file.guard_rules:
+        if names_seen[rule.rule_name] > 1:
+            host.append(rule)
+            continue
+        try:
+            cr = lowering.lower_rule(rule)
+        except Unlowerable:
+            host.append(rule)
+            continue
+        lowering.rule_index[rule.rule_name] = len(compiled)
+        compiled.append(cr)
+    str_empty_bits = np.array(
+        [len(s) == 0 for s in interner.strings], dtype=bool
+    )
+    return CompiledRules(
+        rules=compiled,
+        host_rules=host,
+        interner=interner,
+        str_empty_bits=str_empty_bits,
+    )

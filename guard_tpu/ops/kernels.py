@@ -1,0 +1,588 @@
+"""JAX evaluation kernels: origin-labeled query walks over columnar docs.
+
+The TPU-native replacement for the reference's recursive tree-walk
+(`/root/reference/guard/src/rules/eval_context.rs:337-924`) and clause
+evaluation (`eval.rs:174-1225`):
+
+  * a query's current selection is an (N,) int32 vector of *origin
+    labels* (0 = unselected; label o = node selected on behalf of origin
+    node o-1). Because the document is a tree, every child has exactly
+    one parent, so each traversal step is an exact scatter over the edge
+    arrays — no collisions, no dynamic shapes, no recursion;
+  * per-origin aggregation (the `some`/`match_all`, block and filter
+    semantics) is a segment-sum keyed by origin label;
+  * UnResolved propagation is an (N+1,) per-origin counter carried
+    through every step, reproducing the reference's tri-state outcomes;
+  * string equality is intern-id equality; regex and substring checks
+    gather host-precomputed bit tables (guard_tpu/ops/encoder.py).
+
+Everything is fixed-shape and traced once per (rule-file, node/edge
+bucket): `vmap` batches documents, and the doc axis is DP-sharded across
+the TPU mesh by guard_tpu/parallel/mesh.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.values import BOOL, FLOAT, INT, LIST, MAP, NULL, STRING
+from ..core.values import LOWER_INCLUSIVE, UPPER_INCLUSIVE
+from .encoder import DocBatch
+from .ir import (
+    FAIL,
+    PASS,
+    SKIP,
+    CBlockClause,
+    CClause,
+    CNamedRef,
+    CompiledRules,
+    CRule,
+    CWhenBlock,
+    RhsSpec,
+    Step,
+    StepAllIndices,
+    StepAllValues,
+    StepFilter,
+    StepIndex,
+    StepKey,
+    StepKeysMatch,
+)
+from ..core.exprs import CmpOperator
+
+
+class _DocArrays:
+    """Unbatched (per-document) views used inside the vmap'd kernel."""
+
+    def __init__(self, arrays: Dict[str, jnp.ndarray], str_empty_bits: jnp.ndarray):
+        self.node_kind = arrays["node_kind"]
+        self.scalar_id = arrays["scalar_id"]
+        self.num_val = arrays["num_val"]
+        self.child_count = arrays["child_count"]
+        self.edge_parent = arrays["edge_parent"]
+        self.edge_child = arrays["edge_child"]
+        self.edge_key_id = arrays["edge_key_id"]
+        self.edge_index = arrays["edge_index"]
+        self.edge_valid = arrays["edge_valid"]
+        self.str_empty_bits = str_empty_bits
+        self.n = self.node_kind.shape[0]
+
+
+# ---------------------------------------------------------------------------
+# query steps
+# ---------------------------------------------------------------------------
+def _scatter_child_labels(d: _DocArrays, contrib: jnp.ndarray) -> jnp.ndarray:
+    """(E,) int32 labels -> (N,) labels on child nodes (exact: tree)."""
+    return jnp.zeros(d.n, jnp.int32).at[d.edge_child].max(contrib)
+
+
+def _add_unres(unres, sel, miss):
+    """Accumulate per-origin unresolved counts; origin 0 is a sink."""
+    return unres.at[jnp.where(miss, sel, 0)].add(miss.astype(jnp.int32))
+
+
+def run_steps(d: _DocArrays, steps: List[Step], sel, unres, rule_statuses=None):
+    for step in steps:
+        sel, unres = run_step(d, step, sel, unres, rule_statuses)
+    return sel, unres
+
+
+def run_step(d: _DocArrays, step: Step, sel, unres, rule_statuses=None):
+    pk = sel[d.edge_parent]
+    if isinstance(step, StepKey):
+        key_hit = jnp.zeros_like(d.edge_valid)
+        for kid in step.key_ids:
+            key_hit = key_hit | (d.edge_key_id == kid)
+        key_hit = key_hit & d.edge_valid
+        contrib = jnp.where(key_hit & (pk > 0), pk, 0)
+        new_sel = _scatter_child_labels(d, contrib)
+        resolved = (
+            jnp.zeros(d.n, bool).at[d.edge_parent].max(key_hit)
+        )
+        miss = (sel > 0) & ~resolved
+        if not step.drop_unres:
+            unres = _add_unres(unres, sel, miss)
+        return new_sel, unres
+
+    if isinstance(step, StepAllValues):
+        # `.*`: all children of maps AND lists; scalars pass through;
+        # empty containers are unresolved (eval_context.rs:667-721)
+        is_container = (d.node_kind == MAP) | (d.node_kind == LIST)
+        contrib = jnp.where(d.edge_valid & (pk > 0), pk, 0)
+        child_sel = _scatter_child_labels(d, contrib)
+        keep = jnp.where((sel > 0) & ~is_container, sel, 0)
+        new_sel = jnp.maximum(child_sel, keep)
+        empty_c = (sel > 0) & is_container & (d.child_count == 0)
+        unres = _add_unres(unres, sel, empty_c)
+        return new_sel, unres
+
+    if isinstance(step, StepAllIndices):
+        # `[*]`: elements of lists; maps and scalars pass through
+        # (eval_context.rs:609-665)
+        parent_is_list = d.node_kind[d.edge_parent] == LIST
+        contrib = jnp.where(d.edge_valid & (pk > 0) & parent_is_list, pk, 0)
+        child_sel = _scatter_child_labels(d, contrib)
+        keep = jnp.where((sel > 0) & (d.node_kind != LIST), sel, 0)
+        new_sel = jnp.maximum(child_sel, keep)
+        empty_l = (sel > 0) & (d.node_kind == LIST) & (d.child_count == 0)
+        unres = _add_unres(unres, sel, empty_l)
+        return new_sel, unres
+
+    if isinstance(step, StepIndex):
+        hit = d.edge_valid & (d.edge_index == step.index) & (pk > 0)
+        contrib = jnp.where(hit, pk, 0)
+        new_sel = _scatter_child_labels(d, contrib)
+        resolved = jnp.zeros(d.n, bool).at[d.edge_parent].max(hit)
+        miss = (sel > 0) & ((d.node_kind != LIST) | ~resolved)
+        unres = _add_unres(unres, sel, miss)
+        return new_sel, unres
+
+    if isinstance(step, StepFilter):
+        # expand list candidates to their elements (eval_context.rs:755-791)
+        parent_is_list = d.node_kind[d.edge_parent] == LIST
+        elem_contrib = jnp.where(d.edge_valid & (pk > 0) & parent_is_list, pk, 0)
+        elems = _scatter_child_labels(d, elem_contrib)
+        keep = jnp.where((sel > 0) & (d.node_kind != LIST), sel, 0)
+        cand = jnp.maximum(elems, keep)  # candidates labeled with OUTER origin
+        idx = jnp.arange(d.n, dtype=jnp.int32)
+        cand_self = jnp.where(cand > 0, idx + 1, 0)  # each candidate = own origin
+        status = eval_conjunctions(d, step.conjunctions, cand_self, rule_statuses)
+        st_per_node = status[idx + 1]
+        selected = (cand > 0) & (st_per_node == PASS)
+        new_sel = jnp.where(selected, cand, 0)
+        return new_sel, unres
+
+    if isinstance(step, StepKeysMatch):
+        # `[ keys == ... ]` (eval_context.rs:830-922): select map values
+        # whose KEY matches; key ids index the shared intern table
+        match = _rhs_match_on_ids(d, step.rhs, step.op, d.edge_key_id)
+        if step.op_not:
+            match = ~match
+        contrib = jnp.where(
+            d.edge_valid & (pk > 0) & match & (d.edge_key_id >= 0), pk, 0
+        )
+        new_sel = _scatter_child_labels(d, contrib)
+        return new_sel, unres
+
+    raise TypeError(f"unknown step {step!r}")
+
+
+def _rhs_match_on_ids(d: _DocArrays, rhs: RhsSpec, op: CmpOperator, ids) -> jnp.ndarray:
+    """String-id match (used for keys filters where LHS is a key id)."""
+    safe = jnp.maximum(ids, 0)
+    if rhs.kind == "str":
+        return ids == rhs.str_id
+    if rhs.kind == "regex":
+        bits = jnp.asarray(rhs.bits)
+        return jnp.where(ids >= 0, bits[safe], False)
+    if rhs.kind == "list":
+        out = jnp.zeros_like(ids, dtype=bool)
+        for item in rhs.items:
+            out = out | _rhs_match_on_ids(d, item, CmpOperator.Eq, ids)
+        return out
+    raise TypeError(f"keys filter rhs {rhs.kind}")
+
+
+# ---------------------------------------------------------------------------
+# leaf comparisons
+# ---------------------------------------------------------------------------
+def _compare_scalar_full(d: _DocArrays, rhs: RhsSpec, op: CmpOperator):
+    """(match (N,), comparable (N,)) of `node <op> literal` per node.
+    Non-comparable pairs FAIL regardless of `not` inversion
+    (operators.rs:195-206 keeps NotComparable through the inversion pass,
+    operators.rs:774-777)."""
+    kind = d.node_kind
+    sid = jnp.maximum(d.scalar_id, 0)
+    num = d.num_val
+
+    if op == CmpOperator.Eq or op == CmpOperator.In:
+        if rhs.kind == "str":
+            comparable = kind == STRING
+            return comparable & (d.scalar_id == rhs.str_id), comparable
+        if rhs.kind == "regex":
+            bits = jnp.asarray(rhs.bits)
+            comparable = kind == STRING
+            return comparable & (d.scalar_id >= 0) & bits[sid], comparable
+        if rhs.kind == "num":
+            k = INT if rhs.num_kind == INT else FLOAT
+            comparable = kind == k
+            return comparable & (num == np.float32(rhs.num)), comparable
+        if rhs.kind == "bool":
+            comparable = kind == BOOL
+            return comparable & (num == np.float32(rhs.num)), comparable
+        if rhs.kind == "null":
+            comparable = kind == NULL
+            return comparable, comparable
+        if rhs.kind == "range":
+            k = INT if rhs.range_kind == 9 else FLOAT
+            comparable = kind == k
+            lo_ok = (
+                num >= np.float32(rhs.range_lo)
+                if rhs.range_incl & LOWER_INCLUSIVE
+                else num > np.float32(rhs.range_lo)
+            )
+            hi_ok = (
+                num <= np.float32(rhs.range_hi)
+                if rhs.range_incl & UPPER_INCLUSIVE
+                else num < np.float32(rhs.range_hi)
+            )
+            return comparable & lo_ok & hi_ok, comparable
+        raise TypeError(f"eq rhs {rhs.kind}")
+
+    # ordering ops: same-kind scalars only (path_value.rs:1048-1070)
+    if rhs.kind != "num":
+        raise TypeError(f"ordering vs {rhs.kind}")
+    k = INT if rhs.num_kind == INT else FLOAT
+    comparable = kind == k
+    lit = np.float32(rhs.num)
+    if op == CmpOperator.Gt:
+        out = num > lit
+    elif op == CmpOperator.Ge:
+        out = num >= lit
+    elif op == CmpOperator.Lt:
+        out = num < lit
+    else:
+        out = num <= lit
+    return comparable & out, comparable
+
+
+def _compare_scalar(d: _DocArrays, rhs: RhsSpec, op: CmpOperator):
+    return _compare_scalar_full(d, rhs, op)[0]
+
+
+def _list_children_matching(d: _DocArrays, leaf_is_list, match_per_node):
+    """For each list node: count of children whose scalar matches."""
+    pk_list = leaf_is_list[d.edge_parent]
+    child_match = match_per_node[d.edge_child]
+    contrib = (d.edge_valid & pk_list & child_match).astype(jnp.int32)
+    return jnp.zeros(d.n, jnp.int32).at[d.edge_parent].add(contrib)
+
+
+def _eval_binary_outcomes(d: _DocArrays, c: CClause, sel_leaf):
+    """Per-leaf boolean outcome for binary ops, mirroring EqOperation /
+    InOperation / CommonOperator (operators.rs:146-598). Returns
+    (outcome (N,), active (N,)) where active marks evaluated leaves
+    (lists may be expanded to elements)."""
+    rhs = c.rhs
+    op = c.op
+    is_list_leaf = (sel_leaf > 0) & (d.node_kind == LIST)
+    is_scalar_leaf = (sel_leaf > 0) & (d.node_kind != LIST) & (d.node_kind != MAP)
+    is_map_leaf = (sel_leaf > 0) & (d.node_kind == MAP)
+
+    if op in (CmpOperator.Gt, CmpOperator.Ge, CmpOperator.Lt, CmpOperator.Le):
+        # CommonOperator flattens list leaves (operators.rs:132-144)
+        match = _compare_scalar(d, rhs, op)
+        n_child = _list_children_total(d, is_list_leaf)
+        n_child_ok = _list_children_matching(d, is_list_leaf, match)
+        outcome = jnp.where(
+            is_list_leaf, n_child_ok == n_child, match
+        )
+        # map leaves: not comparable -> FAIL
+        outcome = jnp.where(is_map_leaf, False, outcome)
+        return outcome, (sel_leaf > 0)
+
+    if op == CmpOperator.Eq:
+        if rhs.kind == "list":
+            # list literal RHS: list leaf -> ordered elementwise compare;
+            # scalar leaf vs len-1 list -> compare against the element
+            items = rhs.items
+            ok_list = d.child_count == len(items)
+            for j, item in enumerate(items):
+                m = _compare_scalar(d, item, CmpOperator.Eq)
+                # child at index j must match item j
+                hit = (
+                    d.edge_valid
+                    & (d.edge_index == j)
+                    & m[d.edge_child]
+                )
+                has = jnp.zeros(d.n, bool).at[d.edge_parent].max(hit)
+                ok_list = ok_list & has
+            outcome = jnp.where(is_list_leaf, ok_list, False)
+            if len(items) == 1:
+                scalar_ok = _compare_scalar(d, items[0], CmpOperator.Eq)
+                outcome = jnp.where(is_scalar_leaf, scalar_ok, outcome)
+            if c.op_not:
+                outcome = jnp.where(sel_leaf > 0, ~outcome, outcome)
+            return outcome, (sel_leaf > 0)
+        # scalar literal RHS: list leaves expand to elements
+        match, comparable = _compare_scalar_full(d, rhs, CmpOperator.Eq)
+        if c.op_not:
+            # `not` only flips comparable pairs; NotComparable stays FAIL
+            match = comparable & ~match
+        n_child = _list_children_total(d, is_list_leaf)
+        n_child_ok = _list_children_matching(d, is_list_leaf, match)
+        # all expanded elements must pass for match_all; `some` needs
+        # any-element, hence the (outcome_all, outcome_any) pair.
+        outcome = jnp.where(is_list_leaf, n_child_ok == n_child, match)
+        outcome_any = jnp.where(is_list_leaf, n_child_ok > 0, match)
+        outcome = jnp.where(is_map_leaf, False, outcome)
+        outcome_any = jnp.where(is_map_leaf, False, outcome_any)
+        return (outcome, outcome_any), (sel_leaf > 0)
+
+    if op == CmpOperator.In:
+        if rhs.kind == "str":
+            # string containment lhs in rhs (operators.rs:218-230);
+            # non-strings are NotComparable -> FAIL either way
+            bits = jnp.asarray(rhs.bits)
+            sid = jnp.maximum(d.scalar_id, 0)
+            comparable = d.node_kind == STRING
+            m = comparable & (d.scalar_id >= 0) & bits[sid]
+            if c.op_not:
+                m = comparable & ~m
+            n_child = _list_children_total(d, is_list_leaf)
+            ok_child = _list_children_matching(d, is_list_leaf, m)
+            outcome = jnp.where(is_list_leaf, ok_child == n_child, m)
+            return outcome, (sel_leaf > 0)
+        items = rhs.items if rhs.kind == "list" else [rhs]
+        m = jnp.zeros(d.n, bool)
+        for item in items:
+            m = m | _compare_scalar(d, item, CmpOperator.Eq)
+        # scalar: in == any match; list leaf: ALL elements in rhs
+        # (contained_in, operators.rs:256-321); not_in: NO element in rhs
+        n_child = _list_children_total(d, is_list_leaf)
+        in_child = _list_children_matching(d, is_list_leaf, m)
+        if c.op_not:
+            outcome = jnp.where(is_list_leaf, in_child == 0, ~m)
+        else:
+            outcome = jnp.where(is_list_leaf, in_child == n_child, m)
+        return outcome, (sel_leaf > 0)
+
+    raise TypeError(f"binary op {op}")
+
+
+def _list_children_total(d: _DocArrays, leaf_is_list):
+    pk_list = leaf_is_list[d.edge_parent]
+    contrib = (d.edge_valid & pk_list).astype(jnp.int32)
+    return jnp.zeros(d.n, jnp.int32).at[d.edge_parent].add(contrib)
+
+
+# ---------------------------------------------------------------------------
+# clause / block / conjunction evaluation — all per-origin (N+1,) int8
+# ---------------------------------------------------------------------------
+def _segment_count(d: _DocArrays, sel, pred) -> jnp.ndarray:
+    """(N+1,) counts of pred-true selected nodes per origin label."""
+    labels = jnp.where(pred & (sel > 0), sel, 0)
+    return jnp.zeros(d.n + 1, jnp.int32).at[labels].add(
+        (pred & (sel > 0)).astype(jnp.int32)
+    )
+
+
+def eval_clause(d: _DocArrays, c: CClause, sel, rule_statuses=None) -> jnp.ndarray:
+    unres0 = jnp.zeros(d.n + 1, jnp.int32)
+    sel_leaf, unres = run_steps(d, c.steps, sel, unres0, rule_statuses)
+    n_res = _segment_count(d, sel_leaf, jnp.ones(d.n, bool))
+    n_unres = unres
+    total = n_res + n_unres
+
+    if c.op.is_unary():
+        if c.op == CmpOperator.Empty and c.empty_on_expr:
+            # eval.rs:198-298
+            is_null = d.node_kind == NULL
+            ok_res = jnp.where(c.op_not, ~is_null, is_null)
+            if c.negation:
+                ok_res = ~ok_res
+            pass_res = _segment_count(d, sel_leaf, ok_res)
+            fail_res = n_res - pass_res
+            unres_pass = not c.op_not
+            if c.negation:
+                unres_pass = not unres_pass
+            pass_n = pass_res + (n_unres if unres_pass else 0)
+            fail_n = fail_res + (0 if unres_pass else n_unres)
+            st = jnp.where(fail_n > 0, FAIL, PASS).astype(jnp.int8)
+            empty_result = not c.op_not
+            if c.negation:
+                empty_result = not empty_result
+            empty_status = PASS if empty_result else FAIL
+            return jnp.where(total == 0, jnp.int8(empty_status), st)
+
+        # element-wise unary ops (eval.rs:307-405)
+        kind = d.node_kind
+        if c.op == CmpOperator.Exists:
+            base = jnp.ones(d.n, bool)
+            unres_base = False
+        elif c.op == CmpOperator.Empty:
+            sid = jnp.maximum(d.scalar_id, 0)
+            empty_str = jnp.asarray(d.str_empty_bits)
+            str_is_empty = jnp.where(
+                (kind == STRING) & (d.scalar_id >= 0), empty_str[sid], False
+            )
+            base = jnp.where(
+                (kind == LIST) | (kind == MAP),
+                d.child_count == 0,
+                str_is_empty,
+            )
+            unres_base = True
+        else:
+            target = {
+                CmpOperator.IsString: STRING,
+                CmpOperator.IsList: LIST,
+                CmpOperator.IsMap: MAP,
+                CmpOperator.IsInt: INT,
+                CmpOperator.IsFloat: FLOAT,
+                CmpOperator.IsBool: BOOL,
+                CmpOperator.IsNull: NULL,
+            }[c.op]
+            base = kind == target
+            unres_base = False
+        outcome = base
+        unres_outcome = unres_base
+        if c.op_not:
+            outcome = ~outcome
+            unres_outcome = not unres_outcome
+        if c.negation:
+            outcome = ~outcome
+            unres_outcome = not unres_outcome
+        n_pass = _segment_count(d, sel_leaf, outcome) + (
+            n_unres if unres_outcome else 0
+        )
+        n_fail = total - n_pass
+        if c.match_all:
+            st = jnp.where(n_fail > 0, FAIL, PASS).astype(jnp.int8)
+        else:
+            st = jnp.where(n_pass > 0, PASS, FAIL).astype(jnp.int8)
+        return jnp.where(total == 0, jnp.int8(SKIP), st)
+
+    # binary (eval.rs:765-974; operators.rs) — UnResolved LHS entries FAIL
+    result = _eval_binary_outcomes(d, c, sel_leaf)
+    outcome, active = result
+    if isinstance(outcome, tuple):
+        outcome_all, outcome_any = outcome
+    else:
+        outcome_all = outcome_any = outcome
+    n_pass_all = _segment_count(d, sel_leaf, outcome_all)
+    n_pass_any = _segment_count(d, sel_leaf, outcome_any)
+    n_fail_all = n_res - n_pass_all
+    if c.match_all:
+        n_fail = n_fail_all + n_unres
+        st = jnp.where(n_fail > 0, FAIL, PASS).astype(jnp.int8)
+    else:
+        st = jnp.where(n_pass_any > 0, PASS, FAIL).astype(jnp.int8)
+    return jnp.where(total == 0, jnp.int8(SKIP), st)
+
+
+def eval_node(d: _DocArrays, node, sel, rule_statuses) -> jnp.ndarray:
+    if isinstance(node, CClause):
+        return eval_clause(d, node, sel, rule_statuses)
+    if isinstance(node, CBlockClause):
+        return eval_block_clause(d, node, sel, rule_statuses)
+    if isinstance(node, CWhenBlock):
+        cond = eval_conjunctions(d, node.conditions, sel, rule_statuses)
+        block = eval_conjunctions(d, node.inner, sel, rule_statuses)
+        return jnp.where(cond == PASS, block, jnp.int8(SKIP))
+    if isinstance(node, CNamedRef):
+        st = rule_statuses[node.rule_index]
+        if node.negation:
+            out = jnp.where(st == PASS, jnp.int8(FAIL), jnp.int8(PASS))
+        else:
+            out = jnp.where(st == PASS, jnp.int8(PASS), jnp.int8(FAIL))
+        return jnp.full((d.n + 1,), out, dtype=jnp.int8)
+    raise TypeError(f"unknown node {node!r}")
+
+
+def eval_block_clause(d: _DocArrays, b: CBlockClause, sel, rule_statuses=None):
+    """eval.rs:1303-1426 (+ type blocks, eval.rs:1649-1822)."""
+    unres0 = jnp.zeros(d.n + 1, jnp.int32)
+    leaves, unres = run_steps(d, b.query_steps, sel, unres0, rule_statuses)
+    idx = jnp.arange(d.n, dtype=jnp.int32)
+    inner_sel = jnp.where(leaves > 0, idx + 1, 0)
+    inner_status = eval_conjunctions(d, b.inner, inner_sel, rule_statuses)
+    leaf_status = inner_status[idx + 1]  # (N,) status per leaf node
+    is_leaf = leaves > 0
+    # regroup by OUTER origin (labels carried in `leaves`)
+    n_pass = _segment_count(d, leaves, is_leaf & (leaf_status == PASS))
+    n_fail = _segment_count(d, leaves, is_leaf & (leaf_status == FAIL))
+    n_res = _segment_count(d, leaves, is_leaf)
+    n_fail = n_fail + unres  # unresolved block values count as fails
+    total = n_res + unres
+    if b.match_all:
+        st = jnp.where(
+            n_fail > 0, FAIL, jnp.where(n_pass > 0, PASS, SKIP)
+        ).astype(jnp.int8)
+    else:
+        st = jnp.where(
+            n_pass > 0, PASS, jnp.where(n_fail > 0, FAIL, SKIP)
+        ).astype(jnp.int8)
+    empty_status = FAIL if b.not_empty else SKIP
+    return jnp.where(total == 0, jnp.int8(empty_status), st)
+
+
+def _combine_disjunction(statuses: List[jnp.ndarray]) -> jnp.ndarray:
+    """any PASS -> PASS; else any FAIL -> FAIL; else SKIP
+    (eval.rs:1989-2034)."""
+    any_pass = statuses[0] == PASS
+    any_fail = statuses[0] == FAIL
+    for s in statuses[1:]:
+        any_pass = any_pass | (s == PASS)
+        any_fail = any_fail | (s == FAIL)
+    return jnp.where(
+        any_pass, PASS, jnp.where(any_fail, FAIL, SKIP)
+    ).astype(jnp.int8)
+
+
+def _combine_conjunction(statuses: List[jnp.ndarray]) -> jnp.ndarray:
+    """any FAIL -> FAIL; else any PASS -> PASS; else SKIP
+    (eval.rs:2057-2064)."""
+    any_pass = statuses[0] == PASS
+    any_fail = statuses[0] == FAIL
+    for s in statuses[1:]:
+        any_pass = any_pass | (s == PASS)
+        any_fail = any_fail | (s == FAIL)
+    return jnp.where(
+        any_fail, FAIL, jnp.where(any_pass, PASS, SKIP)
+    ).astype(jnp.int8)
+
+
+def eval_conjunctions(d: _DocArrays, conjunctions, sel, rule_statuses=None):
+    conj_statuses = []
+    for disj in conjunctions:
+        disj_statuses = [eval_node(d, n, sel, rule_statuses) for n in disj]
+        conj_statuses.append(_combine_disjunction(disj_statuses))
+    return _combine_conjunction(conj_statuses)
+
+
+def eval_rule(d: _DocArrays, rule: CRule, rule_statuses) -> jnp.ndarray:
+    """Scalar (int8) status of one rule for one document."""
+    sel_root = jnp.zeros(d.n, jnp.int32).at[0].set(1)
+    body = eval_conjunctions(d, rule.conjunctions, sel_root, rule_statuses)[1]
+    if rule.conditions is not None:
+        cond = eval_conjunctions(d, rule.conditions, sel_root, rule_statuses)[1]
+        return jnp.where(cond == PASS, body, jnp.int8(SKIP))
+    return body
+
+
+def build_doc_evaluator(compiled: CompiledRules):
+    """Returns fn(per-doc arrays dict) -> (num_rules,) int8 statuses."""
+    str_empty = np.asarray(compiled.str_empty_bits)
+
+    def evaluate(arrays: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        d = _DocArrays(arrays, jnp.asarray(str_empty))
+        statuses: List[jnp.ndarray] = []
+        for rule in compiled.rules:
+            statuses.append(eval_rule(d, rule, statuses))
+        if not statuses:
+            return jnp.zeros((0,), jnp.int8)
+        return jnp.stack(statuses)
+
+    return evaluate
+
+
+class BatchEvaluator:
+    """Jit-compiled (docs x rules) status evaluator. One instance per
+    (compiled rule file); retracing happens only per node/edge bucket."""
+
+    def __init__(self, compiled: CompiledRules):
+        self.compiled = compiled
+        self._fn = jax.jit(jax.vmap(build_doc_evaluator(compiled)))
+
+    def __call__(self, batch: DocBatch) -> np.ndarray:
+        """(D, num_rules) int8 statuses: 0 PASS / 1 FAIL / 2 SKIP."""
+        arrays = {k: jnp.asarray(v) for k, v in batch.arrays().items()}
+        return np.asarray(self._fn(arrays))
+
+
+def evaluate_batch(compiled: CompiledRules, batch: DocBatch) -> np.ndarray:
+    return BatchEvaluator(compiled)(batch)
