@@ -1,0 +1,142 @@
+"""Benchmark: templates validated/sec on the batch evaluation engine.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Workload (BASELINE.md config 2 analogue): a security-policy style rule
+set over synthetic CloudFormation templates. `value` is the steady-state
+device throughput of the compiled (docs x rules) kernel (encode done
+once host-side, as in an org-sweep where templates are encoded as they
+stream in). `vs_baseline` is the speedup over the CPU reference
+evaluator (this framework's oracle, same semantics as the reference
+implementation) measured in-process on the same workload — the reference
+publishes no numbers of its own (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+RULES = """
+let s3_buckets = Resources.*[ Type == 'AWS::S3::Bucket' ]
+let volumes = Resources.*[ Type == 'AWS::EC2::Volume' ]
+
+rule s3_bucket_sse when %s3_buckets !empty {
+    %s3_buckets.Properties.BucketEncryption.ServerSideEncryptionConfiguration[*]
+        .ServerSideEncryptionByDefault.SSEAlgorithm IN ['aws:kms', 'AES256']
+}
+
+rule s3_bucket_name when %s3_buckets !empty {
+    %s3_buckets.Properties.BucketName == /^[a-z0-9.-]{3,63}$/ or
+    %s3_buckets.Properties.BucketName !exists
+}
+
+rule volume_encrypted when %volumes !empty {
+    %volumes.Properties.Encrypted == true
+    %volumes.Properties.Size IN r[1,16384]
+}
+
+rule no_public_buckets when %s3_buckets !empty {
+    %s3_buckets.Properties.PublicAccessBlockConfiguration.BlockPublicAcls == true or
+    %s3_buckets.Properties.AccessControl != 'PublicRead'
+}
+"""
+
+
+def make_template(rng, i: int) -> dict:
+    resources = {}
+    for b in range(int(rng.integers(1, 4))):
+        resources[f"bucket{b}"] = {
+            "Type": "AWS::S3::Bucket",
+            "Properties": {
+                "BucketName": f"prod-logs-{i}-{b}",
+                "AccessControl": str(rng.choice(["Private", "PublicRead"])),
+                "PublicAccessBlockConfiguration": {
+                    "BlockPublicAcls": bool(rng.random() < 0.8)
+                },
+                "BucketEncryption": {
+                    "ServerSideEncryptionConfiguration": [
+                        {
+                            "ServerSideEncryptionByDefault": {
+                                "SSEAlgorithm": str(
+                                    rng.choice(["aws:kms", "AES256", "none"])
+                                )
+                            }
+                        }
+                    ]
+                },
+            },
+        }
+    for v in range(int(rng.integers(0, 3))):
+        resources[f"vol{v}"] = {
+            "Type": "AWS::EC2::Volume",
+            "Properties": {
+                "Encrypted": bool(rng.random() < 0.7),
+                "Size": int(rng.integers(1, 20000)),
+            },
+        }
+    return {"Resources": resources}
+
+
+def main() -> None:
+    import jax
+
+    from guard_tpu.core.parser import parse_rules_file
+    from guard_tpu.core.scopes import RootScope
+    from guard_tpu.core.evaluator import eval_rules_file
+    from guard_tpu.core.values import from_plain
+    from guard_tpu.ops.encoder import encode_batch
+    from guard_tpu.ops.ir import compile_rules_file
+    from guard_tpu.ops.kernels import BatchEvaluator
+
+    rng = np.random.default_rng(7)
+    n_docs = 4096
+    rf = parse_rules_file(RULES, "bench.guard")
+    docs = [from_plain(make_template(rng, i)) for i in range(n_docs)]
+
+    batch, interner = encode_batch(docs)
+    compiled = compile_rules_file(rf, interner)
+    assert len(compiled.rules) == 4 and not compiled.host_rules
+
+    evaluator = BatchEvaluator(compiled)
+    import jax.numpy as jnp
+
+    arrays = {k: jax.device_put(jnp.asarray(v)) for k, v in batch.arrays().items()}
+    statuses = evaluator._fn(arrays)  # warm-up: compile
+    jax.block_until_ready(statuses)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        statuses = evaluator._fn(arrays)
+    jax.block_until_ready(statuses)
+    t1 = time.perf_counter()
+    tpu_docs_per_sec = n_docs * iters / (t1 - t0)
+    statuses = np.asarray(statuses)
+
+    # CPU reference-evaluator baseline, measured (BASELINE.md): same
+    # docs x same rules through the oracle
+    n_cpu = 256
+    t0 = time.perf_counter()
+    for doc in docs[:n_cpu]:
+        scope = RootScope(rf, doc)
+        eval_rules_file(rf, scope, None)
+    t1 = time.perf_counter()
+    cpu_docs_per_sec = n_cpu / (t1 - t0)
+
+    print(
+        json.dumps(
+            {
+                "metric": "templates_validated_per_sec_per_chip",
+                "value": round(tpu_docs_per_sec, 1),
+                "unit": "templates/sec",
+                "vs_baseline": round(tpu_docs_per_sec / cpu_docs_per_sec, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,149 @@
+"""`validate --backend=tpu`: batch evaluation with CPU fail-rerun.
+
+The integration point between the command layer and the JAX engine
+(BASELINE.json north star: "gated behind the ffi boundary and surfaced
+as `validate --backend=tpu`"):
+
+  1. encode all data files into one columnar batch (shared interner);
+  2. lower each rule file; rules outside kernel coverage stay on the
+     CPU oracle (host_rules);
+  3. evaluate the (docs x rules) batch on the mesh — statuses only;
+  4. re-run only documents that need rich reports (failures, verbose or
+     structured output) through the CPU oracle — the "fail-rerun" design
+     (SURVEY.md §7 hard-part 6) that keeps kernels lean while reports
+     stay bit-identical to the reference path.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from ..core.errors import GuardError
+from ..core.evaluator import eval_rules_file
+from ..core.qresult import Status
+from ..core.scopes import RootScope
+from ..utils.io import Writer
+from .encoder import encode_batch
+from .ir import FAIL, PASS, SKIP, compile_rules_file
+from ..commands.report import rule_statuses_from_root, simplified_report_from_root
+
+_STATUS = {PASS: Status.PASS, FAIL: Status.FAIL, SKIP: Status.SKIP}
+
+
+def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
+    """Drop-in body for Validate.execute's evaluation loop."""
+    from ..commands.validate import (
+        ERROR_STATUS_CODE,
+        FAILURE_STATUS_CODE,
+        SUCCESS_STATUS_CODE,
+    )
+    from ..commands.reporters.console import single_line_summary, summary_table
+    from ..commands.reporters.junit import JunitTestCase, write_junit
+    from ..commands.reporters.sarif import write_sarif
+    from ..commands.reporters.structured import write_structured
+    from ..parallel.mesh import ShardedBatchEvaluator
+
+    docs = [df.path_value for df in data_files]
+    if not docs or not rule_files:
+        return SUCCESS_STATUS_CODE
+
+    batch, interner = encode_batch(docs)
+
+    errors = 0
+    had_fail = False
+    all_reports: List[dict] = []
+    junit_suites = {}
+
+    for rule_file in rule_files:
+        compiled = compile_rules_file(rule_file.rules, interner)
+        statuses = None
+        if compiled.rules:
+            evaluator = ShardedBatchEvaluator(compiled)
+            statuses = evaluator(batch)  # (D, R)
+
+        cases: List[JunitTestCase] = []
+        for di, data_file in enumerate(data_files):
+            rule_statuses = {}
+            doc_status = Status.SKIP
+            if statuses is not None:
+                for ri, crule in enumerate(compiled.rules):
+                    st = _STATUS[int(statuses[di, ri])]
+                    rule_statuses[crule.name] = st
+                    doc_status = doc_status.and_(st)
+
+            # host fallback for unlowerable rules + rich reporting:
+            # rerun the oracle when anything failed or output needs detail
+            need_oracle = (
+                bool(compiled.host_rules)
+                or validate.structured
+                or validate.verbose
+                or validate.print_json
+                or any(s == Status.FAIL for s in rule_statuses.values())
+            )
+            report = {
+                "name": data_file.name,
+                "metadata": {},
+                "status": doc_status.value,
+                "not_compliant": [],
+                "not_applicable": sorted(
+                    n for n, s in rule_statuses.items() if s == Status.SKIP
+                ),
+                "compliant": sorted(
+                    n for n, s in rule_statuses.items() if s == Status.PASS
+                ),
+            }
+            if need_oracle:
+                try:
+                    scope = RootScope(rule_file.rules, data_file.path_value)
+                    oracle_status = eval_rules_file(
+                        rule_file.rules, scope, data_file.name
+                    )
+                except GuardError as e:
+                    writer.writeln_err(str(e))
+                    errors += 1
+                    continue
+                root_record = scope.reset_recorder().extract()
+                report = simplified_report_from_root(root_record, data_file.name)
+                oracle_rule_statuses = rule_statuses_from_root(root_record)
+                # parity assertion: kernel statuses must agree with oracle
+                for rn, st in rule_statuses.items():
+                    ost = oracle_rule_statuses.get(rn)
+                    if ost is not None and ost != st:
+                        raise GuardError(
+                            f"TPU/CPU status divergence for rule {rn} on "
+                            f"{data_file.name}: tpu={st.value} cpu={ost.value}"
+                        )
+                rule_statuses = oracle_rule_statuses
+                doc_status = oracle_status
+
+            if doc_status == Status.FAIL:
+                had_fail = True
+            all_reports.append(report)
+            for rn, rs in rule_statuses.items():
+                cases.append(JunitTestCase(name=f"{rn}-{data_file.name}", status=rs))
+
+            if not validate.structured:
+                single_line_summary(
+                    writer, data_file.name, rule_file.name, doc_status, report, rule_statuses
+                )
+                show = set(validate.show_summary)
+                if "all" in show:
+                    show = {"pass", "fail", "skip"}
+                if show and show != {"none"}:
+                    summary_table(writer, rule_file.name, data_file.name, rule_statuses, show)
+        junit_suites[rule_file.name] = cases
+
+    if validate.structured:
+        if validate.output_format in ("json", "yaml"):
+            write_structured(writer, all_reports, validate.output_format)
+        elif validate.output_format == "sarif":
+            write_sarif(writer, all_reports)
+        elif validate.output_format == "junit":
+            write_junit(writer, junit_suites)
+
+    if errors > 0:
+        return ERROR_STATUS_CODE
+    if had_fail:
+        return FAILURE_STATUS_CODE
+    return SUCCESS_STATUS_CODE

@@ -1,0 +1,118 @@
+"""Device-mesh sharding for batch policy evaluation.
+
+The reference has no parallelism at all — evaluation of N docs x M rule
+files is a sequential double loop (`/root/reference/guard/src/commands/
+validate.rs:406-434` outer, `:718-756` inner; SURVEY.md §2.3). Here the
+document axis is the data-parallel axis:
+
+  * a 1-D `jax.sharding.Mesh` over all devices with axis "docs";
+  * every DocBatch array is sharded on its leading doc axis with
+    `NamedSharding(P("docs"))`; rule programs are replicated (they are
+    compile-time constants baked into the jaxpr);
+  * the per-doc evaluator is `vmap`'d and jitted with sharded in/out
+    specs, so XLA partitions the whole computation SPMD across the mesh
+    — per-chip work is purely local, and only the final pass/fail count
+    reduction crosses chips (`jnp.sum` -> psum over ICI/DCN);
+  * multi-host: the same code runs under `jax.distributed` since all
+    collectives are XLA-inserted.
+
+Rule-axis parallelism (huge registries) composes on top by splitting the
+compiled-rule list across a second mesh axis; statuses concatenate.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.encoder import DocBatch
+from ..ops.ir import CompiledRules
+from ..ops.kernels import build_doc_evaluator
+
+DOC_AXIS = "docs"
+
+
+def default_mesh(devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (DOC_AXIS,))
+
+
+def pad_to_multiple(batch_arrays: Dict[str, np.ndarray], multiple: int) -> Tuple[Dict[str, np.ndarray], int]:
+    """Pad the doc axis so it divides the mesh; returns (arrays, orig_d)."""
+    d = next(iter(batch_arrays.values())).shape[0]
+    target = ((d + multiple - 1) // multiple) * multiple
+    if target == d:
+        return batch_arrays, d
+    out = {}
+    for k, v in batch_arrays.items():
+        pad = np.zeros((target - d,) + v.shape[1:], dtype=v.dtype)
+        if k == "node_kind":
+            pad = pad - 1  # padding docs are all-padding nodes
+        out[k] = np.concatenate([v, pad], axis=0)
+    return out, d
+
+
+class ShardedBatchEvaluator:
+    """DP-sharded (docs x rules) status evaluator over a device mesh."""
+
+    def __init__(self, compiled: CompiledRules, mesh: Optional[Mesh] = None):
+        self.compiled = compiled
+        self.mesh = mesh if mesh is not None else default_mesh()
+        doc_eval = build_doc_evaluator(compiled)
+        in_spec = NamedSharding(self.mesh, P(DOC_AXIS))
+        out_spec = NamedSharding(self.mesh, P(DOC_AXIS))
+        self._fn = jax.jit(
+            jax.vmap(doc_eval),
+            in_shardings=({k: in_spec for k in _ARRAY_KEYS},),
+            out_shardings=out_spec,
+        )
+        # aggregate summary: per-rule (n_pass, n_fail, n_skip) — the only
+        # cross-chip reduction (SURVEY.md §2.3 "communication backend");
+        # n_valid masks out docs added by mesh padding
+        def summarize(arrays, n_valid):
+            statuses = jax.vmap(doc_eval)(arrays)  # (D, R) int8
+            valid = (jnp.arange(statuses.shape[0]) < n_valid)[:, None]
+            counts = jnp.stack(
+                [
+                    jnp.sum((statuses == 0) & valid, axis=0),
+                    jnp.sum((statuses == 1) & valid, axis=0),
+                    jnp.sum((statuses == 2) & valid, axis=0),
+                ]
+            )
+            return statuses, counts
+
+        self._summary_fn = jax.jit(
+            summarize,
+            in_shardings=({k: in_spec for k in _ARRAY_KEYS}, None),
+            out_shardings=(out_spec, NamedSharding(self.mesh, P())),
+        )
+
+    def __call__(self, batch: DocBatch) -> np.ndarray:
+        arrays, d = pad_to_multiple(batch.arrays(), self.mesh.devices.size)
+        arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
+        out = self._fn(arrays)
+        return np.asarray(out)[:d]
+
+    def with_summary(self, batch: DocBatch) -> Tuple[np.ndarray, np.ndarray]:
+        arrays, d = pad_to_multiple(batch.arrays(), self.mesh.devices.size)
+        arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
+        statuses, counts = self._summary_fn(arrays, d)
+        return np.asarray(statuses)[:d], np.asarray(counts)
+
+
+_ARRAY_KEYS = (
+    "node_kind",
+    "node_parent",
+    "scalar_id",
+    "num_val",
+    "child_count",
+    "edge_parent",
+    "edge_child",
+    "edge_key_id",
+    "edge_index",
+    "edge_valid",
+)
