@@ -48,7 +48,22 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
     if not docs or not rule_files:
         return SUCCESS_STATUS_CODE
 
-    batch, interner = encode_batch(docs)
+    batch = interner = None
+    if all(df.content.lstrip()[:1] in ("{", "[") for df in data_files):
+        # JSON corpus: the native C++ data loader (native/encoder.cpp)
+        from .native_encoder import encode_json_batch_native, native_available
+
+        if native_available():
+            try:
+                batch, interner, err = encode_json_batch_native(
+                    [df.content for df in data_files]
+                )
+                if err is not None:
+                    batch = interner = None
+            except RuntimeError:
+                pass
+    if batch is None:
+        batch, interner = encode_batch(docs)
 
     errors = 0
     had_fail = False

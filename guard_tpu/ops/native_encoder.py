@@ -1,0 +1,137 @@
+"""ctypes bindings for the native C++ columnar encoder.
+
+`encode_json_batch_native` parses a list of JSON document strings in C++
+(native/encoder.cpp) and returns the same `DocBatch` + `Interner` pair
+as the Python encoder (guard_tpu/ops/encoder.py), ~an order of magnitude
+faster — the org-sweep data-loader path. Falls back transparently when
+the shared library hasn't been built (`native/build.sh`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .encoder import DocBatch, Interner
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_SO_PATH = _NATIVE_DIR / "libguard_encoder.so"
+
+
+class _EncodedBatchStruct(ctypes.Structure):
+    _fields_ = [
+        ("n_docs", ctypes.c_int32),
+        ("n_nodes", ctypes.c_int32),
+        ("n_edges", ctypes.c_int32),
+        ("n_strings", ctypes.c_int32),
+        ("node_kind", ctypes.POINTER(ctypes.c_int32)),
+        ("node_parent", ctypes.POINTER(ctypes.c_int32)),
+        ("scalar_id", ctypes.POINTER(ctypes.c_int32)),
+        ("num_val", ctypes.POINTER(ctypes.c_float)),
+        ("child_count", ctypes.POINTER(ctypes.c_int32)),
+        ("edge_parent", ctypes.POINTER(ctypes.c_int32)),
+        ("edge_child", ctypes.POINTER(ctypes.c_int32)),
+        ("edge_key_id", ctypes.POINTER(ctypes.c_int32)),
+        ("edge_index", ctypes.POINTER(ctypes.c_int32)),
+        ("edge_valid", ctypes.POINTER(ctypes.c_uint8)),
+        ("string_blob", ctypes.POINTER(ctypes.c_char)),
+        ("string_blob_len", ctypes.c_int64),
+        ("error_doc", ctypes.c_int32),
+    ]
+
+
+_lib = None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not _SO_PATH.exists():
+        return None
+    lib = ctypes.CDLL(str(_SO_PATH))
+    lib.guard_encode_json_batch.restype = ctypes.POINTER(_EncodedBatchStruct)
+    lib.guard_encode_json_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.c_int32,
+    ]
+    lib.guard_batch_free.argtypes = [ctypes.POINTER(_EncodedBatchStruct)]
+    lib.guard_batch_free.restype = None
+    _lib = lib
+    return lib
+
+
+def build_native(force: bool = False) -> bool:
+    """Compile the shared library via native/build.sh."""
+    if _SO_PATH.exists() and not force:
+        return True
+    try:
+        subprocess.run(
+            ["sh", str(_NATIVE_DIR / "build.sh")],
+            check=True,
+            capture_output=True,
+        )
+    except (subprocess.CalledProcessError, OSError):
+        return False
+    return _SO_PATH.exists()
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def encode_json_batch_native(
+    docs: List[str],
+) -> Tuple[DocBatch, Interner, Optional[int]]:
+    """Encode JSON strings natively. Returns (batch, interner,
+    error_doc_index-or-None). Raises RuntimeError if the library is
+    unavailable."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(
+            "native encoder not built; run native/build.sh or use the "
+            "python encoder"
+        )
+    n = len(docs)
+    arr = (ctypes.c_char_p * n)(*[d.encode("utf-8") for d in docs])
+    ptr = lib.guard_encode_json_batch(arr, n)
+    try:
+        b = ptr.contents
+        nn = b.n_docs * b.n_nodes
+        ne = b.n_docs * b.n_edges
+
+        def np_copy(cptr, count, dtype):
+            return np.ctypeslib.as_array(cptr, shape=(count,)).astype(dtype, copy=True)
+
+        shape_n = (b.n_docs, b.n_nodes)
+        shape_e = (b.n_docs, b.n_edges)
+        batch = DocBatch(
+            node_kind=np_copy(b.node_kind, nn, np.int32).reshape(shape_n),
+            node_parent=np_copy(b.node_parent, nn, np.int32).reshape(shape_n),
+            scalar_id=np_copy(b.scalar_id, nn, np.int32).reshape(shape_n),
+            num_val=np_copy(b.num_val, nn, np.float32).reshape(shape_n),
+            child_count=np_copy(b.child_count, nn, np.int32).reshape(shape_n),
+            edge_parent=np_copy(b.edge_parent, ne, np.int32).reshape(shape_e),
+            edge_child=np_copy(b.edge_child, ne, np.int32).reshape(shape_e),
+            edge_key_id=np_copy(b.edge_key_id, ne, np.int32).reshape(shape_e),
+            edge_index=np_copy(b.edge_index, ne, np.int32).reshape(shape_e),
+            edge_valid=np_copy(b.edge_valid, ne, np.uint8)
+            .reshape(shape_e)
+            .astype(bool),
+            n_docs=b.n_docs,
+            n_nodes=b.n_nodes,
+            n_edges=b.n_edges,
+        )
+        blob = ctypes.string_at(b.string_blob, b.string_blob_len)
+        strings = blob.decode("utf-8").split("\x00")[:-1] if b.string_blob_len else []
+        interner = Interner()
+        for s in strings:
+            interner.intern(s)
+        error_doc = b.error_doc if b.error_doc >= 0 else None
+        return batch, interner, error_doc
+    finally:
+        lib.guard_batch_free(ptr)

@@ -1,0 +1,6 @@
+#!/bin/sh
+# Build the native columnar encoder -> native/libguard_encoder.so
+set -e
+cd "$(dirname "$0")"
+g++ -O2 -fPIC -shared -std=c++17 -o libguard_encoder.so encoder.cpp
+echo "built $(pwd)/libguard_encoder.so"

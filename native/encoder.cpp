@@ -1,0 +1,396 @@
+// guard-tpu native columnar encoder.
+//
+// The data-loader hot path: parses JSON documents and emits the columnar
+// node/edge arrays + shared string-intern table consumed by the JAX
+// kernels (guard_tpu/ops/encoder.py documents the layout). This replaces
+// the Python encoder for org-scale sweeps, playing the role the
+// Rust/libyaml loader plays in the reference
+// (/root/reference/guard/src/rules/libyaml/, values.rs:444).
+//
+// C ABI (used from Python via ctypes, guard_tpu/ops/native_encoder.py):
+//   guard_encode_json_batch(docs, n_docs) -> EncodedBatch*
+//   guard_batch_free(EncodedBatch*)
+//
+// Build: native/build.sh -> libguard_encoder.so
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// value kinds — must match guard_tpu/core/values.py
+// ---------------------------------------------------------------------------
+enum Kind : int32_t {
+  K_NULL = 0,
+  K_STRING = 1,
+  K_BOOL = 3,
+  K_INT = 4,
+  K_FLOAT = 5,
+  K_LIST = 7,
+  K_MAP = 8,
+};
+
+struct Interner {
+  std::unordered_map<std::string, int32_t> ids;
+  std::vector<std::string> strings;
+
+  int32_t intern(const std::string& s) {
+    auto it = ids.find(s);
+    if (it != ids.end()) return it->second;
+    int32_t id = static_cast<int32_t>(strings.size());
+    ids.emplace(s, id);
+    strings.push_back(s);
+    return id;
+  }
+};
+
+struct DocColumns {
+  std::vector<int32_t> node_kind, node_parent, scalar_id, child_count;
+  std::vector<double> num_val;
+  std::vector<int32_t> edge_parent, edge_child, edge_key_id, edge_index;
+};
+
+// ---------------------------------------------------------------------------
+// Minimal recursive-descent JSON parser writing columns directly.
+// ---------------------------------------------------------------------------
+struct Parser {
+  const char* p;
+  const char* end;
+  DocColumns* out;
+  Interner* interner;
+  bool ok = true;
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++;
+  }
+
+  bool parse_string_raw(std::string& s) {
+    if (p >= end || *p != '"') return false;
+    p++;
+    s.clear();
+    while (p < end && *p != '"') {
+      char c = *p++;
+      if (c == '\\' && p < end) {
+        char e = *p++;
+        switch (e) {
+          case 'n': s.push_back('\n'); break;
+          case 't': s.push_back('\t'); break;
+          case 'r': s.push_back('\r'); break;
+          case 'b': s.push_back('\b'); break;
+          case 'f': s.push_back('\f'); break;
+          case '/': s.push_back('/'); break;
+          case '\\': s.push_back('\\'); break;
+          case '"': s.push_back('"'); break;
+          case 'u': {
+            if (end - p < 4) return false;
+            unsigned code = 0;
+            for (int i = 0; i < 4; i++) {
+              char h = *p++;
+              code <<= 4;
+              if (h >= '0' && h <= '9') code |= h - '0';
+              else if (h >= 'a' && h <= 'f') code |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
+              else return false;
+            }
+            // UTF-8 encode (BMP only; surrogate pairs kept as-is)
+            if (code < 0x80) {
+              s.push_back(static_cast<char>(code));
+            } else if (code < 0x800) {
+              s.push_back(static_cast<char>(0xC0 | (code >> 6)));
+              s.push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            } else {
+              s.push_back(static_cast<char>(0xE0 | (code >> 12)));
+              s.push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+              s.push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            }
+            break;
+          }
+          default: return false;
+        }
+      } else {
+        s.push_back(c);
+      }
+    }
+    if (p >= end) return false;
+    p++;  // closing quote
+    return true;
+  }
+
+  int32_t new_node(int32_t kind, int32_t parent) {
+    int32_t idx = static_cast<int32_t>(out->node_kind.size());
+    out->node_kind.push_back(kind);
+    out->node_parent.push_back(parent);
+    out->scalar_id.push_back(-1);
+    out->num_val.push_back(0.0);
+    out->child_count.push_back(0);
+    return idx;
+  }
+
+  // returns node index or -1 on failure
+  int32_t parse_value(int32_t parent) {
+    skip_ws();
+    if (p >= end) return -1;
+    char c = *p;
+    if (c == '{') return parse_map(parent);
+    if (c == '[') return parse_list(parent);
+    if (c == '"') {
+      std::string s;
+      if (!parse_string_raw(s)) return -1;
+      int32_t idx = new_node(K_STRING, parent);
+      out->scalar_id[idx] = interner->intern(s);
+      return idx;
+    }
+    if (c == 't' && end - p >= 4 && strncmp(p, "true", 4) == 0) {
+      p += 4;
+      int32_t idx = new_node(K_BOOL, parent);
+      out->num_val[idx] = 1.0;
+      return idx;
+    }
+    if (c == 'f' && end - p >= 5 && strncmp(p, "false", 5) == 0) {
+      p += 5;
+      return new_node(K_BOOL, parent);
+    }
+    if (c == 'n' && end - p >= 4 && strncmp(p, "null", 4) == 0) {
+      p += 4;
+      return new_node(K_NULL, parent);
+    }
+    // number
+    const char* start = p;
+    bool is_float = false;
+    if (p < end && (*p == '-' || *p == '+')) p++;
+    while (p < end &&
+           ((*p >= '0' && *p <= '9') || *p == '.' || *p == 'e' || *p == 'E' ||
+            *p == '+' || *p == '-')) {
+      if (*p == '.' || *p == 'e' || *p == 'E') is_float = true;
+      p++;
+    }
+    if (p == start) return -1;
+    std::string num(start, p - start);
+    char* endp = nullptr;
+    double v = strtod(num.c_str(), &endp);
+    if (endp == num.c_str()) return -1;
+    int32_t idx = new_node(is_float ? K_FLOAT : K_INT, parent);
+    out->num_val[idx] = v;
+    return idx;
+  }
+
+  int32_t parse_map(int32_t parent) {
+    p++;  // '{'
+    int32_t idx = new_node(K_MAP, parent);
+    skip_ws();
+    if (p < end && *p == '}') {
+      p++;
+      return idx;
+    }
+    int32_t count = 0;
+    while (p < end) {
+      skip_ws();
+      std::string key;
+      if (!parse_string_raw(key)) return -1;
+      skip_ws();
+      if (p >= end || *p != ':') return -1;
+      p++;
+      int32_t child = parse_value(idx);
+      if (child < 0) return -1;
+      out->edge_parent.push_back(idx);
+      out->edge_child.push_back(child);
+      out->edge_key_id.push_back(interner->intern(key));
+      out->edge_index.push_back(-1);
+      count++;
+      skip_ws();
+      if (p < end && *p == ',') {
+        p++;
+        continue;
+      }
+      if (p < end && *p == '}') {
+        p++;
+        out->child_count[idx] = count;
+        return idx;
+      }
+      return -1;
+    }
+    return -1;
+  }
+
+  int32_t parse_list(int32_t parent) {
+    p++;  // '['
+    int32_t idx = new_node(K_LIST, parent);
+    skip_ws();
+    if (p < end && *p == ']') {
+      p++;
+      return idx;
+    }
+    int32_t count = 0;
+    while (p < end) {
+      int32_t child = parse_value(idx);
+      if (child < 0) return -1;
+      out->edge_parent.push_back(idx);
+      out->edge_child.push_back(child);
+      out->edge_key_id.push_back(-1);
+      out->edge_index.push_back(count);
+      count++;
+      skip_ws();
+      if (p < end && *p == ',') {
+        p++;
+        continue;
+      }
+      if (p < end && *p == ']') {
+        p++;
+        out->child_count[idx] = count;
+        return idx;
+      }
+      return -1;
+    }
+    return -1;
+  }
+};
+
+int32_t round_up(int32_t n, int32_t m) {
+  if (n < m) return m;
+  return ((n + m - 1) / m) * m;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+extern "C" {
+
+struct EncodedBatch {
+  int32_t n_docs;
+  int32_t n_nodes;  // padded node capacity
+  int32_t n_edges;  // padded edge capacity
+  int32_t n_strings;
+  // (n_docs * n_nodes) row-major
+  int32_t* node_kind;
+  int32_t* node_parent;
+  int32_t* scalar_id;
+  float* num_val;
+  int32_t* child_count;
+  // (n_docs * n_edges)
+  int32_t* edge_parent;
+  int32_t* edge_child;
+  int32_t* edge_key_id;
+  int32_t* edge_index;
+  uint8_t* edge_valid;
+  // intern table: concatenated NUL-terminated strings
+  char* string_blob;
+  int64_t string_blob_len;
+  int32_t error_doc;  // -1 ok; else index of first unparseable doc
+};
+
+EncodedBatch* guard_encode_json_batch(const char** docs, int32_t n_docs) {
+  Interner interner;
+  std::vector<DocColumns> cols(n_docs);
+  int32_t max_nodes = 1, max_edges = 1;
+  int32_t error_doc = -1;
+
+  for (int32_t i = 0; i < n_docs; i++) {
+    Parser parser;
+    parser.p = docs[i];
+    parser.end = docs[i] + strlen(docs[i]);
+    parser.out = &cols[i];
+    parser.interner = &interner;
+    int32_t root = parser.parse_value(-1);
+    parser.skip_ws();
+    if (root < 0 || parser.p != parser.end) {
+      if (error_doc < 0) error_doc = i;
+      cols[i] = DocColumns{};  // empty doc placeholder
+      continue;
+    }
+    max_nodes = std::max(max_nodes, static_cast<int32_t>(cols[i].node_kind.size()));
+    max_edges = std::max(max_edges, static_cast<int32_t>(cols[i].edge_parent.size()));
+  }
+
+  const int32_t N = round_up(max_nodes, 8);
+  const int32_t E = round_up(max_edges, 8);
+
+  auto* b = new EncodedBatch();
+  b->n_docs = n_docs;
+  b->n_nodes = N;
+  b->n_edges = E;
+  b->n_strings = static_cast<int32_t>(interner.strings.size());
+  b->error_doc = error_doc;
+
+  const int64_t nn = static_cast<int64_t>(n_docs) * N;
+  const int64_t ne = static_cast<int64_t>(n_docs) * E;
+  b->node_kind = new int32_t[nn];
+  b->node_parent = new int32_t[nn];
+  b->scalar_id = new int32_t[nn];
+  b->num_val = new float[nn];
+  b->child_count = new int32_t[nn];
+  b->edge_parent = new int32_t[ne];
+  b->edge_child = new int32_t[ne];
+  b->edge_key_id = new int32_t[ne];
+  b->edge_index = new int32_t[ne];
+  b->edge_valid = new uint8_t[ne];
+
+  std::fill_n(b->node_kind, nn, -1);
+  std::fill_n(b->node_parent, nn, -1);
+  std::fill_n(b->scalar_id, nn, -1);
+  std::fill_n(b->num_val, nn, 0.0f);
+  std::fill_n(b->child_count, nn, 0);
+  std::fill_n(b->edge_parent, ne, 0);
+  std::fill_n(b->edge_child, ne, 0);
+  std::fill_n(b->edge_key_id, ne, -2);
+  std::fill_n(b->edge_index, ne, -2);
+  std::fill_n(b->edge_valid, ne, 0);
+
+  for (int32_t i = 0; i < n_docs; i++) {
+    const DocColumns& c = cols[i];
+    const int64_t no = static_cast<int64_t>(i) * N;
+    const int64_t eo = static_cast<int64_t>(i) * E;
+    for (size_t j = 0; j < c.node_kind.size(); j++) {
+      b->node_kind[no + j] = c.node_kind[j];
+      b->node_parent[no + j] = c.node_parent[j];
+      b->scalar_id[no + j] = c.scalar_id[j];
+      b->num_val[no + j] = static_cast<float>(c.num_val[j]);
+      b->child_count[no + j] = c.child_count[j];
+    }
+    for (size_t j = 0; j < c.edge_parent.size(); j++) {
+      b->edge_parent[eo + j] = c.edge_parent[j];
+      b->edge_child[eo + j] = c.edge_child[j];
+      b->edge_key_id[eo + j] = c.edge_key_id[j];
+      b->edge_index[eo + j] = c.edge_index[j];
+      b->edge_valid[eo + j] = 1;
+    }
+  }
+
+  int64_t blob_len = 0;
+  for (const auto& s : interner.strings) blob_len += static_cast<int64_t>(s.size()) + 1;
+  b->string_blob = new char[std::max<int64_t>(blob_len, 1)];
+  b->string_blob_len = blob_len;
+  {
+    char* w = b->string_blob;
+    for (const auto& s : interner.strings) {
+      memcpy(w, s.data(), s.size());
+      w += s.size();
+      *w++ = '\0';
+    }
+  }
+  return b;
+}
+
+void guard_batch_free(EncodedBatch* b) {
+  if (!b) return;
+  delete[] b->node_kind;
+  delete[] b->node_parent;
+  delete[] b->scalar_id;
+  delete[] b->num_val;
+  delete[] b->child_count;
+  delete[] b->edge_parent;
+  delete[] b->edge_child;
+  delete[] b->edge_key_id;
+  delete[] b->edge_index;
+  delete[] b->edge_valid;
+  delete[] b->string_blob;
+  delete b;
+}
+
+}  // extern "C"
