@@ -1,0 +1,30 @@
+"""AWS-Lambda-style handler.
+
+Equivalent of `/root/reference/guard-lambda/src/main.rs:41-66`: the
+event carries `{"data": "<doc string>", "rules": ["<rules string>", ...],
+"verbose": bool}`; each rules string is validated against the data via
+`run_checks` and the parsed JSON results are returned as
+`{"message": [...]}`.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+from .api import run_checks
+from .core.errors import GuardError
+
+
+def handler(event: Dict[str, Any], context: Any = None) -> Dict[str, List]:
+    data = event.get("data", "")
+    rules = event.get("rules", [])
+    verbose = bool(event.get("verbose", False))
+    results = []
+    for each_rule in rules:
+        try:
+            out = run_checks(data, each_rule, verbose)
+        except GuardError as e:
+            raise ValueError(str(e))
+        results.append(json.loads(out) if out else None)
+    return {"message": results}

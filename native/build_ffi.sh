@@ -1,0 +1,9 @@
+#!/bin/sh
+# Build the C-ABI shim -> native/libguard_ffi.so (+ test binary)
+set -e
+cd "$(dirname "$0")"
+CFLAGS="$(python3-config --includes)"
+LDFLAGS="$(python3-config --ldflags --embed)"
+gcc -O2 -fPIC -shared $CFLAGS guard_ffi.c -o libguard_ffi.so $LDFLAGS
+gcc -O2 -DGUARD_FFI_TEST_MAIN $CFLAGS guard_ffi.c -o guard_ffi_test $LDFLAGS
+echo "built $(pwd)/libguard_ffi.so and guard_ffi_test"

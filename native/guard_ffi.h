@@ -1,0 +1,40 @@
+/* guard-tpu C ABI.
+ *
+ * Equivalent of the reference's guard-ffi crate
+ * (/root/reference/guard-ffi/src/lib.rs:32-47): one-shot validate over
+ * (data, rules) strings returning a JSON report string, plus the string
+ * destructor. The implementation embeds the guard-tpu engine.
+ */
+#ifndef GUARD_TPU_FFI_H
+#define GUARD_TPU_FFI_H
+
+#include <stdbool.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct {
+  const char* content;
+  const char* file_name;
+} guard_validate_input_t;
+
+typedef struct {
+  int32_t code;      /* 0 = ok */
+  char* message;     /* owned; free with guard_tpu_free_string */
+} guard_extern_err_t;
+
+/* Evaluate `rules` against `data`; returns an owned JSON report string
+ * (free with guard_tpu_free_string) or NULL on error (err filled in). */
+char* guard_tpu_run_checks(guard_validate_input_t data,
+                           guard_validate_input_t rules, bool verbose,
+                           guard_extern_err_t* err);
+
+void guard_tpu_free_string(char* s);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* GUARD_TPU_FFI_H */

@@ -1,0 +1,35 @@
+"""pre-commit hook entry point.
+
+Equivalent of `/root/reference/pre_commit_hooks/cfn_guard.py`: exposes
+the `validate` and `test` commands to pre-commit. Unlike the reference
+(which downloads a pinned release binary per-OS), this framework is a
+Python package, so the hook simply invokes the in-process CLI —
+no network access, no binary management.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Sequence
+
+UNKNOWN_OPERATION_MSG = (
+    "Unknown operation. guard-tpu pre-commit-hook only supports validate "
+    "and test commands."
+)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="guard-tpu-hook", add_help=False)
+    parser.add_argument("--operation", default="validate")
+    args, rest = parser.parse_known_args(argv)
+    if args.operation not in ("validate", "test"):
+        print(UNKNOWN_OPERATION_MSG, file=sys.stderr)
+        return 1
+    from guard_tpu.cli import run
+
+    return run([args.operation, *rest])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,249 @@
+"""CLI integration tests mirroring the reference harness
+(`/root/reference/guard/tests/utils.rs:9-56`): build the command from
+argv, inject buffered Reader/Writer, assert the exit-code protocol
+(validate 0/19/5, test 0/7/1) and key output fragments."""
+
+import json
+import pathlib
+
+import pytest
+
+from guard_tpu.cli import run
+from guard_tpu.utils.io import Reader, Writer
+
+RES = pathlib.Path("/root/reference/guard/resources")
+EX = pathlib.Path("/root/reference/guard-examples")
+
+
+def run_cli(args, stdin=""):
+    w = Writer.buffered()
+    code = run(args, writer=w, reader=Reader.from_string(stdin))
+    return code, w.stripped(), w.err_to_stripped()
+
+
+def test_validate_pass_exit_0():
+    code, out, _ = run_cli(
+        [
+            "validate",
+            "-r", str(RES / "validate" / "rules-dir" / "s3_bucket_public_read_prohibited.guard"),
+            "-d", str(RES / "validate" / "data-dir" / "s3-public-read-prohibited-template-compliant.yaml"),
+        ]
+    )
+    assert code == 0
+    assert "Status = PASS" in out
+
+
+def test_validate_fail_exit_19():
+    code, out, _ = run_cli(
+        [
+            "validate",
+            "-r", str(RES / "validate" / "rules-dir" / "s3_bucket_public_read_prohibited.guard"),
+            "-d", str(RES / "validate" / "data-dir" / "s3-public-read-prohibited-template-non-compliant.yaml"),
+        ]
+    )
+    assert code == 19
+    assert "Status = FAIL" in out
+
+
+def test_validate_undefined_variable_exit_5():
+    # malformed-rule.guard references an undefined variable: the
+    # reference errors at evaluation time (validate.rs:187 expects
+    # INTERNAL_FAILURE = 5)
+    code, _out, err = run_cli(
+        [
+            "validate",
+            "-r", str(RES / "validate" / "malformed-rule.guard"),
+            "-d", str(RES / "validate" / "data-dir" / "s3-public-read-prohibited-template-compliant.yaml"),
+        ]
+    )
+    assert code == 5
+    assert "Could not resolve variable" in err
+
+
+def test_validate_invalid_rule_parse_error_exit_5():
+    code, _out, err = run_cli(
+        [
+            "validate",
+            "-r", str(RES / "test-command" / "rule-dir" / "invalid_rule.guard"),
+            "-d", str(RES / "validate" / "data-dir" / "s3-public-read-prohibited-template-compliant.yaml"),
+        ]
+    )
+    assert code == 5
+    assert "Parse Error" in err
+
+
+def test_validate_structured_json():
+    code, out, _ = run_cli(
+        [
+            "validate", "--structured", "-o", "json", "-S", "none",
+            "-r", str(RES / "validate" / "rules-dir" / "s3_bucket_server_side_encryption_enabled.guard"),
+            "-d", str(RES / "validate" / "data-dir" / "s3-server-side-encryption-template-compliant.yaml"),
+        ]
+    )
+    assert code == 0
+    reports = json.loads(out)
+    assert isinstance(reports, list) and reports[0]["status"] == "PASS"
+    assert set(reports[0]) >= {"name", "status", "not_compliant", "compliant", "not_applicable"}
+
+
+def test_validate_sarif_output():
+    code, out, _ = run_cli(
+        [
+            "validate", "--structured", "-o", "sarif", "-S", "none",
+            "-r", str(RES / "validate" / "rules-dir" / "s3_bucket_server_side_encryption_enabled.guard"),
+            "-d", str(RES / "validate" / "data-dir" / "s3-server-side-encryption-template-non-compliant.yaml"),
+        ]
+    )
+    assert code == 19
+    sarif = json.loads(out)
+    assert sarif["version"] == "2.1.0"
+    assert sarif["runs"][0]["results"]
+
+
+def test_validate_junit_output():
+    code, out, _ = run_cli(
+        [
+            "validate", "--structured", "-o", "junit", "-S", "none",
+            "-r", str(RES / "validate" / "rules-dir" / "s3_bucket_server_side_encryption_enabled.guard"),
+            "-d", str(RES / "validate" / "data-dir" / "s3-server-side-encryption-template-compliant.yaml"),
+        ]
+    )
+    assert code == 0
+    assert out.startswith('<?xml version="1.0"')
+    assert "<testsuites" in out
+
+
+def test_validate_payload_mode():
+    payload = json.dumps(
+        {
+            "rules": ["Resources !empty"],
+            "data": ['{"Resources": {"a": {"T": 1}}}', '{"Resources": {}}'],
+        }
+    )
+    code, out, _ = run_cli(["validate", "--payload"], stdin=payload)
+    assert code == 19  # second doc fails
+    assert "DATA_STDIN[1] Status = PASS" in out
+    assert "DATA_STDIN[2] Status = FAIL" in out
+
+
+def test_validate_conflicting_flags():
+    code, _out, err = run_cli(
+        ["validate", "--structured", "-o", "single-line-summary",
+         "-r", "x.guard"]
+    )
+    assert code == 5
+
+
+def test_test_command_exit_codes():
+    code, out, _ = run_cli(
+        [
+            "test",
+            "-r", str(RES / "test-command" / "dir" / "s3_bucket_server_side_encryption_enabled.guard"),
+            "-t", str(RES / "test-command" / "data-dir" / "s3_bucket_server_side_encryption_enabled.yaml"),
+        ]
+    )
+    assert code == 0
+    golden = (RES / "test-command" / "output-dir" / "test_data_file.out").read_text()
+    assert out == golden
+
+    code2, out2, _ = run_cli(
+        [
+            "test",
+            "-r", str(RES / "test-command" / "dir" / "s3_bucket_server_side_encryption_enabled.guard"),
+            "-t", str(RES / "test-command" / "data-dir" / "failing_test.yaml"),
+        ]
+    )
+    assert code2 == 7
+    assert "FAIL Rules:" in out2
+
+
+def test_test_directory_mode():
+    code, out, _ = run_cli(["test", "-d", str(RES / "test-command" / "dir")])
+    assert code == 0
+    assert "Testing Guard File" in out
+
+
+def test_parse_tree_all_example_rules():
+    for guard in sorted(EX.rglob("*.guard")):
+        code, out, err = run_cli(["parse-tree", "-r", str(guard)])
+        assert code == 0, f"{guard}: {err}"
+        tree = json.loads(out)
+        assert "guard_rules" in tree
+
+
+def test_rulegen_self_check():
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as f:
+        f.write(
+            "Resources:\n  V:\n    Type: AWS::EC2::Volume\n"
+            "    Properties:\n      Size: 100\n      Encrypted: true\n"
+        )
+        name = f.name
+    code, out, _ = run_cli(["rulegen", "-t", name])
+    assert code == 0
+    assert "let aws_ec2_volume_resources" in out
+    # generated rules must themselves parse (self-check)
+    from guard_tpu.core.parser import parse_rules_file
+
+    assert parse_rules_file(out, "") is not None
+
+
+def test_completions():
+    for shell in ("bash", "zsh", "fish"):
+        code, out, _ = run_cli(["completions", "-s", shell])
+        assert code == 0 and "validate" in out
+
+
+def test_run_checks_api():
+    import guard_tpu
+
+    out = guard_tpu.run_checks(
+        '{"Resources": {"b": {"Type": "T"}}}', "Resources !empty"
+    )
+    assert json.loads(out)[0]["status"] == "PASS"
+    verbose = guard_tpu.run_checks("{}", "Resources !empty", verbose=True)
+    assert json.loads(verbose)["container"]["kind"] == "FileCheck"
+
+
+def test_builders():
+    from guard_tpu import TestBuilder, ValidateBuilder
+
+    code, out, _err = (
+        ValidateBuilder()
+        .payload()
+        .structured()
+        .show_summary(["none"])
+        .output_format("json")
+        .try_build_and_execute(
+            json.dumps({"rules": ["Resources !empty"], "data": ["{}"]})
+        )
+    )
+    assert code == 19
+    assert json.loads(out)[0]["status"] == "FAIL"
+
+
+def test_lambda_handler():
+    from guard_tpu.lambda_handler import handler
+
+    out = handler(
+        {
+            "data": '{"Resources": {"x": {"T": 1}}}',
+            "rules": ["Resources !empty", "Resources empty"],
+            "verbose": False,
+        }
+    )
+    statuses = [r[0]["status"] for r in out["message"]]
+    assert statuses == ["PASS", "FAIL"]
+
+
+def test_traversal_index():
+    from guard_tpu.core.loader import load_document
+    from guard_tpu.core.traversal import Traversal
+
+    doc = load_document("Resources:\n  b:\n    Type: T\n")
+    t = Traversal(doc)
+    node = t.at("/Resources/b/Type")
+    assert node is not None and node.value.val == "T"
+    up = t.at("1#", node)
+    assert up.value.self_path().s == "/Resources/b"

@@ -1,0 +1,38 @@
+"""C-ABI FFI layer: build the shim and drive it from a real C caller."""
+
+import json
+import pathlib
+import subprocess
+
+import pytest
+
+NATIVE = pathlib.Path(__file__).resolve().parent.parent / "native"
+REPO = NATIVE.parent
+
+
+def _build() -> bool:
+    if (NATIVE / "guard_ffi_test").exists():
+        return True
+    try:
+        subprocess.run(
+            ["sh", str(NATIVE / "build_ffi.sh")], check=True, capture_output=True
+        )
+    except (subprocess.CalledProcessError, OSError):
+        return False
+    return (NATIVE / "guard_ffi_test").exists()
+
+
+pytestmark = pytest.mark.skipif(not _build(), reason="ffi build unavailable")
+
+
+def test_ffi_run_checks_from_c():
+    out = subprocess.run(
+        [str(NATIVE / "guard_ffi_test")],
+        capture_output=True,
+        text=True,
+        env={"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin:/usr/local/bin"},
+    )
+    assert out.returncode == 0, out.stderr
+    reports = json.loads(out.stdout)
+    assert reports[0]["status"] == "FAIL"  # Resources is empty
+    assert reports[0]["name"] == "data.json"
