@@ -26,11 +26,15 @@ pytestmark = pytest.mark.skipif(not _build(), reason="ffi build unavailable")
 
 
 def test_ffi_run_checks_from_c():
+    import os
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO)
     out = subprocess.run(
         [str(NATIVE / "guard_ffi_test")],
         capture_output=True,
         text=True,
-        env={"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin:/usr/local/bin"},
+        env=env,
     )
     assert out.returncode == 0, out.stderr
     reports = json.loads(out.stdout)
