@@ -1,0 +1,80 @@
+"""GitHub Action driver.
+
+Equivalent of `/root/reference/action/src/main.ts:17-60` +
+`handleValidate.ts`: run validate in structured SARIF mode, write the
+SARIF file for code-scanning upload, render findings into the job
+summary, and fail the job on non-compliance.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from guard_tpu.cli import run  # noqa: E402
+from guard_tpu.utils.io import Reader, Writer  # noqa: E402
+
+SARIF_PATH = "guard-tpu.sarif"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rules", required=True)
+    ap.add_argument("--data", required=True)
+    ap.add_argument("--summary", default="true")
+    args = ap.parse_args()
+
+    w = Writer.buffered()
+    code = run(
+        [
+            "validate",
+            "--rules", args.rules,
+            "--data", args.data,
+            "--structured",
+            "--output-format", "sarif",
+            "--show-summary", "none",
+        ],
+        writer=w,
+        reader=Reader.from_string(""),
+    )
+    sarif_text = w.stripped()
+    with open(SARIF_PATH, "w") as f:
+        f.write(sarif_text)
+
+    if args.summary == "true":
+        summary_path = os.environ.get("GITHUB_STEP_SUMMARY")
+        lines = ["## guard-tpu validate results", ""]
+        try:
+            sarif = json.loads(sarif_text)
+            results = sarif["runs"][0]["results"]
+        except (json.JSONDecodeError, KeyError, IndexError):
+            results = []
+        if not results:
+            lines.append("✅ All templates are compliant.")
+        else:
+            lines.append("| Rule | File | Line | Message |")
+            lines.append("|---|---|---|---|")
+            for r in results:
+                loc = r["locations"][0]["physicalLocation"]
+                lines.append(
+                    f"| {r['ruleId']} | {loc['artifactLocation']['uri']} | "
+                    f"{loc['region']['startLine']} | "
+                    f"{r['message']['text'][:120]} |"
+                )
+        out = "\n".join(lines) + "\n"
+        if summary_path:
+            with open(summary_path, "a") as f:
+                f.write(out)
+        else:
+            print(out)
+
+    print(f"SARIF written to {SARIF_PATH}; validate exit code {code}")
+    return 1 if code == 19 else (0 if code == 0 else code)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,6 +10,14 @@ a flat predicate IR and evaluates (documents x rules) batches sharded
 across a TPU mesh (`validate --backend=tpu`).
 """
 
+import sys as _sys
+
+# Deep documents (terraform plan JSON, BASELINE.md config 4) exceed the
+# default interpreter recursion limit in the loader/evaluator; the TPU
+# kernels are iterative, but the CPU oracle walks trees recursively.
+if _sys.getrecursionlimit() < 20000:
+    _sys.setrecursionlimit(20000)
+
 from .api import (
     CommandBuilder,
     ParseTreeBuilder,

@@ -1,0 +1,92 @@
+"""Fuzz-style robustness tests for the two parsers.
+
+The reference fuzzes the DSL and YAML parsers through `run_checks` with
+libFuzzer (guard/fuzz/fuzz_targets/, 420s/target in CI). Here: seeded
+random mutations of valid inputs plus raw garbage — the engine must
+either succeed or raise ParseError/GuardError, never crash with an
+unrelated exception.
+"""
+
+import random
+import string
+
+import pytest
+
+from guard_tpu.api import run_checks
+from guard_tpu.core.errors import GuardError
+from guard_tpu.core.loader import load_document
+from guard_tpu.core.parser import parse_rules_file
+
+SEED_RULES = [
+    "Resources !empty",
+    "let x = Resources.*[ Type == 'T' ]\nrule r when %x !empty {\n  %x.P exists\n}\n",
+    "AWS::S3::Bucket {\n  Properties.Name == /x/ or Properties.Name !exists\n}\n",
+    "a.b[*].c IN r[0,10]\nsome d.*.e != 'v' <<msg>>\n",
+    "rule p(a, b) {\n  %a == %b\n}\nrule q {\n  p(x, y)\n}\n",
+]
+
+SEED_DOCS = [
+    "{}",
+    '{"Resources": {"a": {"Type": "T", "P": [1, 2]}}}',
+    "Resources:\n  a:\n    Type: T\n",
+]
+
+CHARS = string.printable
+
+
+def _mutate(rng, s: str) -> str:
+    s = list(s)
+    for _ in range(rng.randint(1, 6)):
+        op = rng.randint(0, 2)
+        pos = rng.randrange(0, max(1, len(s)))
+        if op == 0 and s:
+            s[pos % len(s)] = rng.choice(CHARS)
+        elif op == 1:
+            s.insert(pos, rng.choice(CHARS))
+        elif op == 2 and s:
+            del s[pos % len(s)]
+    return "".join(s)
+
+
+def test_dsl_parser_fuzz():
+    rng = random.Random(1234)
+    for i in range(400):
+        base = rng.choice(SEED_RULES)
+        mutated = _mutate(rng, base)
+        try:
+            parse_rules_file(mutated, "fuzz.guard")
+        except GuardError:
+            pass  # expected failure mode
+        except RecursionError:
+            pytest.fail(f"recursion blowup on: {mutated!r}")
+
+
+def test_yaml_loader_fuzz():
+    rng = random.Random(99)
+    for i in range(400):
+        base = rng.choice(SEED_DOCS)
+        mutated = _mutate(rng, base)
+        try:
+            load_document(mutated, "fuzz.yaml")
+        except GuardError:
+            pass
+
+
+def test_run_checks_fuzz():
+    rng = random.Random(7)
+    for i in range(150):
+        rules = _mutate(rng, rng.choice(SEED_RULES))
+        data = _mutate(rng, rng.choice(SEED_DOCS))
+        try:
+            run_checks(data, rules)
+        except GuardError:
+            pass
+
+
+def test_deep_document_no_stack_overflow():
+    # terraform-style deep trees (BASELINE.md config 4)
+    depth = 2000
+    doc = "{" * 0 + '{"a":' * depth + "1" + "}" * depth
+    pv = load_document(doc)
+    out = run_checks(doc, "a exists")
+    assert out
