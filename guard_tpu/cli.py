@@ -143,7 +143,17 @@ def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reade
 
 
 def main() -> None:
-    sys.exit(run())
+    try:
+        code = run()
+        sys.stdout.flush()
+    except BrokenPipeError:
+        # stdout consumer (e.g. `| head`) closed early — exit quietly
+        # with the conventional SIGPIPE code
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(141)
+    sys.exit(code)
 
 
 if __name__ == "__main__":

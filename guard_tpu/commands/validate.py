@@ -193,14 +193,20 @@ class Validate:
         else:
             try:
                 data_files = self._load_data_files(reader, writer)
-            except (GuardError, FileNotFoundError, OSError) as e:
+                rule_files, errors = self._load_rule_files(reader, writer)
+            except FileNotFoundError as e:
+                writer.writeln_err(_missing_file_message(e))
+                return ERROR_STATUS_CODE
+            except (GuardError, OSError) as e:
                 writer.writeln_err(str(e))
                 return ERROR_STATUS_CODE
-            rule_files, errors = self._load_rule_files(reader, writer)
 
         try:
             input_params = self._merged_input_params()
-        except (GuardError, FileNotFoundError, OSError) as e:
+        except FileNotFoundError as e:
+            writer.writeln_err(_missing_file_message(e))
+            return ERROR_STATUS_CODE
+        except (GuardError, OSError) as e:
             writer.writeln_err(str(e))
             return ERROR_STATUS_CODE
 
@@ -272,6 +278,13 @@ class Validate:
         if had_fail:
             return FAILURE_STATUS_CODE
         return SUCCESS_STATUS_CODE
+
+
+def _missing_file_message(e: FileNotFoundError) -> str:
+    """Consistent wording whether the error came from walk_files (arg is
+    the bare path) or an OS call (arg carries errno + message)."""
+    path = e.filename if e.filename is not None else str(e)
+    return f"The path `{path}` does not exist"
 
 
 def _clone_pv(pv: PV) -> PV:
