@@ -80,7 +80,37 @@ def make_template(rng, i: int) -> dict:
     return {"Resources": resources}
 
 
+def _probe_tpu_responsive(timeout_s: float = 45.0) -> bool:
+    """The axon TPU tunnel can hang indefinitely at device discovery.
+    Probe it in a subprocess so this process can fall back to CPU
+    without ever touching the wedged plugin."""
+    import subprocess
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        return out.returncode == 0 and "ok" in out.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
+    if not _probe_tpu_responsive():
+        import sys
+
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+        print(
+            "TPU tunnel unresponsive; benchmarking on CPU devices",
+            file=sys.stderr,
+            flush=True,
+        )
     import jax
 
     from guard_tpu.core.parser import parse_rules_file

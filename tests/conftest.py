@@ -10,6 +10,12 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Force the CPU platform programmatically as well: with a wedged axon
+# TPU tunnel, plugin discovery can hang even under JAX_PLATFORMS=cpu.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import pathlib
 import sys
 
