@@ -327,7 +327,9 @@ def _clause_value_report(current: EventRecord, check: ClauseCheck) -> List[dict]
                         "messages": {
                             "custom_message": cc.custom_message or "",
                             "error_message": message,
-                            "location": _location_json(to.value),
+                            # the LHS data property drives SARIF locations and
+                            # code excerpts (cfn.rs emit_code uses bc.from)
+                            "location": _location_json(res),
                         },
                         "check": {
                             "Resolved": {

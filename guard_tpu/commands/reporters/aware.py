@@ -1,0 +1,367 @@
+"""Template-aware console reporters for CloudFormation and Terraform.
+
+Equivalent of the reference's chain-of-responsibility reporter stack
+`GenericSummary -> TfAware -> CfnAware` (built in
+`/root/reference/guard/src/commands/validate.rs:703-716`): validate's
+console path first offers the evaluation to the CloudFormation reporter
+(`reporters/validate/cfn.rs:44` — applies when the document has a
+`Resources` root key, aggregates failures per resource and excerpts the
+offending source lines), then the Terraform-plan reporter
+(`reporters/validate/tf.rs:16` — applies when the document has a
+`resource_changes` root key), and only falls back to the generic
+single-line summary when neither shape matches or resource attribution
+fails (`cfn.rs:196-207` falls back via InternalError).
+
+Here each specialization is a function returning True when it handled
+the report; `console_chain` tries cfn -> tf -> generic in that order.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ...core.exprs import CmpOperator
+from ...core.values import MAP, PV
+from ...utils.io import Writer
+from ..report import iter_clause_failures
+
+def console_chain(
+    writer: Writer,
+    data_file_name: str,
+    data_content: str,
+    data_pv: PV,
+    rules_file_name: str,
+    status,
+    rule_statuses,
+    report: dict,
+    show_summary,
+) -> None:
+    """The full single-line console chain for one (rules, data) pair:
+    SummaryTable header, then CfnAware -> TfAware -> generic body
+    (validate.rs:703-716). `show_summary` is the raw --show-summary list."""
+    from .console import generic_single_line, summary_table_block
+
+    show = set(show_summary)
+    if "all" in show:
+        show = {"pass", "fail", "skip"}
+    show.discard("none")
+    summary_table_block(
+        writer, data_file_name, rules_file_name, status, rule_statuses, show
+    )
+    handled = cfn_single_line(
+        writer, data_file_name, data_content, rules_file_name, data_pv, report
+    ) or tf_single_line(writer, data_file_name, rules_file_name, data_pv, report)
+    if not handled:
+        generic_single_line(
+            writer, data_file_name, rules_file_name, report, rule_statuses, show
+        )
+
+
+_CFN_RESOURCE = re.compile(r"^/Resources/(?P<name>[^/]+)")
+_TF_RESOURCE = re.compile(r"^/resource_changes/(?P<idx>[^/]+)")
+
+_WIDTH = len("PropertyPath") + 4
+
+
+def _cmp_str(comparison) -> str:
+    """eval_context.rs:1847-1960 operator display strings."""
+    if not comparison:
+        return ""
+    op_s, negated = comparison
+    op = CmpOperator(op_s)
+    unary = {
+        CmpOperator.Exists: ("EXISTS", "NOT EXISTS"),
+        CmpOperator.Empty: ("EMPTY", "NOT EMPTY"),
+        CmpOperator.IsList: ("IS LIST", "NOT LIST"),
+        CmpOperator.IsMap: ("IS STRUCT", "NOT STRUCT"),
+        CmpOperator.IsString: ("IS STRING", "NOT STRING"),
+        CmpOperator.IsFloat: ("IS FLOAT", "NOT FLOAT"),
+        CmpOperator.IsNull: ("IS NULL", "NOT NULL"),
+        CmpOperator.IsBool: ("IS BOOl", "NOT BOOL"),
+        CmpOperator.IsInt: ("IS INT", "NOT INT"),
+    }
+    binary = {
+        CmpOperator.Eq: ("EQUAL", "NOT EQUAL"),
+        CmpOperator.Le: ("LESS THAN EQUAL", "NOT LESS THAN EQUAL"),
+        CmpOperator.Lt: ("LESS THAN", "NOT LESS THAN"),
+        CmpOperator.Ge: ("GREATER THAN EQUAL", "NOT GREATER THAN EQUAL"),
+        CmpOperator.Gt: ("GREATER THAN", "NOT GREATER THAN"),
+        CmpOperator.In: ("IN", "NOT IN"),
+    }
+    table = unary if op.is_unary() else binary
+    pos, neg = table.get(op, (op_s, f"NOT {op_s}"))
+    return neg if negated else pos
+
+
+def _map_get(pv: Optional[PV], key: str) -> Optional[PV]:
+    if pv is None or pv.kind != MAP:
+        return None
+    return pv.val.values.get(key)
+
+
+def _scalar(pv: Optional[PV]):
+    if pv is None or not pv.is_scalar():
+        return None
+    return pv.val
+
+
+def _clause_anchor_path(clause: dict) -> str:
+    check = clause.get("check") or {}
+    if "Resolved" in check:
+        r = check["Resolved"]
+        node = r.get("from") or r.get("value")
+        if node:
+            return node["path"]
+    if "InResolved" in check:
+        return check["InResolved"]["from"]["path"]
+    if "UnResolved" in check:
+        return check["UnResolved"]["value"]["traversed_to"]["path"]
+    ur = clause.get("unresolved")
+    if ur:
+        return ur["traversed_to"]["path"]
+    return ""
+
+
+def _fmt_value(v) -> str:
+    import json
+
+    return json.dumps(v)
+
+
+class _CodeExcerpt:
+    """ReadCursor-style source excerpts (utils/mod.rs:7-66, cfn.rs emit_code):
+    the failing line minus two, plus ~6 lines of following context."""
+
+    def __init__(self, content: str):
+        self.lines = content.splitlines()
+
+    def emit(self, writer: Writer, line: Optional[int], prefix: str) -> None:
+        if not line or not self.lines:
+            return
+        writer.writeln(f"{prefix}Code:")
+        # cfn.rs:392-417 — the line at (failing - 2) plus 5 context lines
+        start = max(1, line - 2)
+        for num in range(start, min(start + 6, len(self.lines) + 1)):
+            writer.writeln(f"{prefix}  {num:>5}.{self.lines[num - 1]}")
+
+
+def _emit_clause(
+    writer: Writer,
+    clause: dict,
+    prefix: str,
+    excerpt: Optional[_CodeExcerpt],
+    path_rewrite=None,
+) -> None:
+    msgs = clause.get("messages") or {}
+    custom = msgs.get("custom_message") or ""
+    location = msgs.get("location") or {}
+    line = location.get("line")
+    context = clause.get("context", "")
+    check = clause.get("check") or {}
+    writer.writeln(f"{prefix}Check = {context} {{")
+    inner = prefix + "  "
+    field = prefix + "    "
+    if custom:
+        writer.writeln(f"{inner}Message {{")
+        for ln in custom.split(";"):
+            writer.writeln(f"{field}{ln.strip()}")
+        writer.writeln(f"{inner}}}")
+    if "UnResolved" in check or (clause.get("unresolved") is not None):
+        ur = (
+            check.get("UnResolved", {}).get("value")
+            or clause.get("unresolved")
+            or {}
+        )
+        comparison = check.get("UnResolved", {}).get("comparison")
+        writer.writeln(f"{inner}RequiredPropertyError {{")
+        traversed = ur.get("traversed_to", {})
+        writer.writeln(
+            f"{field}{'PropertyPath':<{_WIDTH}}= {traversed.get('path', '')}"
+        )
+        writer.writeln(
+            f"{field}{'MissingProperty':<{_WIDTH}}= {ur.get('remaining_query', '')}"
+        )
+        if comparison:
+            writer.writeln(f"{field}{'Operator':<{_WIDTH}}= {_cmp_str(comparison)}")
+        reason = ur.get("reason")
+        if reason:
+            writer.writeln(f"{field}{'Reason':<{_WIDTH}}= {reason}")
+        if excerpt is not None:
+            excerpt.emit(writer, line, field)
+        writer.writeln(f"{inner}}}")
+    elif "Resolved" in check and "from" in check["Resolved"]:
+        r = check["Resolved"]
+        path = r["from"]["path"]
+        if path_rewrite:
+            path = path_rewrite(path)
+        writer.writeln(f"{inner}ComparisonError {{")
+        writer.writeln(f"{field}{'PropertyPath':<{_WIDTH}}= {path}")
+        writer.writeln(f"{field}{'Operator':<{_WIDTH}}= {_cmp_str(r.get('comparison'))}")
+        writer.writeln(f"{field}{'Value':<{_WIDTH}}= {_fmt_value(r['from']['value'])}")
+        writer.writeln(f"{field}{'ComparedWith':<{_WIDTH}}= {_fmt_value(r['to']['value'])}")
+        if excerpt is not None:
+            excerpt.emit(writer, line, field)
+        writer.writeln(f"{inner}}}")
+    elif "InResolved" in check:
+        r = check["InResolved"]
+        path = r["from"]["path"]
+        if path_rewrite:
+            path = path_rewrite(path)
+        to_vals = [t["value"] for t in r.get("to", [])]
+        cut_off = max(len(to_vals), 5)
+        shown = to_vals[: cut_off + 1]
+        writer.writeln(f"{inner}ComparisonError {{")
+        writer.writeln(f"{field}{'PropertyPath':<{_WIDTH}}= {path}")
+        writer.writeln(f"{field}{'Operator':<{_WIDTH}}= {_cmp_str(r.get('comparison'))}")
+        if len(shown) < len(to_vals):
+            writer.writeln(f"{field}{'Total':<{_WIDTH}}= {len(to_vals)}")
+        writer.writeln(f"{field}{'Value':<{_WIDTH}}= {_fmt_value(r['from']['value'])}")
+        writer.writeln(
+            f"{field}{'ComparedWith':<{_WIDTH}}= {[_fmt_value(v) for v in shown]}"
+        )
+        if excerpt is not None:
+            excerpt.emit(writer, line, field)
+        writer.writeln(f"{inner}}}")
+    elif "Resolved" in check and "value" in check["Resolved"]:
+        r = check["Resolved"]
+        path = r["value"]["path"]
+        if path_rewrite:
+            path = path_rewrite(path)
+        writer.writeln(f"{inner}ComparisonError {{")
+        writer.writeln(f"{field}{'PropertyPath':<{_WIDTH}}= {path}")
+        writer.writeln(f"{field}{'Operator':<{_WIDTH}}= {_cmp_str(r.get('comparison'))}")
+        if excerpt is not None:
+            excerpt.emit(writer, line, field)
+        writer.writeln(f"{inner}}}")
+    else:
+        err = msgs.get("error_message") or ""
+        if err:
+            writer.writeln(f"{inner}Error = {err}")
+    writer.writeln(f"{prefix}}}")
+
+
+def _group_failures(
+    report: dict, pattern: re.Pattern
+) -> Optional[Dict[str, List[Tuple[str, dict]]]]:
+    """Group failing clauses by resource key; None when any clause cannot
+    be attributed (cfn.rs:196-207 falls back to the generic reporter)."""
+    groups: Dict[str, List[Tuple[str, dict]]] = {}
+    for rule_name, clause in iter_clause_failures(report):
+        path = _clause_anchor_path(clause)
+        m = pattern.match(path)
+        if not m:
+            return None
+        groups.setdefault(m.group(1), []).append((rule_name, clause))
+    return groups
+
+
+def cfn_single_line(
+    writer: Writer,
+    data_file: str,
+    data_content: str,
+    rules_file: str,
+    doc: PV,
+    report: dict,
+) -> bool:
+    """CfnAware single-line summary (cfn.rs:157-420). Returns True when
+    this reporter applies and handled the output."""
+    if _map_get(doc, "Resources") is None:
+        return False
+    if not report["not_compliant"]:
+        return True
+    groups = _group_failures(report, _CFN_RESOURCE)
+    if groups is None:
+        return False
+
+    excerpt = _CodeExcerpt(data_content)
+    resources = _map_get(doc, "Resources")
+    writer.writeln(f"Evaluating data {data_file} against rules {rules_file}")
+    writer.writeln(f"Number of non-compliant resources {len(groups)}")
+    for name in sorted(groups):
+        res = _map_get(resources, name)
+        res_type = _scalar(_map_get(res, "Type")) or ""
+        cdk_path = _scalar(_map_get(_map_get(res, "Metadata"), "aws:cdk:path"))
+        writer.writeln(f"Resource = {name} {{")
+        writer.writeln(f"  {'Type':<10}= {res_type}")
+        if cdk_path:
+            writer.writeln(f"  {'CDK-Path':<10}= {cdk_path}")
+        by_rule: Dict[str, List[dict]] = {}
+        for rule_name, clause in groups[name]:
+            by_rule.setdefault(rule_name, []).append(clause)
+        for rule_name in sorted(by_rule):
+            writer.writeln(f"  Rule = {rule_name} {{")
+            for clause in by_rule[rule_name]:
+                _emit_clause(writer, clause, "    ", excerpt)
+            writer.writeln("  }")
+        writer.writeln("}")
+    return True
+
+
+def _tf_property(path: str) -> str:
+    """tf.rs:215-231 — show the property below change/after as dotted."""
+    idx = path.find("change/after/")
+    if idx < 0:
+        return path
+    return path[idx + len("change/after/") :].replace("/", ".")
+
+
+def tf_single_line(
+    writer: Writer,
+    data_file: str,
+    rules_file: str,
+    doc: PV,
+    report: dict,
+) -> bool:
+    """TfAware single-line summary (tf.rs:100-300). Returns True when the
+    document is a Terraform plan and output was handled."""
+    changes = _map_get(doc, "resource_changes")
+    if changes is None:
+        return False
+    if not report["not_compliant"]:
+        return True
+    groups = _group_failures(report, _TF_RESOURCE)
+    if groups is None:
+        return False
+
+    # resource_changes[idx].address = "<type>.<name>" (tf.rs:134-141)
+    def addr_of(idx: str) -> Tuple[str, str]:
+        entry = None
+        if changes.is_list():
+            try:
+                entry = changes.val[int(idx)]
+            except (ValueError, IndexError):
+                entry = None
+        elif changes.kind == MAP:
+            entry = changes.val.values.get(idx)
+        addr = _scalar(_map_get(entry, "address")) or ""
+        dot = addr.find(".")
+        if dot < 0:
+            return addr, addr
+        return addr[:dot], addr[dot + 1 :]
+
+    named: Dict[str, Tuple[str, List[Tuple[str, dict]]]] = {}
+    for idx, clauses in groups.items():
+        rtype, rname = addr_of(idx)
+        prev = named.get(rname)
+        if prev:
+            prev[1].extend(clauses)
+        else:
+            named[rname] = (rtype, list(clauses))
+
+    writer.writeln(f"Evaluating data {data_file} against rules {rules_file}")
+    writer.writeln(f"Number of non-compliant resources {len(named)}")
+    for rname in sorted(named):
+        rtype, clauses = named[rname]
+        writer.writeln(f"Resource = {rname} {{")
+        writer.writeln(f"  {'Type':<10}= {rtype}")
+        by_rule: Dict[str, List[dict]] = {}
+        for rule_name, clause in clauses:
+            by_rule.setdefault(rule_name, []).append(clause)
+        for rule_name in sorted(by_rule):
+            writer.writeln(f"  Rule = {rule_name} {{")
+            for clause in by_rule[rule_name]:
+                _emit_clause(writer, clause, "    ", None, path_rewrite=_tf_property)
+            writer.writeln("  }")
+        writer.writeln("}")
+    return True

@@ -21,42 +21,95 @@ SHOW_FAIL = "fail"
 SHOW_SKIP = "skip"
 
 
-def single_line_summary(
+def summary_table_block(
     writer: Writer,
     data_file: str,
     rules_file: str,
     status: Status,
-    report: dict,
     rule_statuses: Dict[str, Status],
+    show: set,
 ) -> None:
-    writer.writeln(f"{data_file} Status = {status.value}")
+    """SummaryTable reporter (summary_table.rs:151-237): the leading
+    `<file> Status = <s>` header plus SKIP/PASS/FAILED rule lists, each
+    section gated by its --show-summary flag; runs before the body
+    reporters in the chain (validate.rs:709-716)."""
+    if not show:
+        return
     passed = sorted(n for n, s in rule_statuses.items() if s == Status.PASS)
     skipped = sorted(n for n, s in rule_statuses.items() if s == Status.SKIP)
     failed = sorted(n for n, s in rule_statuses.items() if s == Status.FAIL)
-    if skipped:
+    longest = max((len(n) for n in rule_statuses), default=0)
+    wrote_header = False
+
+    def header():
+        nonlocal wrote_header
+        if not wrote_header:
+            writer.writeln(f"{data_file} Status = {status.value}")
+            wrote_header = True
+
+    if SHOW_SKIP in show and skipped:
+        header()
         writer.writeln("SKIP rules")
         for n in skipped:
-            writer.writeln(f"{n}    SKIP")
-    if passed:
+            writer.writeln(f"{n.ljust(longest + 4)}SKIP")
+    if SHOW_PASS in show and passed:
+        header()
         writer.writeln("PASS rules")
         for n in passed:
-            writer.writeln(f"{n}    PASS")
-    if failed:
+            writer.writeln(f"{n.ljust(longest + 4)}PASS")
+    if SHOW_FAIL in show and failed:
+        header()
         writer.writeln("FAILED rules")
         for n in failed:
-            writer.writeln(f"{n}    FAIL")
-    writer.writeln("---")
+            writer.writeln(f"{n.ljust(longest + 4)}FAIL")
+    if wrote_header:
+        writer.writeln("---")
+
+
+def generic_single_line(
+    writer: Writer,
+    data_file: str,
+    rules_file: str,
+    report: dict,
+    rule_statuses: Dict[str, Status],
+    show: set,
+) -> None:
+    """GenericSummary single-line body (generic_summary.rs:262-306):
+    per-clause failure messages, then compliant / not-applicable rule
+    lines gated by the same --show-summary flags."""
+    passed = sorted(n for n, s in rule_statuses.items() if s == Status.PASS)
+    skipped = sorted(n for n, s in rule_statuses.items() if s == Status.SKIP)
+    failures = list(iter_clause_failures(report))
+    # is_reportable priority cascade (generic_summary.rs:157-176): a
+    # present FAIL flag alone decides, then PASS, then SKIP.
+    if SHOW_FAIL in show:
+        reportable = bool(failures)
+    elif SHOW_PASS in show:
+        reportable = bool(passed)
+    else:
+        reportable = SHOW_SKIP in show and bool(skipped)
+    if not reportable:
+        return
     writer.writeln(f"Evaluation of rules {rules_file} against data {data_file}")
-    writer.writeln("--")
-    for rule_name, clause in iter_clause_failures(report):
-        msgs = clause.get("messages", {})
-        err = msgs.get("error_message") or ""
-        custom = msgs.get("custom_message") or ""
-        prop = _property_path(clause)
-        writer.writeln(
-            f"Property [{prop}] in data [{data_file}] is not compliant with "
-            f"[{rule_name}] because {err} Error Message [{custom}]"
-        )
+    if SHOW_FAIL in show and failures:
+        writer.writeln("--")
+        for rule_name, clause in failures:
+            msgs = clause.get("messages", {})
+            err = msgs.get("error_message") or ""
+            custom = msgs.get("custom_message") or ""
+            prop = _property_path(clause)
+            writer.writeln(
+                f"Property [{prop}] in data [{data_file}] is not compliant with "
+                f"[{rule_name}] because {err} Error Message [{custom}]"
+            )
+    if SHOW_PASS in show and passed:
+        writer.writeln("--")
+        for n in passed:
+            writer.writeln(f"Rule [{n}] is compliant for template [{data_file}]")
+    if SHOW_SKIP in show and skipped:
+        writer.writeln("--")
+        for n in skipped:
+            writer.writeln(f"Rule [{n}] is not applicable for template [{data_file}]")
     writer.writeln("--")
 
 
@@ -75,39 +128,6 @@ def _property_path(clause: dict) -> str:
     if "unresolved" in clause and clause["unresolved"]:
         return clause["unresolved"]["traversed_to"]["path"]
     return ""
-
-
-def summary_table(
-    writer: Writer,
-    rules_file: str,
-    data_file: str,
-    rule_statuses: Dict[str, Status],
-    show: set,
-) -> None:
-    """summary_table.rs: per-rule status table filtered by --show-summary."""
-    longest = max((len(n) for n in rule_statuses), default=0)
-    shown = []
-    for name, status in sorted(rule_statuses.items()):
-        if status == Status.PASS and SHOW_PASS in show:
-            shown.append((name, status))
-        elif status == Status.FAIL and SHOW_FAIL in show:
-            shown.append((name, status))
-        elif status == Status.SKIP and SHOW_SKIP in show:
-            shown.append((name, status))
-    if not shown:
-        return
-    writer.writeln(f"{rules_file} Status = {_overall(rule_statuses).value}")
-    for name, status in shown:
-        writer.writeln(f"{name.ljust(longest + 4)}{status.value}")
-    writer.writeln("---")
-
-
-def _overall(rule_statuses: Dict[str, Status]) -> Status:
-    if any(s == Status.FAIL for s in rule_statuses.values()):
-        return Status.FAIL
-    if any(s == Status.PASS for s in rule_statuses.values()):
-        return Status.PASS
-    return Status.SKIP
 
 
 def print_verbose_tree(writer: Writer, record: EventRecord, indent: int = 0) -> None:

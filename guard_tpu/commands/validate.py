@@ -29,12 +29,8 @@ from ..core.values import PV
 from ..utils.io import Reader, Writer
 from .files import DATA_FILE_EXTENSIONS, RULE_FILE_EXTENSIONS, gather
 from .report import rule_statuses_from_root, simplified_report_from_root
-from .reporters.console import (
-    print_verbose_tree,
-    record_to_json,
-    single_line_summary,
-    summary_table,
-)
+from .reporters.aware import console_chain
+from .reporters.console import print_verbose_tree, record_to_json
 from .reporters.junit import JunitTestCase, write_junit
 from .reporters.sarif import write_sarif
 from .reporters.structured import write_structured
@@ -246,19 +242,11 @@ class Validate:
                 overall = overall.and_(status)
 
                 if not self.structured:
-                    single_line_summary(
-                        writer,
-                        data_file.name,
-                        rule_file.name,
-                        status,
-                        report,
-                        rule_statuses,
+                    console_chain(
+                        writer, data_file.name, data_file.content,
+                        data_file.path_value, rule_file.name,
+                        status, rule_statuses, report, self.show_summary,
                     )
-                    show = set(self.show_summary)
-                    if "all" in show:
-                        show = {"pass", "fail", "skip"}
-                    if show and show != {"none"}:
-                        summary_table(writer, rule_file.name, data_file.name, rule_statuses, show)
                     if self.verbose:
                         print_verbose_tree(writer, root_record)
                     if self.print_json:

@@ -38,7 +38,7 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
         FAILURE_STATUS_CODE,
         SUCCESS_STATUS_CODE,
     )
-    from ..commands.reporters.console import single_line_summary, summary_table
+    from ..commands.reporters.aware import console_chain
     from ..commands.reporters.junit import JunitTestCase, write_junit
     from ..commands.reporters.sarif import write_sarif
     from ..commands.reporters.structured import write_structured
@@ -139,14 +139,11 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                 cases.append(JunitTestCase(name=f"{rn}-{data_file.name}", status=rs))
 
             if not validate.structured:
-                single_line_summary(
-                    writer, data_file.name, rule_file.name, doc_status, report, rule_statuses
+                console_chain(
+                    writer, data_file.name, data_file.content,
+                    data_file.path_value, rule_file.name,
+                    doc_status, rule_statuses, report, validate.show_summary,
                 )
-                show = set(validate.show_summary)
-                if "all" in show:
-                    show = {"pass", "fail", "skip"}
-                if show and show != {"none"}:
-                    summary_table(writer, rule_file.name, data_file.name, rule_statuses, show)
         junit_suites[rule_file.name] = cases
 
     if validate.structured:

@@ -1,0 +1,121 @@
+"""Template-aware console reporter tests: the CfnAware / TfAware /
+generic chain (`/root/reference/guard/src/commands/validate.rs:703-716`,
+`reporters/validate/cfn.rs`, `tf.rs`)."""
+
+import json
+import textwrap
+
+from guard_tpu.cli import run
+from guard_tpu.utils.io import Reader, Writer
+
+
+def run_cli(args, stdin=""):
+    w = Writer.buffered()
+    code = run(args, writer=w, reader=Reader.from_string(stdin))
+    return code, w.stripped(), w.err_to_stripped()
+
+
+CFN_TEMPLATE = textwrap.dedent(
+    """\
+    Resources:
+      logs:
+        Type: AWS::S3::Bucket
+        Metadata:
+          aws:cdk:path: stack/logs/Resource
+        Properties:
+          AccessControl: PublicRead
+      data:
+        Type: AWS::S3::Bucket
+        Properties:
+          AccessControl: Private
+    """
+)
+
+CFN_RULE = "rule no_public { Resources.*.Properties.AccessControl != 'PublicRead' }"
+
+
+def test_cfn_aware_resource_aggregation(tmp_path):
+    t = tmp_path / "t.yaml"
+    t.write_text(CFN_TEMPLATE)
+    r = tmp_path / "r.guard"
+    r.write_text(CFN_RULE)
+    code, out, _ = run_cli(["validate", "-r", str(r), "-d", str(t)])
+    assert code == 19
+    assert "Number of non-compliant resources 1" in out
+    assert "Resource = logs {" in out
+    assert "Type      = AWS::S3::Bucket" in out
+    assert "CDK-Path  = stack/logs/Resource" in out
+    assert "Rule = " in out and "no_public" in out
+    assert "ComparisonError {" in out
+    assert "PropertyPath" in out and "/Resources/logs/Properties/AccessControl" in out
+    assert "Operator" in out and "NOT EQUAL" in out
+    # source excerpt around the failing line
+    assert "Code:" in out
+    assert "AccessControl: PublicRead" in out
+    # compliant resource is not reported
+    assert "Resource = data {" not in out
+
+
+def test_cfn_aware_missing_property(tmp_path):
+    t = tmp_path / "t.yaml"
+    t.write_text(
+        "Resources:\n  b:\n    Type: AWS::S3::Bucket\n    Properties: {}\n"
+    )
+    r = tmp_path / "r.guard"
+    r.write_text("rule enc { Resources.*.Properties.BucketEncryption exists }")
+    code, out, _ = run_cli(["validate", "-r", str(r), "-d", str(t)])
+    assert code == 19
+    assert "Resource = b {" in out
+    assert "RequiredPropertyError {" in out
+    assert "MissingProperty" in out and "BucketEncryption" in out
+
+
+def test_cfn_aware_silent_on_pass(tmp_path):
+    t = tmp_path / "t.yaml"
+    t.write_text(CFN_TEMPLATE)
+    r = tmp_path / "r.guard"
+    r.write_text("rule types { Resources.*.Type == 'AWS::S3::Bucket' }")
+    code, out, _ = run_cli(["validate", "-r", str(r), "-d", str(t)])
+    assert code == 0
+    assert out == ""
+
+
+TF_PLAN = {
+    "resource_changes": [
+        {
+            "address": "aws_s3_bucket.my_bucket",
+            "change": {"after": {"acl": "public-read", "bucket": "b1"}},
+        },
+        {
+            "address": "aws_s3_bucket.other",
+            "change": {"after": {"acl": "private", "bucket": "b2"}},
+        },
+    ]
+}
+
+
+def test_tf_aware_resource_aggregation(tmp_path):
+    t = tmp_path / "plan.json"
+    t.write_text(json.dumps(TF_PLAN))
+    r = tmp_path / "r.guard"
+    r.write_text("rule acl { resource_changes[*].change.after.acl == 'private' }")
+    code, out, _ = run_cli(["validate", "-r", str(r), "-d", str(t)])
+    assert code == 19
+    assert "Number of non-compliant resources 1" in out
+    assert "Resource = my_bucket {" in out
+    assert "Type      = aws_s3_bucket" in out
+    # property path is rewritten below change/after and dotted (tf.rs:215-231)
+    assert "PropertyPath" in out and "= acl" in out
+    assert "Resource = other {" not in out
+
+
+def test_generic_fallback_for_other_docs(tmp_path):
+    t = tmp_path / "d.json"
+    t.write_text(json.dumps({"config": {"mode": "off"}}))
+    r = tmp_path / "r.guard"
+    r.write_text("rule on { config.mode == 'on' }")
+    code, out, _ = run_cli(["validate", "-r", str(r), "-d", str(t)])
+    assert code == 19
+    assert "Evaluation of rules" in out
+    assert "Property [/config/mode]" in out
+    assert "Resource =" not in out

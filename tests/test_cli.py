@@ -22,6 +22,8 @@ def run_cli(args, stdin=""):
 
 
 def test_validate_pass_exit_0():
+    # Default --show-summary=fail on a fully compliant file prints nothing
+    # (reference SummaryTable + CfnAware both stay silent on PASS).
     code, out, _ = run_cli(
         [
             "validate",
@@ -30,7 +32,18 @@ def test_validate_pass_exit_0():
         ]
     )
     assert code == 0
+    assert out == ""
+
+    code, out, _ = run_cli(
+        [
+            "validate", "-S", "all",
+            "-r", str(RES / "validate" / "rules-dir" / "s3_bucket_public_read_prohibited.guard"),
+            "-d", str(RES / "validate" / "data-dir" / "s3-public-read-prohibited-template-compliant.yaml"),
+        ]
+    )
+    assert code == 0
     assert "Status = PASS" in out
+    assert "PASS rules" in out
 
 
 def test_validate_fail_exit_19():
@@ -120,7 +133,7 @@ def test_validate_payload_mode():
             "data": ['{"Resources": {"a": {"T": 1}}}', '{"Resources": {}}'],
         }
     )
-    code, out, _ = run_cli(["validate", "--payload"], stdin=payload)
+    code, out, _ = run_cli(["validate", "--payload", "-S", "all"], stdin=payload)
     assert code == 19  # second doc fails
     assert "DATA_STDIN[1] Status = PASS" in out
     assert "DATA_STDIN[2] Status = FAIL" in out
