@@ -79,9 +79,10 @@ class Interner:
         )
 
     def substring_bits(self, needle_id_unused: int, needle: str) -> np.ndarray:
-        """(S,) bool: `needle in s` for each interned string (the IN
-        operator's string-containment case, operators.rs:218-230)."""
-        return np.array([needle in s for s in self._strings], dtype=bool)
+        """(S,) bool: is each interned string a substring of `needle`?
+        The IN operator's string-containment case is `lhs.val in
+        rhs.val` with lhs the document value (operators.rs:218-230)."""
+        return np.array([s in needle for s in self._strings], dtype=bool)
 
 
 @dataclass

@@ -12,12 +12,17 @@ tri-state combinator nodes.
 
 Lowering is *exact or refused*: any construct whose semantics the kernel
 cannot reproduce bit-for-bit (function calls, query-to-query compares,
-parameterized rules, map literals, variable captures) raises
-`Unlowerable`, and the backend falls back to the CPU oracle for that
-rule. Coverage is wide enough for the dominant registry rule shapes.
+map literals, variable captures) raises `Unlowerable`, and the backend
+falls back to the CPU oracle for that rule. Parameterized rule calls
+(eval.rs:1504-1618) lower by inline expansion: argument queries are
+pre-lowered in the caller's scope, literals bind like `let` literals,
+and the callee body becomes an anonymous gated block. Coverage is wide
+enough for the dominant registry rule shapes.
 """
 
 from __future__ import annotations
+
+import copy
 
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple, Union
@@ -96,7 +101,17 @@ class StepIndex:
 
 @dataclass
 class StepFilter:
+    """Filter semantics depend on the preceding query part
+    (scopes._retrieve_filter, eval_context.rs:723-828): after a key (or
+    at query start) maps expand to their values; after `.*`/`[*]` the
+    map itself is the filter candidate; scalars survive only after
+    `[*]`, otherwise they are UnResolved. Lists always iterate."""
+
     conjunctions: List[List["CClause"]]
+    # prev was a key / query start: map candidates expand to their values
+    expand_maps: bool = False
+    # prev was `[*]`: scalar candidates filter themselves (else UnResolved)
+    scalar_self: bool = False
 
 
 @dataclass
@@ -114,9 +129,16 @@ Step = Union[StepKey, StepAllValues, StepAllIndices, StepIndex, StepFilter, Step
 # ---------------------------------------------------------------------------
 @dataclass
 class RhsSpec:
-    kind: str  # 'str' | 'regex' | 'num' | 'bool' | 'null' | 'range' | 'list' | 'substr'
+    # 'str' | 'regex' | 'num' | 'bool' | 'null' | 'range' | 'list' |
+    # 'substr' | 'never' (literal kinds no document scalar can ever be
+    # comparable with, e.g. char ranges — docs never contain CHAR nodes)
+    kind: str
     str_id: int = -1
     bits: Optional[np.ndarray] = None  # (S,) bool for regex/substr
+    # (S,) bool tables for lexicographic string ordering vs the literal
+    # (path_value.rs:1048-1070 via compare_values; gt = ~le, ge = ~lt)
+    lt_bits: Optional[np.ndarray] = None
+    le_bits: Optional[np.ndarray] = None
     num: float = 0.0
     num_kind: int = INT  # INT or FLOAT for numeric literals
     range_lo: float = 0.0
@@ -150,7 +172,9 @@ class CBlockClause:
 
 @dataclass
 class CWhenBlock:
-    conditions: List[List["CNode"]]
+    # None = ungated grouping (inline-expanded parameterized rule body
+    # without when conditions)
+    conditions: Optional[List[List["CNode"]]]
     inner: List[List["CNode"]]
 
 
@@ -183,7 +207,44 @@ class CompiledRules:
 # ---------------------------------------------------------------------------
 # Lowering
 # ---------------------------------------------------------------------------
+def _prev_class(parts, i) -> str:
+    """Classify the query part preceding parts[i] for filter semantics
+    (scopes._retrieve_filter inspects query[query_index - 1])."""
+    if i == 0:
+        return "start"
+    prev = parts[i - 1]
+    if isinstance(prev, QAllValues):
+        return "allvalues"
+    if isinstance(prev, QAllIndices):
+        return "allindices"
+    if isinstance(prev, QKey):
+        return "key"
+    return "other"
+
+
+@dataclass
+class _PreloweredQuery:
+    """A parameterized-rule argument query, lowered in the CALLER's
+    scope at call time (eval.rs:1574-1599 resolves arguments against the
+    caller's context before entering the callee)."""
+
+    steps: List[Step]
+    match_all: bool
+
+
 class _RuleLowering:
+    """Lowers one RulesFile.
+
+    Variable scoping: a lowered query's steps run relative to the
+    kernel's *current selection*, but the oracle resolves a variable
+    against the scope where it was bound (RootScope/BlockScope,
+    eval_context.rs:47-87). Splicing a variable's steps is therefore
+    only exact when the use site evaluates at the same selection basis
+    as the binding site. Each selection-changing construct (block
+    bodies, filter conjunctions) gets a fresh scope token; bindings
+    remember their token and a use under a different token refuses
+    lowering (host fallback)."""
+
     def __init__(self, rules_file: RulesFile, interner: Interner):
         self.rf = rules_file
         self.interner = interner
@@ -198,6 +259,17 @@ class _RuleLowering:
                 # function-call assignment: rules touching it go host-side
                 self.var_queries[let.var] = None
         self.rule_index = {}
+        self.param_rules = {
+            p.rule.rule_name: p for p in rules_file.parameterized_rules
+        }
+        self._param_stack = set()
+        self._scope = 0  # 0 = rule root (document root selection)
+        self._scope_counter = 0
+
+    def _push_scope(self):
+        self._scope_counter += 1
+        prev, self._scope = self._scope, self._scope_counter
+        return prev
 
     # -- query lowering ------------------------------------------------
     def lower_query(self, parts: List, block_vars: dict) -> List[Step]:
@@ -205,11 +277,32 @@ class _RuleLowering:
         idx = 0
         if parts and part_is_variable(parts[0]):
             var = part_variable(parts[0])
-            vq = self._lookup_var(var, block_vars)
-            if vq is None:
+            if var in block_vars:
+                v, tok = block_vars[var]
+            elif var in self.var_queries:
+                v, tok = self.var_queries[var], 0
+            elif var in self.var_literals:
+                raise Unlowerable(f"literal variable {var} used as query head")
+            else:
+                raise Unlowerable(f"unknown variable {var}")
+            if tok != self._scope:
+                raise Unlowerable(f"variable {var} crosses value scopes")
+            if isinstance(v, _PreloweredQuery):
+                match_all = v.match_all
+                if match_all:
+                    inner = list(v.steps)
+                else:
+                    # about to mark drop_unres: copy the mutated steps
+                    inner = [
+                        copy.copy(s) if isinstance(s, StepKey) else s
+                        for s in v.steps
+                    ]
+            elif isinstance(v, AccessQuery):
+                inner = self.lower_query(v.query, block_vars)
+                match_all = v.match_all
+            else:
                 raise Unlowerable(f"variable {var} is not a plain query")
-            inner = self.lower_query(vq.query, block_vars)
-            if not vq.match_all:
+            if not match_all:
                 for s in inner:
                     if isinstance(s, StepKey):
                         s.drop_unres = True
@@ -218,26 +311,17 @@ class _RuleLowering:
             # skip the implicit [*] the parser inserted after the variable
             if idx < len(parts) and isinstance(parts[idx], QAllIndices):
                 idx += 1
-        for part in parts[idx:]:
-            steps.append(self.lower_part(part, block_vars))
+        for i in range(idx, len(parts)):
+            step = self.lower_part(parts[i], block_vars, _prev_class(parts, i))
+            if step is not None:
+                steps.append(step)
         return steps
 
-    def _lookup_var(self, var: str, block_vars: dict):
-        if var in block_vars:
-            v = block_vars[var]
-        elif var in self.var_queries:
-            v = self.var_queries[var]
-        elif var in self.var_literals:
-            raise Unlowerable(f"literal variable {var} used as query head")
-        else:
-            raise Unlowerable(f"unknown variable {var}")
-        if v is None or not isinstance(v, AccessQuery):
-            return None
-        return v
-
-    def lower_part(self, part, block_vars) -> Step:
+    def lower_part(self, part, block_vars, prev="start") -> Optional[Step]:
         if isinstance(part, QThis):
-            raise Unlowerable("`this` inside query")
+            # identity in the query walk (scopes.py query_retrieval,
+            # eval_context.rs: This continues with the current value)
+            return None
         if isinstance(part, QKey):
             if part_is_variable(part):
                 raise Unlowerable("variable key interpolation")
@@ -267,23 +351,49 @@ class _RuleLowering:
         if isinstance(part, QFilter):
             if part.name is not None:
                 raise Unlowerable("variable capture in filter")
-            return StepFilter(
-                conjunctions=[
+            if prev == "other":
+                # oracle raises InternalError for maps after such parts
+                raise Unlowerable("filter after index/filter/this part")
+            # filter clauses evaluate each candidate as a value scope
+            prev_scope = self._push_scope()
+            try:
+                conjunctions = [
                     [self.lower_guard_clause(c, block_vars) for c in disj]
                     for disj in part.conjunctions
                 ]
+            finally:
+                self._scope = prev_scope
+            return StepFilter(
+                conjunctions=conjunctions,
+                expand_maps=prev in ("start", "key"),
+                scalar_self=prev == "allindices",
             )
         if isinstance(part, QMapKeyFilter):
             if part.name is not None:
                 raise Unlowerable("variable capture in keys filter")
+            if part.clause.comparator not in (CmpOperator.Eq, CmpOperator.In):
+                # keys ordering runs full operator semantics on the
+                # oracle (eval_context.rs:830-922); the id-table match
+                # only covers Eq/In
+                raise Unlowerable("keys filter with ordering comparator")
             rhs = self.lower_rhs(part.clause.compare_with, block_vars)
+            ok_kinds = ("str", "regex")
+            if rhs.kind == "list":
+                if any(it.kind not in ok_kinds for it in rhs.items):
+                    raise Unlowerable("keys filter list with non-string items")
+                if part.clause.comparator == CmpOperator.Eq:
+                    # scalar key == list literal has len-1-unwrap /
+                    # NotComparable semantics (operators.rs:512-528)
+                    raise Unlowerable("keys == list literal")
+            elif rhs.kind not in ok_kinds:
+                raise Unlowerable(f"keys filter rhs kind {rhs.kind}")
             return StepKeysMatch(
                 rhs=rhs, op=part.clause.comparator, op_not=part.clause.comparator_inverse
             )
         raise Unlowerable(f"query part {part!r}")
 
     # -- rhs lowering --------------------------------------------------
-    def lower_rhs(self, cw, block_vars=None) -> RhsSpec:
+    def lower_rhs(self, cw, block_vars=None, op=None) -> RhsSpec:
         if isinstance(cw, AccessQuery):
             # `x IN %allowed` where %allowed is a literal assignment:
             # resolve at compile time (a Literal RHS in the reference,
@@ -292,29 +402,51 @@ class _RuleLowering:
             if parts and part_is_variable(parts[0]):
                 var = part_variable(parts[0])
                 lit = None
-                if block_vars and var in block_vars and isinstance(block_vars[var], PV):
-                    lit = block_vars[var]
+                if block_vars and var in block_vars:
+                    bound = block_vars[var][0]
+                    if isinstance(bound, PV):
+                        lit = bound
                 elif var in self.var_literals:
                     lit = self.var_literals[var]
                 rest = parts[1:]
                 if rest and isinstance(rest[0], QAllIndices):
                     rest = rest[1:]
                 if lit is not None and not rest:
-                    return self.lower_rhs(lit)
+                    return self.lower_rhs(lit, op=op)
             raise Unlowerable("non-literal RHS (query or function call)")
         if not isinstance(cw, PV):
             raise Unlowerable("non-literal RHS (query or function call)")
         k = cw.kind
         if k == STRING:
+            lit = cw.val
+            ordering = op in (
+                CmpOperator.Gt,
+                CmpOperator.Ge,
+                CmpOperator.Lt,
+                CmpOperator.Le,
+            )
             return RhsSpec(
                 kind="str",
-                str_id=self.interner.lookup(cw.val),
-                bits=self.interner.substring_bits(-1, cw.val),
+                str_id=self.interner.lookup(lit),
+                bits=self.interner.substring_bits(-1, lit),
+                # ordering tables only when the clause actually orders
+                lt_bits=np.array(
+                    [s < lit for s in self.interner.strings], dtype=bool
+                )
+                if ordering
+                else None,
+                le_bits=np.array(
+                    [s <= lit for s in self.interner.strings], dtype=bool
+                )
+                if ordering
+                else None,
             )
         if k == REGEX:
             return RhsSpec(kind="regex", bits=self.interner.regex_match_bits(cw.val))
         if k == CHAR:
-            return RhsSpec(kind="str", str_id=self.interner.lookup(cw.val))
+            # docs never contain CHAR nodes (loader emits STRING), and
+            # STRING vs CHAR is NotComparable (path_value.rs:1048-1070)
+            return RhsSpec(kind="never")
         if k == INT:
             return RhsSpec(kind="num", num=float(cw.val), num_kind=INT)
         if k == FLOAT:
@@ -325,7 +457,9 @@ class _RuleLowering:
             return RhsSpec(kind="null")
         if k in (RANGE_INT, RANGE_FLOAT, RANGE_CHAR):
             if k == RANGE_CHAR:
-                raise Unlowerable("char range literal")
+                # only CHAR values fall inside a char range and docs
+                # never contain CHAR nodes: never comparable -> FAIL
+                return RhsSpec(kind="never")
             r = cw.val
             return RhsSpec(
                 kind="range",
@@ -338,7 +472,7 @@ class _RuleLowering:
         if k == 7:  # LIST
             items = [self.lower_rhs(e) for e in cw.val]
             for it in items:
-                if it.kind not in ("str", "regex", "num", "bool", "null", "range"):
+                if it.kind not in ("str", "regex", "num", "bool", "null", "range", "never"):
                     raise Unlowerable("nested list in RHS list literal")
             return RhsSpec(kind="list", items=items)
         raise Unlowerable(f"RHS literal kind {cw.type_info()}")
@@ -360,7 +494,7 @@ class _RuleLowering:
         steps = self.lower_query(parts, block_vars)
         rhs = None
         if not ac.comparator.is_unary():
-            rhs = self.lower_rhs(ac.compare_with, block_vars)
+            rhs = self.lower_rhs(ac.compare_with, block_vars, op=ac.comparator)
         return CClause(
             steps=steps,
             op=ac.comparator,
@@ -375,17 +509,25 @@ class _RuleLowering:
         if isinstance(clause, GuardAccessClause):
             return self.lower_access_clause(clause, block_vars)
         if isinstance(clause, BlockGuardClause):
-            inner_vars = self._merge_block_vars(block_vars, clause.block)
-            return CBlockClause(
-                query_steps=self.lower_query(clause.query.query, block_vars),
-                match_all=clause.query.match_all,
-                not_empty=clause.not_empty,
-                inner=[
+            query_steps = self.lower_query(clause.query.query, block_vars)
+            # block bodies evaluate each query leaf as a value scope
+            prev_scope = self._push_scope()
+            try:
+                inner_vars = self._merge_block_vars(block_vars, clause.block)
+                inner = [
                     [self.lower_guard_clause(c, inner_vars) for c in disj]
                     for disj in clause.block.conjunctions
-                ],
+                ]
+            finally:
+                self._scope = prev_scope
+            return CBlockClause(
+                query_steps=query_steps,
+                match_all=clause.query.match_all,
+                not_empty=clause.not_empty,
+                inner=inner,
             )
         if isinstance(clause, WhenBlockClause):
+            # when-blocks keep the enclosing selection (no value scope)
             inner_vars = self._merge_block_vars(block_vars, clause.block)
             return CWhenBlock(
                 conditions=[
@@ -403,29 +545,95 @@ class _RuleLowering:
                 raise Unlowerable(f"named rule {clause.dependent_rule} not lowerable")
             return CNamedRef(rule_index=target, negation=clause.negation)
         if isinstance(clause, ParameterizedNamedRuleClause):
-            raise Unlowerable("parameterized rule call")
+            return self.lower_parameterized_call(clause, block_vars)
         if isinstance(clause, TypeBlock):
-            inner_vars = self._merge_block_vars(block_vars, clause.block)
-            if clause.conditions is not None:
-                raise Unlowerable("type block with when conditions")
-            return CBlockClause(
-                query_steps=self.lower_query(clause.query, block_vars),
-                match_all=True,
-                not_empty=False,
-                inner=[
+            query_steps = self.lower_query(clause.query, block_vars)
+            prev_scope = self._push_scope()
+            try:
+                inner_vars = self._merge_block_vars(block_vars, clause.block)
+                inner = [
                     [self.lower_guard_clause(c, inner_vars) for c in disj]
                     for disj in clause.block.conjunctions
+                ]
+            finally:
+                self._scope = prev_scope
+            body = CBlockClause(
+                query_steps=query_steps,
+                match_all=True,
+                not_empty=False,
+                inner=inner,
+            )
+            if clause.conditions is None:
+                return body
+            # conditions gate at the enclosing scope; != PASS -> SKIP
+            # (eval.rs:1649-1698, evaluator.eval_type_block_clause)
+            return CWhenBlock(
+                conditions=[
+                    [self.lower_guard_clause(c, block_vars) for c in disj]
+                    for disj in clause.conditions
                 ],
+                inner=[[body]],
             )
         raise Unlowerable(f"clause {type(clause).__name__}")
 
+    def lower_parameterized_call(
+        self, clause: ParameterizedNamedRuleClause, block_vars
+    ) -> CNode:
+        """Inline expansion of `rule_name(arg, ...)` (eval.rs:1504-1618):
+        arguments resolve in the caller's scope, then the callee body
+        evaluates with them overlaid (falling back to the caller's scope
+        for free variables, _ResolvedParameterContext semantics)."""
+        name = clause.named_rule.dependent_rule
+        prule = self.param_rules.get(name)
+        if prule is None:
+            raise Unlowerable(f"unknown parameterized rule {name}")
+        if name in self._param_stack:
+            raise Unlowerable(f"recursive parameterized rule {name}")
+        if len(prule.parameter_names) != len(clause.parameters):
+            # arity mismatch raises on the oracle (exit-code error path)
+            raise Unlowerable(f"arity mismatch calling {name}")
+        if clause.named_rule.negation:
+            raise Unlowerable(f"negated parameterized call {name}")
+        callee_vars = dict(block_vars)
+        for pname, arg in zip(prule.parameter_names, clause.parameters):
+            if isinstance(arg, PV):
+                callee_vars[pname] = (arg, self._scope)
+            elif isinstance(arg, AccessQuery):
+                callee_vars[pname] = (
+                    _PreloweredQuery(
+                        steps=self.lower_query(arg.query, block_vars),
+                        match_all=arg.match_all,
+                    ),
+                    self._scope,
+                )
+            else:
+                raise Unlowerable("function-call argument in rule call")
+        rule = prule.rule
+        callee_vars = self._merge_block_vars(callee_vars, rule.block)
+        self._param_stack.add(name)
+        try:
+            inner = [
+                [self.lower_guard_clause(c, callee_vars) for c in disj]
+                for disj in rule.block.conjunctions
+            ]
+            conds = None
+            if rule.conditions is not None:
+                conds = [
+                    [self.lower_guard_clause(c, callee_vars) for c in disj]
+                    for disj in rule.conditions
+                ]
+        finally:
+            self._param_stack.discard(name)
+        return CWhenBlock(conditions=conds, inner=inner)
+
     def _merge_block_vars(self, outer: dict, block: Block) -> dict:
+        """Bindings carry the scope token they were made under."""
         merged = dict(outer)
         for let in block.assignments:
             if isinstance(let.value, (AccessQuery, PV)):
-                merged[let.var] = let.value
+                merged[let.var] = (let.value, self._scope)
             else:
-                merged[let.var] = None  # function call: bail if used
+                merged[let.var] = (None, self._scope)  # function call: bail if used
         return merged
 
     def lower_rule(self, rule: Rule) -> CRule:

@@ -142,11 +142,30 @@ def run_step(d: _DocArrays, step: Step, sel, unres, rule_statuses=None):
         return new_sel, unres
 
     if isinstance(step, StepFilter):
-        # expand list candidates to their elements (eval_context.rs:755-791)
+        # list candidates always iterate their elements
+        # (eval_context.rs:755-791); map/scalar handling depends on the
+        # preceding part (ir.StepFilter docstring)
+        is_map = d.node_kind == MAP
+        is_list = d.node_kind == LIST
+        is_scalar = (sel > 0) & ~is_map & ~is_list
         parent_is_list = d.node_kind[d.edge_parent] == LIST
-        elem_contrib = jnp.where(d.edge_valid & (pk > 0) & parent_is_list, pk, 0)
+        expand_parent = parent_is_list
+        if step.expand_maps:
+            expand_parent = expand_parent | (d.node_kind[d.edge_parent] == MAP)
+        elem_contrib = jnp.where(d.edge_valid & (pk > 0) & expand_parent, pk, 0)
         elems = _scatter_child_labels(d, elem_contrib)
-        keep = jnp.where((sel > 0) & (d.node_kind != LIST), sel, 0)
+        if step.expand_maps:
+            # maps expanded to values; scalars are UnResolved
+            keep = jnp.zeros_like(sel)
+            unres = _add_unres(unres, sel, is_scalar)
+        else:
+            # maps filter themselves; scalars only survive after `[*]`
+            keep_mask = (sel > 0) & is_map
+            if step.scalar_self:
+                keep_mask = keep_mask | is_scalar
+            else:
+                unres = _add_unres(unres, sel, is_scalar)
+            keep = jnp.where(keep_mask, sel, 0)
         cand = jnp.maximum(elems, keep)  # candidates labeled with OUTER origin
         idx = jnp.arange(d.n, dtype=jnp.int32)
         cand_self = jnp.where(cand > 0, idx + 1, 0)  # each candidate = own origin
@@ -158,7 +177,8 @@ def run_step(d: _DocArrays, step: Step, sel, unres, rule_statuses=None):
 
     if isinstance(step, StepKeysMatch):
         # `[ keys == ... ]` (eval_context.rs:830-922): select map values
-        # whose KEY matches; key ids index the shared intern table
+        # whose KEY matches; key ids index the shared intern table.
+        # Non-map candidates are UnResolved (scopes._retrieve_map_key_filter)
         match = _rhs_match_on_ids(d, step.rhs, step.op, d.edge_key_id)
         if step.op_not:
             match = ~match
@@ -166,15 +186,22 @@ def run_step(d: _DocArrays, step: Step, sel, unres, rule_statuses=None):
             d.edge_valid & (pk > 0) & match & (d.edge_key_id >= 0), pk, 0
         )
         new_sel = _scatter_child_labels(d, contrib)
+        not_map = (sel > 0) & (d.node_kind != MAP)
+        unres = _add_unres(unres, sel, not_map)
         return new_sel, unres
 
     raise TypeError(f"unknown step {step!r}")
 
 
 def _rhs_match_on_ids(d: _DocArrays, rhs: RhsSpec, op: CmpOperator, ids) -> jnp.ndarray:
-    """String-id match (used for keys filters where LHS is a key id)."""
+    """String-id match (used for keys filters where LHS is a key id).
+    Lowering restricts keys-filter RHS to Eq/In over str/regex/list."""
     safe = jnp.maximum(ids, 0)
     if rhs.kind == "str":
+        if op == CmpOperator.In:
+            # `keys in 'lit'`: substring containment (operators.rs:218-230)
+            bits = jnp.asarray(rhs.bits)
+            return jnp.where(ids >= 0, bits[safe], False)
         return ids == rhs.str_id
     if rhs.kind == "regex":
         bits = jnp.asarray(rhs.bits)
@@ -198,6 +225,12 @@ def _compare_scalar_full(d: _DocArrays, rhs: RhsSpec, op: CmpOperator):
     kind = d.node_kind
     sid = jnp.maximum(d.scalar_id, 0)
     num = d.num_val
+
+    if rhs.kind == "never":
+        # literal kinds no document scalar is comparable with (char
+        # ranges, char literals): NotComparable -> FAIL everywhere
+        never = jnp.zeros(d.n, bool)
+        return never, never
 
     if op == CmpOperator.Eq or op == CmpOperator.In:
         if rhs.kind == "str":
@@ -234,8 +267,29 @@ def _compare_scalar_full(d: _DocArrays, rhs: RhsSpec, op: CmpOperator):
         raise TypeError(f"eq rhs {rhs.kind}")
 
     # ordering ops: same-kind scalars only (path_value.rs:1048-1070)
+    if rhs.kind == "str":
+        # lexicographic string ordering via precomputed tables
+        comparable = (kind == STRING) & (d.scalar_id >= 0)
+        lt = jnp.asarray(rhs.lt_bits)[sid]
+        le = jnp.asarray(rhs.le_bits)[sid]
+        if op == CmpOperator.Gt:
+            out = ~le
+        elif op == CmpOperator.Ge:
+            out = ~lt
+        elif op == CmpOperator.Lt:
+            out = lt
+        else:
+            out = le
+        return comparable & out, comparable
+    if rhs.kind == "null":
+        # NULL is ordered and all nulls compare equal (compare_values)
+        comparable = kind == NULL
+        out = op in (CmpOperator.Ge, CmpOperator.Le)
+        return comparable & out, comparable
     if rhs.kind != "num":
-        raise TypeError(f"ordering vs {rhs.kind}")
+        # bool/regex/range/list RHS: NotComparable -> FAIL everywhere
+        never = jnp.zeros(d.n, bool)
+        return never, never
     k = INT if rhs.num_kind == INT else FLOAT
     comparable = kind == k
     lit = np.float32(rhs.num)
@@ -337,18 +391,29 @@ def _eval_binary_outcomes(d: _DocArrays, c: CClause, sel_leaf):
             ok_child = _list_children_matching(d, is_list_leaf, m)
             outcome = jnp.where(is_list_leaf, ok_child == n_child, m)
             return outcome, (sel_leaf > 0)
-        items = rhs.items if rhs.kind == "list" else [rhs]
-        m = jnp.zeros(d.n, bool)
-        for item in items:
-            m = m | _compare_scalar(d, item, CmpOperator.Eq)
-        # scalar: in == any match; list leaf: ALL elements in rhs
-        # (contained_in, operators.rs:256-321); not_in: NO element in rhs
-        n_child = _list_children_total(d, is_list_leaf)
-        in_child = _list_children_matching(d, is_list_leaf, m)
+        if rhs.kind == "list":
+            # membership via loose_eq (never NotComparable): pure
+            # inversion under `not` (operators.rs value_in/list_in)
+            m = jnp.zeros(d.n, bool)
+            for item in rhs.items:
+                m = m | _compare_scalar(d, item, CmpOperator.Eq)
+            # scalar: in == any match; list leaf: ALL elements in rhs
+            # (contained_in, operators.rs:256-321); not_in: NO element
+            n_child = _list_children_total(d, is_list_leaf)
+            in_child = _list_children_matching(d, is_list_leaf, m)
+            if c.op_not:
+                outcome = jnp.where(is_list_leaf, in_child == 0, ~m)
+            else:
+                outcome = jnp.where(is_list_leaf, in_child == n_child, m)
+            return outcome, (sel_leaf > 0)
+        # scalar RHS: _contained_in -> _match_value(compare_eq), where
+        # NotComparable stays FAIL through the `not` inversion
+        # (evaluator.operator_compare keeps not_comparable tuples), and
+        # a LIST lhs vs non-list RHS is NotComparable -> FAIL
+        m, comparable = _compare_scalar_full(d, rhs, CmpOperator.Eq)
         if c.op_not:
-            outcome = jnp.where(is_list_leaf, in_child == 0, ~m)
-        else:
-            outcome = jnp.where(is_list_leaf, in_child == n_child, m)
+            m = comparable & ~m
+        outcome = jnp.where(is_list_leaf, False, m)
         return outcome, (sel_leaf > 0)
 
     raise TypeError(f"binary op {op}")
@@ -470,8 +535,11 @@ def eval_node(d: _DocArrays, node, sel, rule_statuses) -> jnp.ndarray:
     if isinstance(node, CBlockClause):
         return eval_block_clause(d, node, sel, rule_statuses)
     if isinstance(node, CWhenBlock):
-        cond = eval_conjunctions(d, node.conditions, sel, rule_statuses)
         block = eval_conjunctions(d, node.inner, sel, rule_statuses)
+        if node.conditions is None:
+            # ungated grouping (inline-expanded parameterized rule body)
+            return block
+        cond = eval_conjunctions(d, node.conditions, sel, rule_statuses)
         return jnp.where(cond == PASS, block, jnp.int8(SKIP))
     if isinstance(node, CNamedRef):
         st = rule_statuses[node.rule_index]

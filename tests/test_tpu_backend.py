@@ -175,6 +175,157 @@ def test_empty_checks_parity():
     assert_parity(rules, docs)
 
 
+def test_parameterized_rule_call_parity():
+    rules = (
+        "rule kms_key_check(topics) {\n"
+        "  %topics.Properties.Kms exists\n"
+        "  %topics.Properties.Kms == /^arn:/\n"
+        "}\n"
+        "rule caller {\n"
+        "  kms_key_check(Resources.*[ Type == 'AWS::SNS::Topic' ])\n"
+        "}\n"
+    )
+    docs = [
+        {"Resources": {"t": {"Type": "AWS::SNS::Topic", "Properties": {"Kms": "arn:aws:x"}}}},
+        {"Resources": {"t": {"Type": "AWS::SNS::Topic", "Properties": {"Kms": "alias/x"}}}},
+        {"Resources": {"t": {"Type": "AWS::SNS::Topic", "Properties": {}}}},
+        {"Resources": {"t": {"Type": "Other"}}},
+        {},
+    ]
+    assert_parity(rules, docs)
+
+
+def test_parameterized_rule_literal_arg_parity():
+    rules = (
+        "rule enc_is(algos) {\n"
+        "  Resources.*.Properties.Alg IN %algos\n"
+        "}\n"
+        "rule caller {\n"
+        "  enc_is(['aws:kms', 'AES256'])\n"
+        "}\n"
+    )
+    docs = [
+        {"Resources": {"x": {"Properties": {"Alg": "aws:kms"}}}},
+        {"Resources": {"x": {"Properties": {"Alg": "none"}}}},
+        {"Resources": {"x": {"Properties": {}}}},
+    ]
+    assert_parity(rules, docs)
+
+
+def test_parameterized_rule_with_when_inside_parity():
+    rules = (
+        "rule sized(vols) {\n"
+        "  when %vols !empty {\n"
+        "    %vols.Size <= 100\n"
+        "  }\n"
+        "}\n"
+        "rule caller {\n"
+        "  sized(Resources.*[ Type == 'V' ])\n"
+        "}\n"
+    )
+    docs = [
+        {"Resources": {"v": {"Type": "V", "Size": 50}}},
+        {"Resources": {"v": {"Type": "V", "Size": 500}}},
+        {"Resources": {"v": {"Type": "W", "Size": 500}}},
+        {},
+    ]
+    assert_parity(rules, docs)
+
+
+def test_type_block_with_when_conditions_parity():
+    rules = (
+        "rule r {\n"
+        "  AWS::S3::Bucket when Mode == 'strict' {\n"
+        "    Properties.Enc exists\n"
+        "  }\n"
+        "}\n"
+    )
+    docs = [
+        {"Mode": "strict", "Resources": {"b": {"Type": "AWS::S3::Bucket", "Properties": {"Enc": 1}}}},
+        {"Mode": "strict", "Resources": {"b": {"Type": "AWS::S3::Bucket", "Properties": {}}}},
+        {"Mode": "lax", "Resources": {"b": {"Type": "AWS::S3::Bucket", "Properties": {}}}},
+        {"Mode": "strict", "Resources": {"b": {"Type": "Other"}}},
+    ]
+    assert_parity(rules, docs)
+
+
+def test_this_in_query_parity():
+    rules = "rule r {\n  Resources.*.Name == /^p/\n  this.Resources exists\n}\n"
+    docs = [
+        {"Resources": {"x": {"Name": "p1"}}},
+        {"Resources": {"x": {"Name": "q1"}}},
+    ]
+    assert_parity(rules, docs)
+
+
+def test_char_range_never_comparable_parity():
+    rules = "rule r {\n  Resources.x.C IN r(a,z)\n}\n"
+    docs = [
+        {"Resources": {"x": {"C": "m"}}},
+        {"Resources": {"x": {"C": 5}}},
+    ]
+    assert_parity(rules, docs)
+
+
+def test_in_string_containment_direction_parity():
+    # lhs.val in rhs.val — the document value is the needle
+    rules = (
+        "rule r {\n  Resources.x.V IN 'abcdef'\n}\n"
+        "rule rn {\n  Resources.x.V !IN 'abcdef'\n}\n"
+    )
+    docs = [
+        {"Resources": {"x": {"V": v}}} for v in ["abc", "abcdefgh", "zzz", 5]
+    ]
+    assert_parity(rules, docs)
+
+
+def test_not_in_scalar_rhs_not_comparable_parity():
+    # NotComparable stays FAIL through the `not` inversion; a LIST lhs
+    # vs non-list RHS is NotComparable
+    rules = (
+        "rule r {\n  Resources.x.C !IN r(a,z)\n}\n"
+        "rule r2 {\n  Resources.x.L IN r[0,10]\n}\n"
+    )
+    docs = [
+        {"Resources": {"x": {"C": "m", "L": [5]}}},
+        {"Resources": {"x": {"C": 5, "L": 5}}},
+    ]
+    assert_parity(rules, docs)
+
+
+def test_variable_crossing_value_scope_refuses():
+    # a binding spliced at a narrower selection than its scope must
+    # refuse lowering (the oracle resolves it at the binding scope)
+    from guard_tpu.ops.encoder import Interner
+    from guard_tpu.ops.ir import compile_rules_file as cmp_rules
+
+    rules = (
+        "rule p(a) {\n  Resources.* {\n    Type exists\n    %a == 'strict'\n  }\n}\n"
+        "rule caller {\n  p(Config.Mode)\n}\n"
+        "let mode = Config.Mode\n"
+        "rule filevar {\n  Resources.* {\n    %mode == 'strict'\n  }\n}\n"
+    )
+    rf = parse_rules_file(rules, "t.guard")
+    doc = from_plain({"Config": {"Mode": "strict"}, "Resources": {"r": {"Type": "T"}}})
+    batch, interner = encode_batch([doc])
+    compiled = cmp_rules(rf, interner)
+    assert {r.rule_name for r in compiled.host_rules} == {"caller", "filevar"}
+
+
+def test_string_ordering_parity():
+    rules = (
+        "rule r {\n  Resources.x.V >= 'm'\n}\n"
+        "rule r2 {\n  Resources.x.V < 'm'\n}\n"
+        "rule r3 {\n  Resources.x.V > 'm'\n}\n"
+        "rule r4 {\n  Resources.x.V <= 'm'\n}\n"
+    )
+    docs = [
+        {"Resources": {"x": {"V": v}}}
+        for v in ["a", "m", "z", "mm", 5, True]
+    ]
+    assert_parity(rules, docs)
+
+
 # ---------------------------------------------------------------------------
 # full examples corpus differential
 # ---------------------------------------------------------------------------
