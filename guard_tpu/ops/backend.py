@@ -73,24 +73,31 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
     for rule_file in rule_files:
         compiled = compile_rules_file(rule_file.rules, interner)
         statuses = None
+        unsure = None
         if compiled.rules:
             evaluator = ShardedBatchEvaluator(compiled)
             statuses = evaluator(batch)  # (D, R)
+            unsure = evaluator.last_unsure  # (D, R) bool or None
 
         cases: List[JunitTestCase] = []
         for di, data_file in enumerate(data_files):
             rule_statuses = {}
+            unsure_rules = set()
             doc_status = Status.SKIP
             if statuses is not None:
                 for ri, crule in enumerate(compiled.rules):
                     st = _STATUS[int(statuses[di, ri])]
                     rule_statuses[crule.name] = st
                     doc_status = doc_status.and_(st)
+                    if unsure is not None and bool(unsure[di, ri]):
+                        unsure_rules.add(crule.name)
 
             # host fallback for unlowerable rules + rich reporting:
-            # rerun the oracle when anything failed or output needs detail
+            # rerun the oracle when anything failed, output needs
+            # detail, or the kernel flagged a shape it can't decide
             need_oracle = (
                 bool(compiled.host_rules)
+                or bool(unsure_rules)
                 or validate.structured
                 or validate.verbose
                 or validate.print_json
@@ -121,10 +128,12 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                 root_record = scope.reset_recorder().extract()
                 report = simplified_report_from_root(root_record, data_file.name)
                 oracle_rule_statuses = rule_statuses_from_root(root_record)
-                # parity assertion: kernel statuses must agree with oracle
+                # parity assertion: kernel statuses must agree with the
+                # oracle (except results the kernel flagged unsure —
+                # those use the oracle's answer by design)
                 for rn, st in rule_statuses.items():
                     ost = oracle_rule_statuses.get(rn)
-                    if ost is not None and ost != st:
+                    if ost is not None and ost != st and rn not in unsure_rules:
                         raise GuardError(
                             f"TPU/CPU status divergence for rule {rn} on "
                             f"{data_file.name}: tpu={st.value} cpu={ost.value}"

@@ -202,8 +202,8 @@ class DocBatch:
     n_nodes: int
     n_edges: int
 
-    def arrays(self) -> dict:
-        return {
+    def arrays(self, include_struct: bool = False) -> dict:
+        out = {
             "node_kind": self.node_kind,
             "node_parent": self.node_parent,
             "scalar_id": self.scalar_id,
@@ -215,6 +215,65 @@ class DocBatch:
             "edge_index": self.edge_index,
             "edge_valid": self.edge_valid,
         }
+        if include_struct:
+            out["struct_id"] = self.struct_ids()
+        return out
+
+    def struct_ids(self) -> np.ndarray:
+        """(D, N) int32 canonical-form ids: two nodes get the same id
+        iff they are `loose_eq` (values.loose_eq — strict scalar kinds,
+        ordered lists, unordered maps). Used by query-RHS comparisons
+        so set membership is an id-equality test on device. Computed
+        lazily (only rules with query RHS pay for it) and cached."""
+        if getattr(self, "_struct_ids", None) is not None:
+            return self._struct_ids
+        d_n = self.node_kind.shape
+        out = np.full(d_n, -1, dtype=np.int32)
+        table: dict = {}
+        for di in range(d_n[0]):
+            kinds = self.node_kind[di]
+            sids = self.scalar_id[di]
+            nums = self.num_val[di]
+            # group children per parent from the edge arrays
+            children: dict = {}
+            ev = self.edge_valid[di]
+            ep = self.edge_parent[di]
+            ec = self.edge_child[di]
+            ek = self.edge_key_id[di]
+            ei = self.edge_index[di]
+            for e in range(self.edge_parent.shape[1]):
+                if not ev[e]:
+                    continue
+                children.setdefault(int(ep[e]), []).append(
+                    (int(ei[e]), int(ek[e]), int(ec[e]))
+                )
+            # children always have higher indices than their parent
+            # (encoder visit order), so a reverse scan is bottom-up
+            for i in range(d_n[1] - 1, -1, -1):
+                k = int(kinds[i])
+                if k < 0:
+                    continue
+                if k == LIST:
+                    elems = sorted(children.get(i, []))
+                    key = ("l",) + tuple(int(out[di, c]) for _, _, c in elems)
+                elif k == MAP:
+                    entries = children.get(i, [])
+                    key = ("m", frozenset(
+                        (kid, int(out[di, c])) for _, kid, c in entries
+                    ))
+                elif k in (STRING, REGEX, CHAR):
+                    key = ("s", int(sids[i]))
+                elif k in (INT, FLOAT, BOOL):
+                    key = (k, float(nums[i]))
+                else:  # NULL
+                    key = ("n",)
+                sid = table.get(key)
+                if sid is None:
+                    sid = len(table)
+                    table[key] = sid
+                out[di, i] = sid
+        self._struct_ids = out
+        return out
 
 
 def _round_up(n: int, multiple: int = 8) -> int:

@@ -160,6 +160,10 @@ class CClause:
     rhs: Optional[RhsSpec]
     empty_on_expr: bool  # eval.rs:193-196 special EMPTY handling
     lhs_starts_at_root: bool = False  # absolute query inside value scope? no: relative
+    # RHS that is itself a query (resolved per document in the same
+    # scope as the LHS): set-comparison semantics, operators.rs:552-594
+    # (Eq query_in) and :434-451 (In). Only for Eq/In.
+    rhs_query_steps: Optional[List[Step]] = None
 
 
 @dataclass
@@ -202,6 +206,10 @@ class CompiledRules:
     interner: Interner
     # empty-string bit table for the EMPTY check on strings
     str_empty_bits: np.ndarray
+    # any rule compares against a query RHS: kernels need the canonical
+    # struct-id column (DocBatch.struct_ids) and may emit per-(doc,rule)
+    # "unsure" bits that route those docs to the oracle
+    needs_struct_ids: bool = False
 
 
 # ---------------------------------------------------------------------------
@@ -265,6 +273,7 @@ class _RuleLowering:
         self._param_stack = set()
         self._scope = 0  # 0 = rule root (document root selection)
         self._scope_counter = 0
+        self.needs_struct_ids = False
 
     def _push_scope(self):
         self._scope_counter += 1
@@ -493,8 +502,22 @@ class _RuleLowering:
         )
         steps = self.lower_query(parts, block_vars)
         rhs = None
+        rhs_query_steps = None
         if not ac.comparator.is_unary():
-            rhs = self.lower_rhs(ac.compare_with, block_vars, op=ac.comparator)
+            try:
+                rhs = self.lower_rhs(ac.compare_with, block_vars, op=ac.comparator)
+            except Unlowerable:
+                # non-literal RHS: a query compared per document in the
+                # same scope as the LHS (eval_guard_access_clause
+                # resolves it with resolver.query)
+                if not isinstance(ac.compare_with, AccessQuery):
+                    raise
+                if ac.comparator not in (CmpOperator.Eq, CmpOperator.In):
+                    raise Unlowerable("ordering comparison with query RHS")
+                rhs_query_steps = self.lower_query(
+                    ac.compare_with.query, block_vars
+                )
+                self.needs_struct_ids = True
         return CClause(
             steps=steps,
             op=ac.comparator,
@@ -503,6 +526,7 @@ class _RuleLowering:
             match_all=ac.query.match_all,
             rhs=rhs,
             empty_on_expr=empty_on_expr,
+            rhs_query_steps=rhs_query_steps,
         )
 
     def lower_guard_clause(self, clause, block_vars) -> CNode:
@@ -661,10 +685,12 @@ def compile_rules_file(rules_file: RulesFile, interner: Interner) -> CompiledRul
     names_seen = {}
     for r in rules_file.guard_rules:
         names_seen[r.rule_name] = names_seen.get(r.rule_name, 0) + 1
+    needs_struct = False
     for rule in rules_file.guard_rules:
         if names_seen[rule.rule_name] > 1:
             host.append(rule)
             continue
+        lowering.needs_struct_ids = False
         try:
             cr = lowering.lower_rule(rule)
         except Unlowerable:
@@ -672,6 +698,7 @@ def compile_rules_file(rules_file: RulesFile, interner: Interner) -> CompiledRul
             continue
         lowering.rule_index[rule.rule_name] = len(compiled)
         compiled.append(cr)
+        needs_struct = needs_struct or lowering.needs_struct_ids
     str_empty_bits = np.array(
         [len(s) == 0 for s in interner.strings], dtype=bool
     )
@@ -680,4 +707,5 @@ def compile_rules_file(rules_file: RulesFile, interner: Interner) -> CompiledRul
         host_rules=host,
         interner=interner,
         str_empty_bits=str_empty_bits,
+        needs_struct_ids=needs_struct,
     )

@@ -24,7 +24,7 @@ the TPU mesh by guard_tpu/parallel/mesh.py.
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -60,6 +60,7 @@ class _DocArrays:
 
     def __init__(self, arrays: Dict[str, jnp.ndarray], str_empty_bits: jnp.ndarray):
         self.node_kind = arrays["node_kind"]
+        self.node_parent = arrays["node_parent"]
         self.scalar_id = arrays["scalar_id"]
         self.num_val = arrays["num_val"]
         self.child_count = arrays["child_count"]
@@ -68,8 +69,13 @@ class _DocArrays:
         self.edge_key_id = arrays["edge_key_id"]
         self.edge_index = arrays["edge_index"]
         self.edge_valid = arrays["edge_valid"]
+        self.struct_id = arrays.get("struct_id")  # only for query-RHS rules
         self.str_empty_bits = str_empty_bits
         self.n = self.node_kind.shape[0]
+        # trace-time accumulator of per-clause "unsure" bits (shapes the
+        # kernel cannot decide exactly, routed to the oracle by the
+        # backend); eval_rule scoops up the bits its body appended
+        self.unsure_acc: List[jnp.ndarray] = []
 
 
 # ---------------------------------------------------------------------------
@@ -436,7 +442,94 @@ def _segment_count(d: _DocArrays, sel, pred) -> jnp.ndarray:
     )
 
 
+def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses) -> jnp.ndarray:
+    """LHS query vs RHS query, per origin (operators.rs:552-594 Eq
+    `query_in` set-difference; :434-451 In containment; the `not`
+    inversion reverse-diffs, operators.rs:637-646 via evaluator
+    `operator_compare`). Membership tests are canonical struct-id
+    equality (= loose_eq, encoder.DocBatch.struct_ids)."""
+    zero = jnp.zeros(d.n + 1, jnp.int32)
+    lhs_sel, lhs_unres = run_steps(d, c.steps, sel, zero, rule_statuses)
+    rhs_sel, rhs_unres = run_steps(d, c.rhs_query_steps, sel, zero, rule_statuses)
+    ones = jnp.ones(d.n, bool)
+    n_lhs = _segment_count(d, lhs_sel, ones)
+    n_rhs = _segment_count(d, rhs_sel, ones)
+    lhs_total = n_lhs + lhs_unres
+    rhs_total = n_rhs + rhs_unres
+
+    sid = d.struct_id
+    eq = (sid[:, None] == sid[None, :]) & (sid[:, None] >= 0)  # (N,N) loose_eq
+    same_origin = (lhs_sel[:, None] == rhs_sel[None, :]) & (lhs_sel[:, None] > 0)
+
+    if c.op == CmpOperator.Eq:
+        contained = eq  # loose_eq membership both directions
+    else:  # In: contained_in(l, r) — scalar/map in list-r also matches
+        is_list = d.node_kind == LIST
+        # count children of j loose_eq to i: boolean matmul over nodes
+        childmat = (
+            (d.node_parent[None, :] == jnp.arange(d.n)[:, None]).T
+        ).astype(jnp.float32)  # childmat[c, j] = 1 iff parent(c) == j
+        in_list = (eq.astype(jnp.float32) @ childmat) > 0  # (i, j)
+        contained = eq | (
+            (~is_list)[:, None] & is_list[None, :] & in_list
+        )
+        # l LIST in r LIST uses unordered-membership recursion the
+        # kernel does not model (unless identical): flag unsure
+        pair = same_origin & (rhs_sel[None, :] > 0)
+        unsure = jnp.any(
+            pair & is_list[:, None] & is_list[None, :] & ~eq
+        )
+        d.unsure_acc.append(unsure)
+
+    # member tests within each origin
+    m_lhs_in_rhs = jnp.any(same_origin & (rhs_sel[None, :] > 0) & contained, axis=1)
+    lhs_here = lhs_sel > 0
+    rhs_here = rhs_sel > 0
+    cnt_lhs_not_in = _segment_count(d, lhs_sel, lhs_here & ~m_lhs_in_rhs)
+
+    if c.op == CmpOperator.Eq:
+        rl_origin = (rhs_sel[:, None] == lhs_sel[None, :]) & (rhs_sel[:, None] > 0)
+        m_rhs_in_lhs = jnp.any(rl_origin & (lhs_sel[None, :] > 0) & eq, axis=1)
+        cnt_rhs_not_in = _segment_count(d, rhs_sel, rhs_here & ~m_rhs_in_lhs)
+        use_lhs_diff = n_lhs > n_rhs
+        diff_cnt = jnp.where(use_lhs_diff, cnt_lhs_not_in, cnt_rhs_not_in)
+        q_success = diff_cnt == 0
+        if c.op_not:
+            # reverse-diff: rdiff over lhs when diff came from lhs,
+            # else over rhs (operators.rs:637-646 + operator_compare)
+            diff_lhs = lhs_here & ~m_lhs_in_rhs  # diff membership (lhs case)
+            diff_rhs = rhs_here & ~m_rhs_in_lhs
+            ll_origin = (lhs_sel[:, None] == lhs_sel[None, :]) & (lhs_sel[:, None] > 0)
+            rr_origin = (rhs_sel[:, None] == rhs_sel[None, :]) & (rhs_sel[:, None] > 0)
+            in_diff_a = jnp.any(ll_origin & diff_lhs[None, :] & eq, axis=1)
+            in_diff_b = jnp.any(rr_origin & diff_rhs[None, :] & eq, axis=1)
+            rdiff_a = _segment_count(d, lhs_sel, lhs_here & ~in_diff_a)
+            rdiff_b = _segment_count(d, rhs_sel, rhs_here & ~in_diff_b)
+            rdiff_cnt = jnp.where(use_lhs_diff, rdiff_a, rdiff_b)
+            q_success = jnp.where(q_success, False, rdiff_cnt == 0)
+    else:  # In
+        q_success = cnt_lhs_not_in == 0
+        if c.op_not:
+            diff_lhs = lhs_here & ~m_lhs_in_rhs
+            ll_origin = (lhs_sel[:, None] == lhs_sel[None, :]) & (lhs_sel[:, None] > 0)
+            in_diff = jnp.any(ll_origin & diff_lhs[None, :] & eq, axis=1)
+            rdiff_cnt = _segment_count(d, lhs_sel, lhs_here & ~in_diff)
+            q_success = jnp.where(q_success, False, rdiff_cnt == 0)
+
+    # unresolved entries survive the inversion as FAILs; rhs-unresolved
+    # entries exist only when some lhs resolved (evaluator._eq_operation)
+    entry_fail = (lhs_unres > 0) | ((rhs_unres > 0) & (n_lhs > 0))
+    if c.match_all:
+        st = jnp.where(entry_fail | ~q_success, FAIL, PASS).astype(jnp.int8)
+    else:
+        st = jnp.where(q_success, PASS, FAIL).astype(jnp.int8)
+    skip = (lhs_total == 0) | (rhs_total == 0)
+    return jnp.where(skip, jnp.int8(SKIP), st)
+
+
 def eval_clause(d: _DocArrays, c: CClause, sel, rule_statuses=None) -> jnp.ndarray:
+    if c.rhs_query_steps is not None:
+        return _eval_query_rhs_clause(d, c, sel, rule_statuses)
     unres0 = jnp.zeros(d.n + 1, jnp.int32)
     sel_leaf, unres = run_steps(d, c.steps, sel, unres0, rule_statuses)
     n_res = _segment_count(d, sel_leaf, jnp.ones(d.n, bool))
@@ -543,6 +636,8 @@ def eval_node(d: _DocArrays, node, sel, rule_statuses) -> jnp.ndarray:
         return jnp.where(cond == PASS, block, jnp.int8(SKIP))
     if isinstance(node, CNamedRef):
         st = rule_statuses[node.rule_index]
+        # an unsure dependency makes the referencing rule unsure too
+        d.unsure_acc.append(d.rule_unsure[node.rule_index])
         if node.negation:
             out = jnp.where(st == PASS, jnp.int8(FAIL), jnp.int8(PASS))
         else:
@@ -612,44 +707,77 @@ def eval_conjunctions(d: _DocArrays, conjunctions, sel, rule_statuses=None):
     return _combine_conjunction(conj_statuses)
 
 
-def eval_rule(d: _DocArrays, rule: CRule, rule_statuses) -> jnp.ndarray:
-    """Scalar (int8) status of one rule for one document."""
+def eval_rule(d: _DocArrays, rule: CRule, rule_statuses) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(status, unsure) of one rule for one document. `unsure` ORs the
+    bits clauses in this rule's body appended to d.unsure_acc."""
+    mark = len(d.unsure_acc)
     sel_root = jnp.zeros(d.n, jnp.int32).at[0].set(1)
     body = eval_conjunctions(d, rule.conjunctions, sel_root, rule_statuses)[1]
     if rule.conditions is not None:
         cond = eval_conjunctions(d, rule.conditions, sel_root, rule_statuses)[1]
-        return jnp.where(cond == PASS, body, jnp.int8(SKIP))
-    return body
+        status = jnp.where(cond == PASS, body, jnp.int8(SKIP))
+    else:
+        status = body
+    bits = d.unsure_acc[mark:]
+    del d.unsure_acc[mark:]
+    unsure = jnp.asarray(False)
+    for b in bits:
+        unsure = unsure | b
+    return status, unsure
 
 
-def build_doc_evaluator(compiled: CompiledRules):
-    """Returns fn(per-doc arrays dict) -> (num_rules,) int8 statuses."""
+def build_doc_evaluator(compiled: CompiledRules, with_unsure: bool = False):
+    """Returns fn(per-doc arrays dict) -> (num_rules,) int8 statuses,
+    or (statuses, unsure (num_rules,) bool) when with_unsure."""
     str_empty = np.asarray(compiled.str_empty_bits)
 
-    def evaluate(arrays: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    def evaluate(arrays: Dict[str, jnp.ndarray]):
         d = _DocArrays(arrays, jnp.asarray(str_empty))
+        d.rule_unsure = []
         statuses: List[jnp.ndarray] = []
         for rule in compiled.rules:
-            statuses.append(eval_rule(d, rule, statuses))
+            st, u = eval_rule(d, rule, statuses)
+            statuses.append(st)
+            d.rule_unsure.append(u)
         if not statuses:
-            return jnp.zeros((0,), jnp.int8)
-        return jnp.stack(statuses)
+            out = jnp.zeros((0,), jnp.int8)
+            return (out, jnp.zeros((0,), bool)) if with_unsure else out
+        out = jnp.stack(statuses)
+        if with_unsure:
+            return out, jnp.stack(d.rule_unsure)
+        return out
 
     return evaluate
 
 
 class BatchEvaluator:
     """Jit-compiled (docs x rules) status evaluator. One instance per
-    (compiled rule file); retracing happens only per node/edge bucket."""
+    (compiled rule file); retracing happens only per node/edge bucket.
+    When the rule file compares against query RHS, `last_unsure` holds
+    the (D, R) bool matrix of results the backend must route to the
+    oracle."""
 
     def __init__(self, compiled: CompiledRules):
         self.compiled = compiled
-        self._fn = jax.jit(jax.vmap(build_doc_evaluator(compiled)))
+        self._with_unsure = compiled.needs_struct_ids
+        self._fn = jax.jit(
+            jax.vmap(build_doc_evaluator(compiled, with_unsure=self._with_unsure))
+        )
+        self.last_unsure: Optional[np.ndarray] = None
 
     def __call__(self, batch: DocBatch) -> np.ndarray:
         """(D, num_rules) int8 statuses: 0 PASS / 1 FAIL / 2 SKIP."""
-        arrays = {k: jnp.asarray(v) for k, v in batch.arrays().items()}
-        return np.asarray(self._fn(arrays))
+        arrays = {
+            k: jnp.asarray(v)
+            for k, v in batch.arrays(include_struct=self._with_unsure).items()
+        }
+        out = self._fn(arrays)
+        if self._with_unsure:
+            statuses, unsure = out
+            self.last_unsure = np.asarray(unsure)
+            return np.asarray(statuses)
+        self.last_unsure = None
+        return np.asarray(out)
 
 
 def evaluate_batch(compiled: CompiledRules, batch: DocBatch) -> np.ndarray:

@@ -50,31 +50,38 @@ def pad_to_multiple(batch_arrays: Dict[str, np.ndarray], multiple: int) -> Tuple
     out = {}
     for k, v in batch_arrays.items():
         pad = np.zeros((target - d,) + v.shape[1:], dtype=v.dtype)
-        if k == "node_kind":
+        if k in ("node_kind", "struct_id"):
             pad = pad - 1  # padding docs are all-padding nodes
         out[k] = np.concatenate([v, pad], axis=0)
     return out, d
 
 
 class ShardedBatchEvaluator:
-    """DP-sharded (docs x rules) status evaluator over a device mesh."""
+    """DP-sharded (docs x rules) status evaluator over a device mesh.
+    When the rule file compares against query RHS, `last_unsure` holds
+    the (D, R) bool matrix of results to route to the CPU oracle."""
 
     def __init__(self, compiled: CompiledRules, mesh: Optional[Mesh] = None):
         self.compiled = compiled
         self.mesh = mesh if mesh is not None else default_mesh()
-        doc_eval = build_doc_evaluator(compiled)
+        self._with_unsure = compiled.needs_struct_ids
+        doc_eval = build_doc_evaluator(compiled, with_unsure=self._with_unsure)
+        keys = _ARRAY_KEYS + (("struct_id",) if self._with_unsure else ())
         in_spec = NamedSharding(self.mesh, P(DOC_AXIS))
         out_spec = NamedSharding(self.mesh, P(DOC_AXIS))
         self._fn = jax.jit(
             jax.vmap(doc_eval),
-            in_shardings=({k: in_spec for k in _ARRAY_KEYS},),
-            out_shardings=out_spec,
+            in_shardings=({k: in_spec for k in keys},),
+            out_shardings=(out_spec, out_spec) if self._with_unsure else out_spec,
         )
+        self.last_unsure = None
+
         # aggregate summary: per-rule (n_pass, n_fail, n_skip) — the only
         # cross-chip reduction (SURVEY.md §2.3 "communication backend");
         # n_valid masks out docs added by mesh padding
         def summarize(arrays, n_valid):
-            statuses = jax.vmap(doc_eval)(arrays)  # (D, R) int8
+            out = jax.vmap(doc_eval)(arrays)  # (D, R) int8
+            statuses = out[0] if self._with_unsure else out
             valid = (jnp.arange(statuses.shape[0]) < n_valid)[:, None]
             counts = jnp.stack(
                 [
@@ -87,18 +94,29 @@ class ShardedBatchEvaluator:
 
         self._summary_fn = jax.jit(
             summarize,
-            in_shardings=({k: in_spec for k in _ARRAY_KEYS}, None),
+            in_shardings=({k: in_spec for k in keys}, None),
             out_shardings=(out_spec, NamedSharding(self.mesh, P())),
         )
 
+    def _arrays(self, batch: DocBatch):
+        return pad_to_multiple(
+            batch.arrays(include_struct=self._with_unsure),
+            self.mesh.devices.size,
+        )
+
     def __call__(self, batch: DocBatch) -> np.ndarray:
-        arrays, d = pad_to_multiple(batch.arrays(), self.mesh.devices.size)
+        arrays, d = self._arrays(batch)
         arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
         out = self._fn(arrays)
+        if self._with_unsure:
+            statuses, unsure = out
+            self.last_unsure = np.asarray(unsure)[:d]
+            return np.asarray(statuses)[:d]
+        self.last_unsure = None
         return np.asarray(out)[:d]
 
     def with_summary(self, batch: DocBatch) -> Tuple[np.ndarray, np.ndarray]:
-        arrays, d = pad_to_multiple(batch.arrays(), self.mesh.devices.size)
+        arrays, d = self._arrays(batch)
         arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
         statuses, counts = self._summary_fn(arrays, d)
         return np.asarray(statuses)[:d], np.asarray(counts)

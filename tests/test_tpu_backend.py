@@ -25,20 +25,28 @@ def cpu_status(rf, doc, rule_name):
 
 
 def tpu_statuses(rf, docs):
+    from guard_tpu.ops.kernels import BatchEvaluator
+
     batch, interner = encode_batch(docs)
     compiled = compile_rules_file(rf, interner)
     if not compiled.rules:
         return None, compiled
-    return evaluate_batch(compiled, batch), compiled
+    ev = BatchEvaluator(compiled)
+    statuses = ev(batch)
+    tpu_statuses.last_unsure = ev.last_unsure
+    return statuses, compiled
 
 
 def assert_parity(rules_text, doc_dicts):
     rf = parse_rules_file(rules_text, "t.guard")
     docs = [from_plain(d) for d in doc_dicts]
     statuses, compiled = tpu_statuses(rf, docs)
+    unsure = tpu_statuses.last_unsure
     assert statuses is not None, "rule should be lowerable"
     for di, doc in enumerate(docs):
         for ri, crule in enumerate(compiled.rules):
+            if unsure is not None and bool(unsure[di, ri]):
+                continue  # kernel routes these to the oracle by design
             cpu = cpu_status(rf, doc, crule.name)
             tpu = STATUS[int(statuses[di, ri])]
             assert cpu == tpu, f"doc {di} rule {crule.name}: cpu={cpu} tpu={tpu}"
@@ -324,6 +332,74 @@ def test_string_ordering_parity():
         for v in ["a", "m", "z", "mm", 5, True]
     ]
     assert_parity(rules, docs)
+
+
+def test_query_rhs_eq_parity():
+    rules = (
+        "rule r {\n  Resources.a.P == Resources.b.P\n}\n"
+        "rule rn {\n  Resources.a.P != Resources.b.P\n}\n"
+    )
+    docs = [
+        {"Resources": {"a": {"P": "x"}, "b": {"P": "x"}}},
+        {"Resources": {"a": {"P": "x"}, "b": {"P": "y"}}},
+        {"Resources": {"a": {"P": 5}, "b": {"P": 5}}},
+        {"Resources": {"a": {"P": 5}, "b": {"P": 5.0}}},
+        {"Resources": {"a": {"P": [1, 2]}, "b": {"P": [1, 2]}}},
+        {"Resources": {"a": {"P": [1, 2]}, "b": {"P": [2, 1]}}},
+        {"Resources": {"a": {"P": {"k": 1}}, "b": {"P": {"k": 1}}}},
+        {"Resources": {"a": {"P": "x"}, "b": {}}},
+        {"Resources": {"a": {}, "b": {}}},
+    ]
+    assert_parity(rules, docs)
+
+
+def test_query_rhs_eq_multi_value_sets_parity():
+    rules = "rule r {\n  Resources.*.Tags == Allowed.Tags\n}\n"
+    docs = [
+        {"Resources": {"a": {"Tags": "t1"}, "b": {"Tags": "t2"}},
+         "Allowed": {"Tags": ["t1", "t2"]}},
+        {"Resources": {"a": {"Tags": "t1"}}, "Allowed": {"Tags": ["t1"]}},
+        {"Resources": {"a": {"Tags": "t3"}}, "Allowed": {"Tags": ["t1", "t2"]}},
+    ]
+    assert_parity(rules, docs)
+
+
+def test_query_rhs_in_parity():
+    rules = (
+        "let allowed = Mappings.AllowedValues\n"
+        "rule r {\n  Resources.*.Properties.Alg IN %allowed\n}\n"
+        "rule rn {\n  Resources.*.Properties.Alg !IN %allowed\n}\n"
+    )
+    docs = [
+        {"Mappings": {"AllowedValues": ["aws:kms", "AES256"]},
+         "Resources": {"x": {"Properties": {"Alg": "aws:kms"}}}},
+        {"Mappings": {"AllowedValues": ["aws:kms"]},
+         "Resources": {"x": {"Properties": {"Alg": "none"}}}},
+        {"Mappings": {"AllowedValues": "aws:kms"},
+         "Resources": {"x": {"Properties": {"Alg": "aws:kms"}}}},
+        {"Mappings": {},
+         "Resources": {"x": {"Properties": {"Alg": "aws:kms"}}}},
+        {"Mappings": {"AllowedValues": [5, 7]},
+         "Resources": {"x": {"Properties": {"Alg": 5}}}},
+    ]
+    assert_parity(rules, docs)
+
+
+def test_query_rhs_in_list_list_flags_unsure():
+    rules = "rule r {\n  Resources.x.L IN Resources.x.Allowed\n}\n"
+    rf = parse_rules_file(rules, "t.guard")
+    docs = [
+        from_plain({"Resources": {"x": {"L": [1, 2], "Allowed": [[2, 1], [3]]}}}),
+        from_plain({"Resources": {"x": {"L": "s", "Allowed": ["s", "t"]}}}),
+    ]
+    statuses, compiled = tpu_statuses(rf, docs)
+    unsure = tpu_statuses.last_unsure
+    assert compiled.needs_struct_ids
+    assert unsure is not None
+    # doc 0 has a list-vs-list containment -> unsure; doc 1 does not
+    assert bool(unsure[0, 0])
+    assert not bool(unsure[1, 0])
+    assert STATUS[int(statuses[1, 0])] == cpu_status(rf, docs[1], "r")
 
 
 # ---------------------------------------------------------------------------
