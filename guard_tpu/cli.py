@@ -70,6 +70,20 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["single-line-summary", "json", "yaml", "junit"],
     )
 
+    s = sub.add_parser(
+        "sweep",
+        help=(
+            "Resumable batch evaluation over a large corpus: chunked TPU "
+            "evaluation with a JSONL checkpoint manifest"
+        ),
+    )
+    s.add_argument("--rules", "-r", nargs="*", default=[])
+    s.add_argument("--data", "-d", nargs="*", default=[])
+    s.add_argument("--manifest", "-M", default="sweep-manifest.jsonl")
+    s.add_argument("--chunk-size", "-c", type=int, default=1024)
+    s.add_argument("--backend", default="tpu", choices=["cpu", "tpu"])
+    s.add_argument("--last-modified", "-m", action="store_true")
+
     pt = sub.add_parser("parse-tree", help="Prints the parse tree for a rules file")
     pt.add_argument("--rules", "-r", default=None)
     pt.add_argument("--output", "-o", default=None)
@@ -122,6 +136,17 @@ def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reade
                 last_modified=args.last_modified,
                 verbose=args.verbose,
                 output_format=args.output_format,
+            ).execute(writer, reader)
+        if args.command == "sweep":
+            from .commands.sweep import Sweep
+
+            return Sweep(
+                rules=args.rules,
+                data=args.data,
+                manifest=args.manifest,
+                chunk_size=args.chunk_size,
+                backend=args.backend,
+                last_modified=args.last_modified,
             ).execute(writer, reader)
         if args.command == "parse-tree":
             return ParseTree(

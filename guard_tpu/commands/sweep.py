@@ -1,0 +1,290 @@
+"""The `sweep` command: resumable batch evaluation over large corpora.
+
+The reference has no checkpoint/resume — runs are short-lived and
+stateless (SURVEY.md §5: "for the TPU sweep over 1M templates, add
+batch-level resumability; nothing to copy from the reference"). This
+command is that subsystem: the corpus is split into deterministic
+chunks, each chunk batch-evaluates on the TPU engine (statuses only —
+use `validate` for rich reports), and a JSONL manifest records one
+line per completed chunk. Re-running with the same manifest skips
+completed chunks whose content signature still matches, so an
+interrupted sweep resumes where it stopped.
+
+Exit codes follow `validate` (0 pass / 19 fail / 5 error,
+reference commands/mod.rs:69-71).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from ..core.errors import GuardError, ParseError
+from ..core.evaluator import eval_rules_file
+from ..core.loader import load_document
+from ..core.parser import parse_rules_file
+from ..core.qresult import Status
+from ..core.scopes import RootScope
+from ..utils.io import Reader, Writer
+from .files import DATA_FILE_EXTENSIONS, RULE_FILE_EXTENSIONS, gather
+from .validate import (
+    ERROR_STATUS_CODE,
+    FAILURE_STATUS_CODE,
+    SUCCESS_STATUS_CODE,
+    DataFile,
+    RuleFile,
+)
+
+_STATUS_NAMES = ("pass", "fail", "skip")
+
+
+def _chunk_signature(paths: List[Path]) -> str:
+    h = hashlib.sha256()
+    for p in paths:
+        st = p.stat()
+        h.update(f"{p}\0{st.st_size}\0{int(st.st_mtime)}\n".encode())
+    return h.hexdigest()[:16]
+
+
+def _read_manifest(path: Path) -> Dict[int, dict]:
+    """Last record per chunk index wins (a re-run appends)."""
+    done: Dict[int, dict] = {}
+    if not path.exists():
+        return done
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn tail write from an interrupted run
+        if isinstance(rec, dict) and "chunk" in rec:
+            done[int(rec["chunk"])] = rec
+    return done
+
+
+@dataclass
+class Sweep:
+    rules: List[str] = field(default_factory=list)
+    data: List[str] = field(default_factory=list)
+    manifest: str = "sweep-manifest.jsonl"
+    chunk_size: int = 1024
+    backend: str = "tpu"  # tpu | cpu (oracle; mainly for testing)
+    last_modified: bool = False
+
+    def execute(self, writer: Writer, reader: Reader) -> int:
+        if not self.rules:
+            raise GuardError("must specify rules")
+        if not self.data:
+            raise GuardError("must specify data")
+        if self.chunk_size < 1:
+            raise GuardError("chunk-size must be >= 1")
+
+        rule_files, parse_errors = self._parse_rules(writer)
+        if not rule_files:
+            writer.writeln_err("no parseable rule files")
+            return ERROR_STATUS_CODE
+
+        paths = list(gather(self.data, DATA_FILE_EXTENSIONS, self.last_modified))
+        chunks = [
+            paths[i : i + self.chunk_size]
+            for i in range(0, len(paths), self.chunk_size)
+        ]
+
+        manifest_path = Path(self.manifest)
+        done = _read_manifest(manifest_path)
+        manifest_path.parent.mkdir(parents=True, exist_ok=True)
+
+        evaluated = skipped = 0
+        with manifest_path.open("a") as mf:
+            for ci, chunk in enumerate(chunks):
+                sig = _chunk_signature(chunk)
+                prev = done.get(ci)
+                if prev is not None and prev.get("sig") == sig:
+                    skipped += 1
+                    continue
+                rec = self._evaluate_chunk(ci, sig, chunk, rule_files, writer)
+                done[ci] = rec
+                mf.write(json.dumps(rec) + "\n")
+                mf.flush()
+                evaluated += 1
+
+        totals = {k: 0 for k in _STATUS_NAMES}
+        failed: List[dict] = []
+        errors = parse_errors
+        for ci in range(len(chunks)):
+            rec = done.get(ci)
+            if rec is None:
+                continue
+            for k in _STATUS_NAMES:
+                totals[k] += rec["counts"].get(k, 0)
+            failed.extend(rec.get("failed", []))
+            errors += rec.get("errors", 0)
+        summary = {
+            "chunks": len(chunks),
+            "evaluated": evaluated,
+            "resumed": skipped,
+            "documents": len(paths),
+            "counts": totals,
+            "failed": failed,
+            "errors": errors,
+            "manifest": str(manifest_path),
+        }
+        writer.writeln(json.dumps(summary))
+        if errors:
+            return ERROR_STATUS_CODE
+        if totals["fail"]:
+            return FAILURE_STATUS_CODE
+        return SUCCESS_STATUS_CODE
+
+    def _parse_rules(self, writer: Writer):
+        rule_files: List[RuleFile] = []
+        errors = 0
+        for f in gather(self.rules, RULE_FILE_EXTENSIONS, self.last_modified):
+            content = f.read_text()
+            try:
+                rf = parse_rules_file(content, f.name)
+            except ParseError as e:
+                # per-file error isolation (validate.rs:406-434)
+                writer.writeln_err(f"Parse Error on ruleset file {f.name}")
+                writer.writeln_err(str(e))
+                errors += 1
+                continue
+            if rf is not None:
+                rule_files.append(
+                    RuleFile(name=f.name, full_name=str(f), content=content, rules=rf)
+                )
+        return rule_files, errors
+
+    # -- one chunk ----------------------------------------------------
+    def _evaluate_chunk(
+        self, ci: int, sig: str, chunk: List[Path], rule_files, writer: Writer
+    ) -> dict:
+        counts = {k: 0 for k in _STATUS_NAMES}
+        failed: List[dict] = []
+        errors = 0
+
+        data_files: List[DataFile] = []
+        for p in chunk:
+            try:
+                content = p.read_text()
+                data_files.append(
+                    DataFile(
+                        name=p.name,
+                        content=content,
+                        path_value=load_document(content, p.name),
+                    )
+                )
+            except (GuardError, OSError) as e:
+                writer.writeln_err(f"skipping {p}: {e}")
+                errors += 1
+
+        per_doc: List[Dict[str, Status]] = [dict() for _ in data_files]
+        if self.backend == "tpu":
+            errors += self._eval_tpu(data_files, rule_files, per_doc, writer)
+        else:
+            errors += self._eval_oracle(
+                data_files, rule_files, None, per_doc, writer
+            )
+
+        for df, statuses in zip(data_files, per_doc):
+            doc_status = Status.SKIP
+            for st in statuses.values():
+                doc_status = doc_status.and_(st)
+            counts[doc_status.value.lower()] += 1
+            fails = sorted(n for n, s in statuses.items() if s == Status.FAIL)
+            if fails:
+                failed.append({"data": df.name, "rules": fails})
+
+        return {
+            "chunk": ci,
+            "sig": sig,
+            "files": len(chunk),
+            "first": chunk[0].name if chunk else None,
+            "counts": counts,
+            "failed": failed,
+            "errors": errors,
+        }
+
+    def _eval_tpu(self, data_files, rule_files, per_doc, writer) -> int:
+        from ..ops.encoder import encode_batch
+        from ..ops.ir import FAIL, PASS, SKIP, compile_rules_file
+        from ..ops.native_encoder import encode_json_batch_native, native_available
+        from ..parallel.mesh import ShardedBatchEvaluator
+
+        _status = {PASS: Status.PASS, FAIL: Status.FAIL, SKIP: Status.SKIP}
+        if not data_files:
+            return 0
+        batch = interner = None
+        if native_available() and all(
+            df.content.lstrip()[:1] in ("{", "[") for df in data_files
+        ):
+            try:
+                batch, interner, err = encode_json_batch_native(
+                    [df.content for df in data_files]
+                )
+                if err is not None:
+                    batch = interner = None
+            except RuntimeError:
+                batch = interner = None
+        if batch is None:
+            batch, interner = encode_batch([df.path_value for df in data_files])
+
+        errors = 0
+        for rf in rule_files:
+            compiled = compile_rules_file(rf.rules, interner)
+            unsure = None
+            if compiled.rules:
+                evaluator = ShardedBatchEvaluator(compiled)
+                statuses = evaluator(batch)
+                unsure = evaluator.last_unsure
+                for di in range(len(data_files)):
+                    for ri, crule in enumerate(compiled.rules):
+                        per_doc[di][crule.name] = _status[int(statuses[di, ri])]
+            # host fallback: unlowerable rules run on the oracle for
+            # every doc; unsure-flagged docs re-run all rules on it
+            if compiled.host_rules:
+                errors += self._eval_oracle(
+                    data_files,
+                    [rf],
+                    {"only_rules": {r.rule_name for r in compiled.host_rules}},
+                    per_doc,
+                    writer,
+                )
+            if unsure is not None:
+                oracle_docs = {
+                    di for di in range(len(data_files)) if bool(unsure[di].any())
+                }
+                if oracle_docs:
+                    errors += self._eval_oracle(
+                        data_files, [rf], {"only_docs": oracle_docs}, per_doc, writer
+                    )
+        return errors
+
+    def _eval_oracle(self, data_files, rule_files, restrict, per_doc, writer) -> int:
+        from .report import rule_statuses_from_root
+
+        only_docs = restrict.get("only_docs") if restrict else None
+        only_rules = restrict.get("only_rules") if restrict else None
+        errors = 0
+        for rf in rule_files:
+            for di, df in enumerate(data_files):
+                if only_docs is not None and di not in only_docs:
+                    continue
+                try:
+                    scope = RootScope(rf.rules, df.path_value)
+                    eval_rules_file(rf.rules, scope, df.name)
+                except GuardError as e:
+                    writer.writeln_err(f"{df.name} vs {rf.name}: {e}")
+                    errors += 1
+                    continue
+                statuses = rule_statuses_from_root(scope.reset_recorder().extract())
+                for rn, st in statuses.items():
+                    if only_rules is not None and rn not in only_rules:
+                        continue
+                    per_doc[di][rn] = st
+        return errors

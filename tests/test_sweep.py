@@ -1,0 +1,111 @@
+"""`sweep` command: chunked evaluation with checkpoint/resume manifest
+(the batch-resumability subsystem, SURVEY.md §5)."""
+
+import json
+
+import pytest
+
+from guard_tpu.cli import run
+from guard_tpu.utils.io import Reader, Writer
+
+RULES = """\
+rule sized {
+    Resources.*.Size <= 100
+}
+"""
+
+
+def _mk_corpus(tmp_path, n=5, bad=(2,)):
+    rules = tmp_path / "rules.guard"
+    rules.write_text(RULES)
+    data = tmp_path / "data"
+    data.mkdir()
+    for i in range(n):
+        size = 500 if i in bad else 50
+        (data / f"doc{i:02}.json").write_text(
+            json.dumps({"Resources": {"r": {"Size": size}}})
+        )
+    return rules, data
+
+
+def _run_sweep(tmp_path, rules, data, backend="tpu", chunk=2):
+    w = Writer.buffered()
+    code = run(
+        [
+            "sweep",
+            "-r", str(rules),
+            "-d", str(data),
+            "-M", str(tmp_path / "manifest.jsonl"),
+            "-c", str(chunk),
+            "--backend", backend,
+        ],
+        writer=w,
+        reader=Reader.from_string(""),
+    )
+    out = w.stripped()
+    summary = json.loads(out.splitlines()[-1]) if out.strip() else None
+    return code, summary
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_sweep_counts_and_exit_code(tmp_path, backend):
+    rules, data = _mk_corpus(tmp_path)
+    code, summary = _run_sweep(tmp_path, rules, data, backend=backend)
+    assert code == 19
+    assert summary["documents"] == 5
+    assert summary["counts"] == {"pass": 4, "fail": 1, "skip": 0}
+    assert summary["failed"] == [{"data": "doc02.json", "rules": ["sized"]}]
+    assert summary["evaluated"] == 3  # ceil(5 / 2) chunks
+    assert summary["resumed"] == 0
+
+
+def test_sweep_resume_skips_completed_chunks(tmp_path):
+    rules, data = _mk_corpus(tmp_path)
+    code, s1 = _run_sweep(tmp_path, rules, data, backend="cpu")
+    assert s1["evaluated"] == 3
+    # second run: everything checkpointed, nothing re-evaluated
+    code, s2 = _run_sweep(tmp_path, rules, data, backend="cpu")
+    assert code == 19
+    assert s2["evaluated"] == 0
+    assert s2["resumed"] == 3
+    assert s2["counts"] == s1["counts"]
+
+
+def test_sweep_interrupted_manifest_resumes_tail(tmp_path):
+    rules, data = _mk_corpus(tmp_path)
+    _run_sweep(tmp_path, rules, data, backend="cpu")
+    manifest = tmp_path / "manifest.jsonl"
+    lines = manifest.read_text().splitlines()
+    # simulate a crash after the first two chunks (plus a torn write)
+    manifest.write_text("\n".join(lines[:2]) + '\n{"chunk": 2, "tor')
+    code, s = _run_sweep(tmp_path, rules, data, backend="cpu")
+    assert s["evaluated"] == 1
+    assert s["resumed"] == 2
+    assert s["counts"] == {"pass": 4, "fail": 1, "skip": 0}
+
+
+def test_sweep_reruns_changed_chunk(tmp_path):
+    rules, data = _mk_corpus(tmp_path)
+    _, s1 = _run_sweep(tmp_path, rules, data, backend="cpu")
+    # fix the failing doc: its chunk signature changes -> re-evaluated
+    bad = data / "doc02.json"
+    bad.write_text(json.dumps({"Resources": {"r": {"Size": 10}}}))
+    import os
+
+    os.utime(bad, (0, 0))  # force a different mtime signature
+    code, s2 = _run_sweep(tmp_path, rules, data, backend="cpu")
+    assert code == 0
+    assert s2["evaluated"] == 1
+    assert s2["resumed"] == 2
+    assert s2["counts"] == {"pass": 5, "fail": 0, "skip": 0}
+
+
+def test_sweep_error_paths(tmp_path):
+    rules, data = _mk_corpus(tmp_path, n=2, bad=())
+    w = Writer.buffered()
+    code = run(
+        ["sweep", "-d", str(data)],
+        writer=w,
+        reader=Reader.from_string(""),
+    )
+    assert code == 5  # no rules
