@@ -112,6 +112,8 @@ def main() -> None:
             flush=True,
         )
     import jax
+    import jax.numpy as jnp
+    from jax import lax
 
     from guard_tpu.core.parser import parse_rules_file
     from guard_tpu.core.scopes import RootScope
@@ -119,7 +121,7 @@ def main() -> None:
     from guard_tpu.core.values import from_plain
     from guard_tpu.ops.encoder import encode_batch
     from guard_tpu.ops.ir import compile_rules_file
-    from guard_tpu.ops.kernels import BatchEvaluator
+    from guard_tpu.ops.kernels import build_doc_evaluator
 
     rng = np.random.default_rng(7)
     n_docs = 4096
@@ -129,22 +131,47 @@ def main() -> None:
     batch, interner = encode_batch(docs)
     compiled = compile_rules_file(rf, interner)
     assert len(compiled.rules) == 4 and not compiled.host_rules
+    doc_eval = build_doc_evaluator(compiled)
 
-    evaluator = BatchEvaluator(compiled)
-    import jax.numpy as jnp
+    # Measurement: the remote-device tunnel makes per-dispatch timing
+    # meaningless (async dispatch returns before execution; host
+    # round-trips re-upload inputs). So the evaluation runs K times
+    # inside ONE compiled fori_loop with an opaque zero data dependency
+    # (defeats loop-invariant hoisting), the scalar reduction is
+    # fetched, and per-iteration device time is the K-loop minus the
+    # 1-loop wall time over (K - 1).
+    def make_loop(iters: int):
+        @jax.jit
+        def loop(arrays):
+            def body(_, acc):
+                dep = jnp.minimum(acc % 2, 0).astype(jnp.int32)  # opaque 0
+                arr2 = dict(arrays)
+                arr2["scalar_id"] = arrays["scalar_id"] + dep
+                st = jax.vmap(doc_eval)(arr2)
+                return acc + jnp.sum(st.astype(jnp.int32))
+
+            return lax.fori_loop(0, iters, body, jnp.int32(0))
+
+        return loop
 
     arrays = {k: jax.device_put(jnp.asarray(v)) for k, v in batch.arrays().items()}
-    statuses = evaluator._fn(arrays)  # warm-up: compile
-    jax.block_until_ready(statuses)
+    k_inner = 17
+    fn1, fnk = make_loop(1), make_loop(k_inner)
+    int(fn1(arrays))  # compile
+    int(fnk(arrays))
 
-    iters = 20
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        statuses = evaluator._fn(arrays)
-    jax.block_until_ready(statuses)
-    t1 = time.perf_counter()
-    tpu_docs_per_sec = n_docs * iters / (t1 - t0)
-    statuses = np.asarray(statuses)
+    def _med(fn, reps=3):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            int(fn(arrays))  # scalar fetch forces completion
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2]
+
+    t_1 = _med(fn1)
+    t_k = _med(fnk)
+    per_iter = max((t_k - t_1) / (k_inner - 1), 1e-9)
+    tpu_docs_per_sec = n_docs / per_iter
 
     # CPU reference-evaluator baseline, measured (BASELINE.md): same
     # docs x same rules through the oracle
