@@ -1,0 +1,29 @@
+# guard-tpu container image (equivalent of the reference Dockerfile,
+# which copies the cfn-guard binary into a distroless base).
+#
+#   docker build -t guard-tpu .
+#   docker run --rm -v $PWD:/work guard-tpu validate -r /work/rules -d /work/templates
+#
+# The default image evaluates on CPU devices (jax[cpu]); for TPU hosts
+# install the matching jax[tpu] wheel in a derived image.
+FROM python:3.12-slim AS build
+
+WORKDIR /src
+COPY pyproject.toml ./
+COPY guard_tpu ./guard_tpu
+COPY pre_commit_hooks ./pre_commit_hooks
+COPY native ./native
+RUN pip install --no-cache-dir --prefix=/install .
+
+# optional native pieces (columnar JSON encoder, C ABI shim)
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && sh native/build.sh || echo "native encoder skipped" \
+    && sh native/build_ffi.sh || echo "ffi shim skipped" \
+    && mkdir -p /install/lib/guard-tpu-native \
+    && cp native/*.so /install/lib/guard-tpu-native/ 2>/dev/null || true
+
+FROM python:3.12-slim
+COPY --from=build /install /usr/local
+ENV GUARD_TPU_NATIVE_DIR=/usr/local/lib/guard-tpu-native
+ENTRYPOINT ["guard-tpu"]
+CMD ["--help"]

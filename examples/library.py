@@ -1,0 +1,65 @@
+"""Minimal embedding example for the guard-tpu library API.
+
+Equivalent of the reference's library example
+(/root/reference/guard-examples/library/src/main.rs:22-45): build a
+Validate command programmatically, feed a payload through an injected
+reader, and capture structured output — no files, no CLI.
+
+Run: python examples/library.py
+"""
+
+import json
+
+import guard_tpu
+from guard_tpu.api import ValidateBuilder
+from guard_tpu.utils.io import Reader, Writer
+
+RULES = """
+rule s3_bucket_server_side_encryption {
+    Resources.*[ Type == 'AWS::S3::Bucket' ] {
+        Properties.BucketEncryption exists
+    }
+}
+"""
+
+TEMPLATE = json.dumps(
+    {
+        "Resources": {
+            "logs": {
+                "Type": "AWS::S3::Bucket",
+                "Properties": {"BucketEncryption": {"ServerSideEncryptionConfiguration": []}},
+            },
+            "scratch": {"Type": "AWS::S3::Bucket", "Properties": {}},
+        }
+    }
+)
+
+
+def one_shot() -> None:
+    """run_checks: single (data, rules) pair -> JSON report string."""
+    report = guard_tpu.run_checks(TEMPLATE, RULES)
+    print("run_checks ->")
+    print(json.dumps(json.loads(report), indent=2)[:400], "...")
+
+
+def builder_payload() -> None:
+    """ValidateBuilder payload mode (the wasm/npm entry in the
+    reference, lib.rs:318-347): rules+data from one JSON payload."""
+    payload = json.dumps({"rules": [RULES], "data": [TEMPLATE]})
+    cmd = (
+        ValidateBuilder()
+        .payload(True)
+        .structured(True)
+        .output_format("json")
+        .show_summary(["none"])
+        .try_build()
+    )
+    writer = Writer.buffered()
+    code = cmd.execute(writer, Reader.from_string(payload))
+    print(f"builder payload exit code: {code}")
+    print(writer.stripped()[:400], "...")
+
+
+if __name__ == "__main__":
+    one_shot()
+    builder_payload()

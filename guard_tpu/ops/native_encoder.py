@@ -18,7 +18,14 @@ import numpy as np
 
 from .encoder import DocBatch, Interner
 
-_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+import os
+
+_NATIVE_DIR = Path(
+    os.environ.get(
+        "GUARD_TPU_NATIVE_DIR",
+        Path(__file__).resolve().parent.parent.parent / "native",
+    )
+)
 _SO_PATH = _NATIVE_DIR / "libguard_encoder.so"
 
 

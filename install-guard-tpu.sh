@@ -1,0 +1,33 @@
+#!/bin/sh
+# Install guard-tpu and smoke-test the CLI.
+#
+# Equivalent of the reference's install-guard.sh (which downloads a
+# pinned release binary per-OS); guard-tpu is a Python package, so the
+# install path is pip. By default installs from the current checkout;
+# pass a pip requirement (e.g. a git URL or version) to override.
+#
+#   sh install-guard-tpu.sh            # install from this checkout
+#   sh install-guard-tpu.sh guard-tpu==0.1.0
+set -eu
+
+REQ="${1:-}"
+PYTHON="${PYTHON:-python3}"
+
+if ! command -v "$PYTHON" >/dev/null 2>&1; then
+    echo "error: $PYTHON not found" >&2
+    exit 1
+fi
+
+if [ -z "$REQ" ]; then
+    SCRIPT_DIR=$(CDPATH= cd -- "$(dirname -- "$0")" && pwd)
+    REQ="$SCRIPT_DIR"
+fi
+
+echo "installing guard-tpu from: $REQ"
+"$PYTHON" -m pip install --upgrade "$REQ"
+
+# smoke test: version + a tiny payload validate (exit 0 expected)
+guard-tpu --version
+printf '%s' '{"rules":["rule ok { this exists }"],"data":["{\"a\":1}"]}' \
+    | guard-tpu validate --payload -S none >/dev/null
+echo "guard-tpu installed and working"

@@ -1,0 +1,71 @@
+"""Satellite surfaces: the npm wrapper's CLI contract, the library
+embedding example, and the install-script smoke path (SURVEY.md §2.2)."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_ts_lib_cli_contract(tmp_path):
+    """ts_lib/index.ts drives `validate --structured -S none -o sarif
+    -r <files> -d <files>`; that invocation must emit parseable SARIF
+    and the documented exit codes."""
+    rules = tmp_path / "r.guard"
+    rules.write_text("rule has_res {\n  Resources !empty\n}\n")
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"Resources": {"a": 1}}))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"Other": 1}))
+
+    from guard_tpu.cli import run
+    from guard_tpu.utils.io import Reader, Writer
+
+    w = Writer.buffered()
+    code = run(
+        [
+            "validate", "--structured", "-S", "none", "-o", "sarif",
+            "-r", str(rules), "-d", str(good), str(bad),
+        ],
+        writer=w,
+        reader=Reader.from_string(""),
+    )
+    assert code == 19  # EXIT_CODES.validationFailure in ts_lib/index.ts
+    sarif = json.loads(w.stripped())
+    assert sarif["version"] == "2.1.0"
+    assert sarif["runs"][0]["results"], "failing doc must produce results"
+
+    # the TS source must reference exactly this surface
+    ts = (REPO / "ts_lib" / "index.ts").read_text()
+    for fragment in ('"--structured"', '"sarif"', "validationFailure: 19"):
+        assert fragment in ts
+
+
+def test_library_example_runs():
+    out = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "library.py")],
+        capture_output=True,
+        text=True,
+        env={"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin:/usr/local/bin"},
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "run_checks ->" in out.stdout
+    assert "builder payload exit code: 19" in out.stdout
+
+
+def test_install_script_payload_smoke():
+    """The smoke payload baked into install-guard-tpu.sh must pass."""
+    from guard_tpu.cli import run
+    from guard_tpu.utils.io import Reader, Writer
+
+    payload = '{"rules":["rule ok { this exists }"],"data":["{\\"a\\":1}"]}'
+    w = Writer.buffered()
+    code = run(
+        ["validate", "--payload", "-S", "none"],
+        writer=w,
+        reader=Reader.from_string(payload),
+    )
+    assert code == 0

@@ -1,0 +1,116 @@
+/**
+ * guard-tpu npm surface: validate() -> SARIF.
+ *
+ * Equivalent of the reference ts-lib (/root/reference/guard/ts-lib/
+ * index.ts:156-178): walk rule/data paths, run a structured SARIF
+ * validate, and rewrite result locations to real file names. The
+ * reference drives a wasm build of its engine; this wrapper drives the
+ * installed `guard-tpu` CLI (python) over the same payload contract,
+ * so the evaluation semantics are the framework's single engine.
+ */
+
+import { execFile } from "child_process";
+import { promises as fs } from "fs";
+import * as path from "path";
+
+export interface ValidateInput {
+  /** Path to a rule file or a directory of .guard files. */
+  rulesPath: string;
+  /** Path to a data file or a directory of JSON/YAML templates. */
+  dataPath: string;
+  /** CLI entry point; defaults to `guard-tpu` on PATH. */
+  cliPath?: string;
+  /** Evaluate on the TPU batch engine (`--backend tpu`). */
+  tpuBackend?: boolean;
+}
+
+export interface SarifLog {
+  version: string;
+  $schema: string;
+  runs: Array<{
+    tool: { driver: { name: string; rules?: unknown[] } };
+    results: Array<{
+      ruleId?: string;
+      message: { text: string };
+      locations?: Array<{
+        physicalLocation?: {
+          artifactLocation?: { uri?: string };
+          region?: { startLine?: number; startColumn?: number };
+        };
+      }>;
+    }>;
+  }>;
+}
+
+const RULE_EXTENSIONS = new Set([".guard", ".ruleset"]);
+const DATA_EXTENSIONS = new Set([".json", ".yaml", ".yml", ".jsn", ".template"]);
+
+async function collectFiles(root: string, exts: Set<string>): Promise<string[]> {
+  const st = await fs.stat(root);
+  if (st.isFile()) return [root];
+  const out: string[] = [];
+  for (const entry of await fs.readdir(root, { withFileTypes: true })) {
+    const p = path.join(root, entry.name);
+    if (entry.isDirectory()) {
+      out.push(...(await collectFiles(p, exts)));
+    } else if (exts.has(path.extname(entry.name))) {
+      out.push(p);
+    }
+  }
+  return out.sort();
+}
+
+function runCli(
+  cli: string,
+  args: string[],
+  stdin?: string
+): Promise<{ code: number; stdout: string; stderr: string }> {
+  return new Promise((resolve, reject) => {
+    const child = execFile(cli, args, { maxBuffer: 64 * 1024 * 1024 }, (err, stdout, stderr) => {
+      const anyErr = err as NodeJS.ErrnoException | null;
+      if (anyErr && anyErr.code === "ENOENT") {
+        reject(new Error(`guard-tpu CLI not found at '${cli}'`));
+        return;
+      }
+      // validate exits 19 on rule failures — that is a result, not an error
+      const code = anyErr && typeof anyErr.code === "number" ? anyErr.code : 0;
+      resolve({ code, stdout: stdout ?? "", stderr: stderr ?? "" });
+    });
+    if (stdin !== undefined && child.stdin) {
+      child.stdin.write(stdin);
+      child.stdin.end();
+    }
+  });
+}
+
+/**
+ * Validate every data file against every rule file; returns the SARIF
+ * log (reference ts-lib formatOutput contract: ruleIds/uris refer to
+ * the real input file names).
+ */
+export async function validate(input: ValidateInput): Promise<SarifLog> {
+  const cli = input.cliPath ?? "guard-tpu";
+  const ruleFiles = await collectFiles(input.rulesPath, RULE_EXTENSIONS);
+  const dataFiles = await collectFiles(input.dataPath, DATA_EXTENSIONS);
+  if (ruleFiles.length === 0) throw new Error(`no rule files under ${input.rulesPath}`);
+  if (dataFiles.length === 0) throw new Error(`no data files under ${input.dataPath}`);
+
+  const args = [
+    "validate",
+    "--structured",
+    "-S", "none",
+    "-o", "sarif",
+    "-r", ...ruleFiles,
+    "-d", ...dataFiles,
+  ];
+  if (input.tpuBackend) args.push("--backend", "tpu");
+
+  const { code, stdout, stderr } = await runCli(cli, args);
+  if (code !== 0 && code !== 19) {
+    throw new Error(`guard-tpu validate failed (exit ${code}): ${stderr}`);
+  }
+  return JSON.parse(stdout) as SarifLog;
+}
+
+/** Exit-code protocol of the wrapped CLI (reference commands/mod.rs:69-73). */
+export const EXIT_CODES = { success: 0, validationFailure: 19, error: 5 } as const;
