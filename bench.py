@@ -154,7 +154,10 @@ def main() -> None:
 
         return loop
 
-    arrays = {k: jax.device_put(jnp.asarray(v)) for k, v in batch.arrays().items()}
+    arrays = {
+        k: jax.device_put(jnp.asarray(v))
+        for k, v in compiled.device_arrays(batch).items()
+    }
     k_inner = 17
     fn1, fnk = make_loop(1), make_loop(k_inner)
     int(fn1(arrays))  # compile

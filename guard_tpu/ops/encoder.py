@@ -186,6 +186,22 @@ class DocBatch:
 
     All arrays have a leading doc axis — the axis that gets DP-sharded
     across the TPU mesh (guard_tpu/parallel/mesh.py).
+
+    On construction three derived *per-node* columns are computed from
+    the edge arrays. They fold each node's unique parent edge into the
+    node itself, which is what lets the kernels run entirely on
+    elementwise ops + one-hot parent compares — device-side gathers are
+    catastrophically slow on TPU (measured ~150x a fused masked
+    reduction at these shapes), so every array the kernel indexes by a
+    *data-dependent* index is instead precomputed host-side:
+
+      * ``node_key_id``     (D, N): intern id of the map key under
+        which this node sits (-1 for list elements, -2 for the root
+        and padding);
+      * ``node_index``      (D, N): list index of this node in its
+        parent (-1 for map entries, -2 for root/padding);
+      * ``node_parent_kind`` (D, N): node kind of the parent (-1 for
+        root/padding).
     """
 
     node_kind: np.ndarray  # (D, N) int32; -1 padding
@@ -201,6 +217,27 @@ class DocBatch:
     n_docs: int
     n_nodes: int
     n_edges: int
+    node_key_id: np.ndarray = None  # (D, N) derived, see class docstring
+    node_index: np.ndarray = None  # (D, N) derived
+    node_parent_kind: np.ndarray = None  # (D, N) derived
+
+    def __post_init__(self):
+        if self.node_key_id is not None:
+            return
+        d, n = self.node_kind.shape
+        # scatter each edge's attributes onto its child node; invalid
+        # padding edges all have child 0 (the root), which is fixed up
+        # after the scatter — the root has no parent edge
+        self.node_key_id = np.full((d, n), -2, dtype=np.int32)
+        np.put_along_axis(self.node_key_id, self.edge_child, self.edge_key_id, axis=1)
+        self.node_key_id[:, 0] = -2
+        self.node_index = np.full((d, n), -2, dtype=np.int32)
+        np.put_along_axis(self.node_index, self.edge_child, self.edge_index, axis=1)
+        self.node_index[:, 0] = -2
+        pk = np.take_along_axis(self.node_kind, np.maximum(self.edge_parent, 0), axis=1)
+        self.node_parent_kind = np.full((d, n), -1, dtype=np.int32)
+        np.put_along_axis(self.node_parent_kind, self.edge_child, pk, axis=1)
+        self.node_parent_kind[:, 0] = -1
 
     def arrays(self, include_struct: bool = False) -> dict:
         out = {
@@ -214,6 +251,9 @@ class DocBatch:
             "edge_key_id": self.edge_key_id,
             "edge_index": self.edge_index,
             "edge_valid": self.edge_valid,
+            "node_key_id": self.node_key_id,
+            "node_index": self.node_index,
+            "node_parent_kind": self.node_parent_kind,
         }
         if include_struct:
             out["struct_id"] = self.struct_ids()
@@ -278,6 +318,62 @@ class DocBatch:
 
 def _round_up(n: int, multiple: int = 8) -> int:
     return max(multiple, ((n + multiple - 1) // multiple) * multiple)
+
+
+# node-capacity buckets for the kernel path: the kernels' fused one-hot
+# traversal is O(N^2) per doc per step, which is the fastest known
+# formulation on TPU for the small/medium documents that dominate real
+# corpora (device gathers/scatters measured ~150x slower at these
+# shapes) but a real cliff for giant documents — those route to the CPU
+# oracle instead (ops/backend.py)
+NODE_BUCKETS = (64, 128, 256, 512, 1024, 2048)
+
+
+def split_batch_by_size(
+    batch: DocBatch, buckets: Tuple[int, ...] = NODE_BUCKETS
+) -> Tuple[List[Tuple[DocBatch, np.ndarray]], np.ndarray]:
+    """Split a batch into per-size-bucket sub-batches so small documents
+    are not padded (and evaluated) at the largest document's shape.
+
+    Returns (groups, oversize_doc_indices): each group is (sub_batch,
+    doc_indices) with node/edge axes sliced down to the bucket shape —
+    exact because padding is always a suffix. Documents larger than the
+    biggest bucket are returned in `oversize_doc_indices` for CPU-oracle
+    evaluation."""
+    n_real = (batch.node_kind >= 0).sum(axis=1)
+    e_real = batch.edge_valid.sum(axis=1)
+    oversize = np.where(n_real > buckets[-1])[0]
+    groups: List[Tuple[DocBatch, np.ndarray]] = []
+    lo = 0
+    for b in buckets:
+        idx = np.where((n_real > lo) & (n_real <= b))[0]
+        lo = b
+        if len(idx) == 0:
+            continue
+        m_nodes = min(b, batch.n_nodes)
+        m_edges = min(
+            max(_round_up(int(e_real[idx].max())), 8), batch.n_edges
+        )
+        sub = DocBatch(
+            node_kind=batch.node_kind[idx, :m_nodes],
+            node_parent=batch.node_parent[idx, :m_nodes],
+            scalar_id=batch.scalar_id[idx, :m_nodes],
+            num_val=batch.num_val[idx, :m_nodes],
+            child_count=batch.child_count[idx, :m_nodes],
+            edge_parent=batch.edge_parent[idx, :m_edges],
+            edge_child=batch.edge_child[idx, :m_edges],
+            edge_key_id=batch.edge_key_id[idx, :m_edges],
+            edge_index=batch.edge_index[idx, :m_edges],
+            edge_valid=batch.edge_valid[idx, :m_edges],
+            n_docs=len(idx),
+            n_nodes=m_nodes,
+            n_edges=m_edges,
+            node_key_id=batch.node_key_id[idx, :m_nodes],
+            node_index=batch.node_index[idx, :m_nodes],
+            node_parent_kind=batch.node_parent_kind[idx, :m_nodes],
+        )
+        groups.append((sub, idx))
+    return groups, oversize
 
 
 def encode_batch(docs: List[PV], interner: Optional[Interner] = None,

@@ -139,6 +139,12 @@ class RhsSpec:
     # (path_value.rs:1048-1070 via compare_values; gt = ~le, ge = ~lt)
     lt_bits: Optional[np.ndarray] = None
     le_bits: Optional[np.ndarray] = None
+    # slots into CompiledRules.bit_tables, assigned by _assign_bit_slots:
+    # the (S,) per-string tables are materialized host-side into (D, N)
+    # per-NODE bool columns per batch, so the kernel never gathers
+    bits_slot: int = -1
+    lt_slot: int = -1
+    le_slot: int = -1
     num: float = 0.0
     num_kind: int = INT  # INT or FLOAT for numeric literals
     range_lo: float = 0.0
@@ -210,6 +216,41 @@ class CompiledRules:
     # struct-id column (DocBatch.struct_ids) and may emit per-(doc,rule)
     # "unsure" bits that route those docs to the oracle
     needs_struct_ids: bool = False
+    # (table, target) per slot; target "scalar" applies the (S,) table
+    # through scalar_id, "key" through node_key_id
+    bit_tables: List[Tuple[np.ndarray, str]] = field(default_factory=list)
+    str_empty_slot: int = -1
+
+    def device_arrays(self, batch) -> dict:
+        """Everything the kernel reads, as a flat dict of (D, ...)
+        arrays: the static per-node columns plus one precomputed bool
+        column per bit-table slot (gathering `table[id]` here on the
+        host — device gathers are ~150x slower than the kernels' fused
+        one-hot forms at these shapes)."""
+        out = {
+            "node_kind": batch.node_kind,
+            "node_parent": batch.node_parent,
+            "scalar_id": batch.scalar_id,
+            "num_val": batch.num_val,
+            "child_count": batch.child_count,
+            "node_key_id": batch.node_key_id,
+            "node_index": batch.node_index,
+            "node_parent_kind": batch.node_parent_kind,
+        }
+        if self.needs_struct_ids:
+            out["struct_id"] = batch.struct_ids()
+        for i, (table, target) in enumerate(self.bit_tables):
+            ids = batch.scalar_id if target == "scalar" else batch.node_key_id
+            if len(table) == 0:
+                col = np.zeros(ids.shape, dtype=bool)
+            else:
+                # ids beyond the table (strings interned after compile)
+                # are conservatively False; lowering re-runs per chunk
+                # in the sweep path so this only affects padding
+                safe = np.clip(ids, 0, len(table) - 1)
+                col = table[safe] & (ids >= 0) & (ids < len(table))
+            out[f"bits{i}"] = col
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -702,10 +743,86 @@ def compile_rules_file(rules_file: RulesFile, interner: Interner) -> CompiledRul
     str_empty_bits = np.array(
         [len(s) == 0 for s in interner.strings], dtype=bool
     )
-    return CompiledRules(
+    out = CompiledRules(
         rules=compiled,
         host_rules=host,
         interner=interner,
         str_empty_bits=str_empty_bits,
         needs_struct_ids=needs_struct,
     )
+    _assign_bit_slots(out)
+    return out
+
+
+def _assign_bit_slots(compiled: CompiledRules) -> None:
+    """Walk the compiled tree and give a slot in `compiled.bit_tables`
+    to every bit table the kernel will actually read (each slot becomes
+    a host-materialized (D, N) column per batch, so unused ones cost
+    real transfer/pad work). Tables inside StepKeysMatch apply to
+    map-key ids ("key" target); everywhere else to scalar ids. Readers
+    (kernels.py): regex bits under Eq/In; str substring bits only under
+    In; lt/le ordering tables whenever present (they are only built for
+    ordering clauses); the empty-string table only for elementwise
+    Empty clauses."""
+    seen = {}
+    uses_empty = [False]
+
+    def slot(arr: np.ndarray, target: str) -> int:
+        k = (id(arr), target)
+        if k not in seen:
+            seen[k] = len(compiled.bit_tables)
+            compiled.bit_tables.append((arr, target))
+        return seen[k]
+
+    def do_rhs(rhs: Optional[RhsSpec], target: str, op) -> None:
+        if rhs is None:
+            return
+        reads_bits = (
+            rhs.kind == "regex" and op in (CmpOperator.Eq, CmpOperator.In)
+        ) or (rhs.kind == "str" and op == CmpOperator.In)
+        if reads_bits and rhs.bits is not None:
+            rhs.bits_slot = slot(rhs.bits, target)
+        if rhs.lt_bits is not None:
+            rhs.lt_slot = slot(rhs.lt_bits, target)
+        if rhs.le_bits is not None:
+            rhs.le_slot = slot(rhs.le_bits, target)
+        if rhs.items:
+            for it in rhs.items:
+                # list items always compare by Eq semantics (membership
+                # / elementwise list-literal compare)
+                do_rhs(it, target, CmpOperator.Eq)
+
+    def do_steps(steps: List[Step]) -> None:
+        for s in steps:
+            if isinstance(s, StepKeysMatch):
+                do_rhs(s.rhs, "key", s.op)
+            elif isinstance(s, StepFilter):
+                do_conjs(s.conjunctions)
+
+    def do_node(n) -> None:
+        if isinstance(n, CClause):
+            do_steps(n.steps)
+            do_rhs(n.rhs, "scalar", n.op)
+            if n.op == CmpOperator.Empty and not n.empty_on_expr:
+                uses_empty[0] = True
+            if n.rhs_query_steps is not None:
+                do_steps(n.rhs_query_steps)
+        elif isinstance(n, CBlockClause):
+            do_steps(n.query_steps)
+            do_conjs(n.inner)
+        elif isinstance(n, CWhenBlock):
+            if n.conditions is not None:
+                do_conjs(n.conditions)
+            do_conjs(n.inner)
+
+    def do_conjs(conjs) -> None:
+        for disj in conjs:
+            for n in disj:
+                do_node(n)
+
+    for r in compiled.rules:
+        if r.conditions is not None:
+            do_conjs(r.conditions)
+        do_conjs(r.conjunctions)
+    if uses_empty[0]:
+        compiled.str_empty_slot = slot(compiled.str_empty_bits, "scalar")

@@ -66,12 +66,13 @@ class ShardedBatchEvaluator:
         self.mesh = mesh if mesh is not None else default_mesh()
         self._with_unsure = compiled.needs_struct_ids
         doc_eval = build_doc_evaluator(compiled, with_unsure=self._with_unsure)
-        keys = _ARRAY_KEYS + (("struct_id",) if self._with_unsure else ())
+        # every input array is doc-major: one sharding as a pytree
+        # prefix covers the whole arrays dict
         in_spec = NamedSharding(self.mesh, P(DOC_AXIS))
         out_spec = NamedSharding(self.mesh, P(DOC_AXIS))
         self._fn = jax.jit(
             jax.vmap(doc_eval),
-            in_shardings=({k: in_spec for k in keys},),
+            in_shardings=(in_spec,),
             out_shardings=(out_spec, out_spec) if self._with_unsure else out_spec,
         )
         self.last_unsure = None
@@ -94,13 +95,13 @@ class ShardedBatchEvaluator:
 
         self._summary_fn = jax.jit(
             summarize,
-            in_shardings=({k: in_spec for k in keys}, None),
+            in_shardings=(in_spec, None),
             out_shardings=(out_spec, NamedSharding(self.mesh, P())),
         )
 
     def _arrays(self, batch: DocBatch):
         return pad_to_multiple(
-            batch.arrays(include_struct=self._with_unsure),
+            self.compiled.device_arrays(batch),
             self.mesh.devices.size,
         )
 
@@ -122,15 +123,3 @@ class ShardedBatchEvaluator:
         return np.asarray(statuses)[:d], np.asarray(counts)
 
 
-_ARRAY_KEYS = (
-    "node_kind",
-    "node_parent",
-    "scalar_id",
-    "num_val",
-    "child_count",
-    "edge_parent",
-    "edge_child",
-    "edge_key_id",
-    "edge_index",
-    "edge_valid",
-)

@@ -526,3 +526,94 @@ def test_sharded_evaluator_cpu_mesh():
     st2, counts = ev.with_summary(batch)
     assert counts.shape == (3, 1)
     assert int(counts[0, 0]) + int(counts[1, 0]) == 37
+
+
+def test_split_batch_by_size_groups_and_oversize():
+    from guard_tpu.ops.encoder import split_batch_by_size
+
+    small = {"a": 1}
+    medium = {"Resources": {f"r{i}": {"Type": "T", "Properties": {"x": i}} for i in range(30)}}
+    giant = {"Resources": {f"r{i}": {"Type": "T"} for i in range(1100)}}
+    docs = [from_plain(d) for d in (small, medium, giant, small)]
+    batch, _ = encode_batch(docs)
+    groups, oversize = split_batch_by_size(batch)
+    assert list(oversize) == [2]
+    covered = sorted(int(i) for _, idx in groups for i in idx)
+    assert covered == [0, 1, 3]
+    for sub, idx in groups:
+        # padding shapes shrink to the bucket, content preserved exactly
+        assert sub.n_nodes <= batch.n_nodes
+        for j, di in enumerate(idx):
+            n = int((sub.node_kind[j] >= 0).sum())
+            assert n == int((batch.node_kind[di] >= 0).sum())
+            np.testing.assert_array_equal(
+                sub.node_kind[j, :n], batch.node_kind[di, :n]
+            )
+            np.testing.assert_array_equal(
+                sub.node_key_id[j, :n], batch.node_key_id[di, :n]
+            )
+
+
+def test_bucketed_parity_mixed_sizes():
+    """Same statuses whether evaluated as one batch or per size bucket."""
+    from guard_tpu.ops.encoder import split_batch_by_size
+    from guard_tpu.ops.kernels import BatchEvaluator
+
+    rules = """
+let r = Resources.*[ Type == 'AWS::S3::Bucket' ]
+rule sse when %r !empty { %r.Properties.Enc == true }
+"""
+    rf = parse_rules_file(rules, "t.guard")
+    doc_dicts = []
+    for i in range(6):
+        res = {
+            f"b{j}": {
+                "Type": "AWS::S3::Bucket",
+                "Properties": {"Enc": (i + j) % 2 == 0},
+            }
+            for j in range(1 + 20 * (i % 3))
+        }
+        doc_dicts.append({"Resources": res})
+    docs = [from_plain(d) for d in doc_dicts]
+    batch, interner = encode_batch(docs)
+    compiled = compile_rules_file(rf, interner)
+    ev = BatchEvaluator(compiled)
+    whole = ev(batch)
+    groups, oversize = split_batch_by_size(batch, buckets=(32, 64, 2048))
+    assert len(oversize) == 0 and len(groups) >= 2
+    merged = np.full_like(whole, -1)
+    for sub, idx in groups:
+        merged[idx] = BatchEvaluator(compiled)(sub)
+    np.testing.assert_array_equal(whole, merged)
+    for di, doc in enumerate(docs):
+        cpu = cpu_status(rf, doc, "sse")
+        assert STATUS[int(whole[di, 0])] == cpu
+
+
+def test_backend_routes_oversize_docs_to_oracle(tmp_path):
+    """validate --backend tpu agrees with the plain oracle backend when
+    the corpus contains a document beyond the largest node bucket."""
+    import json
+
+    from guard_tpu.cli import run
+
+    rules = tmp_path / "r.guard"
+    rules.write_text(
+        "let b = Resources.*[ Type == 'AWS::S3::Bucket' ]\n"
+        "rule sse when %b !empty { %b.Properties.Enc == true }\n"
+    )
+    data = tmp_path / "data"
+    data.mkdir()
+    (data / "small.json").write_text(json.dumps(
+        {"Resources": {"b": {"Type": "AWS::S3::Bucket", "Properties": {"Enc": True}}}}
+    ))
+    giant = {"Resources": {f"r{i}": {"Type": "X"} for i in range(1100)}}
+    giant["Resources"]["b"] = {
+        "Type": "AWS::S3::Bucket", "Properties": {"Enc": False}
+    }
+    (data / "giant.json").write_text(json.dumps(giant))
+    code_tpu = run([
+        "validate", "--backend", "tpu", "-r", str(rules), "-d", str(data)
+    ])
+    code_cpu = run(["validate", "-r", str(rules), "-d", str(data)])
+    assert code_tpu == code_cpu == 19  # giant doc fails via oracle routing

@@ -68,13 +68,21 @@ function runCli(
   return new Promise((resolve, reject) => {
     const child = execFile(cli, args, { maxBuffer: 64 * 1024 * 1024 }, (err, stdout, stderr) => {
       const anyErr = err as NodeJS.ErrnoException | null;
-      if (anyErr && anyErr.code === "ENOENT") {
-        reject(new Error(`guard-tpu CLI not found at '${cli}'`));
+      if (anyErr) {
+        // validate exits 19 on rule failures — a result, not an error
+        if (typeof anyErr.code === "number") {
+          resolve({ code: anyErr.code, stdout: stdout ?? "", stderr: stderr ?? "" });
+          return;
+        }
+        if (anyErr.code === "ENOENT") {
+          reject(new Error(`guard-tpu CLI not found at '${cli}'`));
+          return;
+        }
+        // spawn failure (EACCES, ...) or signal kill: surface it
+        reject(new Error(`guard-tpu CLI failed to run: ${anyErr.message}`));
         return;
       }
-      // validate exits 19 on rule failures — that is a result, not an error
-      const code = anyErr && typeof anyErr.code === "number" ? anyErr.code : 0;
-      resolve({ code, stdout: stdout ?? "", stderr: stderr ?? "" });
+      resolve({ code: 0, stdout: stdout ?? "", stderr: stderr ?? "" });
     });
     if (stdin !== undefined && child.stdin) {
       child.stdin.write(stdin);
