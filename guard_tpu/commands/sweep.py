@@ -211,7 +211,7 @@ class Sweep:
         }
 
     def _eval_tpu(self, data_files, rule_files, per_doc, writer) -> int:
-        from ..ops.encoder import encode_batch, split_batch_by_size
+        from ..ops.encoder import encode_batch
         from ..ops.ir import FAIL, PASS, SKIP, compile_rules_file
         from ..ops.native_encoder import encode_json_batch_native, native_available
         from ..parallel.mesh import ShardedBatchEvaluator
@@ -234,45 +234,43 @@ class Sweep:
         if batch is None:
             batch, interner = encode_batch([df.path_value for df in data_files])
 
-        # size-bucketed groups; oversize docs evaluate on the oracle
-        import numpy as np
-
-        groups, oversize = split_batch_by_size(batch)
-        host_docs = {int(i) for i in oversize}
-
         errors = 0
         for rf in rule_files:
             compiled = compile_rules_file(rf.rules, interner)
             unsure = None
+            host_docs = set()
             if compiled.rules:
                 evaluator = ShardedBatchEvaluator(compiled)
-                statuses = np.full(
-                    (batch.n_docs, len(compiled.rules)), SKIP, np.int8
-                )
-                unsure = np.zeros((batch.n_docs, len(compiled.rules)), bool)
-                for sub, idx in groups:
-                    statuses[idx] = evaluator(sub)
-                    if evaluator.last_unsure is not None:
-                        unsure[idx] = evaluator.last_unsure
+                statuses, unsure, host_docs = evaluator.evaluate_bucketed(batch)
                 for di in range(len(data_files)):
                     if di in host_docs:
                         continue
                     for ri, crule in enumerate(compiled.rules):
                         per_doc[di][crule.name] = _status[int(statuses[di, ri])]
+            # oversize docs: the oracle evaluates EVERY rule for them,
+            # so the host-rules pass below excludes them (no
+            # double-evaluation / double-counted errors)
             if host_docs:
                 errors += self._eval_oracle(
                     data_files, [rf], {"only_docs": host_docs}, per_doc, writer
                 )
             # host fallback: unlowerable rules run on the oracle for
-            # every doc; unsure-flagged docs re-run all rules on it
+            # every other doc; unsure-flagged docs re-run all rules
             if compiled.host_rules:
-                errors += self._eval_oracle(
-                    data_files,
-                    [rf],
-                    {"only_rules": {r.rule_name for r in compiled.host_rules}},
-                    per_doc,
-                    writer,
-                )
+                rest = set(range(len(data_files))) - host_docs
+                if rest:
+                    errors += self._eval_oracle(
+                        data_files,
+                        [rf],
+                        {
+                            "only_rules": {
+                                r.rule_name for r in compiled.host_rules
+                            },
+                            "only_docs": rest,
+                        },
+                        per_doc,
+                        writer,
+                    )
             if unsure is not None:
                 oracle_docs = {
                     di for di in range(len(data_files)) if bool(unsure[di].any())

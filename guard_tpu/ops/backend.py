@@ -24,7 +24,7 @@ from ..core.evaluator import eval_rules_file
 from ..core.qresult import Status
 from ..core.scopes import RootScope
 from ..utils.io import Writer
-from .encoder import encode_batch, split_batch_by_size
+from .encoder import encode_batch
 from .ir import FAIL, PASS, SKIP, compile_rules_file
 from ..commands.report import rule_statuses_from_root, simplified_report_from_root
 
@@ -65,18 +65,11 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
     if batch is None:
         batch, interner = encode_batch(docs)
 
-    # size-bucketed batching: each group evaluates at its own padded
-    # shape (the kernel is O(N^2)/doc/step, so padding everyone to the
-    # largest doc wastes quadratic work); oversize docs go to the oracle
-    import numpy as np
-
-    groups, oversize = split_batch_by_size(batch)
-    host_docs = {int(i) for i in oversize}
-
     errors = 0
     had_fail = False
     all_reports: List[dict] = []
     junit_suites = {}
+    host_docs = set()
 
     for rule_file in rule_files:
         compiled = compile_rules_file(rule_file.rules, interner)
@@ -84,12 +77,7 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
         unsure = None
         if compiled.rules:
             evaluator = ShardedBatchEvaluator(compiled)
-            statuses = np.full((batch.n_docs, len(compiled.rules)), SKIP, np.int8)
-            unsure = np.zeros((batch.n_docs, len(compiled.rules)), bool)
-            for sub, idx in groups:
-                statuses[idx] = evaluator(sub)  # retraces per bucket shape
-                if evaluator.last_unsure is not None:
-                    unsure[idx] = evaluator.last_unsure
+            statuses, unsure, host_docs = evaluator.evaluate_bucketed(batch)
 
         cases: List[JunitTestCase] = []
         for di, data_file in enumerate(data_files):

@@ -103,15 +103,17 @@ class StepIndex:
 class StepFilter:
     """Filter semantics depend on the preceding query part
     (scopes._retrieve_filter, eval_context.rs:723-828): after a key (or
-    at query start) maps expand to their values; after `.*`/`[*]` the
-    map itself is the filter candidate; scalars survive only after
-    `[*]`, otherwise they are UnResolved. Lists always iterate."""
+    at query start) maps expand to their values; after `.*` the map
+    itself is the filter candidate (each value was re-scoped by
+    accumulate_map, eval_context.rs:216-229); scalars are UnResolved.
+    Lists always iterate. Filters after `[*]` refuse lowering: list
+    elements are NOT re-scoped (accumulate, eval_context.rs:142-178),
+    so map candidates there evaluate the filter against the *outer*
+    scope — semantics the kernel does not model."""
 
     conjunctions: List[List["CClause"]]
     # prev was a key / query start: map candidates expand to their values
     expand_maps: bool = False
-    # prev was `[*]`: scalar candidates filter themselves (else UnResolved)
-    scalar_self: bool = False
 
 
 @dataclass
@@ -404,6 +406,11 @@ class _RuleLowering:
             if prev == "other":
                 # oracle raises InternalError for maps after such parts
                 raise Unlowerable("filter after index/filter/this part")
+            if prev == "allindices":
+                # `[*]` does not re-scope list elements, so map
+                # candidates evaluate the filter against the outer
+                # scope (eval_context.rs:142-178 + :725-734) — host only
+                raise Unlowerable("filter after [*] keeps the outer scope")
             # filter clauses evaluate each candidate as a value scope
             prev_scope = self._push_scope()
             try:
@@ -416,7 +423,6 @@ class _RuleLowering:
             return StepFilter(
                 conjunctions=conjunctions,
                 expand_maps=prev in ("start", "key"),
-                scalar_self=prev == "allindices",
             )
         if isinstance(part, QMapKeyFilter):
             if part.name is not None:

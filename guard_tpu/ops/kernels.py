@@ -191,13 +191,10 @@ def run_step(d: _DocArrays, step: Step, sel, unres, rule_statuses=None):
             keep = jnp.zeros_like(sel)
             unres = _add_unres(d, unres, sel, is_scalar)
         else:
-            # maps filter themselves; scalars only survive after `[*]`
-            keep_mask = (sel > 0) & is_map
-            if step.scalar_self:
-                keep_mask = keep_mask | is_scalar
-            else:
-                unres = _add_unres(d, unres, sel, is_scalar)
-            keep = jnp.where(keep_mask, sel, 0)
+            # after `.*`: maps filter themselves (accumulate_map
+            # re-scoped each value); scalars are UnResolved
+            unres = _add_unres(d, unres, sel, is_scalar)
+            keep = jnp.where((sel > 0) & is_map, sel, 0)
         cand = jnp.maximum(elems, keep)  # candidates labeled with OUTER origin
         idx = jnp.arange(d.n, dtype=jnp.int32)
         cand_self = jnp.where(cand > 0, idx + 1, 0)  # each candidate = own origin

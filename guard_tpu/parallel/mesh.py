@@ -116,6 +116,28 @@ class ShardedBatchEvaluator:
         self.last_unsure = None
         return np.asarray(out)[:d]
 
+    def evaluate_bucketed(self, batch: DocBatch):
+        """Size-bucketed evaluation of a whole corpus batch.
+
+        Returns (statuses (D, R) int8, unsure (D, R) bool, host_docs):
+        each size-bucket group evaluates at its own padded shape (the
+        kernel is O(N^2)/doc/step, so padding everyone to the largest
+        document wastes quadratic work); documents beyond the largest
+        bucket are left SKIP-filled and returned in `host_docs` for
+        CPU-oracle evaluation."""
+        from ..ops.encoder import split_batch_by_size
+        from ..ops.ir import SKIP
+
+        groups, oversize = split_batch_by_size(batch)
+        n_rules = len(self.compiled.rules)
+        statuses = np.full((batch.n_docs, n_rules), SKIP, np.int8)
+        unsure = np.zeros((batch.n_docs, n_rules), bool)
+        for sub, idx in groups:
+            statuses[idx] = self(sub)  # retraces once per bucket shape
+            if self.last_unsure is not None:
+                unsure[idx] = self.last_unsure
+        return statuses, unsure, {int(i) for i in oversize}
+
     def with_summary(self, batch: DocBatch) -> Tuple[np.ndarray, np.ndarray]:
         arrays, d = self._arrays(batch)
         arrays = {k: jnp.asarray(v) for k, v in arrays.items()}

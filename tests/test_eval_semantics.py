@@ -285,3 +285,51 @@ def test_yaml_scalar_type_eq():
     rules = "Resources.r.Properties.TTL == \"900\"\n"
     assert clause_status(rules.strip(), "Resources:\n  r:\n    Properties:\n      TTL: '900'\n") == Status.PASS
     assert clause_status(rules.strip(), "Resources:\n  r:\n    Properties:\n      TTL: 900\n") == Status.FAIL
+
+
+def test_filter_scope_asymmetry_star_vs_allindices():
+    """Reference asymmetry: `.*` on a map re-scopes each value
+    (accumulate_map wraps a ValueScope, eval_context.rs:216-229), so
+    `.*[ filter ]` evaluates the filter against each candidate. `[*]`
+    on a list does NOT re-scope (accumulate, eval_context.rs:142-178),
+    so `[*][ filter ]` evaluates map candidates against the outer
+    scope — the filter keys resolve from the query root, not the
+    element. `list[ filter ]` directly after a key iterates elements
+    each in its own scope (the Filter-on-List branch)."""
+    from guard_tpu.core.parser import parse_rules_file
+    from guard_tpu.core.scopes import RootScope
+    from guard_tpu.core.values import from_plain
+
+    doc = from_plain(
+        {
+            "Resources": {
+                "a": {"Type": "T1"},
+                "b": {"Type": "T2"},
+            },
+            "Items": [{"Kind": "x"}, {"Kind": "y"}],
+        }
+    )
+
+    # .*[ filter ]: candidate-scoped -> selects resource a only
+    rules = "rule r { Resources.*[ Type == 'T1' ] !empty }"
+    rf = parse_rules_file(rules, "t.guard")
+    assert RootScope(rf, doc).rule_status("r").value == "PASS"
+
+    # list[ filter ] after a key: element-scoped -> selects {Kind: x}
+    rules = "rule r { Items[ Kind == 'x' ] !empty }"
+    rf = parse_rules_file(rules, "t.guard")
+    assert RootScope(rf, doc).rule_status("r").value == "PASS"
+
+    # list[*][ filter ]: outer-scoped for map candidates -> `Kind`
+    # resolves from the ROOT (missing) -> no candidate selected
+    rules = "rule r { Items[*][ Kind == 'x' ] !empty }"
+    rf = parse_rules_file(rules, "t.guard")
+    assert RootScope(rf, doc).rule_status("r").value == "FAIL"
+
+    # ...and the TPU lowering refuses the outer-scope construct
+    from guard_tpu.ops.encoder import encode_batch
+    from guard_tpu.ops.ir import compile_rules_file
+
+    batch, interner = encode_batch([doc])
+    compiled = compile_rules_file(rf, interner)
+    assert not compiled.rules and len(compiled.host_rules) == 1

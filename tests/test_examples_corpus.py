@@ -1,0 +1,61 @@
+"""This repo's own example-rule corpus (examples/rules/): every domain
+runs through the `test` command (expectation suites must pass), and
+every lowerable rule also runs differentially kernel-vs-oracle on the
+test inputs — the corpus doubles as a TPU parity suite."""
+
+import pathlib
+
+import pytest
+import yaml
+
+from guard_tpu.cli import run
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.core.scopes import RootScope
+from guard_tpu.core.values import from_plain
+from guard_tpu.ops.encoder import encode_batch
+from guard_tpu.ops.ir import compile_rules_file
+from guard_tpu.ops.kernels import BatchEvaluator
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent / "examples" / "rules"
+DOMAINS = sorted(p.name for p in ROOT.iterdir() if p.is_dir())
+
+STATUS = {0: "PASS", 1: "FAIL", 2: "SKIP"}
+
+
+@pytest.mark.parametrize("domain", DOMAINS)
+def test_domain_expectations(domain):
+    code = run(["test", "-d", str(ROOT / domain)])
+    assert code == 0, f"expectation suite failed for {domain}"
+
+
+def _domain_cases(domain):
+    for guard in sorted((ROOT / domain).glob("*.guard")):
+        rf = parse_rules_file(guard.read_text(), guard.name)
+        for spec in sorted((ROOT / domain / "tests").glob("*.yaml")):
+            for case in yaml.safe_load(spec.read_text()):
+                yield rf, case
+
+
+@pytest.mark.parametrize("domain", DOMAINS)
+def test_domain_tpu_parity(domain):
+    checked = 0
+    for rf, case in _domain_cases(domain):
+        doc = from_plain(case.get("input") or {})
+        batch, interner = encode_batch([doc])
+        compiled = compile_rules_file(rf, interner)
+        if not compiled.rules:
+            continue
+        ev = BatchEvaluator(compiled)
+        statuses = ev(batch)
+        unsure = ev.last_unsure
+        scope = RootScope(rf, doc)
+        for ri, crule in enumerate(compiled.rules):
+            if unsure is not None and bool(unsure[0, ri]):
+                continue
+            cpu = scope.rule_status(crule.name).value
+            tpu = STATUS[int(statuses[0, ri])]
+            assert cpu == tpu, (
+                f"{domain}/{crule.name} on {case['name']}: cpu={cpu} tpu={tpu}"
+            )
+            checked += 1
+    assert checked > 0, f"no lowerable rules exercised in {domain}"
