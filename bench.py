@@ -1,20 +1,31 @@
 """Benchmark: templates validated/sec on the batch evaluation engine.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Default (driver contract): ONE JSON line
+{"metric", "value", "unit", "vs_baseline"} for the BASELINE.md config-2
+analogue (4-rule security-policy set over synthetic CFN templates).
+`value` is the steady-state device throughput of the compiled
+(docs x rules) kernel (encode done once host-side, as in an org-sweep
+where templates are encoded as they stream in). `vs_baseline` is the
+speedup over the CPU reference evaluator (this framework's oracle, same
+semantics as the reference implementation) measured in-process on the
+same workload — the reference publishes no numbers of its own
+(BASELINE.md).
 
-Workload (BASELINE.md config 2 analogue): a security-policy style rule
-set over synthetic CloudFormation templates. `value` is the steady-state
-device throughput of the compiled (docs x rules) kernel (encode done
-once host-side, as in an org-sweep where templates are encoded as they
-stream in). `vs_baseline` is the speedup over the CPU reference
-evaluator (this framework's oracle, same semantics as the reference
-implementation) measured in-process on the same workload — the reference
-publishes no numbers of its own (BASELINE.md).
+`python bench.py --all` additionally measures the other BASELINE.md
+workload analogues (encryption single-rule, AWS Config items stream,
+deep Terraform plans, regex-heavy registry style), one JSON line each.
+
+Measurement note: the remote-device tunnel makes per-dispatch timing
+meaningless (async dispatch returns before execution). The evaluation
+runs K times inside ONE compiled fori_loop with an opaque zero data
+dependency (defeats loop-invariant hoisting), and per-iteration device
+time is the K-loop minus the 1-loop wall time over (K - 1).
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -43,6 +54,77 @@ rule no_public_buckets when %s3_buckets !empty {
     %s3_buckets.Properties.AccessControl != 'PublicRead'
 }
 """
+
+ENCRYPTION_RULES = """
+let s3_buckets = Resources.*[ Type == 'AWS::S3::Bucket' ]
+
+rule s3_bucket_sse when %s3_buckets !empty {
+    %s3_buckets.Properties.BucketEncryption exists
+    %s3_buckets.Properties.BucketEncryption.ServerSideEncryptionConfiguration[*]
+        .ServerSideEncryptionByDefault.SSEAlgorithm IN ['aws:kms', 'AES256']
+}
+"""
+
+CONFIG_ITEM_RULES = """
+rule encrypted_volumes when resourceType == 'AWS::EC2::Volume' {
+    configuration.encrypted == true
+}
+
+rule public_access_blocked when resourceType == 'AWS::S3::Bucket' {
+    supplementaryConfiguration.PublicAccessBlockConfiguration.blockPublicAcls == true
+}
+
+rule no_open_ssh when resourceType == 'AWS::EC2::SecurityGroup' {
+    configuration.ipPermissions[*].fromPort != 22 or
+    configuration.ipPermissions[ fromPort == 22 ].ipRanges[*] == /^10\\./
+}
+
+rule resource_in_region {
+    awsRegion IN ['us-east-1', 'us-west-2', 'eu-west-1']
+}
+"""
+
+TF_RULES = """
+let creates = resource_changes[ change.actions[*] == 'create' ]
+
+rule no_destroys when resource_changes exists {
+    resource_changes[*].change.actions[*] != 'delete'
+}
+
+rule buckets_private when %creates !empty {
+    resource_changes[ type == 'aws_s3_bucket' ].change.after.acl != 'public-read'
+}
+
+rule instances_tagged when %creates !empty {
+    resource_changes[ type == 'aws_instance' ].change.after.tags.env
+        IN ['prod', 'staging', 'dev']
+}
+"""
+
+
+def regex_heavy_rules(n: int = 16) -> str:
+    """Registry-style regex-heavy ruleset: n ARN/name-shape checks."""
+    pats = [
+        r"/^arn:aws:iam::\d{12}:role\//",
+        r"/^[a-z][a-z0-9-]{2,62}$/",
+        r"/^vpc-[0-9a-f]{8,17}$/",
+        r"/(?i)prod|staging/",
+        r"/^\d+\.\d+\.\d+\.\d+\/\d+$/",
+        r"/^arn:aws:kms:[a-z0-9-]+:\d{12}:key\//",
+        r"/^(?:[a-z0-9]+-)*[a-z0-9]+$/",
+        r"/secret|password|token/",
+    ]
+    fields = ["RoleArn", "Name", "VpcId", "Stage", "Cidr", "KmsKey", "Slug", "Blob"]
+    out = []
+    for i in range(n):
+        f = fields[i % len(fields)]
+        p = pats[i % len(pats)]
+        out.append(
+            f"rule rx_{i} when Resources exists {{\n"
+            f"    some Resources.*.Properties.{f} == {p} or\n"
+            f"    Resources.*.Properties.{f} !exists\n}}\n"
+        )
+    return "\n".join(out)
 
 
 def make_template(rng, i: int) -> dict:
@@ -80,12 +162,75 @@ def make_template(rng, i: int) -> dict:
     return {"Resources": resources}
 
 
+def make_config_item(rng, i: int) -> dict:
+    """AWS Config configuration-item shaped doc."""
+    rtype = ["AWS::EC2::Volume", "AWS::S3::Bucket", "AWS::EC2::SecurityGroup"][i % 3]
+    item = {
+        "version": "1.3",
+        "resourceType": rtype,
+        "resourceId": f"r-{i:08x}",
+        "awsRegion": str(rng.choice(["us-east-1", "us-west-2", "eu-west-1", "ap-south-1"])),
+        "configuration": {},
+        "supplementaryConfiguration": {},
+        "tags": {"env": str(rng.choice(["prod", "dev"])), "owner": f"team{i % 7}"},
+    }
+    if rtype == "AWS::EC2::Volume":
+        item["configuration"] = {
+            "encrypted": bool(rng.random() < 0.6),
+            "size": int(rng.integers(1, 1000)),
+        }
+    elif rtype == "AWS::S3::Bucket":
+        item["supplementaryConfiguration"] = {
+            "PublicAccessBlockConfiguration": {
+                "blockPublicAcls": bool(rng.random() < 0.8)
+            }
+        }
+    else:
+        item["configuration"] = {
+            "ipPermissions": [
+                {
+                    "fromPort": int(rng.choice([22, 80, 443])),
+                    "ipRanges": [str(rng.choice(["10.0.0.0/8", "0.0.0.0/0"]))],
+                }
+                for _ in range(int(rng.integers(1, 4)))
+            ]
+        }
+    return item
+
+
+def make_tf_plan(rng, i: int, depth_pad: int = 6) -> dict:
+    """Terraform plan JSON with deep after-trees."""
+    changes = []
+    for j in range(int(rng.integers(2, 6))):
+        rtype = str(rng.choice(["aws_s3_bucket", "aws_instance", "aws_vpc"]))
+        after = {
+            "acl": str(rng.choice(["private", "public-read"])),
+            "tags": {"env": str(rng.choice(["prod", "staging", "qa"]))},
+            "instance_type": "t3.micro",
+        }
+        # deep nesting exercises long step programs
+        node = after
+        for k in range(depth_pad):
+            node[f"nested{k}"] = {"level": k, "leaf": f"v{i}-{j}-{k}"}
+            node = node[f"nested{k}"]
+        changes.append(
+            {
+                "address": f"{rtype}.r{j}",
+                "type": rtype,
+                "change": {
+                    "actions": [str(rng.choice(["create", "update"]))],
+                    "after": after,
+                },
+            }
+        )
+    return {"format_version": "1.2", "resource_changes": changes}
+
+
 def _probe_tpu_responsive(timeout_s: float = 45.0) -> bool:
     """The axon TPU tunnel can hang indefinitely at device discovery.
     Probe it in a subprocess so this process can fall back to CPU
     without ever touching the wedged plugin."""
     import subprocess
-    import sys
 
     try:
         out = subprocess.run(
@@ -99,18 +244,8 @@ def _probe_tpu_responsive(timeout_s: float = 45.0) -> bool:
         return False
 
 
-def main() -> None:
-    if not _probe_tpu_responsive():
-        import sys
-
-        import jax as _jax
-
-        _jax.config.update("jax_platforms", "cpu")
-        print(
-            "TPU tunnel unresponsive; benchmarking on CPU devices",
-            file=sys.stderr,
-            flush=True,
-        )
+def measure(rules_text: str, docs, min_rules: int, n_cpu: int = 256):
+    """(tpu_docs_per_sec, vs_cpu) for one workload."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -118,28 +253,20 @@ def main() -> None:
     from guard_tpu.core.parser import parse_rules_file
     from guard_tpu.core.scopes import RootScope
     from guard_tpu.core.evaluator import eval_rules_file
-    from guard_tpu.core.values import from_plain
     from guard_tpu.ops.encoder import encode_batch
     from guard_tpu.ops.ir import compile_rules_file
     from guard_tpu.ops.kernels import build_doc_evaluator
 
-    rng = np.random.default_rng(7)
-    n_docs = 4096
-    rf = parse_rules_file(RULES, "bench.guard")
-    docs = [from_plain(make_template(rng, i)) for i in range(n_docs)]
-
+    n_docs = len(docs)
+    rf = parse_rules_file(rules_text, "bench.guard")
     batch, interner = encode_batch(docs)
     compiled = compile_rules_file(rf, interner)
-    assert len(compiled.rules) == 4 and not compiled.host_rules
+    assert len(compiled.rules) >= min_rules and not compiled.host_rules, (
+        f"bench rules must lower: {len(compiled.rules)} lowered, "
+        f"{len(compiled.host_rules)} host"
+    )
     doc_eval = build_doc_evaluator(compiled)
 
-    # Measurement: the remote-device tunnel makes per-dispatch timing
-    # meaningless (async dispatch returns before execution; host
-    # round-trips re-upload inputs). So the evaluation runs K times
-    # inside ONE compiled fori_loop with an opaque zero data dependency
-    # (defeats loop-invariant hoisting), the scalar reduction is
-    # fetched, and per-iteration device time is the K-loop minus the
-    # 1-loop wall time over (K - 1).
     def make_loop(iters: int):
         @jax.jit
         def loop(arrays):
@@ -176,26 +303,68 @@ def main() -> None:
     per_iter = max((t_k - t_1) / (k_inner - 1), 1e-9)
     tpu_docs_per_sec = n_docs / per_iter
 
-    # CPU reference-evaluator baseline, measured (BASELINE.md): same
-    # docs x same rules through the oracle
-    n_cpu = 256
     t0 = time.perf_counter()
     for doc in docs[:n_cpu]:
         scope = RootScope(rf, doc)
         eval_rules_file(rf, scope, None)
     t1 = time.perf_counter()
     cpu_docs_per_sec = n_cpu / (t1 - t0)
+    return tpu_docs_per_sec, tpu_docs_per_sec / cpu_docs_per_sec
 
+
+def _emit(metric: str, value: float, vs: float) -> None:
     print(
         json.dumps(
             {
-                "metric": "templates_validated_per_sec_per_chip",
-                "value": round(tpu_docs_per_sec, 1),
+                "metric": metric,
+                "value": round(value, 1),
                 "unit": "templates/sec",
-                "vs_baseline": round(tpu_docs_per_sec / cpu_docs_per_sec, 2),
+                "vs_baseline": round(vs, 2),
             }
-        )
+        ),
+        flush=True,
     )
+
+
+def main() -> None:
+    if not _probe_tpu_responsive():
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+        print(
+            "TPU tunnel unresponsive; benchmarking on CPU devices",
+            file=sys.stderr,
+            flush=True,
+        )
+    from guard_tpu.core.values import from_plain
+
+    rng = np.random.default_rng(7)
+    run_all = "--all" in sys.argv
+
+    # config 2 (headline, the driver's one-line contract)
+    docs = [from_plain(make_template(rng, i)) for i in range(4096)]
+    v, r = measure(RULES, docs, min_rules=4)
+    _emit("templates_validated_per_sec_per_chip", v, r)
+    if not run_all:
+        return
+
+    # config 1: single-rule encryption set
+    v, r = measure(ENCRYPTION_RULES, docs, min_rules=1)
+    _emit("config1_encryption_templates_per_sec", v, r)
+
+    # config 3: AWS Config configuration-item stream
+    items = [from_plain(make_config_item(rng, i)) for i in range(8192)]
+    v, r = measure(CONFIG_ITEM_RULES, items, min_rules=4)
+    _emit("config3_config_items_per_sec", v, r)
+
+    # config 4: Terraform plans, deep trees
+    plans = [from_plain(make_tf_plan(rng, i)) for i in range(2048)]
+    v, r = measure(TF_RULES, plans, min_rules=3)
+    _emit("config4_tf_plans_per_sec", v, r)
+
+    # config 5: regex-heavy registry-style ruleset
+    v, r = measure(regex_heavy_rules(16), docs, min_rules=16)
+    _emit("config5_regex_registry_templates_per_sec", v, r)
 
 
 if __name__ == "__main__":
