@@ -82,6 +82,13 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--manifest", "-M", default="sweep-manifest.jsonl")
     s.add_argument("--chunk-size", "-c", type=int, default=1024)
     s.add_argument("--backend", default="tpu", choices=["cpu", "tpu"])
+    s.add_argument(
+        "--rule-shards",
+        type=int,
+        default=1,
+        help="split the rule set across this many device groups "
+        "(rule-axis parallelism for huge registries)",
+    )
     s.add_argument("--last-modified", "-m", action="store_true")
 
     pt = sub.add_parser("parse-tree", help="Prints the parse tree for a rules file")
@@ -146,6 +153,7 @@ def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reade
                 manifest=args.manifest,
                 chunk_size=args.chunk_size,
                 backend=args.backend,
+                rule_shards=args.rule_shards,
                 last_modified=args.last_modified,
             ).execute(writer, reader)
         if args.command == "parse-tree":

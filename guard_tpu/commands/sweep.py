@@ -74,6 +74,7 @@ class Sweep:
     manifest: str = "sweep-manifest.jsonl"
     chunk_size: int = 1024
     backend: str = "tpu"  # tpu | cpu (oracle; mainly for testing)
+    rule_shards: int = 1  # >1: rule-axis parallelism (parallel/rules.py)
     last_modified: bool = False
 
     def execute(self, writer: Writer, reader: Reader) -> int:
@@ -240,8 +241,21 @@ class Sweep:
             unsure = None
             host_docs = set()
             if compiled.rules:
-                evaluator = ShardedBatchEvaluator(compiled)
-                statuses, unsure, host_docs = evaluator.evaluate_bucketed(batch)
+                if self.rule_shards > 1:
+                    from ..parallel.mesh import evaluate_bucketed
+                    from ..parallel.rules import RuleShardedEvaluator
+
+                    ev = RuleShardedEvaluator(
+                        compiled, rule_shards=self.rule_shards
+                    )
+                    statuses, unsure, host_docs = evaluate_bucketed(
+                        ev, len(compiled.rules), batch
+                    )
+                else:
+                    evaluator = ShardedBatchEvaluator(compiled)
+                    statuses, unsure, host_docs = evaluator.evaluate_bucketed(
+                        batch
+                    )
                 for di in range(len(data_files)):
                     if di in host_docs:
                         continue

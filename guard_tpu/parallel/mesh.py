@@ -105,10 +105,16 @@ class ShardedBatchEvaluator:
             self.mesh.devices.size,
         )
 
-    def __call__(self, batch: DocBatch) -> np.ndarray:
+    def dispatch(self, batch: DocBatch):
+        """Launch evaluation WITHOUT blocking (JAX dispatch is async):
+        returns (device_out, n_valid). Use to overlap work across
+        device sub-meshes (parallel/rules.py) before collecting."""
         arrays, d = self._arrays(batch)
         arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
-        out = self._fn(arrays)
+        return self._fn(arrays), d
+
+    def __call__(self, batch: DocBatch) -> np.ndarray:
+        out, d = self.dispatch(batch)
         if self._with_unsure:
             statuses, unsure = out
             self.last_unsure = np.asarray(unsure)[:d]
@@ -117,26 +123,7 @@ class ShardedBatchEvaluator:
         return np.asarray(out)[:d]
 
     def evaluate_bucketed(self, batch: DocBatch):
-        """Size-bucketed evaluation of a whole corpus batch.
-
-        Returns (statuses (D, R) int8, unsure (D, R) bool, host_docs):
-        each size-bucket group evaluates at its own padded shape (the
-        kernel is O(N^2)/doc/step, so padding everyone to the largest
-        document wastes quadratic work); documents beyond the largest
-        bucket are left SKIP-filled and returned in `host_docs` for
-        CPU-oracle evaluation."""
-        from ..ops.encoder import split_batch_by_size
-        from ..ops.ir import SKIP
-
-        groups, oversize = split_batch_by_size(batch)
-        n_rules = len(self.compiled.rules)
-        statuses = np.full((batch.n_docs, n_rules), SKIP, np.int8)
-        unsure = np.zeros((batch.n_docs, n_rules), bool)
-        for sub, idx in groups:
-            statuses[idx] = self(sub)  # retraces once per bucket shape
-            if self.last_unsure is not None:
-                unsure[idx] = self.last_unsure
-        return statuses, unsure, {int(i) for i in oversize}
+        return evaluate_bucketed(self, len(self.compiled.rules), batch)
 
     def with_summary(self, batch: DocBatch) -> Tuple[np.ndarray, np.ndarray]:
         arrays, d = self._arrays(batch)
@@ -145,3 +132,26 @@ class ShardedBatchEvaluator:
         return np.asarray(statuses)[:d], np.asarray(counts)
 
 
+
+
+def evaluate_bucketed(evaluator, n_rules: int, batch: DocBatch):
+    """Size-bucketed evaluation of a whole corpus batch through any
+    evaluator exposing __call__(sub_batch) -> (d, R) statuses and a
+    `last_unsure` attribute (ShardedBatchEvaluator, RuleShardedEvaluator).
+
+    Returns (statuses (D, R) int8, unsure (D, R) bool, host_docs): each
+    size-bucket group evaluates at its own padded shape (the kernel is
+    O(N^2)/doc/step, so padding everyone to the largest document wastes
+    quadratic work); documents beyond the largest bucket are left
+    SKIP-filled and returned in `host_docs` for CPU-oracle evaluation."""
+    from ..ops.encoder import split_batch_by_size
+    from ..ops.ir import SKIP
+
+    groups, oversize = split_batch_by_size(batch)
+    statuses = np.full((batch.n_docs, n_rules), SKIP, np.int8)
+    unsure = np.zeros((batch.n_docs, n_rules), bool)
+    for sub, idx in groups:
+        statuses[idx] = evaluator(sub)  # retraces once per bucket shape
+        if evaluator.last_unsure is not None:
+            unsure[idx] = evaluator.last_unsure
+    return statuses, unsure, {int(i) for i in oversize}

@@ -1,0 +1,210 @@
+"""Rule-axis parallelism: shard a huge compiled rule set across device
+groups (SURVEY.md §2.3 — the "model parallel" axis of the (docs x
+rules) batch matrix).
+
+Rule programs are compile-time constants baked into each jaxpr, so the
+rule axis cannot be a sharded *array* axis the way documents are.
+Instead the compiled rule list is partitioned into dependency-closed
+groups (named-rule references, `CNamedRef` — eval.rs:1227-1289 — must
+stay with their referents), each group compiles into its own SPMD
+evaluator over a disjoint sub-mesh of devices, and all groups dispatch
+asynchronously before any result is collected — on hardware the groups
+run concurrently, each DP-sharding the full document batch over its own
+devices. Statuses concatenate on the host.
+
+Use when the rule registry is large enough that one chip's compile/step
+time is rule-bound rather than doc-bound; for small rule files the flat
+doc-axis evaluator (mesh.ShardedBatchEvaluator) is strictly better.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..ops.encoder import DocBatch
+from ..ops.ir import (
+    CBlockClause,
+    CClause,
+    CNamedRef,
+    CompiledRules,
+    CWhenBlock,
+    StepFilter,
+    compile_rules_file,
+)
+from .mesh import Mesh, ShardedBatchEvaluator
+
+
+def _rule_dependencies(compiled: CompiledRules) -> List[set]:
+    """Per-rule sets of referenced rule indices (CNamedRef edges)."""
+
+    deps: List[set] = []
+
+    def walk_node(n, acc: set) -> None:
+        if isinstance(n, CNamedRef):
+            acc.add(n.rule_index)
+        elif isinstance(n, CClause):
+            for s in n.steps + (n.rhs_query_steps or []):
+                if isinstance(s, StepFilter):
+                    walk_conjs(s.conjunctions, acc)
+        elif isinstance(n, CBlockClause):
+            for s in n.query_steps:
+                if isinstance(s, StepFilter):
+                    walk_conjs(s.conjunctions, acc)
+            walk_conjs(n.inner, acc)
+        elif isinstance(n, CWhenBlock):
+            if n.conditions is not None:
+                walk_conjs(n.conditions, acc)
+            walk_conjs(n.inner, acc)
+
+    def walk_conjs(conjs, acc: set) -> None:
+        for disj in conjs:
+            for n in disj:
+                walk_node(n, acc)
+
+    for rule in compiled.rules:
+        acc: set = set()
+        if rule.conditions is not None:
+            walk_conjs(rule.conditions, acc)
+        walk_conjs(rule.conjunctions, acc)
+        deps.append(acc)
+    return deps
+
+
+def partition_rules(compiled: CompiledRules, n_groups: int) -> List[List[int]]:
+    """Partition rule indices into <= n_groups dependency-closed groups
+    of balanced size (union-find over CNamedRef edges, then greedy
+    bin-packing of the components, largest first)."""
+    n = len(compiled.rules)
+    parent = list(range(n))
+
+    def find(x: int) -> int:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    def union(a: int, b: int) -> None:
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+
+    for i, refs in enumerate(_rule_dependencies(compiled)):
+        for j in refs:
+            union(i, j)
+
+    components: Dict[int, List[int]] = {}
+    for i in range(n):
+        components.setdefault(find(i), []).append(i)
+
+    groups: List[List[int]] = [[] for _ in range(max(1, n_groups))]
+    for comp in sorted(components.values(), key=len, reverse=True):
+        min(groups, key=len).extend(comp)
+    return [sorted(g) for g in groups if g]
+
+
+def _slice_compiled(compiled: CompiledRules, indices: List[int]) -> CompiledRules:
+    """A CompiledRules containing only `indices`, with CNamedRef
+    rule_index fields remapped into the slice (indices must be
+    dependency-closed — guaranteed by partition_rules)."""
+    remap = {old: new for new, old in enumerate(indices)}
+
+    def fix_node(n):
+        if isinstance(n, CNamedRef):
+            return CNamedRef(rule_index=remap[n.rule_index], negation=n.negation)
+        if isinstance(n, CClause):
+            c = copy.copy(n)
+            c.steps = [fix_step(s) for s in n.steps]
+            if n.rhs_query_steps is not None:
+                c.rhs_query_steps = [fix_step(s) for s in n.rhs_query_steps]
+            return c
+        if isinstance(n, CBlockClause):
+            b = copy.copy(n)
+            b.query_steps = [fix_step(s) for s in n.query_steps]
+            b.inner = fix_conjs(n.inner)
+            return b
+        if isinstance(n, CWhenBlock):
+            w = copy.copy(n)
+            if n.conditions is not None:
+                w.conditions = fix_conjs(n.conditions)
+            w.inner = fix_conjs(n.inner)
+            return w
+        return n
+
+    def fix_step(s):
+        if isinstance(s, StepFilter):
+            f = copy.copy(s)
+            f.conjunctions = fix_conjs(s.conjunctions)
+            return f
+        return s
+
+    def fix_conjs(conjs):
+        return [[fix_node(n) for n in disj] for disj in conjs]
+
+    rules = []
+    for i in indices:
+        r = copy.copy(compiled.rules[i])
+        if r.conditions is not None:
+            r.conditions = fix_conjs(r.conditions)
+        r.conjunctions = fix_conjs(r.conjunctions)
+        rules.append(r)
+
+    return CompiledRules(
+        rules=rules,
+        host_rules=[],
+        interner=compiled.interner,
+        str_empty_bits=compiled.str_empty_bits,
+        needs_struct_ids=compiled.needs_struct_ids,
+        bit_tables=compiled.bit_tables,  # slots stay valid: shared specs
+        str_empty_slot=compiled.str_empty_slot,
+    )
+
+
+class RuleShardedEvaluator:
+    """(docs x rules) evaluation over a 2-D (rule-groups x docs)
+    device decomposition: devices split into `rule_shards` disjoint
+    sub-meshes, each evaluating a dependency-closed slice of the rule
+    set DP-sharded over the full document batch. All shards dispatch
+    before any collects, so groups run concurrently on hardware."""
+
+    def __init__(
+        self,
+        compiled: CompiledRules,
+        rule_shards: int = 2,
+        devices: Optional[Sequence] = None,
+    ):
+        self.compiled = compiled
+        devices = list(devices) if devices is not None else jax.devices()
+        rule_shards = max(1, min(rule_shards, len(compiled.rules) or 1, len(devices)))
+        self.groups = partition_rules(compiled, rule_shards)
+        # disjoint device split covering every device (remainder
+        # devices go to the first groups)
+        splits = np.array_split(np.arange(len(devices)), len(self.groups))
+        self.shards: List[Tuple[ShardedBatchEvaluator, List[int]]] = []
+        for idx, dev_idx in zip(self.groups, splits):
+            sub_devices = [devices[i] for i in dev_idx]
+            sub = _slice_compiled(compiled, idx)
+            mesh = Mesh(np.array(sub_devices), ("docs",))
+            self.shards.append((ShardedBatchEvaluator(sub, mesh), idx))
+        self.last_unsure: Optional[np.ndarray] = None
+
+    def __call__(self, batch: DocBatch) -> np.ndarray:
+        """(D, num_rules) int8 statuses in the original rule order."""
+        n_rules = len(self.compiled.rules)
+        statuses = np.empty((batch.n_docs, n_rules), np.int8)
+        unsure = np.zeros((batch.n_docs, n_rules), bool)
+        pending = [
+            (ev, idx, ev.dispatch(batch)) for ev, idx in self.shards
+        ]  # all dispatched before any collect
+        for ev, idx, (out, d) in pending:
+            if ev._with_unsure:
+                st, un = out
+                statuses[:, idx] = np.asarray(st)[:d]
+                unsure[:, idx] = np.asarray(un)[:d]
+            else:
+                statuses[:, idx] = np.asarray(out)[:d]
+        self.last_unsure = unsure if self.compiled.needs_struct_ids else None
+        return statuses

@@ -109,3 +109,40 @@ def test_sweep_error_paths(tmp_path):
         reader=Reader.from_string(""),
     )
     assert code == 5  # no rules
+
+
+def test_sweep_rule_shards_matches_flat(tmp_path):
+    """--rule-shards N produces the same manifest counts as flat."""
+    import json
+
+    from guard_tpu.cli import run
+
+    rules = tmp_path / "r.guard"
+    rules.write_text(
+        "let b = Resources.*[ Type == 'AWS::S3::Bucket' ]\n"
+        "rule sse when %b !empty { %b.Properties.Enc == true }\n"
+        "rule named when %b !empty {\n"
+        "    %b.Properties.Name == /^[a-z]+$/\n"
+        "}\n"
+    )
+    data = tmp_path / "data"
+    data.mkdir()
+    for i in range(9):
+        doc = {"Resources": {"b": {"Type": "AWS::S3::Bucket", "Properties": {
+            "Enc": i % 2 == 0, "Name": "logs" if i % 3 else "BAD"}}}}
+        (data / f"t{i}.json").write_text(json.dumps(doc))
+
+    def counts(args, manifest):
+        run(["sweep", "-r", str(rules), "-d", str(data),
+             "-M", str(tmp_path / manifest), "-c", "4"] + args)
+        recs = [json.loads(l) for l in
+                (tmp_path / manifest).read_text().splitlines()]
+        total = {"pass": 0, "fail": 0, "skip": 0}
+        for r in recs:
+            for k in total:
+                total[k] += r["counts"][k]
+        return total
+
+    flat = counts([], "flat.jsonl")
+    sharded = counts(["--rule-shards", "2"], "sharded.jsonl")
+    assert flat == sharded and sum(flat.values()) == 9
