@@ -1,0 +1,57 @@
+"""Functional pins (reference guard/tests/functional.rs:7-80 analogue):
+the full verbose JSON event tree for one validate call is pinned, and
+the grammar parses every .guard file shipped with the reference
+(pr.yml:168-200's registry parse check, run over the in-repo corpus)."""
+
+import json
+import pathlib
+
+import pytest
+
+from guard_tpu.cli import run
+from guard_tpu.utils.io import Reader, Writer
+
+REF = pathlib.Path("/root/reference")
+GOLDEN = pathlib.Path(__file__).parent / "golden" / "event_tree.json"
+
+needs_reference = pytest.mark.skipif(
+    not REF.exists(), reason="reference checkout not available"
+)
+
+
+def _event_tree(args):
+    w = Writer.buffered()
+    code = run(args, writer=w)
+    out = w.stripped()
+    start = out.index("\n{")
+    return code, json.loads(out[start:])
+
+
+@needs_reference
+def test_verbose_event_tree_pinned():
+    rules = REF / "guard/resources/validate/rules-dir/s3_bucket_public_read_prohibited.guard"
+    data = REF / "guard/resources/validate/data-dir/s3-public-read-prohibited-template-non-compliant.yaml"
+    code, tree = _event_tree(
+        ["validate", "-r", str(rules), "-d", str(data), "--print-json"]
+    )
+    assert code == 19
+    expected = json.loads(GOLDEN.read_text())
+    assert tree == expected
+
+
+@needs_reference
+def test_grammar_parses_every_reference_guard_file():
+    from guard_tpu.core.errors import ParseError
+    from guard_tpu.core.parser import parse_rules_file
+
+    parsed = 0
+    for root in ("guard-examples", "guard/resources", "docs"):
+        for g in sorted((REF / root).rglob("*.guard")):
+            text = g.read_text()
+            if g.name.startswith("invalid_"):
+                with pytest.raises(ParseError):
+                    parse_rules_file(text, g.name)
+                continue
+            parse_rules_file(text, g.name)  # must not raise
+            parsed += 1
+    assert parsed >= 40
