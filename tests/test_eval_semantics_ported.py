@@ -1,0 +1,317 @@
+"""Second batch of semantic cases ported from the reference's pinned
+evaluation suite (guard/src/rules/eval_tests.rs) — rule/doc/expectation
+data re-expressed as pytest cases against this framework's oracle.
+Each test cites the reference test function it pins."""
+
+import pytest
+import yaml
+
+from guard_tpu.core.loader import load_document
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.core.scopes import RootScope
+from guard_tpu.core.values import from_plain
+
+
+def _status(rules, doc, rule=None):
+    rf = parse_rules_file(rules, "t.guard")
+    scope = RootScope(rf, doc if not isinstance(doc, dict) else from_plain(doc))
+    if rule is None:
+        from guard_tpu.core.evaluator import eval_rules_file
+
+        return eval_rules_file(rf, scope, None).value
+    return scope.rule_status(rule).value
+
+
+def _clause_status(clause, doc):
+    return _status(f"rule t {{ {clause} }}", doc, "t")
+
+
+IAM_TWO_ROLES = {
+    "Resources": {
+        "iamrole": {
+            "Type": "AWS::IAM::Role",
+            "Properties": {
+                "PermissionsBoundary": "arn:aws:iam::123456789012:policy/permboundary",
+                "AssumeRolePolicyDocument": {
+                    "Version": "2021-01-10",
+                    "Statement": {
+                        "Effect": "Allow",
+                        "Principal": "*",
+                        "Action": "*",
+                        "Resource": "*",
+                    },
+                },
+            },
+        },
+        "iamRole2": {
+            "Type": "AWS::IAM::Role",
+            "Properties": {
+                "PermissionsBoundary": "arn:aws:iam::123456789112:policy/permboundary",
+                "AssumeRolePolicyDocument": {
+                    "Version": "2021-01-10",
+                    "Statement": {
+                        "Effect": "Allow",
+                        "Principal": "*",
+                        "Action": "*",
+                        "Resource": "*",
+                    },
+                },
+                "Tags": [{"Key": "Key", "Value": "Value"}],
+            },
+        },
+    }
+}
+
+
+def test_unintuitive_all_clause_that_skips():
+    """eval_tests.rs rules_file_tests_the_unituitive_all_clause_that_skips:
+    a when-gate over ALL resources' Tags EXISTS fails on the untagged
+    resource, so the inner block SKIPs and the file PASSes."""
+    rules = """
+let iam_resources = Resources.*[ Type == "AWS::IAM::Role" ]
+rule iam_resources_exists {
+    %iam_resources !EMPTY
+}
+
+rule iam_basic_checks when iam_resources_exists {
+    %iam_resources.Properties.AssumeRolePolicyDocument.Version == /(\\d{4})-(\\d{2})-(\\d{2})/
+    %iam_resources.Properties.PermissionsBoundary == /arn:aws:iam::(\\d{12}):policy/
+    when %iam_resources.Properties.Tags EXISTS
+         %iam_resources.Properties.Tags !EMPTY {
+
+        %iam_resources.Properties.Tags[*].Value == /[a-zA-Z0-9]+/
+        %iam_resources.Properties.Tags[*].Key   == /[a-zA-Z0-9]+/
+    }
+}"""
+    assert _status(rules, IAM_TWO_ROLES) == "PASS"
+
+
+def test_type_block_fails_on_untagged_resource():
+    """eval_tests.rs rule_test_type_blocks: the AWS::IAM::Role type
+    block evaluates per resource; the untagged one FAILs the file."""
+    rules = """
+rule iam_basic_checks {
+  AWS::IAM::Role {
+    Properties.AssumeRolePolicyDocument.Version == /(\\d{4})-(\\d{2})-(\\d{2})/
+    Properties.PermissionsBoundary == /arn:aws:iam::(\\d{12}):policy/
+    Properties.Tags[*].Value == /[a-zA-Z0-9]+/
+    Properties.Tags[*].Key   == /[a-zA-Z0-9]+/
+  }
+}"""
+    assert _status(rules, IAM_TWO_ROLES) == "FAIL"
+
+
+def test_some_variable_selection_counts():
+    """eval_tests.rs test_rules_with_some_clauses: `some` in a variable
+    assignment drops unresolved entries; only the role whose Tag key
+    matches the regex is selected."""
+    rules = (
+        "let x = some Resources.*[ Type == 'AWS::IAM::Role' ]"
+        ".Properties.Tags[ Key == /[A-Za-z0-9]+Role/ ]\n"
+        "rule has_x when %x !empty {\n    %x exists\n}\n"
+    )
+    doc = {
+        "Resources": {
+            "CounterTaskDefExecutionRole5959CB2D": {
+                "Type": "AWS::IAM::Role",
+                "Properties": {
+                    "Tags": [{"Key": "TestRole", "Value": ""}],
+                },
+            },
+            "BlankRole001": {
+                "Type": "AWS::IAM::Role",
+                "Properties": {"Tags": [{"Key": "FooBar", "Value": ""}]},
+            },
+            "BlankRole002": {
+                "Type": "AWS::IAM::Role",
+                "Properties": {},
+            },
+        }
+    }
+    rf = parse_rules_file(rules, "t.guard")
+    scope = RootScope(rf, from_plain(doc))
+    selected = scope.resolve_variable("x")
+    resolved = [r for r in selected if getattr(r, "value", None) is not None]
+    assert len(resolved) == 1
+    assert _status(rules, doc, "has_x") == "PASS"
+
+
+def test_map_keys_filter_function():
+    """eval_tests.rs test_map_keys_function: `[ keys == /regex/ ]`
+    selects map values by key name."""
+    rules = """
+let api_gw = Resources[ Type == 'AWS::ApiGateway::RestApi' ]
+rule check_rest_api_is_private_and_has_access {
+    %api_gw {
+      Properties.EndpointConfiguration == ["PRIVATE"]
+      some Properties.Policy.Statement[*].Condition[ keys == /aws:[sS]ource(Vpc|VPC|Vpce|VPCE)/ ] !empty
+    }
+}"""
+    base = {
+        "Resources": {
+            "apiGw": {
+                "Type": "AWS::ApiGateway::RestApi",
+                "Properties": {
+                    "EndpointConfiguration": ["PRIVATE"],
+                    "Policy": {
+                        "Statement": [
+                            {
+                                "Action": "Allow",
+                                "Resource": ["*", "aws:"],
+                                "Condition": {"aws:IsSecure": True},
+                            }
+                        ]
+                    },
+                },
+            }
+        }
+    }
+    assert _status(rules, base) == "FAIL"
+    with_vpc = yaml.safe_load(yaml.safe_dump(base))
+    with_vpc["Resources"]["apiGw"]["Properties"]["Policy"]["Statement"][0][
+        "Condition"
+    ]["aws:sourceVpc"] = ["vpc-1234"]
+    assert _status(rules, with_vpc) == "PASS"
+
+
+@pytest.mark.parametrize(
+    "clause,expected",
+    [
+        ("Tags[*].Key == /Name/", "FAIL"),
+        ("some Tags[*].Key == /Name/", "FAIL"),
+        ("Tags[*] { Key == /Name/ }", "FAIL"),
+        ("some Tags[*] { Key == /Name/ }", "FAIL"),
+        ("Tags !empty", "FAIL"),
+        ("Tags empty", "PASS"),
+        ("Tags[*] !empty", "FAIL"),
+        ("Tags[*] empty", "PASS"),
+    ],
+)
+def test_all_list_value_access_on_empty(clause, expected):
+    """eval_tests.rs ensure_all_list_value_access_on_empty_fails: every
+    element access on an empty list is unresolved -> FAIL; emptiness
+    checks PASS."""
+    assert _clause_status(clause, {"Tags": []}) == expected
+
+
+def test_rule_clause_tags_present_and_empty():
+    """eval_tests.rs rule_clause_tests."""
+    rules = """
+rule check_all_resources_have_tags_present {
+    let all_resources = Resources.*.Properties
+
+    %all_resources.Tags EXISTS
+    %all_resources.Tags !EMPTY
+}"""
+    tagged = {
+        "Resources": {
+            "vpc": {
+                "Type": "AWS::EC2::VPC",
+                "Properties": {
+                    "CidrBlock": "10.0.0.0/25",
+                    "Tags": [{"Key": "my-vpc", "Value": "my-vpc"}],
+                },
+            }
+        }
+    }
+    assert _status(rules, tagged) == "PASS"
+    untagged = {
+        "Resources": {
+            "vpc": {
+                "Type": "AWS::EC2::VPC",
+                "Properties": {"CidrBlock": "10.0.0.0/25", "Tags": []},
+            }
+        }
+    }
+    assert _status(rules, untagged) == "FAIL"
+
+
+@pytest.mark.parametrize(
+    "ttl_yaml,expected",
+    [
+        ("'900'", "PASS"),
+        ("!!str 900", "PASS"),
+        ("900", "FAIL"),
+        ('!!int "900"', "FAIL"),
+        ('!!float "900"', "FAIL"),
+    ],
+)
+def test_type_conversions_no_coercion(ttl_yaml, expected):
+    """eval_tests.rs test_type_conversions: YAML tags decide the node
+    type and comparisons never coerce ("900" != 900)."""
+    template = (
+        "Resources:\n"
+        "    MasterRecord:\n"
+        "        Type: AWS::Route53::RecordSet\n"
+        "        Properties:\n"
+        f"            TTL: {ttl_yaml}\n"
+    )
+    doc = load_document(template, "t.yaml")
+    rules = """
+let aws_route53_recordset_resources = Resources.*[ Type == 'AWS::Route53::RecordSet' ]
+rule aws_route53_recordset when %aws_route53_recordset_resources !empty {
+  %aws_route53_recordset_resources.Properties.TTL == "900"
+}"""
+    assert _status(rules, doc) == expected
+
+
+def test_double_projection_with_key_interpolation():
+    """eval_tests.rs double_projection_tests: variable key interpolation
+    (Resources.%iam_references) plus a filter over a variable's
+    results."""
+    rules = """
+rule check_ecs_against_local_or_metadata {
+    let ecs_tasks = Resources.*[
+        Type == 'AWS::ECS::TaskDefinition'
+        Properties.TaskRoleArn exists
+    ]
+
+    let iam_references = some %ecs_tasks.Properties.TaskRoleArn.'Fn::GetAtt'[0]
+    when %iam_references !empty {
+        let iam_local = Resources.%iam_references
+        %iam_local.Type == 'AWS::IAM::Role'
+        %iam_local.Properties.PermissionsBoundary exists
+    }
+
+    let ecs_task_role_is_string = %ecs_tasks[
+        Properties.TaskRoleArn is_string
+    ]
+    when %ecs_task_role_is_string !empty {
+        %ecs_task_role_is_string.Metadata.NotRestricted exists
+    }
+}"""
+    passing = {
+        "Resources": {
+            "ecs": {
+                "Type": "AWS::ECS::TaskDefinition",
+                "Metadata": {"NotRestricted": True},
+                "Properties": {"TaskRoleArn": "aws:arn..."},
+            },
+            "ecs2": {
+                "Type": "AWS::ECS::TaskDefinition",
+                "Properties": {"TaskRoleArn": {"Fn::GetAtt": ["iam", "arn"]}},
+            },
+            "iam": {
+                "Type": "AWS::IAM::Role",
+                "Properties": {"PermissionsBoundary": "aws:arn"},
+            },
+        }
+    }
+    assert _status(rules, passing) == "PASS"
+    failing = {
+        "Resources": {
+            "ecs2": {
+                "Type": "AWS::ECS::TaskDefinition",
+                "Properties": {"TaskRoleArn": {"Fn::GetAtt": ["iam", "arn"]}},
+            }
+        }
+    }
+    assert _status(rules, failing) == "FAIL"
+
+
+def test_is_bool_and_is_int_strictness():
+    """eval_tests.rs is_bool / is_int."""
+    assert _clause_status("foo is_bool", {"foo": False}) == "PASS"
+    assert _clause_status("foo is_bool", {"foo": "false"}) == "FAIL"
+    assert _clause_status("foo is_int", {"foo": 1}) == "PASS"
+    assert _clause_status("foo is_int", {"foo": "1"}) == "FAIL"
