@@ -37,7 +37,9 @@ def gen_rules(cfn_resources: dict) -> Dict[str, Dict[str, Set[str]]]:
             if isinstance(prop_val, str):
                 rendered = '"' + prop_val.strip().replace("\n", "") + '"'
             else:
-                rendered = json.dumps(prop_val, separators=(", ", ": "))
+                # compact separators match the reference's serde_json
+                # to_string output (rulegen.rs golden files)
+                rendered = json.dumps(prop_val, separators=(",", ":"))
                 rendered = rendered.strip().replace("\n", "")
             rule_map.setdefault(rtype, {}).setdefault(prop_name, set()).add(rendered)
     return rule_map

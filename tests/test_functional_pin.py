@@ -55,3 +55,22 @@ def test_grammar_parses_every_reference_guard_file():
             parse_rules_file(text, g.name)  # must not raise
             parsed += 1
     assert parsed >= 40
+
+
+@needs_reference
+def test_rulegen_matches_reference_golden():
+    """rulegen output is byte-identical to the reference's golden file
+    (guard/tests/rulegen.rs + resources/rulegen/output-dir)."""
+    w = Writer.buffered()
+    code = run(
+        ["rulegen", "-t", str(
+            REF / "guard/resources/rulegen/data-dir/"
+            "s3-public-read-prohibited-template-compliant.yaml"
+        )],
+        writer=w,
+    )
+    assert code == 0
+    golden = (
+        REF / "guard/resources/rulegen/output-dir/test_rulegen_from_template.out"
+    ).read_text()
+    assert w.stripped() == golden
