@@ -25,6 +25,12 @@ from ..core.records import (
 from ..core.values import PV
 
 
+def get_rule_name(rules_file_name: str, name: str) -> str:
+    """summary_table.rs get_rule_name: strip a leading "<file>/"."""
+    prefix = rules_file_name + "/"
+    return name[len(prefix):] if name.startswith(prefix) else name
+
+
 def _pv_json(pv: PV) -> dict:
     """PathAwareValue serialization {path, value} (path_value.rs:864-880)."""
     return {"path": pv.self_path().s, "value": pv.to_plain()}
@@ -34,7 +40,10 @@ def _pv_display(pv: PV) -> str:
     loc = pv.self_path().loc
     import json
 
-    return f"Path={pv.self_path().s}[L:{loc.line},C:{loc.col}] Value={json.dumps(pv.to_plain())}"
+    return (
+        f"Path={pv.self_path().s}[L:{loc.line},C:{loc.col}] "
+        f"Value={json.dumps(pv.to_plain(), separators=(',', ':'))}"
+    )
 
 
 def _ur_json(ur) -> dict:
@@ -191,6 +200,7 @@ def _clause_value_report(current: EventRecord, check: ClauseCheck) -> List[dict]
                             f"[{ur.remaining_query}] is missing. Value traversed "
                             f"to [{_pv_display(ur.traversed_to)}]"
                         ),
+                        "location": _location_json(ur.traversed_to),
                     },
                     "unresolved": _ur_json(ur),
                 }
@@ -235,8 +245,8 @@ def _clause_value_report(current: EventRecord, check: ClauseCheck) -> List[dict]
             {
                 "Clause": {
                     "Unary": {
-                        "context": current.context,
                         "check": check_json,
+                        "context": current.context,
                         "messages": {
                             "custom_message": uc.value.custom_message or "",
                             "error_message": message,

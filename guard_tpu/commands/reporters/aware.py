@@ -36,10 +36,14 @@ def console_chain(
     rule_statuses,
     report: dict,
     show_summary,
+    output_format: str = "single-line-summary",
 ) -> None:
-    """The full single-line console chain for one (rules, data) pair:
-    SummaryTable header, then CfnAware -> TfAware -> generic body
-    (validate.rs:703-716). `show_summary` is the raw --show-summary list."""
+    """The full console chain for one (rules, data) pair: SummaryTable
+    header, then CfnAware -> TfAware -> generic body for the
+    single-line format (validate.rs:703-716), or the serialized
+    FileReport for `-o json|yaml` without --structured
+    (generic_summary.rs:104-105 / cfn.rs:86-87). `show_summary` is the
+    raw --show-summary list."""
     from .console import generic_single_line, summary_table_block
 
     show = set(show_summary)
@@ -49,6 +53,23 @@ def console_chain(
     summary_table_block(
         writer, data_file_name, rules_file_name, status, rule_statuses, show
     )
+    if output_format in ("json", "yaml"):
+        import json as _json
+
+        import yaml as _yaml
+
+        from .structured import _strip_locations
+
+        rep = _strip_locations(report)
+        if output_format == "yaml":
+            writer.write(
+                _yaml.safe_dump(
+                    rep, sort_keys=False, default_flow_style=False, width=2**31
+                )
+            )
+        else:
+            writer.write(_json.dumps(rep, indent=2))
+        return
     handled = cfn_single_line(
         writer, data_file_name, data_content, rules_file_name, data_pv, report
     ) or tf_single_line(writer, data_file_name, rules_file_name, data_pv, report)
@@ -242,18 +263,224 @@ def _emit_clause(
 
 
 def _group_failures(
-    report: dict, pattern: re.Pattern
+    report: dict, pattern: re.Pattern, floor: str
 ) -> Optional[Dict[str, List[Tuple[str, dict]]]]:
-    """Group failing clauses by resource key; None when any clause cannot
-    be attributed (cfn.rs:196-207 falls back to the generic reporter)."""
+    """Group failing clauses by resource key. The reference only
+    considers paths sorting lexicographically >= the resource-section
+    floor (cfn.rs:180 `path_tree.range("/Resources"..)`), so failures
+    anchored before it (root-level properties) are silently dropped;
+    a path at-or-after the floor that still cannot be attributed falls
+    back to the generic reporter (cfn.rs:196-207 InternalError)."""
     groups: Dict[str, List[Tuple[str, dict]]] = {}
     for rule_name, clause in iter_clause_failures(report):
         path = _clause_anchor_path(clause)
+        if path < floor:
+            continue
         m = pattern.match(path)
         if not m:
             return None
         groups.setdefault(m.group(1), []).append((rule_name, clause))
     return groups
+
+
+def _node_paths(leaf: dict) -> List[str]:
+    """value_from/value_to paths of a leaf clause/block node
+    (common.rs insert_into_trees)."""
+    paths: List[str] = []
+    if "Clause" in leaf:
+        payload = leaf["Clause"].get("Unary") or leaf["Clause"].get("Binary") or {}
+        check = payload.get("check") or {}
+        if "Resolved" in check:
+            r = check["Resolved"]
+            if "from" in r:
+                paths.append(r["from"]["path"])
+                if "to" in r:
+                    paths.append(r["to"]["path"])
+            elif "value" in r:
+                paths.append(r["value"]["path"])
+        elif "InResolved" in check:
+            paths.append(check["InResolved"]["from"]["path"])
+        elif "UnResolved" in check:
+            paths.append(check["UnResolved"]["value"]["traversed_to"]["path"])
+    elif "Block" in leaf:
+        ur = leaf["Block"].get("unresolved")
+        if ur:
+            paths.append(ur["traversed_to"]["path"])
+    return paths
+
+
+def _leaves(node: dict):
+    if "Rule" in node:
+        for child in node["Rule"]["checks"]:
+            yield from _leaves(child)
+    elif "Disjunctions" in node:
+        for child in node["Disjunctions"]["checks"]:
+            yield from _leaves(child)
+    else:
+        yield node
+
+
+def _emit_messages(writer: Writer, prefix: str, custom: str, error: str, width: int) -> None:
+    """common.rs emit_messages:762-823."""
+    if custom:
+        parts = custom.split(";") if ";" in custom else custom.split("\n")
+        parts = [p.strip() for p in parts]
+        parts = [p for p in parts if p]
+        if len(parts) > 1:
+            writer.writeln(f"{prefix}{'Message':<{width}} {{")
+            for p in parts:
+                writer.writeln(f"{prefix}  {p}")
+            writer.writeln(f"{prefix}}}")
+        elif parts:
+            writer.writeln(f"{prefix}{'Message':<{width}} = {parts[0]}")
+    if error:
+        writer.writeln(f"{prefix}{'Error':<{width}} = {error}")
+
+
+def _plain_value_display(v) -> str:
+    from ...core.values import plain_value_display
+
+    return plain_value_display(v)
+
+
+def _loc_disp(path: str, msgs: dict) -> str:
+    loc = (msgs or {}).get("location") or {}
+    return f"{path}[L:{loc.get('line', 0)},C:{loc.get('col', 0)}]"
+
+
+def _pprint_clauses(
+    writer: Writer,
+    node: dict,
+    members: set,
+    prefix: str,
+    excerpt: Optional[_CodeExcerpt],
+    rules_file: str,
+) -> None:
+    """common.rs pprint_clauses:919-1100 with the cfn.rs ErrWriter field
+    and code-excerpt emission inlined."""
+    if "Rule" in node:
+        rr = node["Rule"]
+        writer.writeln(f"{prefix}Rule = {rr['name']} {{")
+        p2 = prefix + "  "
+        msgs = rr.get("messages") or {}
+        _emit_messages(
+            writer, p2, msgs.get("custom_message") or "", msgs.get("error_message") or "", 0
+        )
+        writer.writeln(f"{p2}ALL {{")
+        p3 = p2 + "  "
+        for child in rr["checks"]:
+            _pprint_clauses(writer, child, members, p3, excerpt, rules_file)
+        writer.writeln(f"{p2}}}")
+        writer.writeln(f"{prefix}}}")
+        return
+    if "Disjunctions" in node:
+        writer.writeln(f"{prefix}ANY {{")
+        p2 = prefix + "  "
+        for child in node["Disjunctions"]["checks"]:
+            _pprint_clauses(writer, child, members, p2, excerpt, rules_file)
+        writer.writeln(f"{prefix}}}")
+        return
+    if id(node) not in members:
+        return
+    if "Block" in node:
+        blk = node["Block"]
+        msgs = blk.get("messages") or {}
+        writer.writeln(f"{prefix}Check = {blk.get('context', '')} {{")
+        p2 = prefix + "  "
+        writer.writeln(f"{p2}RequiredPropertyError {{")
+        p3 = p2 + "  "
+        ur = blk.get("unresolved")
+        width = len("Message") + 4
+        if ur and ur["traversed_to"]["path"]:
+            width = len("MissingProperty") + 4
+            writer.writeln(f"{p3}{'PropertyPath':<{width}}= {ur['traversed_to']['path']}")
+            writer.writeln(f"{p3}{'MissingProperty':<{width}}= {ur['remaining_query']}")
+        _emit_messages(
+            writer, p3, msgs.get("custom_message") or "", msgs.get("error_message") or "", width
+        )
+        # the reference buffers the code excerpt and writeln!s the
+        # buffer afterwards, leaving a blank line (common.rs:1030-1042)
+        if excerpt is not None and ur:
+            loc = msgs.get("location") or {}
+            excerpt.emit(writer, loc.get("line"), p3)
+        writer.writeln("")
+        writer.writeln(f"{p2}}}")
+        writer.writeln(f"{prefix}}}")
+        return
+    payload = node["Clause"].get("Unary") or node["Clause"].get("Binary") or {}
+    check = payload.get("check") or {}
+    msgs = payload.get("messages") or {}
+    context = payload.get("context", "")
+    custom = msgs.get("custom_message") or ""
+    error = msgs.get("error_message") or ""
+    width = len("PropertyPath") + 4
+    if "UnResolved" in check:
+        # emit_retrieval_error (common.rs:826-876): unpadded fields,
+        # PropertyPath carries the source location
+        ur = check["UnResolved"]["value"]
+        writer.writeln(f"{prefix}Check = {context} {{")
+        p2 = prefix + "  "
+        _emit_messages(writer, p2, custom, "", 0)
+        writer.writeln(f"{p2}RequiredPropertyError {{")
+        p3 = p2 + "  "
+        writer.writeln(
+            f"{p3}PropertyPath = {_loc_disp(ur['traversed_to']['path'], msgs)}"
+        )
+        writer.writeln(f"{p3}MissingProperty = {ur['remaining_query']}")
+        if ur.get("reason"):
+            writer.writeln(f"{p3}Reason = {ur['reason']}")
+        if excerpt is not None:
+            loc = msgs.get("location") or {}
+            excerpt.emit(writer, loc.get("line"), p3)
+        writer.writeln(f"{p2}}}")
+        writer.writeln(f"{prefix}}}")
+        return
+    writer.writeln(f"{prefix}Check = {context} {{")
+    p2 = prefix + "  "
+    writer.writeln(f"{p2}ComparisonError {{")
+    p3 = p2 + "  "
+    loc = msgs.get("location") or {}
+    # the reference buffers the field lines + code excerpt, emits
+    # Message/Error first, then writeln!s the buffer — so messages come
+    # first and a blank line trails the block (common.rs:1112-1148)
+    _emit_messages(writer, p3, custom, error, width)
+    if "Resolved" in check and "from" in check["Resolved"]:
+        r = check["Resolved"]
+        writer.writeln(f"{p3}{'PropertyPath':<{width}}= {_loc_disp(r['from']['path'], msgs)}")
+        writer.writeln(f"{p3}{'Operator':<{width}}= {_cmp_str(r.get('comparison'))}")
+        writer.writeln(
+            f"{p3}{'Value':<{width}}= {_plain_value_display(r['from']['value'])}"
+        )
+        writer.writeln(
+            f"{p3}{'ComparedWith':<{width}}= {_plain_value_display(r['to']['value'])}"
+        )
+        if excerpt is not None:
+            excerpt.emit(writer, loc.get("line"), p3)
+    elif "InResolved" in check:
+        r = check["InResolved"]
+        to_vals = [t["value"] for t in r.get("to", [])]
+        cut_off = max(len(to_vals), 5)
+        shown = to_vals[: cut_off + 1]
+        writer.writeln(f"{p3}{'PropertyPath':<{width}}= {_loc_disp(r['from']['path'], msgs)}")
+        writer.writeln(f"{p3}{'Operator':<{width}}= {_cmp_str(r.get('comparison'))}")
+        if cut_off < len(to_vals):
+            writer.writeln(f"{p3}{'Total':<{width}}= {len(to_vals)}")
+        writer.writeln(
+            f"{p3}{'Value':<{width}}= {_plain_value_display(r['from']['value'])}"
+        )
+        collected = "[" + ", ".join(_plain_value_display(v) for v in shown) + "]"
+        writer.writeln(f"{p3}{'ComparedWith':<{width}}= {collected}")
+        if excerpt is not None:
+            excerpt.emit(writer, loc.get("line"), p3)
+    elif "Resolved" in check and "value" in check["Resolved"]:
+        r = check["Resolved"]
+        writer.writeln(f"{p3}{'PropertyPath':<{width}}= {_loc_disp(r['value']['path'], msgs)}")
+        writer.writeln(f"{p3}{'Operator':<{width}}= {_cmp_str(r.get('comparison'))}")
+        if excerpt is not None:
+            excerpt.emit(writer, loc.get("line"), p3)
+    writer.writeln("")
+    writer.writeln(f"{p2}}}")
+    writer.writeln(f"{prefix}}}")
 
 
 def cfn_single_line(
@@ -265,20 +492,44 @@ def cfn_single_line(
     report: dict,
 ) -> bool:
     """CfnAware single-line summary (cfn.rs:157-420). Returns True when
-    this reporter applies and handled the output."""
-    if _map_get(doc, "Resources") is None:
+    this reporter applies and handled the output. Failures anchored at
+    paths sorting before "/Resources" are silently dropped (cfn.rs:180
+    path_tree.range); a path at-or-after that cannot be attributed to a
+    known resource falls back to the generic reporter (cfn.rs:196-207)."""
+    resources = _map_get(doc, "Resources")
+    if resources is None:
         return False
     if not report["not_compliant"]:
         return True
-    groups = _group_failures(report, _CFN_RESOURCE)
-    if groups is None:
-        return False
+
+    def resource_name_of(path: str) -> Optional[str]:
+        """Resource names may themselves contain '/' (cfn.rs:183-194
+        probes progressively longer names against the template)."""
+        if not path.startswith("/Resources/"):
+            return None
+        segs = path[len("/Resources/"):].split("/")
+        for i in range(1, len(segs) + 1):
+            name = "/".join(segs[:i])
+            if _map_get(resources, name) is not None:
+                return name
+        return None
+
+    members_by_resource: Dict[str, set] = {}
+    for rule_node in report["not_compliant"]:
+        for leaf in _leaves(rule_node):
+            for path in _node_paths(leaf):
+                if path < "/Resources":
+                    continue
+                name = resource_name_of(path)
+                if name is None:
+                    return False
+                members_by_resource.setdefault(name, set()).add(id(leaf))
 
     excerpt = _CodeExcerpt(data_content)
-    resources = _map_get(doc, "Resources")
     writer.writeln(f"Evaluating data {data_file} against rules {rules_file}")
-    writer.writeln(f"Number of non-compliant resources {len(groups)}")
-    for name in sorted(groups):
+    writer.writeln(f"Number of non-compliant resources {len(members_by_resource)}")
+    for name in sorted(members_by_resource):
+        members = members_by_resource[name]
         res = _map_get(resources, name)
         res_type = _scalar(_map_get(res, "Type")) or ""
         cdk_path = _scalar(_map_get(_map_get(res, "Metadata"), "aws:cdk:path"))
@@ -286,14 +537,9 @@ def cfn_single_line(
         writer.writeln(f"  {'Type':<10}= {res_type}")
         if cdk_path:
             writer.writeln(f"  {'CDK-Path':<10}= {cdk_path}")
-        by_rule: Dict[str, List[dict]] = {}
-        for rule_name, clause in groups[name]:
-            by_rule.setdefault(rule_name, []).append(clause)
-        for rule_name in sorted(by_rule):
-            writer.writeln(f"  Rule = {rule_name} {{")
-            for clause in by_rule[rule_name]:
-                _emit_clause(writer, clause, "    ", excerpt)
-            writer.writeln("  }")
+        for rule_node in report["not_compliant"]:
+            if any(id(leaf) in members for leaf in _leaves(rule_node)):
+                _pprint_clauses(writer, rule_node, members, "  ", excerpt, rules_file)
         writer.writeln("}")
     return True
 
@@ -320,7 +566,7 @@ def tf_single_line(
         return False
     if not report["not_compliant"]:
         return True
-    groups = _group_failures(report, _TF_RESOURCE)
+    groups = _group_failures(report, _TF_RESOURCE, "/resource_changes")
     if groups is None:
         return False
 

@@ -44,10 +44,47 @@ def combine_reports(reports: List[dict]) -> List[dict]:
     return [by_name[n] for n in order]
 
 
+def _strip_locations(reports):
+    """Messages.location is serde(skip_serializing) in the reference
+    (eval_context.rs:1609-1614): kept internally for SARIF/console code
+    excerpts, never serialized into structured output. Walks the known
+    report structure only, so embedded template data keeps any
+    "location" keys it happens to contain."""
+    import copy
+
+    def fix_node(node):
+        if "Rule" in node:
+            node["Rule"]["messages"].pop("location", None)
+            for child in node["Rule"]["checks"]:
+                fix_node(child)
+        elif "Disjunctions" in node:
+            for child in node["Disjunctions"]["checks"]:
+                fix_node(child)
+        elif "Block" in node:
+            node["Block"]["messages"].pop("location", None)
+        elif "Clause" in node:
+            inner = node["Clause"]
+            payload = inner.get("Unary") or inner.get("Binary")
+            if payload:
+                payload["messages"].pop("location", None)
+
+    out = copy.deepcopy(reports)
+    for report in [out] if isinstance(out, dict) else out:
+        for node in report.get("not_compliant", []):
+            fix_node(node)
+    return out
+
+
 def write_structured(writer: Writer, reports: List[dict], output_format: str) -> None:
-    combined = combine_reports(reports)
+    combined = _strip_locations(combine_reports(reports))
     if output_format == "yaml":
-        writer.write(yaml.safe_dump(combined, sort_keys=False, default_flow_style=False))
+        writer.write(
+            yaml.safe_dump(
+                combined,
+                sort_keys=False,
+                default_flow_style=False,
+                width=2**31,  # serde_yaml never wraps long scalars
+            )
+        )
     else:
         writer.write(json.dumps(combined, indent=2))
-        writer.writeln()

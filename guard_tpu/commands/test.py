@@ -226,7 +226,10 @@ class Test:
                             JunitTestCase(
                                 name=rule_name,
                                 status=Status.FAIL,
-                                message=f"Expected = {expected}, Evaluated = {[s.value for s in statuses]}",
+                                failure_messages=[
+                                    f"Expected = {expected}, Evaluated = "
+                                    f"{[s.value for s in statuses]}"
+                                ],
                             )
                         )
                         spec_report["rules"].append(

@@ -219,10 +219,11 @@ class Validate:
         overall = Status.SKIP
         had_fail = False
         all_reports: List[dict] = []
-        junit_suites = {}
+        # JUnit: one suite per data file, one case per rules file
+        # (reporters/validate/xml.rs:22-61)
+        junit_suites = {df.name: [] for df in data_files}
 
         for rule_file in rule_files:
-            cases: List[JunitTestCase] = []
             for data_file in data_files:
                 try:
                     scope = RootScope(rule_file.rules, data_file.path_value)
@@ -230,13 +231,27 @@ class Validate:
                 except GuardError as e:
                     writer.writeln_err(str(e))
                     errors += 1
+                    junit_suites[data_file.name].append(
+                        JunitTestCase(
+                            name=rule_file.name, status=Status.FAIL, error=str(e)
+                        )
+                    )
                     continue
                 root_record = scope.reset_recorder().extract()
                 report = simplified_report_from_root(root_record, data_file.name)
                 rule_statuses = rule_statuses_from_root(root_record)
                 all_reports.append(report)
-                for rn, rs in rule_statuses.items():
-                    cases.append(JunitTestCase(name=f"{rn}-{data_file.name}", status=rs))
+                from .reporters.junit import failure_info_from_report
+
+                fname, fmsgs = failure_info_from_report(report)
+                junit_suites[data_file.name].append(
+                    JunitTestCase(
+                        name=rule_file.name,
+                        status=status,
+                        failure_name=fname if status == Status.FAIL else None,
+                        failure_messages=fmsgs if status == Status.FAIL else None,
+                    )
+                )
                 if status == Status.FAIL:
                     had_fail = True
                 overall = overall.and_(status)
@@ -246,12 +261,12 @@ class Validate:
                         writer, data_file.name, data_file.content,
                         data_file.path_value, rule_file.name,
                         status, rule_statuses, report, self.show_summary,
+                        self.output_format,
                     )
                     if self.verbose:
                         print_verbose_tree(writer, root_record)
                     if self.print_json:
                         writer.writeln(json.dumps(record_to_json(root_record), indent=2))
-            junit_suites[rule_file.name] = cases
 
         if self.structured:
             if self.output_format in ("json", "yaml"):

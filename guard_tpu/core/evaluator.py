@@ -1061,10 +1061,15 @@ def eval_guard_named_clause(gnc: GuardNamedRuleClause, resolver) -> Status:
     return outcome
 
 
-def eval_general_block_clause(block: Block, resolver, eval_fn) -> Status:
+def eval_general_block_clause(
+    block: Block,
+    resolver,
+    eval_fn,
+    context: str = "cfn_guard::rules::exprs::GuardClause#disjunction",
+) -> Status:
     """eval.rs:1291-1301."""
     scope = BlockScope(block, resolver.root(), resolver)
-    return eval_conjunction_clauses(block.conjunctions, scope, eval_fn)
+    return eval_conjunction_clauses(block.conjunctions, scope, eval_fn, context)
 
 
 def eval_guard_block_clause(block_clause: BlockGuardClause, resolver) -> Status:
@@ -1165,7 +1170,9 @@ def eval_when_condition_block(context: str, conditions, block: Block, resolver) 
     when_context = f"{context}/When"
     resolver.start_record(when_context)
     try:
-        status = eval_conjunction_clauses(conditions, resolver, eval_when_clause)
+        status = eval_conjunction_clauses(
+            conditions, resolver, eval_when_clause, context="cfn_guard::rules::exprs::WhenGuardClause#disjunction"
+        )
     except GuardError as e:
         resolver.end_record(when_context, RecordType(RecordType.WHEN_CONDITION, Status.FAIL))
         resolver.end_record(
@@ -1324,7 +1331,10 @@ def eval_type_block_clause(type_block: TypeBlock, resolver) -> Status:
         resolver.start_record(when_context)
         try:
             status = eval_conjunction_clauses(
-                type_block.conditions, resolver, eval_when_clause
+                type_block.conditions,
+                resolver,
+                eval_when_clause,
+                context="cfn_guard::rules::exprs::WhenGuardClause#disjunction",
             )
         except GuardError as e:
             resolver.end_record(
@@ -1470,7 +1480,9 @@ def eval_rule(rule: Rule, resolver) -> Status:
         when_context = f"Rule#{context}/When"
         resolver.start_record(when_context)
         try:
-            status = eval_conjunction_clauses(rule.conditions, resolver, eval_when_clause)
+            status = eval_conjunction_clauses(
+            rule.conditions, resolver, eval_when_clause, context="cfn_guard::rules::exprs::WhenGuardClause#disjunction"
+        )
         except GuardError:
             resolver.end_record(when_context, RecordType(RecordType.RULE_CONDITION, Status.FAIL))
             resolver.end_record(
@@ -1494,7 +1506,12 @@ def eval_rule(rule: Rule, resolver) -> Status:
         resolver.end_record(when_context, RecordType(RecordType.RULE_CONDITION, Status.PASS))
 
     try:
-        status = eval_general_block_clause(rule.block, resolver, eval_rule_clause)
+        status = eval_general_block_clause(
+            rule.block,
+            resolver,
+            eval_rule_clause,
+            context="cfn_guard::rules::exprs::RuleClause#disjunction",
+        )
     except GuardError:
         resolver.end_record(
             context,
@@ -1544,11 +1561,17 @@ def eval_rules_file(
     return overall
 
 
-def eval_conjunction_clauses(conjunctions, resolver, eval_fn) -> Status:
+def eval_conjunction_clauses(
+    conjunctions,
+    resolver,
+    eval_fn,
+    context: str = "cfn_guard::rules::exprs::GuardClause#disjunction",
+) -> Status:
     """eval.rs:1971-2065 — AND over conjunctions, OR within each;
-    SKIPs don't count either way."""
+    SKIPs don't count either way. The context embeds the reference's
+    generic type name (eval.rs:1982 uses std::any::type_name::<T>()),
+    which reporters pin byte-for-byte."""
     num_passes = num_fails = 0
-    context = "GuardClause#disjunction"
     for conjunction in conjunctions:
         num_of_disjunction_fails = 0
         multiple_ors = len(conjunction) > 1

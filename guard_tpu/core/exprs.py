@@ -211,7 +211,9 @@ def display_let_value(lv: LetValue) -> str:
         return lv.display()
     if isinstance(lv, FunctionExpr):
         return lv.display()
-    return repr(lv.to_plain())
+    from .values import value_only_display
+
+    return value_only_display(lv)
 
 
 @dataclass
@@ -245,11 +247,17 @@ class GuardAccessClause:
     negation: bool = False
 
     def display(self) -> str:
+        # exprs.rs:332-359: GuardAccessClause renders "{not|} {clause}"
+        # (leading space when not negated) and AccessClause renders
+        # "{query} {display_comparator}{rhs}" where display_comparator
+        # carries a trailing space — hence the double space before the
+        # RHS and the trailing spaces on unary clauses. Reports pin
+        # these strings byte-for-byte.
         ac = self.access_clause
-        not_s = "not " if self.negation else ""
+        lead = "not" if self.negation else ""
         cmp_not = "not " if ac.comparator_inverse else ""
-        rhs = f" {display_let_value(ac.compare_with)}" if ac.compare_with is not None else ""
-        return f"{not_s}{ac.query.display()} {cmp_not}{ac.comparator.display()}{rhs}"
+        rhs = display_let_value(ac.compare_with) if ac.compare_with is not None else ""
+        return f"{lead} {ac.query.display()} {cmp_not}{ac.comparator.display()}  {rhs}"
 
 
 @dataclass

@@ -40,7 +40,7 @@ from .exprs import (
 from .functions import call_function
 from .qresult import LITERAL, RESOLVED, UNRESOLVED, QueryResult, Status, UnResolved
 from .records import EventRecord, RecordType
-from .values import LIST, MAP, STRING, PV
+from .values import LIST, MAP, STRING, PV, rust_debug_pv
 
 # ---------------------------------------------------------------------------
 # Key-case converters (eval_context.rs:315-326, via the cruet crate):
@@ -427,7 +427,7 @@ def query_retrieval_with_converter(
             _unresolved(
                 current,
                 f"Attempting to retrieve from index {part.index} but type is not an "
-                f"array at path {current.self_path().s}, type {current.type_info()}",
+                f"array at path {current.self_path().disp()}, type {current.type_info()}",
                 query[query_index:],
             )
         ]
@@ -454,7 +454,7 @@ def _retrieve_index(parent: PV, index: int, elements: List[PV], query: List) -> 
         return QueryResult.resolved(elements[check])
     return _unresolved(
         parent,
-        f"Array Index out of bounds for path = {parent.self_path().s} on index = "
+        f"Array Index out of bounds for path = {parent.self_path().disp()} on index = "
         f"{index} inside Array, remaining query = {display_query(query)}",
         query,
     )
@@ -468,7 +468,7 @@ def _accumulate(
         return [
             _unresolved(
                 parent,
-                f"No more entries for value at path = {parent.self_path().s} on type = "
+                f"No more entries for value at path = {parent.self_path().disp()} on type = "
                 f"{parent.type_info()} ",
                 query[query_index:],
             )
@@ -490,7 +490,7 @@ def _accumulate_map(
         return [
             _unresolved(
                 parent,
-                f"No more entries for value at path = {parent.self_path().s} on type = "
+                f"No more entries for value at path = {parent.self_path().disp()} on type = "
                 f"{parent.type_info()} ",
                 query[query_index:],
             )
@@ -525,7 +525,7 @@ def _retrieve_key(part: QKey, query_index, query, current: PV, resolver, convert
             _unresolved(
                 current,
                 f"Attempting to retrieve from index {idx} but type is not an array "
-                f"at path {current.self_path().s}",
+                f"at path {current.self_path().disp()}",
                 query,
             )
         ]
@@ -535,7 +535,8 @@ def _retrieve_key(part: QKey, query_index, query, current: PV, resolver, convert
             _unresolved(
                 current,
                 f"Attempting to retrieve from key {key} but type is not an struct "
-                f"type at path {current.self_path().s}, Type = {current.type_info()}",
+                f"type at path {current.self_path().disp()}, Type = "
+                f"{current.type_info()}, Value = {rust_debug_pv(current)}",
                 query[query_index:],
             )
         ]
@@ -574,7 +575,7 @@ def _retrieve_key(part: QKey, query_index, query, current: PV, resolver, convert
                     _unresolved(
                         current,
                         f"Keys returned for variable {var} could not completely "
-                        f"resolve. Path traversed until {ur.traversed_to.self_path().s}"
+                        f"resolve. Path traversed until {ur.traversed_to.self_path().disp()}"
                         f"{ur.reason or ''}",
                         query[query_index:],
                     )
@@ -594,7 +595,7 @@ def _retrieve_key(part: QKey, query_index, query, current: PV, resolver, convert
                         _unresolved(
                             current,
                             f"Could not locate key = {kv.val} inside struct at path = "
-                            f"{current.self_path().s}",
+                            f"{current.self_path().disp()}",
                             query[query_index:],
                         )
                     )
@@ -613,7 +614,7 @@ def _retrieve_key(part: QKey, query_index, query, current: PV, resolver, convert
                                 _unresolved(
                                     current,
                                     f"Could not locate key = {inner.val} inside struct "
-                                    f"at path = {inner.self_path().s}",
+                                    f"at path = {inner.self_path().disp()}",
                                     query[query_index:],
                                 )
                             )
@@ -653,7 +654,7 @@ def _retrieve_key(part: QKey, query_index, query, current: PV, resolver, convert
     return [
         _unresolved(
             current,
-            f"Could not find key {key} inside struct at path {current.self_path().s}",
+            f"Could not find key {key} inside struct at path {current.self_path().disp()}",
             query[query_index:],
         )
     ]
@@ -758,7 +759,7 @@ def _retrieve_filter(part: QFilter, query_index, query, current: PV, resolver, c
         _unresolved(
             current,
             f"Filter on value type that was not a struct or array "
-            f"{current.type_info()} {current.self_path().s}",
+            f"{current.type_info()} {current.self_path().disp()}",
             query[query_index:],
         )
     ]
@@ -796,7 +797,7 @@ def _retrieve_map_key_filter(
             _unresolved(
                 current,
                 f"Map Filter for keys was not a struct {current.type_info()} "
-                f"{current.self_path().s}",
+                f"{current.self_path().disp()}",
                 query[query_index:],
             )
         ]

@@ -100,6 +100,11 @@ class Path:
     def root() -> "Path":
         return Path("", _ROOT_LOC)
 
+    def disp(self) -> str:
+        """Path Display (path_value.rs:62-66): "{path}[L:{l},C:{c}]" —
+        the form the reference embeds in unresolved reasons/messages."""
+        return f"{self.s}[L:{self.loc.line},C:{self.loc.col}]"
+
     def extend(self, part: str, loc: Optional[Location] = None) -> "Path":
         return Path(self.s + "/" + part, loc if loc is not None else self.loc)
 
@@ -461,3 +466,98 @@ def from_plain(value, path: Optional[Path] = None) -> PV:
             mv.values[ks] = from_plain(v, kp)
         return PV.map_(path, mv)
     raise IncompatibleError(f"Cannot convert {type(value)} to a path-aware value")
+
+
+def _rust_num(v) -> str:
+    """Rust {} Display for numbers: integral floats print bare."""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, int):
+        return str(v)
+    if float(v) == int(v) and abs(v) < 1e16:
+        return str(int(v))
+    return repr(float(v))
+
+
+def plain_value_display(v) -> str:
+    """ValueOnlyDisplay over a plain-python projection (reports store
+    to_plain() values); same rendering rules as value_only_display."""
+    if v is None:
+        return '"NULL"'
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, str):
+        return f'"{v}"'
+    if isinstance(v, (int, float)):
+        return _rust_num(v)
+    if isinstance(v, list):
+        return "[" + ",".join(plain_value_display(e) for e in v) + "]"
+    if isinstance(v, dict):
+        return "{" + ",".join(
+            f'"{k}":{plain_value_display(val)}' for k, val in v.items()
+        ) + "}"
+    return str(v)
+
+
+def value_only_display(pv: "PV") -> str:
+    """ValueOnlyDisplay (display.rs:42-99): the reference's value
+    rendering used in clause-display contexts and console reporters —
+    double-quoted strings, "/re/" regexes, "NULL", compact containers."""
+    k = pv.kind
+    if k == NULL:
+        return '"NULL"'
+    if k == STRING:
+        return f'"{pv.val}"'
+    if k == REGEX:
+        return f'"/{pv.val}/"'
+    if k == CHAR:
+        return f"'{pv.val}'"
+    if k == BOOL:
+        return "true" if pv.val else "false"
+    if k in (INT, FLOAT):
+        return _rust_num(pv.val)
+    if k == LIST:
+        return "[" + ",".join(value_only_display(e) for e in pv.val) + "]"
+    if k == MAP:
+        return "{" + ",".join(
+            f'"{kk}":{value_only_display(vv)}' for kk, vv in pv.val.values.items()
+        ) + "}"
+    r = pv.val  # ranges (display.rs write_range); char bounds print bare
+    lo = "[" if r.inclusive & LOWER_INCLUSIVE else "("
+    hi = "]" if r.inclusive & UPPER_INCLUSIVE else ")"
+
+    def bound(b):
+        return b if isinstance(b, str) else _rust_num(b)
+
+    return f"{lo}{bound(r.lower)},{bound(r.upper)}{hi}"
+
+
+def rust_debug_pv(pv: "PV") -> str:
+    """Rust derive(Debug) rendering of a PathAwareValue, embedded in one
+    unresolved reason (eval_context.rs:580-581 uses {:?} of the value)."""
+    p = pv.path
+    path = f'Path("{p.s}", Location {{ line: {p.loc.line}, col: {p.loc.col} }})'
+    k = pv.kind
+    if k == STRING:
+        return f'String(({path}, "{pv.val}"))'
+    if k == REGEX:
+        return f'Regex(({path}, "{pv.val}"))'
+    if k == CHAR:
+        return f"Char(({path}, '{pv.val}'))"
+    if k == BOOL:
+        return f"Bool(({path}, {'true' if pv.val else 'false'}))"
+    if k == INT:
+        return f"Int(({path}, {pv.val}))"
+    if k == FLOAT:
+        return f"Float(({path}, {_rust_num(pv.val)}.0))" if float(pv.val) == int(pv.val) else f"Float(({path}, {pv.val}))"
+    if k == NULL:
+        return f"Null({path})"
+    if k == LIST:
+        inner = ", ".join(rust_debug_pv(e) for e in pv.val)
+        return f"List(({path}, [{inner}]))"
+    if k == MAP:
+        entries = ", ".join(
+            f'"{kk}": {rust_debug_pv(vv)}' for kk, vv in pv.val.values.items()
+        )
+        return f"Map(({path}, MapValue {{ values: {{{entries}}} }}))"
+    return repr(pv)

@@ -68,7 +68,7 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
     errors = 0
     had_fail = False
     all_reports: List[dict] = []
-    junit_suites = {}
+    junit_suites = {df.name: [] for df in data_files}
     host_docs = set()
 
     for rule_file in rule_files:
@@ -79,7 +79,6 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
             evaluator = ShardedBatchEvaluator(compiled)
             statuses, unsure, host_docs = evaluator.evaluate_bucketed(batch)
 
-        cases: List[JunitTestCase] = []
         for di, data_file in enumerate(data_files):
             rule_statuses = {}
             unsure_rules = set()
@@ -145,16 +144,25 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
             if doc_status == Status.FAIL:
                 had_fail = True
             all_reports.append(report)
-            for rn, rs in rule_statuses.items():
-                cases.append(JunitTestCase(name=f"{rn}-{data_file.name}", status=rs))
+            from ..commands.reporters.junit import failure_info_from_report
+
+            fname, fmsgs = failure_info_from_report(report)
+            junit_suites[data_file.name].append(
+                JunitTestCase(
+                    name=rule_file.name,
+                    status=doc_status,
+                    failure_name=fname if doc_status == Status.FAIL else None,
+                    failure_messages=fmsgs if doc_status == Status.FAIL else None,
+                )
+            )
 
             if not validate.structured:
                 console_chain(
                     writer, data_file.name, data_file.content,
                     data_file.path_value, rule_file.name,
                     doc_status, rule_statuses, report, validate.show_summary,
+                    validate.output_format,
                 )
-        junit_suites[rule_file.name] = cases
 
     if validate.structured:
         if validate.output_format in ("json", "yaml"):

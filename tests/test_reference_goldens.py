@@ -1,0 +1,185 @@
+"""Byte-level parity with the reference's validate golden outputs
+(guard/tests/validate.rs + resources/validate/output-dir): console
+summary with CFN-aware resource blocks, verbose event trees, structured
+JSON/YAML, SARIF and JUnit. SARIF/JUnit apply the same sanitizations
+the reference's own tests do (uri / time) plus tool-identity
+neutralization (this framework reports its own name)."""
+
+import pathlib
+import re
+
+import pytest
+
+from guard_tpu.cli import run
+from guard_tpu.utils.io import Reader, Writer
+
+REF = pathlib.Path("/root/reference/guard/resources/validate")
+
+needs_reference = pytest.mark.skipif(
+    not REF.exists(), reason="reference checkout not available"
+)
+
+
+def _run(args, stdin: str = None):
+    w = Writer.buffered()
+    r = Reader.from_string(stdin) if stdin is not None else None
+    code = run(args, writer=w, reader=r)
+    return code, w.stripped()
+
+
+def _golden(name: str) -> str:
+    return (REF / "output-dir" / name).read_text()
+
+
+CONSOLE_CASES = [
+    (
+        "rules_dir_against_data_dir.out",
+        ["-r", str(REF / "rules-dir"), "-d", str(REF / "data-dir")],
+        19,
+    ),
+    (
+        "advanced_regex_negative_lookbehind_non_compliant.out",
+        [
+            "-r", str(REF / "rules-dir/advanced_regex_negative_lookbehind_rule.guard"),
+            "-d", str(REF / "data-dir/advanced_regex_negative_lookbehind_non_compliant.yaml"),
+            "--show-summary", "all",
+        ],
+        19,
+    ),
+    (
+        "advanced_regex_negative_lookbehind_compliant.out",
+        [
+            "-r", str(REF / "rules-dir/advanced_regex_negative_lookbehind_rule.guard"),
+            "-d", str(REF / "data-dir/advanced_regex_negative_lookbehind_compliant.yaml"),
+            "--show-summary", "all",
+        ],
+        0,
+    ),
+    (
+        "test_single_data_file_single_rules_file_verbose.out",
+        [
+            "-r", str(REF / "rules-dir/s3_bucket_public_read_prohibited.guard"),
+            "-d", str(REF / "data-dir/s3-public-read-prohibited-template-non-compliant.yaml"),
+            "--show-summary", "all",
+        ],
+        19,
+    ),
+    (
+        "test_single_data_file_single_rules_file_verbose_compliant.out",
+        [
+            "-r", str(REF / "rules-dir/s3_bucket_public_read_prohibited.guard"),
+            "-d", str(REF / "data-dir/s3-public-read-prohibited-template-compliant.yaml"),
+            "--show-summary", "all", "--verbose",
+        ],
+        0,
+    ),
+    (
+        "test_single_data_file_single_rules_file_verbose_non_compliant.out",
+        [
+            "-r", str(REF / "rules-dir/s3_bucket_public_read_prohibited.guard"),
+            "-d", str(REF / "data-dir/s3-public-read-prohibited-template-non-compliant.yaml"),
+            "--show-summary", "all", "--verbose",
+        ],
+        19,
+    ),
+    (
+        "failing_template_without_resources_at_root.out",
+        [
+            "-r", str(REF / "workshop.guard"),
+            "-d", str(REF / "template_where_resources_isnt_root.json"),
+            "--show-summary", "all", "--verbose",
+        ],
+        19,
+    ),
+    (
+        "failing_template_with_slash_in_key.out",
+        [
+            "-r", str(REF / "rules-dir/s3_bucket_server_side_encryption_enabled.guard"),
+            "-d", str(REF / "failing_template_with_slash_in_key.yaml"),
+            "--show-summary", "all", "--verbose",
+        ],
+        19,
+    ),
+]
+
+
+@needs_reference
+@pytest.mark.parametrize(
+    "golden,args,expected_code",
+    CONSOLE_CASES,
+    ids=[c[0] for c in CONSOLE_CASES],
+)
+def test_console_goldens(golden, args, expected_code):
+    code, out = _run(["validate"] + args)
+    assert code == expected_code
+    assert out == _golden(golden)
+
+
+STRUCTURED_ARGS = [
+    "validate",
+    "-r", str(REF / "rules-dir"),
+    "-d", str(REF / "data-dir/s3-public-read-prohibited-template-non-compliant.yaml"),
+    "--show-summary", "none", "--structured", "-o",
+]
+
+
+@needs_reference
+@pytest.mark.parametrize("fmt", ["json", "yaml"])
+def test_structured_goldens(fmt):
+    code, out = _run(STRUCTURED_ARGS + [fmt])
+    assert code == 19
+    assert out == _golden(f"structured.{fmt}")
+
+
+@needs_reference
+def test_sarif_golden():
+    code, out = _run(STRUCTURED_ARGS + ["sarif"])
+    assert code == 19
+
+    def sanitize(t):
+        # same uri sanitization as the reference's own tests
+        # (tests/utils.rs:82-91) plus tool-identity neutralization
+        t = re.sub(r'"uri": ".*"', '"uri": "some/path"', t)
+        t = re.sub(
+            r'"(name|semanticVersion|fullName|organization|downloadUri|'
+            r'informationUri)": ".*"',
+            '"id": "x"',
+            t,
+        )
+        t = re.sub(
+            r'"text": "(AWS CloudFormation Guard|guard-tpu) is an open-source.*"',
+            '"text": "d"',
+            t,
+        )
+        return t
+
+    assert sanitize(out) == sanitize(_golden("structured.sarif"))
+
+
+@needs_reference
+def test_junit_golden():
+    code, out = _run(STRUCTURED_ARGS + ["junit"])
+    assert code == 19
+
+    def sanitize(t):
+        # tests/utils.rs:70-79 time sanitization + tool name
+        t = re.sub(r'time="[^"]*"', 'time="0"', t)
+        return t.replace("guard-tpu validate report", "cfn-guard validate report")
+
+    assert sanitize(out) == sanitize(_golden("structured.junit"))
+
+
+@needs_reference
+def test_stdin_payload_verbose_goldens():
+    data = (REF / "data-dir/s3-public-read-prohibited-template-compliant.yaml").read_text()
+    rules = str(REF / "rules-dir/s3_bucket_public_read_prohibited.guard")
+    code, out = _run(["validate", "-r", rules, "--verbose"], stdin=data)
+    assert code == 0
+    assert out == _golden("payload_verbose_success.out")
+    code, out = _run(["validate", "-r", rules, "--verbose", "-o", "yaml"], stdin=data)
+    assert code == 0
+    assert out == _golden("payload_verbose_yaml_compliant.out")
+    data_nc = (REF / "data-dir/s3-public-read-prohibited-template-non-compliant.yaml").read_text()
+    code, out = _run(["validate", "-r", rules, "--verbose"], stdin=data_nc)
+    assert code == 19
+    assert out == _golden("payload_verbose_non_compliant.out")
