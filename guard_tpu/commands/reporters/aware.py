@@ -79,7 +79,6 @@ def console_chain(
         )
 
 
-_CFN_RESOURCE = re.compile(r"^/Resources/(?P<name>[^/]+)")
 _TF_RESOURCE = re.compile(r"^/resource_changes/(?P<idx>[^/]+)")
 
 _WIDTH = len("PropertyPath") + 4

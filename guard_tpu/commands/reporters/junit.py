@@ -29,7 +29,9 @@ class JunitTestCase:
         failure_messages: Optional[List[str]] = None,
         error: Optional[str] = None,
         time_ms: int = 0,
+        id: Optional[str] = None,
     ):
+        self.id = id
         self.name = name
         self.status = status
         self.failure_name = failure_name
@@ -82,8 +84,12 @@ def write_junit(
     name: str = "cfn-guard validate report",
 ) -> None:
     total = sum(len(cases) for cases in suites.values())
+    # Fail and Error are mutually exclusive (reference xml.rs:36-41)
     failures = sum(
-        1 for cases in suites.values() for c in cases if c.status == Status.FAIL
+        1
+        for cases in suites.values()
+        for c in cases
+        if c.status == Status.FAIL and c.error is None
     )
     errors = sum(
         1 for cases in suites.values() for c in cases if c.error is not None
@@ -94,14 +100,17 @@ def write_junit(
         f'failures="{failures}" errors="{errors}" time="0">'
     )
     for suite_name, cases in suites.items():
-        s_failures = sum(1 for c in cases if c.status == Status.FAIL)
+        s_failures = sum(
+            1 for c in cases if c.status == Status.FAIL and c.error is None
+        )
         s_errors = sum(1 for c in cases if c.error is not None)
         out.append(
             f'    <testsuite name="{_esc_attr(suite_name)}" '
             f'errors="{s_errors}" failures="{s_failures}" time="0">'
         )
         for case in cases:
-            base = f'name="{_esc_attr(case.name)}" time="{case.time_ms}"'
+            id_attr = f'id="{_esc_attr(case.id)}" ' if case.id is not None else ""
+            base = f'{id_attr}name="{_esc_attr(case.name)}" time="{case.time_ms}"'
             if case.error is not None:
                 out.append(f'        <testcase {base} status="error">')
                 out.append(f"            <error>{_esc_text(case.error)}</error>")

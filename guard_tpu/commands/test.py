@@ -108,8 +108,16 @@ class Test:
         exit_code = TEST_SUCCESS_STATUS_CODE
         junit_suites = {}
         structured_reports = []
+        single_file_mode = self.directory is None
         for rules_path, test_files in pairs:
+            console = self.output_format == "single-line-summary"
             if self.directory is not None and not test_files:
+                if console:
+                    writer.writeln(
+                        f"Guard File {rules_path} did not have any tests "
+                        "associated, skipping."
+                    )
+                    writer.writeln("---")
                 continue
             try:
                 rf = parse_rules_file(rules_path.read_text(), rules_path.name)
@@ -119,22 +127,36 @@ class Test:
                 continue
             if rf is None:
                 continue
-            if self.directory is not None:
+            if self.directory is not None and console:
                 writer.writeln(f"Testing Guard File {rules_path}")
             code, cases, reports = self._run_specs(writer, rf, rules_path.name, test_files)
             junit_suites[str(rules_path)] = cases
-            structured_reports.extend(reports)
+            structured_reports.append(
+                {
+                    "rule_file": self.rules if single_file_mode else str(rules_path),
+                    "test_cases": reports,
+                }
+            )
             if code == TEST_ERROR_STATUS_CODE:
                 exit_code = TEST_ERROR_STATUS_CODE
             elif code == TEST_FAILURE_STATUS_CODE and exit_code == TEST_SUCCESS_STATUS_CODE:
                 exit_code = TEST_FAILURE_STATUS_CODE
+            if self.directory is not None and console:
+                writer.writeln("---")  # per-file separator (test.rs:279)
 
         if self.output_format in ("json", "yaml"):
-            out = structured_reports
+            # single-file mode serializes the one report object; a
+            # directory serializes the list (test/structured.rs:211+)
+            out = structured_reports[0] if single_file_mode and structured_reports else structured_reports
             if self.output_format == "json":
-                writer.writeln(json.dumps(out, indent=2))
+                # serde to_writer_pretty emits no trailing newline
+                writer.write(json.dumps(out, indent=2))
             else:
-                writer.write(yaml.safe_dump(out, sort_keys=False))
+                writer.write(
+                    yaml.safe_dump(
+                        out, sort_keys=False, default_flow_style=False, width=2**31
+                    )
+                )
         elif self.output_format == "junit":
             write_junit(writer, junit_suites, name="cfn-guard test report")
         return exit_code
@@ -196,10 +218,20 @@ class Test:
                     counter += 1
                     continue
                 top = scope.reset_recorder().extract()
+                if self.verbose and self.output_format == "single-line-summary":
+                    # the reference prints the event tree right after
+                    # the case header, before the expectation lines
+                    # (test.rs verbose path)
+                    print_verbose_tree(writer, top)
                 by_rules = _rule_statuses(top, rule_file_name)
                 passed_lines: List[str] = []
                 failed_lines: List[str] = []
-                spec_report = {"name": spec.name or "", "rules": []}
+                spec_report = {
+                    "name": spec.name or "",
+                    "passed_rules": [],
+                    "failed_rules": [],
+                    "skipped_rules": [],
+                }
                 for rule_name, statuses in by_rules.items():
                     expected = spec.expectations.get(rule_name)
                     if expected is None:
@@ -207,15 +239,23 @@ class Test:
                             writer.writeln(
                                 f"  No Test expectation was set for Rule {rule_name}"
                             )
+                        else:
+                            spec_report["skipped_rules"].append({"name": rule_name})
                         continue
                     matched = next(
                         (s for s in statuses if s.value == expected), None
                     )
                     if matched is not None:
                         passed_lines.append(f"{rule_name}: Expected = {expected}")
-                        cases.append(JunitTestCase(name=rule_name, status=Status.PASS))
-                        spec_report["rules"].append(
-                            {"name": rule_name, "expected": expected, "evaluated": [s.value for s in statuses], "passed": True}
+                        cases.append(
+                            JunitTestCase(
+                                name=rule_name,
+                                status=Status.PASS,
+                                id=spec.name or "",
+                            )
+                        )
+                        spec_report["passed_rules"].append(
+                            {"name": rule_name, "evaluated": matched.value}
                         )
                     else:
                         failed_lines.append(
@@ -226,14 +266,19 @@ class Test:
                             JunitTestCase(
                                 name=rule_name,
                                 status=Status.FAIL,
+                                id=spec.name or "",
                                 failure_messages=[
                                     f"Expected = {expected}, Evaluated = "
                                     f"{[s.value for s in statuses]}"
                                 ],
                             )
                         )
-                        spec_report["rules"].append(
-                            {"name": rule_name, "expected": expected, "evaluated": [s.value for s in statuses], "passed": False}
+                        spec_report["failed_rules"].append(
+                            {
+                                "name": rule_name,
+                                "expected": expected,
+                                "evaluated": [s.value for s in statuses],
+                            }
                         )
                         exit_code = max(exit_code, TEST_FAILURE_STATUS_CODE)
                 if self.output_format == "single-line-summary":
@@ -245,8 +290,6 @@ class Test:
                         writer.writeln("  PASS Rules:")
                         for line in passed_lines:
                             writer.writeln(f"    {line}")
-                    if self.verbose:
-                        print_verbose_tree(writer, top)
                     writer.writeln()
                 reports.append(spec_report)
                 counter += 1

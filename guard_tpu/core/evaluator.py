@@ -1481,8 +1481,11 @@ def eval_rule(rule: Rule, resolver) -> Status:
         resolver.start_record(when_context)
         try:
             status = eval_conjunction_clauses(
-            rule.conditions, resolver, eval_when_clause, context="cfn_guard::rules::exprs::WhenGuardClause#disjunction"
-        )
+                rule.conditions,
+                resolver,
+                eval_when_clause,
+                context="cfn_guard::rules::exprs::WhenGuardClause#disjunction",
+            )
         except GuardError:
             resolver.end_record(when_context, RecordType(RecordType.RULE_CONDITION, Status.FAIL))
             resolver.end_record(

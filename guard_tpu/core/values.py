@@ -470,10 +470,16 @@ def from_plain(value, path: Optional[Path] = None) -> PV:
 
 def _rust_num(v) -> str:
     """Rust {} Display for numbers: integral floats print bare."""
+    import math
+
     if isinstance(v, bool):
         return "true" if v else "false"
     if isinstance(v, int):
         return str(v)
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "inf" if v > 0 else "-inf"
     if float(v) == int(v) and abs(v) < 1e16:
         return str(int(v))
     return repr(float(v))

@@ -183,3 +183,88 @@ def test_stdin_payload_verbose_goldens():
     code, out = _run(["validate", "-r", rules, "--verbose"], stdin=data_nc)
     assert code == 19
     assert out == _golden("payload_verbose_non_compliant.out")
+
+
+TEST_REF = pathlib.Path("/root/reference/guard/resources")
+
+
+def _run_in_ref(args, cwd=None):
+    """test-command goldens embed paths relative to the reference's
+    guard/ directory, so run with that cwd."""
+    import os
+
+    prev = os.getcwd()
+    os.chdir(cwd or str(TEST_REF.parent))
+    try:
+        return _run(args)
+    finally:
+        os.chdir(prev)
+
+
+TEST_CONSOLE_CASES = [
+    (
+        "test_data_file.out",
+        ["-r", "resources/validate/rules-dir/s3_bucket_server_side_encryption_enabled.guard",
+         "-t", "resources/test-command/data-dir/s3_bucket_server_side_encryption_enabled.json"],
+    ),
+    (
+        "test_data_file_with_shorthand_reference.out",
+        ["-r", "resources/validate/rules-dir/s3_bucket_server_side_encryption_enabled.guard",
+         "-t", "resources/test-command/data-dir/s3_bucket_logging_enabled_tests.json"],
+    ),
+    (
+        "test_data_file_verbose.out",
+        ["-r", "resources/validate/rules-dir/s3_bucket_server_side_encryption_enabled.guard",
+         "-t", "resources/test-command/data-dir/s3_bucket_server_side_encryption_enabled.json",
+         "--verbose"],
+    ),
+    ("test_data_dir_verbose.out", ["-d", "resources/test-command/dir", "--verbose"]),
+    (
+        "functions.out",
+        ["-r", "resources/test-command/functions/rules/json_parse.guard",
+         "-t", "resources/test-command/functions/data/template.yaml"],
+    ),
+    (
+        "structured_single_report_json.out",
+        ["-r", "resources/validate/rules-dir/s3_bucket_server_side_encryption_enabled.guard",
+         "-t", "resources/test-command/data-dir/s3_bucket_server_side_encryption_enabled.json",
+         "-o", "json"],
+    ),
+    (
+        "structured_single_report_yaml.out",
+        ["-r", "resources/validate/rules-dir/s3_bucket_server_side_encryption_enabled.guard",
+         "-t", "resources/test-command/data-dir/s3_bucket_server_side_encryption_enabled.json",
+         "-o", "yaml"],
+    ),
+    ("structured_directory_report_json.out", ["-d", "resources/test-command/dir", "-o", "json"]),
+    ("structured_directory_report_yaml.out", ["-d", "resources/test-command/dir", "-o", "yaml"]),
+]
+
+
+@needs_reference
+@pytest.mark.parametrize(
+    "golden,args", TEST_CONSOLE_CASES, ids=[c[0] for c in TEST_CONSOLE_CASES]
+)
+def test_test_command_goldens(golden, args):
+    code, out = _run_in_ref(["test"] + args)
+    assert code == 0
+    assert out == (TEST_REF / "test-command/output-dir" / golden).read_text()
+
+
+@needs_reference
+@pytest.mark.parametrize("mode", ["single", "directory"])
+def test_test_command_junit_goldens(mode):
+    if mode == "single":
+        args = ["-r", "resources/validate/rules-dir/s3_bucket_server_side_encryption_enabled.guard",
+                "-t", "resources/test-command/data-dir/s3_bucket_server_side_encryption_enabled.json"]
+    else:
+        args = ["-d", "resources/test-command/dir"]
+    code, out = _run_in_ref(["test"] + args + ["-o", "junit"])
+    assert code == 0
+
+    def sanitize(t):
+        t = re.sub(r'time="[^"]*"', 'time="0"', t)
+        return t.replace("guard-tpu", "cfn-guard")
+
+    gold = (TEST_REF / f"test-command/output-dir/structured_{mode}_report_junit.out").read_text()
+    assert sanitize(out) == sanitize(gold)
