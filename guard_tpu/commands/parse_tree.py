@@ -78,8 +78,8 @@ def let_value_json(lv):
     if isinstance(lv, FunctionExpr):
         return {
             "FunctionCall": {
-                "name": lv.name,
                 "parameters": [let_value_json(p) for p in lv.parameters],
+                "name": lv.name,
                 "location": location_json(lv.location),
             }
         }
@@ -139,8 +139,8 @@ def clause_json(c):
             "BlockClause": {
                 "query": access_query_json(c.query),
                 "block": block_json(c.block),
-                "not_empty": c.not_empty,
                 "location": location_json(c.location),
+                "not_empty": c.not_empty,
             }
         }
     if isinstance(c, WhenBlockClause):
@@ -163,6 +163,27 @@ def conjunctions_json(conjunctions):
     return [[clause_json(c) for c in disjunction] for disjunction in conjunctions]
 
 
+def rule_clause_json(c):
+    """RuleClause serialization (exprs.rs:257-261): GuardClause variants
+    gain an extra `Clause` enum layer inside rule bodies; when/type
+    blocks are RuleClause-level variants."""
+    if isinstance(c, (WhenBlockClause, TypeBlock)):
+        return clause_json(c)
+    return {"Clause": clause_json(c)}
+
+
+def rule_block_json(b):
+    return {
+        "assignments": [
+            {"var": a.var, "value": let_value_json(a.value)} for a in b.assignments
+        ],
+        "conjunctions": [
+            [rule_clause_json(c) for c in disjunction]
+            for disjunction in b.conjunctions
+        ],
+    }
+
+
 def block_json(b):
     return {
         "assignments": [
@@ -181,7 +202,7 @@ def rules_file_json(rf):
             {
                 "rule_name": r.rule_name,
                 "conditions": conjunctions_json(r.conditions) if r.conditions else None,
-                "block": block_json(r.block),
+                "block": rule_block_json(r.block),
             }
             for r in rf.guard_rules
         ],
@@ -191,7 +212,7 @@ def rules_file_json(rf):
                 "rule": {
                     "rule_name": pr.rule.rule_name,
                     "conditions": None,
-                    "block": block_json(pr.rule.block),
+                    "block": rule_block_json(pr.rule.block),
                 },
             }
             for rf_pr in [rf.parameterized_rules]
@@ -218,10 +239,16 @@ class ParseTree:
         if rf is None:
             return SUCCESS
         tree = rules_file_json(rf)
-        if self.print_yaml:
-            writer.write(yaml.safe_dump(tree, sort_keys=False))
+        # reference default is YAML; --print-json switches
+        # (parse_tree.rs:46-64, serde writers emit no trailing newline)
+        if self.print_json:
+            writer.write(json.dumps(tree, indent=2))
         else:
-            writer.writeln(json.dumps(tree, indent=2))
+            writer.write(
+                yaml.safe_dump(
+                    tree, sort_keys=False, default_flow_style=False, width=2**31
+                )
+            )
         return SUCCESS
 
 

@@ -178,7 +178,7 @@ def test_test_directory_mode():
 
 def test_parse_tree_all_example_rules():
     for guard in sorted(EX.rglob("*.guard")):
-        code, out, err = run_cli(["parse-tree", "-r", str(guard)])
+        code, out, err = run_cli(["parse-tree", "-r", str(guard), "--print-json"])
         assert code == 0, f"{guard}: {err}"
         tree = json.loads(out)
         assert "guard_rules" in tree

@@ -268,3 +268,26 @@ def test_test_command_junit_goldens(mode):
 
     gold = (TEST_REF / f"test-command/output-dir/structured_{mode}_report_junit.out").read_text()
     assert sanitize(out) == sanitize(gold)
+
+
+PARSE_TREE_CASES = [
+    ("parse-tree/rules-dir/rule_with_this_keyword.guard",
+     "parse-tree/output-dir/test_rule_with_this_keyword.yaml", []),
+    ("parse-tree/rules-dir/iterate_through_json_list_without_key.guard",
+     "parse-tree/output-dir/test_rule_iterate_through_json_list_without_key.yaml", []),
+    ("validate/functions/rules/string_manipulation.guard",
+     "parse-tree/output-dir/parse_tree_functions.yaml", []),
+    ("validate/rules-dir/s3_bucket_server_side_encryption_enabled.guard",
+     "parse-tree/output-dir/s3_bucket_server_side_encryption_parse_tree.json",
+     ["--print-json"]),
+]
+
+
+@needs_reference
+@pytest.mark.parametrize(
+    "rules,golden,extra", PARSE_TREE_CASES, ids=[c[1].split("/")[-1] for c in PARSE_TREE_CASES]
+)
+def test_parse_tree_goldens(rules, golden, extra):
+    code, out = _run(["parse-tree", "-r", str(TEST_REF / rules)] + extra)
+    assert code == 0
+    assert out == (TEST_REF / golden).read_text()
