@@ -1,0 +1,153 @@
+"""Third batch of semantic cases ported from the reference's pinned
+evaluation suite (guard/src/rules/eval_tests.rs)."""
+
+import pytest
+
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.core.scopes import RootScope
+from guard_tpu.core.values import from_plain
+
+
+def _status(rules, doc, rule=None):
+    from guard_tpu.core.evaluator import eval_rules_file
+
+    rf = parse_rules_file(rules, "t.guard")
+    scope = RootScope(rf, from_plain(doc))
+    if rule is None:
+        return eval_rules_file(rf, scope, None).value
+    return scope.rule_status(rule).value
+
+
+def _clause_status(clause, doc):
+    return _status(f"rule t {{ {clause} }}", doc, "t")
+
+
+IAM_STATEMENTS = {
+    "Statement": [
+        {
+            "Sid": "PrincipalPutObjectIfIpAddress",
+            "Effect": "Allow",
+            "Action": "s3:PutObject",
+            "Resource": "arn:aws:s3:::my-service-bucket/*",
+            "Condition": {
+                "Bool": {"aws:ViaAWSService": "false"},
+                "StringEquals": {"aws:SourceVpc": "vpc-12243sc"},
+            },
+        },
+        {
+            "Sid": "ServicePutObject",
+            "Effect": "Allow",
+            "Action": "s3:PutObject",
+            "Resource": "arn:aws:s3:::my-service-bucket/*",
+            "Condition": {"Bool": {"aws:ViaAWSService": "true"}},
+        },
+    ]
+}
+
+SOURCE_VPC_CLAUSE = (
+    "SOME Statement[*].Condition.*[ THIS IS_STRUCT ]"
+    "[ KEYS ==  /aws:[sS]ource(Vpc|VPC|Vpce|VPCE)/ ] NOT EMPTY"
+)
+
+
+def test_iam_statement_condition_key_filters():
+    """eval_tests.rs test_iam_statement_clauses: chained filters over
+    statement conditions, keys-filters after this-is-struct filters,
+    upper-case operator forms."""
+    clause = (
+        "Statement[\n        Condition EXISTS ].Condition.*[\n"
+        "            this is_struct ][ KEYS == /aws:[sS]ource(Vpc|VPC|Vpce|VPCE)/ ] NOT EMPTY"
+    )
+    assert _clause_status(clause, IAM_STATEMENTS) == "PASS"
+
+    clause = (
+        "Statement[ Condition EXISTS\n"
+        "           Condition.*[ KEYS == /aws:[sS]ource(Vpc|VPC|Vpce|VPCE)/ ] !EMPTY ] NOT EMPTY"
+    )
+    assert _clause_status(clause, IAM_STATEMENTS) == "PASS"
+
+    assert _clause_status(SOURCE_VPC_CLAUSE, IAM_STATEMENTS) == "PASS"
+
+
+@pytest.mark.parametrize(
+    "doc,expected",
+    [
+        (
+            {"Statement": [{"Sid": "x", "Effect": "Allow", "Action": "s3:PutObject"}]},
+            "FAIL",
+        ),
+        (
+            {
+                "Statement": [
+                    {
+                        "Sid": "x",
+                        "Effect": "Allow",
+                        "Action": "s3:PutObject",
+                        "Condition": {"array": [1, 3, 4]},
+                    }
+                ]
+            },
+            "FAIL",
+        ),
+        (
+            {
+                "Statement": [
+                    {
+                        "Sid": "x",
+                        "Effect": "Allow",
+                        "Action": "s3:PutObject",
+                        "Condition": {
+                            "array": [1, 3, 4],
+                            "StringEquals": {"aws:SourceVpc": "vpc-12243sc"},
+                        },
+                    }
+                ]
+            },
+            "PASS",
+        ),
+    ],
+)
+def test_iam_statement_negative_and_mixed_cases(doc, expected):
+    """eval_tests.rs test_iam_statement_clauses continued: missing
+    conditions FAIL; non-struct condition values are filtered out by
+    `this is_struct`; mixed structs still PASS."""
+    assert _clause_status(SOURCE_VPC_CLAUSE, doc) == expected
+
+
+def test_nested_tags_block_missing_fails():
+    """eval_tests.rs rules_file_tests_simpler_correct_form...: nested
+    Tags[*] block over a resource without Tags fails the whole file
+    with a missing-block-value."""
+    rules = """
+rule iam_basic_checks {
+    Resources[ Type == 'AWS::IAM::Role' ] {
+        Properties {
+            AssumeRolePolicyDocument.Version == /(\\d{4})-(\\d{2})-(\\d{2})/
+            PermissionsBoundary == /arn:aws:iam::(\\d{12}):policy/
+            Tags[*] {
+                Key   == /[a-zA-Z0-9]+/
+                Value == /[a-zA-Z0-9]+/
+            }
+        }
+    }
+}"""
+    doc = {
+        "Resources": {
+            "iamrole": {
+                "Type": "AWS::IAM::Role",
+                "Properties": {
+                    "PermissionsBoundary": "arn:aws:iam::123456789012:policy/permboundary",
+                    "AssumeRolePolicyDocument": {"Version": "2021-01-10"},
+                },
+            },
+            "iamRole2": {
+                "Type": "AWS::IAM::Role",
+                "Properties": {
+                    "PermissionsBoundary": "arn:aws:iam::123456789112:policy/permboundary",
+                    "AssumeRolePolicyDocument": {"Version": "2021-01-10"},
+                    "Tags": [{"Key": "Key", "Value": "Value"}],
+                },
+            },
+        }
+    }
+    assert _status(rules, doc) == "FAIL"
