@@ -93,10 +93,11 @@ class ShardedBatchEvaluator:
             )
             return statuses, counts
 
+        replicated = NamedSharding(self.mesh, P())
         self._summary_fn = jax.jit(
             summarize,
-            in_shardings=(in_spec, None),
-            out_shardings=(out_spec, NamedSharding(self.mesh, P())),
+            in_shardings=(in_spec, replicated),
+            out_shardings=(out_spec, replicated),
         )
 
     def _arrays(self, batch: DocBatch):
@@ -110,7 +111,10 @@ class ShardedBatchEvaluator:
         returns (device_out, n_valid). Use to overlap work across
         device sub-meshes (parallel/rules.py) before collecting."""
         arrays, d = self._arrays(batch)
-        arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
+        # numpy straight into the jitted call: in_shardings place the
+        # arrays on this evaluator's mesh; jnp.asarray would commit them
+        # to the default device first (wrong backend on TPU hosts when
+        # the mesh is a CPU mesh).
         return self._fn(arrays), d
 
     def __call__(self, batch: DocBatch) -> np.ndarray:
@@ -127,8 +131,7 @@ class ShardedBatchEvaluator:
 
     def with_summary(self, batch: DocBatch) -> Tuple[np.ndarray, np.ndarray]:
         arrays, d = self._arrays(batch)
-        arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
-        statuses, counts = self._summary_fn(arrays, d)
+        statuses, counts = self._summary_fn(arrays, np.int32(d))
         return np.asarray(statuses)[:d], np.asarray(counts)
 
 
