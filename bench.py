@@ -1,14 +1,17 @@
 """Benchmark: templates validated/sec on the batch evaluation engine.
 
-Default (driver contract): ONE JSON line
-{"metric", "value", "unit", "vs_baseline"} for the BASELINE.md config-2
+Default (driver contract): ONE JSON line with the contract keys
+{"metric", "value", "unit", "vs_baseline"} plus the self-describing
+extras {"vs_oracle", "baseline_note"}, for the BASELINE.md config-2
 analogue (4-rule security-policy set over synthetic CFN templates).
 `value` is the steady-state device throughput of the compiled
 (docs x rules) kernel (encode done once host-side, as in an org-sweep
-where templates are encoded as they stream in). `vs_baseline` is the
-speedup over the CPU reference evaluator (this framework's oracle, same
-semantics as the reference implementation) measured in-process on the
-same workload — the reference publishes no numbers of its own
+where templates are encoded as they stream in). `vs_oracle` (and the
+driver-contract alias `vs_baseline`) is the speedup over this
+framework's OWN pure-Python CPU oracle measured in-process on the same
+workload — NOT over the reference's native engine, which cannot be
+built in this environment (no Rust toolchain) and would be much faster
+than the Python oracle. The reference publishes no numbers of its own
 (BASELINE.md).
 
 `python bench.py --all` additionally measures the other BASELINE.md
@@ -317,6 +320,12 @@ def measure(rules_text: str, docs, min_rules: int, n_cpu: int = 256):
 
 
 def _emit(metric: str, value: float, vs: float) -> None:
+    # `vs_baseline` is required by the driver contract; `vs_oracle` is
+    # the honest name: the divisor is this framework's own pure-Python
+    # CPU oracle, NOT the reference's native engine (no Rust toolchain
+    # exists in this environment, so the reference binary cannot be
+    # built or measured here — expect the native engine to be one to
+    # two orders of magnitude faster than the Python oracle).
     print(
         json.dumps(
             {
@@ -324,6 +333,8 @@ def _emit(metric: str, value: float, vs: float) -> None:
                 "value": round(value, 1),
                 "unit": "templates/sec",
                 "vs_baseline": round(vs, 2),
+                "vs_oracle": round(vs, 2),
+                "baseline_note": "divisor is this repo's pure-Python CPU oracle; the reference's native engine is unbuildable in this env and would be substantially faster than the oracle",
             }
         ),
         flush=True,
