@@ -22,6 +22,8 @@ arrays (see guard_tpu/ops/kernels.py).
 
 from __future__ import annotations
 
+import math
+import struct
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -40,6 +42,45 @@ from ..core.values import (
     PV,
     compiled_regex,
 )
+
+
+_BIAS32 = 1 << 31
+_BIAS64 = 1 << 63
+
+
+def num_key(kind: int, v) -> Optional[Tuple[int, int]]:
+    """Order-preserving exact (hi, lo) int32 pair for a numeric value.
+
+    The device compares numbers EXACTLY — the reference compares native
+    i64/f64 (`/root/reference/guard/src/rules/path_value.rs:1071-1191`)
+    and float32 columns silently collide above 2^24:
+
+      * INT / BOOL: the i64 value biased to u64, split into two int32
+        lanes (hi signed-biased, lo biased) — lexicographic (hi, lo)
+        compare == exact i64 compare, for ALL i64 values;
+      * FLOAT: the f64 bit pattern mapped through the standard monotone
+        key (negative values bit-flipped, positives sign-set), -0.0
+        collapsed to 0.0 — lexicographic compare == exact IEEE total
+        order restricted to non-NaN values.
+
+    Returns None when no exact encoding exists (NaN, ints outside i64):
+    the encoder flags the whole document `num_exotic` and the backend
+    routes it to the CPU oracle, never deciding it on device.
+    """
+    if kind == FLOAT:
+        fv = float(v)
+        if math.isnan(fv):
+            return None
+        if fv == 0.0:
+            fv = 0.0  # collapse -0.0 so -0.0 == 0.0 holds
+        b = struct.unpack("<Q", struct.pack("<d", fv))[0]
+        u = (b ^ 0xFFFFFFFFFFFFFFFF) if (b >> 63) else (b | _BIAS64)
+    else:
+        iv = int(v)
+        if iv < -_BIAS64 or iv >= _BIAS64:
+            return None
+        u = iv + _BIAS64
+    return int((u >> 32) - _BIAS32), int((u & 0xFFFFFFFF) - _BIAS32)
 
 
 class Interner:
@@ -92,7 +133,8 @@ class EncodedDoc:
     node_kind: np.ndarray  # (n,) int32, PV kind; -1 padding
     node_parent: np.ndarray  # (n,) int32, -1 for root
     scalar_id: np.ndarray  # (n,) int32 intern id for STRING/REGEX/CHAR else -1
-    num_val: np.ndarray  # (n,) float64 numeric value (int/float/bool)
+    num_hi: np.ndarray  # (n,) int32 exact numeric key, high lane (num_key)
+    num_lo: np.ndarray  # (n,) int32 exact numeric key, low lane
     child_count: np.ndarray  # (n,) int32 (len of list / size of map)
     edge_parent: np.ndarray  # (e,) int32
     edge_child: np.ndarray  # (e,) int32
@@ -100,18 +142,31 @@ class EncodedDoc:
     edge_index: np.ndarray  # (e,) int32 list index, -1 for map entries
     n_nodes: int
     n_edges: int
+    # document contains a number with no exact device encoding (NaN or
+    # an int outside i64): must be evaluated by the CPU oracle
+    num_exotic: bool = False
 
 
 def encode_document(doc: PV, interner: Interner) -> EncodedDoc:
     kinds: List[int] = []
     parents: List[int] = []
     scalar_ids: List[int] = []
-    num_vals: List[float] = []
+    num_his: List[int] = []
+    num_los: List[int] = []
     child_counts: List[int] = []
     e_parent: List[int] = []
     e_child: List[int] = []
     e_key: List[int] = []
     e_index: List[int] = []
+    exotic = [False]
+
+    def push_num(kind: int, v) -> None:
+        key = num_key(kind, v)
+        if key is None:
+            exotic[0] = True
+            key = (0, 0)
+        num_his.append(key[0])
+        num_los.append(key[1])
 
     def visit(pv: PV, parent: int) -> int:
         idx = len(kinds)
@@ -120,23 +175,26 @@ def encode_document(doc: PV, interner: Interner) -> EncodedDoc:
         k = pv.kind
         if k in (STRING, REGEX, CHAR):
             scalar_ids.append(interner.intern(pv.val))
-            num_vals.append(0.0)
+            num_his.append(0)
+            num_los.append(0)
             child_counts.append(0)
         elif k == INT or k == FLOAT:
             scalar_ids.append(-1)
-            num_vals.append(float(pv.val))
+            push_num(k, pv.val)
             child_counts.append(0)
         elif k == BOOL:
             scalar_ids.append(-1)
-            num_vals.append(1.0 if pv.val else 0.0)
+            push_num(INT, 1 if pv.val else 0)
             child_counts.append(0)
         elif k == NULL:
             scalar_ids.append(-1)
-            num_vals.append(0.0)
+            num_his.append(0)
+            num_los.append(0)
             child_counts.append(0)
         elif k == LIST:
             scalar_ids.append(-1)
-            num_vals.append(0.0)
+            num_his.append(0)
+            num_los.append(0)
             child_counts.append(len(pv.val))
             for i, item in enumerate(pv.val):
                 ci = visit(item, idx)
@@ -147,7 +205,8 @@ def encode_document(doc: PV, interner: Interner) -> EncodedDoc:
         elif k == MAP:
             mv = pv.val
             scalar_ids.append(-1)
-            num_vals.append(0.0)
+            num_his.append(0)
+            num_los.append(0)
             child_counts.append(len(mv.values))
             for key_node in mv.keys:
                 child = mv.values.get(key_node.val)
@@ -160,7 +219,8 @@ def encode_document(doc: PV, interner: Interner) -> EncodedDoc:
                 e_index.append(-1)
         else:  # ranges never appear in documents
             scalar_ids.append(-1)
-            num_vals.append(0.0)
+            num_his.append(0)
+            num_los.append(0)
             child_counts.append(0)
         return idx
 
@@ -169,7 +229,8 @@ def encode_document(doc: PV, interner: Interner) -> EncodedDoc:
         node_kind=np.array(kinds, dtype=np.int32),
         node_parent=np.array(parents, dtype=np.int32),
         scalar_id=np.array(scalar_ids, dtype=np.int32),
-        num_val=np.array(num_vals, dtype=np.float64),
+        num_hi=np.array(num_his, dtype=np.int32),
+        num_lo=np.array(num_los, dtype=np.int32),
         child_count=np.array(child_counts, dtype=np.int32),
         edge_parent=np.array(e_parent, dtype=np.int32),
         edge_child=np.array(e_child, dtype=np.int32),
@@ -177,6 +238,7 @@ def encode_document(doc: PV, interner: Interner) -> EncodedDoc:
         edge_index=np.array(e_index, dtype=np.int32),
         n_nodes=len(kinds),
         n_edges=len(e_parent),
+        num_exotic=exotic[0],
     )
 
 
@@ -207,7 +269,8 @@ class DocBatch:
     node_kind: np.ndarray  # (D, N) int32; -1 padding
     node_parent: np.ndarray  # (D, N)
     scalar_id: np.ndarray  # (D, N)
-    num_val: np.ndarray  # (D, N) float32 (f64 values saturate; see below)
+    num_hi: np.ndarray  # (D, N) int32 exact numeric key, high lane (num_key)
+    num_lo: np.ndarray  # (D, N) int32 exact numeric key, low lane
     child_count: np.ndarray  # (D, N)
     edge_parent: np.ndarray  # (D, E); padding edges point at node N-? no: -1
     edge_child: np.ndarray  # (D, E)
@@ -220,8 +283,14 @@ class DocBatch:
     node_key_id: np.ndarray = None  # (D, N) derived, see class docstring
     node_index: np.ndarray = None  # (D, N) derived
     node_parent_kind: np.ndarray = None  # (D, N) derived
+    # (D,) bool: doc has a number with no exact device encoding (NaN or
+    # beyond-i64 int); such docs route to the CPU oracle like oversize
+    # ones (split_batch_by_size) so the device never decides them
+    num_exotic: np.ndarray = None
 
     def __post_init__(self):
+        if self.num_exotic is None:
+            self.num_exotic = np.zeros(self.node_kind.shape[0], dtype=bool)
         if self.node_key_id is not None:
             return
         d, n = self.node_kind.shape
@@ -244,7 +313,8 @@ class DocBatch:
             "node_kind": self.node_kind,
             "node_parent": self.node_parent,
             "scalar_id": self.scalar_id,
-            "num_val": self.num_val,
+            "num_hi": self.num_hi,
+            "num_lo": self.num_lo,
             "child_count": self.child_count,
             "edge_parent": self.edge_parent,
             "edge_child": self.edge_child,
@@ -273,7 +343,8 @@ class DocBatch:
         for di in range(d_n[0]):
             kinds = self.node_kind[di]
             sids = self.scalar_id[di]
-            nums = self.num_val[di]
+            nhi = self.num_hi[di]
+            nlo = self.num_lo[di]
             # group children per parent from the edge arrays
             children: dict = {}
             ev = self.edge_valid[di]
@@ -304,7 +375,8 @@ class DocBatch:
                 elif k in (STRING, REGEX, CHAR):
                     key = ("s", int(sids[i]))
                 elif k in (INT, FLOAT, BOOL):
-                    key = (k, float(nums[i]))
+                    # the exact key pair: no float32 collisions
+                    key = (k, int(nhi[i]), int(nlo[i]))
                 else:  # NULL
                     key = ("n",)
                 sid = table.get(key)
@@ -338,15 +410,17 @@ def split_batch_by_size(
     Returns (groups, oversize_doc_indices): each group is (sub_batch,
     doc_indices) with node/edge axes sliced down to the bucket shape —
     exact because padding is always a suffix. Documents larger than the
-    biggest bucket are returned in `oversize_doc_indices` for CPU-oracle
-    evaluation."""
+    biggest bucket — and documents whose numbers have no exact device
+    encoding (num_exotic) — are returned in `oversize_doc_indices` for
+    CPU-oracle evaluation."""
     n_real = (batch.node_kind >= 0).sum(axis=1)
     e_real = batch.edge_valid.sum(axis=1)
-    oversize = np.where(n_real > buckets[-1])[0]
+    host_mask = (n_real > buckets[-1]) | batch.num_exotic
+    oversize = np.where(host_mask)[0]
     groups: List[Tuple[DocBatch, np.ndarray]] = []
     lo = 0
     for b in buckets:
-        idx = np.where((n_real > lo) & (n_real <= b))[0]
+        idx = np.where((n_real > lo) & (n_real <= b) & ~host_mask)[0]
         lo = b
         if len(idx) == 0:
             continue
@@ -358,7 +432,8 @@ def split_batch_by_size(
             node_kind=batch.node_kind[idx, :m_nodes],
             node_parent=batch.node_parent[idx, :m_nodes],
             scalar_id=batch.scalar_id[idx, :m_nodes],
-            num_val=batch.num_val[idx, :m_nodes],
+            num_hi=batch.num_hi[idx, :m_nodes],
+            num_lo=batch.num_lo[idx, :m_nodes],
             child_count=batch.child_count[idx, :m_nodes],
             edge_parent=batch.edge_parent[idx, :m_edges],
             edge_child=batch.edge_child[idx, :m_edges],
@@ -371,6 +446,7 @@ def split_batch_by_size(
             node_key_id=batch.node_key_id[idx, :m_nodes],
             node_index=batch.node_index[idx, :m_nodes],
             node_parent_kind=batch.node_parent_kind[idx, :m_nodes],
+            num_exotic=batch.num_exotic[idx],
         )
         groups.append((sub, idx))
     return groups, oversize
@@ -412,7 +488,8 @@ def encode_batch(docs: List[PV], interner: Optional[Interner] = None,
         node_kind=pad_node("node_kind", -1),
         node_parent=pad_node("node_parent", -1),
         scalar_id=pad_node("scalar_id", -1),
-        num_val=pad_node("num_val", 0.0).astype(np.float32),
+        num_hi=pad_node("num_hi", 0),
+        num_lo=pad_node("num_lo", 0),
         child_count=pad_node("child_count", 0),
         # padding edges self-loop on node 0 but are masked by edge_valid
         edge_parent=pad_edge("edge_parent", 0),
@@ -423,5 +500,8 @@ def encode_batch(docs: List[PV], interner: Optional[Interner] = None,
         n_docs=d,
         n_nodes=n,
         n_edges=e_max,
+        num_exotic=np.array(
+            [enc.num_exotic for enc in encoded], dtype=bool
+        ),
     )
     return batch, interner

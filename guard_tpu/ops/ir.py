@@ -66,7 +66,7 @@ from ..core.values import (
     STRING,
     PV,
 )
-from .encoder import Interner
+from .encoder import Interner, num_key
 
 PASS, FAIL, SKIP = 0, 1, 2
 
@@ -147,10 +147,13 @@ class RhsSpec:
     bits_slot: int = -1
     lt_slot: int = -1
     le_slot: int = -1
-    num: float = 0.0
+    # exact numeric literal as an order-preserving (hi, lo) int32 key
+    # pair (encoder.num_key) — compares exactly against the document's
+    # num_hi/num_lo columns; no float32 collisions
+    num_key: Tuple[int, int] = (0, 0)
     num_kind: int = INT  # INT or FLOAT for numeric literals
-    range_lo: float = 0.0
-    range_hi: float = 0.0
+    range_lo_key: Tuple[int, int] = (0, 0)
+    range_hi_key: Tuple[int, int] = (0, 0)
     range_incl: int = 0
     range_kind: int = RANGE_INT
     items: Optional[List["RhsSpec"]] = None  # for 'list'
@@ -233,7 +236,8 @@ class CompiledRules:
             "node_kind": batch.node_kind,
             "node_parent": batch.node_parent,
             "scalar_id": batch.scalar_id,
-            "num_val": batch.num_val,
+            "num_hi": batch.num_hi,
+            "num_lo": batch.num_lo,
             "child_count": batch.child_count,
             "node_key_id": batch.node_key_id,
             "node_index": batch.node_index,
@@ -503,12 +507,16 @@ class _RuleLowering:
             # docs never contain CHAR nodes (loader emits STRING), and
             # STRING vs CHAR is NotComparable (path_value.rs:1048-1070)
             return RhsSpec(kind="never")
-        if k == INT:
-            return RhsSpec(kind="num", num=float(cw.val), num_kind=INT)
-        if k == FLOAT:
-            return RhsSpec(kind="num", num=float(cw.val), num_kind=FLOAT)
+        if k == INT or k == FLOAT:
+            key = num_key(k, cw.val)
+            if key is None:
+                # NaN / beyond-i64 literal: no exact device encoding
+                raise Unlowerable("numeric literal without exact encoding")
+            return RhsSpec(kind="num", num_key=key, num_kind=k)
         if k == BOOL:
-            return RhsSpec(kind="bool", num=1.0 if cw.val else 0.0)
+            return RhsSpec(
+                kind="bool", num_key=num_key(INT, 1 if cw.val else 0)
+            )
         if k == NULL:
             return RhsSpec(kind="null")
         if k in (RANGE_INT, RANGE_FLOAT, RANGE_CHAR):
@@ -517,13 +525,18 @@ class _RuleLowering:
                 # never contain CHAR nodes: never comparable -> FAIL
                 return RhsSpec(kind="never")
             r = cw.val
+            nk = INT if k == RANGE_INT else FLOAT
+            lo_key = num_key(nk, r.lower)
+            hi_key = num_key(nk, r.upper)
+            if lo_key is None or hi_key is None:
+                raise Unlowerable("range bound without exact encoding")
             return RhsSpec(
                 kind="range",
-                range_lo=float(r.lower),
-                range_hi=float(r.upper),
+                range_lo_key=lo_key,
+                range_hi_key=hi_key,
                 range_incl=r.inclusive,
                 range_kind=k,
-                num_kind=INT if k == RANGE_INT else FLOAT,
+                num_kind=nk,
             )
         if k == 7:  # LIST
             items = [self.lower_rhs(e) for e in cw.val]

@@ -68,7 +68,8 @@ class _DocArrays:
         self.node_kind = arrays["node_kind"]
         self.node_parent = arrays["node_parent"]
         self.scalar_id = arrays["scalar_id"]
-        self.num_val = arrays["num_val"]
+        self.num_hi = arrays["num_hi"]
+        self.num_lo = arrays["num_lo"]
         self.child_count = arrays["child_count"]
         self.node_key_id = arrays["node_key_id"]
         self.node_index = arrays["node_index"]
@@ -241,13 +242,30 @@ def _rhs_match_on_keys(d: _DocArrays, rhs: RhsSpec, op: CmpOperator) -> jnp.ndar
 # ---------------------------------------------------------------------------
 # leaf comparisons
 # ---------------------------------------------------------------------------
+def _num_eq(d: _DocArrays, key) -> jnp.ndarray:
+    """Exact numeric equality against a literal's (hi, lo) key pair."""
+    return (d.num_hi == jnp.int32(key[0])) & (d.num_lo == jnp.int32(key[1]))
+
+
+def _num_lt(d: _DocArrays, key) -> jnp.ndarray:
+    """Exact numeric < via lexicographic (hi, lo) compare — both lanes
+    are biased int32, so signed compare == the underlying i64/f64
+    order (encoder.num_key)."""
+    hi, lo = jnp.int32(key[0]), jnp.int32(key[1])
+    return (d.num_hi < hi) | ((d.num_hi == hi) & (d.num_lo < lo))
+
+
+def _num_gt(d: _DocArrays, key) -> jnp.ndarray:
+    hi, lo = jnp.int32(key[0]), jnp.int32(key[1])
+    return (d.num_hi > hi) | ((d.num_hi == hi) & (d.num_lo > lo))
+
+
 def _compare_scalar_full(d: _DocArrays, rhs: RhsSpec, op: CmpOperator):
     """(match (N,), comparable (N,)) of `node <op> literal` per node.
     Non-comparable pairs FAIL regardless of `not` inversion
     (operators.rs:195-206 keeps NotComparable through the inversion pass,
     operators.rs:774-777)."""
     kind = d.node_kind
-    num = d.num_val
 
     if rhs.kind == "never":
         # literal kinds no document scalar is comparable with (char
@@ -265,10 +283,10 @@ def _compare_scalar_full(d: _DocArrays, rhs: RhsSpec, op: CmpOperator):
         if rhs.kind == "num":
             k = INT if rhs.num_kind == INT else FLOAT
             comparable = kind == k
-            return comparable & (num == np.float32(rhs.num)), comparable
+            return comparable & _num_eq(d, rhs.num_key), comparable
         if rhs.kind == "bool":
             comparable = kind == BOOL
-            return comparable & (num == np.float32(rhs.num)), comparable
+            return comparable & _num_eq(d, rhs.num_key), comparable
         if rhs.kind == "null":
             comparable = kind == NULL
             return comparable, comparable
@@ -276,14 +294,14 @@ def _compare_scalar_full(d: _DocArrays, rhs: RhsSpec, op: CmpOperator):
             k = INT if rhs.range_kind == 9 else FLOAT
             comparable = kind == k
             lo_ok = (
-                num >= np.float32(rhs.range_lo)
+                ~_num_lt(d, rhs.range_lo_key)
                 if rhs.range_incl & LOWER_INCLUSIVE
-                else num > np.float32(rhs.range_lo)
+                else _num_gt(d, rhs.range_lo_key)
             )
             hi_ok = (
-                num <= np.float32(rhs.range_hi)
+                ~_num_gt(d, rhs.range_hi_key)
                 if rhs.range_incl & UPPER_INCLUSIVE
-                else num < np.float32(rhs.range_hi)
+                else _num_lt(d, rhs.range_hi_key)
             )
             return comparable & lo_ok & hi_ok, comparable
         raise TypeError(f"eq rhs {rhs.kind}")
@@ -314,15 +332,14 @@ def _compare_scalar_full(d: _DocArrays, rhs: RhsSpec, op: CmpOperator):
         return never, never
     k = INT if rhs.num_kind == INT else FLOAT
     comparable = kind == k
-    lit = np.float32(rhs.num)
     if op == CmpOperator.Gt:
-        out = num > lit
+        out = _num_gt(d, rhs.num_key)
     elif op == CmpOperator.Ge:
-        out = num >= lit
+        out = ~_num_lt(d, rhs.num_key)
     elif op == CmpOperator.Lt:
-        out = num < lit
+        out = _num_lt(d, rhs.num_key)
     else:
-        out = num <= lit
+        out = ~_num_gt(d, rhs.num_key)
     return comparable & out, comparable
 
 

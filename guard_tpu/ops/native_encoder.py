@@ -38,13 +38,15 @@ class _EncodedBatchStruct(ctypes.Structure):
         ("node_kind", ctypes.POINTER(ctypes.c_int32)),
         ("node_parent", ctypes.POINTER(ctypes.c_int32)),
         ("scalar_id", ctypes.POINTER(ctypes.c_int32)),
-        ("num_val", ctypes.POINTER(ctypes.c_float)),
+        ("num_hi", ctypes.POINTER(ctypes.c_int32)),
+        ("num_lo", ctypes.POINTER(ctypes.c_int32)),
         ("child_count", ctypes.POINTER(ctypes.c_int32)),
         ("edge_parent", ctypes.POINTER(ctypes.c_int32)),
         ("edge_child", ctypes.POINTER(ctypes.c_int32)),
         ("edge_key_id", ctypes.POINTER(ctypes.c_int32)),
         ("edge_index", ctypes.POINTER(ctypes.c_int32)),
         ("edge_valid", ctypes.POINTER(ctypes.c_uint8)),
+        ("doc_exotic", ctypes.POINTER(ctypes.c_uint8)),
         ("string_blob", ctypes.POINTER(ctypes.c_char)),
         ("string_blob_len", ctypes.c_int64),
         ("error_doc", ctypes.c_int32),
@@ -120,7 +122,8 @@ def encode_json_batch_native(
             node_kind=np_copy(b.node_kind, nn, np.int32).reshape(shape_n),
             node_parent=np_copy(b.node_parent, nn, np.int32).reshape(shape_n),
             scalar_id=np_copy(b.scalar_id, nn, np.int32).reshape(shape_n),
-            num_val=np_copy(b.num_val, nn, np.float32).reshape(shape_n),
+            num_hi=np_copy(b.num_hi, nn, np.int32).reshape(shape_n),
+            num_lo=np_copy(b.num_lo, nn, np.int32).reshape(shape_n),
             child_count=np_copy(b.child_count, nn, np.int32).reshape(shape_n),
             edge_parent=np_copy(b.edge_parent, ne, np.int32).reshape(shape_e),
             edge_child=np_copy(b.edge_child, ne, np.int32).reshape(shape_e),
@@ -132,6 +135,9 @@ def encode_json_batch_native(
             n_docs=b.n_docs,
             n_nodes=b.n_nodes,
             n_edges=b.n_edges,
+            num_exotic=np_copy(b.doc_exotic, b.n_docs, np.uint8).astype(bool)
+            if b.n_docs
+            else np.zeros(0, dtype=bool),
         )
         blob = ctypes.string_at(b.string_blob, b.string_blob_len)
         strings = blob.decode("utf-8").split("\x00")[:-1] if b.string_blob_len else []

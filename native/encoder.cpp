@@ -13,6 +13,7 @@
 //
 // Build: native/build.sh -> libguard_encoder.so
 
+#include <cerrno>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -51,9 +52,32 @@ struct Interner {
 
 struct DocColumns {
   std::vector<int32_t> node_kind, node_parent, scalar_id, child_count;
-  std::vector<double> num_val;
+  std::vector<int32_t> num_hi, num_lo;
   std::vector<int32_t> edge_parent, edge_child, edge_key_id, edge_index;
+  // doc has a number with no exact encoding (int outside i64); must be
+  // evaluated by the CPU oracle (guard_tpu/ops/encoder.py num_key)
+  bool num_exotic = false;
 };
+
+// Order-preserving exact (hi, lo) int32 key pair for numerics — MUST
+// match guard_tpu/ops/encoder.py num_key(): lexicographic signed
+// (hi, lo) compare == exact i64 / f64-total-order compare. The XOR with
+// 2^31 reinterpreted as int32 equals the arithmetic bias subtraction.
+static void int_key(long long iv, int32_t* hi, int32_t* lo) {
+  unsigned long long u =
+      static_cast<unsigned long long>(iv) + 0x8000000000000000ULL;
+  *hi = static_cast<int32_t>(static_cast<uint32_t>(u >> 32) ^ 0x80000000U);
+  *lo = static_cast<int32_t>(static_cast<uint32_t>(u) ^ 0x80000000U);
+}
+
+static void float_key(double fv, int32_t* hi, int32_t* lo) {
+  if (fv == 0.0) fv = 0.0;  // collapse -0.0
+  unsigned long long b;
+  memcpy(&b, &fv, 8);
+  unsigned long long u = (b >> 63) ? ~b : (b | 0x8000000000000000ULL);
+  *hi = static_cast<int32_t>(static_cast<uint32_t>(u >> 32) ^ 0x80000000U);
+  *lo = static_cast<int32_t>(static_cast<uint32_t>(u) ^ 0x80000000U);
+}
 
 // ---------------------------------------------------------------------------
 // Minimal recursive-descent JSON parser writing columns directly.
@@ -126,7 +150,8 @@ struct Parser {
     out->node_kind.push_back(kind);
     out->node_parent.push_back(parent);
     out->scalar_id.push_back(-1);
-    out->num_val.push_back(0.0);
+    out->num_hi.push_back(0);
+    out->num_lo.push_back(0);
     out->child_count.push_back(0);
     return idx;
   }
@@ -148,12 +173,14 @@ struct Parser {
     if (c == 't' && end - p >= 4 && strncmp(p, "true", 4) == 0) {
       p += 4;
       int32_t idx = new_node(K_BOOL, parent);
-      out->num_val[idx] = 1.0;
+      int_key(1, &out->num_hi[idx], &out->num_lo[idx]);
       return idx;
     }
     if (c == 'f' && end - p >= 5 && strncmp(p, "false", 5) == 0) {
       p += 5;
-      return new_node(K_BOOL, parent);
+      int32_t idx = new_node(K_BOOL, parent);
+      int_key(0, &out->num_hi[idx], &out->num_lo[idx]);
+      return idx;
     }
     if (c == 'n' && end - p >= 4 && strncmp(p, "null", 4) == 0) {
       p += 4;
@@ -172,10 +199,25 @@ struct Parser {
     if (p == start) return -1;
     std::string num(start, p - start);
     char* endp = nullptr;
-    double v = strtod(num.c_str(), &endp);
+    if (is_float) {
+      double v = strtod(num.c_str(), &endp);
+      if (endp == num.c_str()) return -1;
+      int32_t idx = new_node(K_FLOAT, parent);
+      float_key(v, &out->num_hi[idx], &out->num_lo[idx]);
+      return idx;
+    }
+    // integers parse exactly as i64 (the reference compares native
+    // i64, path_value.rs:1071-1191); out-of-range ints have no exact
+    // device encoding and flag the doc for CPU-oracle evaluation
+    errno = 0;
+    long long v = strtoll(num.c_str(), &endp, 10);
     if (endp == num.c_str()) return -1;
-    int32_t idx = new_node(is_float ? K_FLOAT : K_INT, parent);
-    out->num_val[idx] = v;
+    int32_t idx = new_node(K_INT, parent);
+    if (errno == ERANGE) {
+      out->num_exotic = true;
+    } else {
+      int_key(v, &out->num_hi[idx], &out->num_lo[idx]);
+    }
     return idx;
   }
 
@@ -271,7 +313,8 @@ struct EncodedBatch {
   int32_t* node_kind;
   int32_t* node_parent;
   int32_t* scalar_id;
-  float* num_val;
+  int32_t* num_hi;  // exact numeric key pair (encoder.py num_key)
+  int32_t* num_lo;
   int32_t* child_count;
   // (n_docs * n_edges)
   int32_t* edge_parent;
@@ -279,6 +322,8 @@ struct EncodedBatch {
   int32_t* edge_key_id;
   int32_t* edge_index;
   uint8_t* edge_valid;
+  // (n_docs): doc contains a number with no exact encoding
+  uint8_t* doc_exotic;
   // intern table: concatenated NUL-terminated strings
   char* string_blob;
   int64_t string_blob_len;
@@ -323,19 +368,23 @@ EncodedBatch* guard_encode_json_batch(const char** docs, int32_t n_docs) {
   b->node_kind = new int32_t[nn];
   b->node_parent = new int32_t[nn];
   b->scalar_id = new int32_t[nn];
-  b->num_val = new float[nn];
+  b->num_hi = new int32_t[nn];
+  b->num_lo = new int32_t[nn];
   b->child_count = new int32_t[nn];
   b->edge_parent = new int32_t[ne];
   b->edge_child = new int32_t[ne];
   b->edge_key_id = new int32_t[ne];
   b->edge_index = new int32_t[ne];
   b->edge_valid = new uint8_t[ne];
+  b->doc_exotic = new uint8_t[n_docs > 0 ? n_docs : 1];
 
   std::fill_n(b->node_kind, nn, -1);
   std::fill_n(b->node_parent, nn, -1);
   std::fill_n(b->scalar_id, nn, -1);
-  std::fill_n(b->num_val, nn, 0.0f);
+  std::fill_n(b->num_hi, nn, 0);
+  std::fill_n(b->num_lo, nn, 0);
   std::fill_n(b->child_count, nn, 0);
+  std::fill_n(b->doc_exotic, n_docs > 0 ? n_docs : 1, 0);
   std::fill_n(b->edge_parent, ne, 0);
   std::fill_n(b->edge_child, ne, 0);
   std::fill_n(b->edge_key_id, ne, -2);
@@ -346,11 +395,13 @@ EncodedBatch* guard_encode_json_batch(const char** docs, int32_t n_docs) {
     const DocColumns& c = cols[i];
     const int64_t no = static_cast<int64_t>(i) * N;
     const int64_t eo = static_cast<int64_t>(i) * E;
+    b->doc_exotic[i] = c.num_exotic ? 1 : 0;
     for (size_t j = 0; j < c.node_kind.size(); j++) {
       b->node_kind[no + j] = c.node_kind[j];
       b->node_parent[no + j] = c.node_parent[j];
       b->scalar_id[no + j] = c.scalar_id[j];
-      b->num_val[no + j] = static_cast<float>(c.num_val[j]);
+      b->num_hi[no + j] = c.num_hi[j];
+      b->num_lo[no + j] = c.num_lo[j];
       b->child_count[no + j] = c.child_count[j];
     }
     for (size_t j = 0; j < c.edge_parent.size(); j++) {
@@ -382,13 +433,15 @@ void guard_batch_free(EncodedBatch* b) {
   delete[] b->node_kind;
   delete[] b->node_parent;
   delete[] b->scalar_id;
-  delete[] b->num_val;
+  delete[] b->num_hi;
+  delete[] b->num_lo;
   delete[] b->child_count;
   delete[] b->edge_parent;
   delete[] b->edge_child;
   delete[] b->edge_key_id;
   delete[] b->edge_index;
   delete[] b->edge_valid;
+  delete[] b->doc_exotic;
   delete[] b->string_blob;
   delete b;
 }
