@@ -9,14 +9,21 @@ import pathlib
 import subprocess
 import sys
 
+import pytest
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
 
-def test_dryrun_multichip_subprocess_no_platform_forcing():
+# platform=None: default platform untouched (may resolve to a TPU
+# backend). platform="cpu": the env var is set but NOT honored on hosts
+# whose TPU plugin self-registers (axon) — the dry run must force the
+# platform programmatically either way.
+@pytest.mark.parametrize("platform", [None, "cpu"])
+def test_dryrun_multichip_subprocess_no_platform_forcing(platform):
     env = os.environ.copy()
-    # the driver's environment: N virtual CPU devices, default platform
-    # untouched (may resolve to a TPU backend)
     env.pop("JAX_PLATFORMS", None)
+    if platform is not None:
+        env["JAX_PLATFORMS"] = platform
     flags = [
         f
         for f in env.get("XLA_FLAGS", "").split()
