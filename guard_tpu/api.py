@@ -18,8 +18,11 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from .commands.parse_tree import ParseTree
-from .commands.report import rule_statuses_from_root, simplified_report_from_root
-from .commands.reporters.console import record_to_json
+from .commands.report import (
+    rule_statuses_from_root,
+    serde_record_json,
+    simplified_report_from_root,
+)
 from .commands.rulegen import Rulegen
 from .commands.test import Test
 from .commands.validate import Validate
@@ -48,9 +51,11 @@ def run_checks(data: str, rules: str, verbose: bool = False,
     eval_rules_file(rules_file, scope, data_file_name or None)
     root_record = scope.reset_recorder().extract()
     if verbose:
-        return json.dumps(record_to_json(root_record), indent=2)
+        return json.dumps(
+            serde_record_json(root_record), indent=2, ensure_ascii=False
+        )
     report = simplified_report_from_root(root_record, data_file_name)
-    return json.dumps([report], indent=2)
+    return json.dumps([report], indent=2, ensure_ascii=False)
 
 
 class CommandBuilder:

@@ -321,17 +321,3 @@ def print_verbose_tree(writer: Writer, record: EventRecord, indent: int = 0) -> 
     walk(record, "", True)
 
 
-def record_to_json(record: EventRecord):
-    """--print-json: full serde-style dump of the event tree."""
-    container = None
-    if record.container is not None:
-        status = record.container.status()
-        container = {
-            "kind": record.container.kind,
-            "status": status.value if status is not None else None,
-        }
-    return {
-        "context": record.context,
-        "container": container,
-        "children": [record_to_json(c) for c in record.children],
-    }

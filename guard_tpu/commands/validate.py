@@ -28,9 +28,13 @@ from ..core.scopes import RootScope
 from ..core.values import PV
 from ..utils.io import Reader, Writer
 from .files import DATA_FILE_EXTENSIONS, RULE_FILE_EXTENSIONS, gather
-from .report import rule_statuses_from_root, simplified_report_from_root
+from .report import (
+    rule_statuses_from_root,
+    serde_record_json,
+    simplified_report_from_root,
+)
 from .reporters.aware import console_chain
-from .reporters.console import print_verbose_tree, record_to_json
+from .reporters.console import print_verbose_tree
 from .reporters.junit import JunitTestCase, write_junit
 from .reporters.sarif import write_sarif
 from .reporters.structured import write_structured
@@ -266,7 +270,13 @@ class Validate:
                     if self.verbose:
                         print_verbose_tree(writer, root_record)
                     if self.print_json:
-                        writer.writeln(json.dumps(record_to_json(root_record), indent=2))
+                        writer.writeln(
+                            json.dumps(
+                                serde_record_json(root_record),
+                                indent=2,
+                                ensure_ascii=False,
+                            )
+                        )
 
         if self.structured:
             if self.output_format in ("json", "yaml"):

@@ -74,3 +74,31 @@ def test_rulegen_matches_reference_golden():
         REF / "guard/resources/rulegen/output-dir/test_rulegen_from_template.out"
     ).read_text()
     assert w.stripped() == golden
+
+
+@needs_reference
+def test_print_json_matches_reference_functional_golden():
+    """Reproduces guard/tests/functional.rs:7-80: run_checks(verbose)
+    must emit the reference's serde EventRecord encoding, compared
+    against the reference's own expected JSON extracted from the test
+    source (the reference test compares parsed values the same way)."""
+    import re
+
+    from guard_tpu.api import run_checks
+
+    src = (REF / "guard/tests/functional.rs").read_text()
+    expected = json.loads(
+        re.search(r'let expected = r#"(.*?)"#;', src, re.S).group(1)
+    )
+    data = re.search(
+        r'let data = String::from\(\s*r#"(.*?)"#,?\s*\)', src, re.S
+    ).group(1)
+    rule = 'AWS::ApiGateway::Method { Properties.AuthorizationType == "NONE"}'
+    out = run_checks(
+        data,
+        rule,
+        verbose=True,
+        data_file_name="functional_test.json",
+        rules_file_name="functional_test.rule",
+    )
+    assert json.loads(out) == expected
