@@ -394,11 +394,14 @@ def _round_up(n: int, multiple: int = 8) -> int:
 
 # node-capacity buckets for the kernel path: the kernels' fused one-hot
 # traversal is O(N^2) per doc per step, which is the fastest known
-# formulation on TPU for the small/medium documents that dominate real
-# corpora (device gathers/scatters measured ~150x slower at these
-# shapes) but a real cliff for giant documents — those route to the CPU
-# oracle instead (ops/backend.py)
-NODE_BUCKETS = (64, 128, 256, 512, 1024, 2048)
+# formulation on TPU up to at least 4096 nodes (gather- and scatter-
+# based alternatives re-measured 2026-07: flat ~3.5-5ms per primitive
+# regardless of N, losing to the fused one-hot everywhere below ~8k
+# nodes). Deferred UnResolved histograms + scalar root-mode aggregation
+# (kernels.py) keep the N^2 term count low, so giant documents stay on
+# device through the 8192 bucket; beyond that they route to the CPU
+# oracle (ops/backend.py)
+NODE_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096, 8192)
 
 
 def split_batch_by_size(

@@ -124,18 +124,50 @@ def _segment_count(d: _DocArrays, sel, pred) -> jnp.ndarray:
     return jnp.sum(mask & active[None, :], axis=1, dtype=jnp.int32)
 
 
-def _add_unres(d: _DocArrays, unres, sel, miss):
-    """Accumulate per-origin unresolved counts; origin 0 is a sink."""
-    return unres + _segment_count(d, sel, miss)
+def _agg(d: _DocArrays, sel, pred, scalar: bool):
+    """Count pred-true selected nodes: per origin label (N+1,) in node
+    mode, or one scalar when the selection provably has a single origin
+    (rule-root evaluation) — the scalar form replaces the (N+1, N)
+    one-hot histogram with an O(N) masked sum."""
+    if scalar:
+        return jnp.sum(pred & (sel > 0), dtype=jnp.int32)
+    return _segment_count(d, sel, pred)
 
 
-def run_steps(d: _DocArrays, steps: List[Step], sel, unres, rule_statuses=None):
+class _UnresAcc:
+    """Deferred UnResolved accounting for one query walk.
+
+    A node can become unresolved at most once along a walk (it leaves
+    the selection when it does, and selection only moves down the
+    tree), and its origin label is constant while selected — so instead
+    of one (N+1, N) histogram per STEP, each step just records the
+    miss labels and the walk pays for a single histogram (or a single
+    masked sum in scalar mode) at the end."""
+
+    __slots__ = ("miss_labels",)
+
+    def __init__(self, d: _DocArrays):
+        self.miss_labels = jnp.zeros(d.n, jnp.int32)
+
+    def add(self, sel, miss) -> None:
+        # every call site's `miss` implies sel > 0
+        self.miss_labels = jnp.where(miss, sel, self.miss_labels)
+
+    def finalize(self, d: _DocArrays, scalar: bool):
+        return _agg(d, self.miss_labels, self.miss_labels > 0, scalar)
+
+
+def run_steps(d: _DocArrays, steps: List[Step], sel, rule_statuses=None,
+              scalar: bool = False):
+    """Walk a query: returns (leaf selection, unresolved counts) —
+    counts are (N+1,) per origin, or a scalar in single-origin mode."""
+    acc = _UnresAcc(d)
     for step in steps:
-        sel, unres = run_step(d, step, sel, unres, rule_statuses)
-    return sel, unres
+        sel = run_step(d, step, sel, acc, rule_statuses)
+    return sel, acc.finalize(d, scalar)
 
 
-def run_step(d: _DocArrays, step: Step, sel, unres, rule_statuses=None):
+def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None):
     psel = _parent_select(d, sel)  # label of each node's parent
     if isinstance(step, StepKey):
         kh = jnp.zeros(d.n, bool)
@@ -145,8 +177,8 @@ def run_step(d: _DocArrays, step: Step, sel, unres, rule_statuses=None):
         resolved = _count_children(d, kh) > 0
         miss = (sel > 0) & ~resolved
         if not step.drop_unres:
-            unres = _add_unres(d, unres, sel, miss)
-        return new_sel, unres
+            acc.add(sel, miss)
+        return new_sel
 
     if isinstance(step, StepAllValues):
         # `.*`: all children of maps AND lists; scalars pass through;
@@ -155,8 +187,8 @@ def run_step(d: _DocArrays, step: Step, sel, unres, rule_statuses=None):
         keep = jnp.where((sel > 0) & ~is_container, sel, 0)
         new_sel = jnp.maximum(psel, keep)
         empty_c = (sel > 0) & is_container & (d.child_count == 0)
-        unres = _add_unres(d, unres, sel, empty_c)
-        return new_sel, unres
+        acc.add(sel, empty_c)
+        return new_sel
 
     if isinstance(step, StepAllIndices):
         # `[*]`: elements of lists; maps and scalars pass through
@@ -165,16 +197,16 @@ def run_step(d: _DocArrays, step: Step, sel, unres, rule_statuses=None):
         keep = jnp.where((sel > 0) & (d.node_kind != LIST), sel, 0)
         new_sel = jnp.maximum(child_sel, keep)
         empty_l = (sel > 0) & (d.node_kind == LIST) & (d.child_count == 0)
-        unres = _add_unres(d, unres, sel, empty_l)
-        return new_sel, unres
+        acc.add(sel, empty_l)
+        return new_sel
 
     if isinstance(step, StepIndex):
         at_idx = d.node_index == step.index
         new_sel = jnp.where(at_idx, psel, 0)
         resolved = _count_children(d, at_idx & (psel > 0)) > 0
         miss = (sel > 0) & ((d.node_kind != LIST) | ~resolved)
-        unres = _add_unres(d, unres, sel, miss)
-        return new_sel, unres
+        acc.add(sel, miss)
+        return new_sel
 
     if isinstance(step, StepFilter):
         # list candidates always iterate their elements
@@ -187,14 +219,14 @@ def run_step(d: _DocArrays, step: Step, sel, unres, rule_statuses=None):
         if step.expand_maps:
             expand_parent = expand_parent | (d.node_parent_kind == MAP)
         elems = jnp.where(expand_parent, psel, 0)
+        # scalar candidates are UnResolved either way
+        acc.add(sel, is_scalar)
         if step.expand_maps:
-            # maps expanded to values; scalars are UnResolved
+            # maps expanded to values
             keep = jnp.zeros_like(sel)
-            unres = _add_unres(d, unres, sel, is_scalar)
         else:
             # after `.*`: maps filter themselves (accumulate_map
-            # re-scoped each value); scalars are UnResolved
-            unres = _add_unres(d, unres, sel, is_scalar)
+            # re-scoped each value)
             keep = jnp.where((sel > 0) & is_map, sel, 0)
         cand = jnp.maximum(elems, keep)  # candidates labeled with OUTER origin
         idx = jnp.arange(d.n, dtype=jnp.int32)
@@ -203,7 +235,7 @@ def run_step(d: _DocArrays, step: Step, sel, unres, rule_statuses=None):
         st_per_node = status[1:]
         selected = (cand > 0) & (st_per_node == PASS)
         new_sel = jnp.where(selected, cand, 0)
-        return new_sel, unres
+        return new_sel
 
     if isinstance(step, StepKeysMatch):
         # `[ keys == ... ]` (eval_context.rs:830-922): select map values
@@ -214,8 +246,8 @@ def run_step(d: _DocArrays, step: Step, sel, unres, rule_statuses=None):
             match = ~match
         new_sel = jnp.where(match & (d.node_key_id >= 0), psel, 0)
         not_map = (sel > 0) & (d.node_kind != MAP)
-        unres = _add_unres(d, unres, sel, not_map)
-        return new_sel, unres
+        acc.add(sel, not_map)
+        return new_sel
 
     raise TypeError(f"unknown step {step!r}")
 
@@ -448,9 +480,8 @@ def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses) -> jnp
     inversion reverse-diffs, operators.rs:637-646 via evaluator
     `operator_compare`). Membership tests are canonical struct-id
     equality (= loose_eq, encoder.DocBatch.struct_ids)."""
-    zero = jnp.zeros(d.n + 1, jnp.int32)
-    lhs_sel, lhs_unres = run_steps(d, c.steps, sel, zero, rule_statuses)
-    rhs_sel, rhs_unres = run_steps(d, c.rhs_query_steps, sel, zero, rule_statuses)
+    lhs_sel, lhs_unres = run_steps(d, c.steps, sel, rule_statuses)
+    rhs_sel, rhs_unres = run_steps(d, c.rhs_query_steps, sel, rule_statuses)
     ones = jnp.ones(d.n, bool)
     n_lhs = _segment_count(d, lhs_sel, ones)
     n_rhs = _segment_count(d, rhs_sel, ones)
@@ -527,12 +558,13 @@ def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses) -> jnp
     return jnp.where(skip, jnp.int8(SKIP), st)
 
 
-def eval_clause(d: _DocArrays, c: CClause, sel, rule_statuses=None) -> jnp.ndarray:
+def eval_clause(d: _DocArrays, c: CClause, sel, rule_statuses=None,
+                scalar: bool = False) -> jnp.ndarray:
     if c.rhs_query_steps is not None:
-        return _eval_query_rhs_clause(d, c, sel, rule_statuses)
-    unres0 = jnp.zeros(d.n + 1, jnp.int32)
-    sel_leaf, unres = run_steps(d, c.steps, sel, unres0, rule_statuses)
-    n_res = _segment_count(d, sel_leaf, jnp.ones(d.n, bool))
+        st = _eval_query_rhs_clause(d, c, sel, rule_statuses)
+        return st[1] if scalar else st
+    sel_leaf, unres = run_steps(d, c.steps, sel, rule_statuses, scalar=scalar)
+    n_res = _agg(d, sel_leaf, jnp.ones(d.n, bool), scalar)
     n_unres = unres
     total = n_res + n_unres
 
@@ -543,7 +575,7 @@ def eval_clause(d: _DocArrays, c: CClause, sel, rule_statuses=None) -> jnp.ndarr
             ok_res = jnp.where(c.op_not, ~is_null, is_null)
             if c.negation:
                 ok_res = ~ok_res
-            pass_res = _segment_count(d, sel_leaf, ok_res)
+            pass_res = _agg(d, sel_leaf, ok_res, scalar)
             fail_res = n_res - pass_res
             unres_pass = not c.op_not
             if c.negation:
@@ -592,7 +624,7 @@ def eval_clause(d: _DocArrays, c: CClause, sel, rule_statuses=None) -> jnp.ndarr
         if c.negation:
             outcome = ~outcome
             unres_outcome = not unres_outcome
-        n_pass = _segment_count(d, sel_leaf, outcome) + (
+        n_pass = _agg(d, sel_leaf, outcome, scalar) + (
             n_unres if unres_outcome else 0
         )
         n_fail = total - n_pass
@@ -609,8 +641,8 @@ def eval_clause(d: _DocArrays, c: CClause, sel, rule_statuses=None) -> jnp.ndarr
         outcome_all, outcome_any = outcome
     else:
         outcome_all = outcome_any = outcome
-    n_pass_all = _segment_count(d, sel_leaf, outcome_all)
-    n_pass_any = _segment_count(d, sel_leaf, outcome_any)
+    n_pass_all = _agg(d, sel_leaf, outcome_all, scalar)
+    n_pass_any = _agg(d, sel_leaf, outcome_any, scalar)
     n_fail_all = n_res - n_pass_all
     if c.match_all:
         n_fail = n_fail_all + n_unres
@@ -620,17 +652,17 @@ def eval_clause(d: _DocArrays, c: CClause, sel, rule_statuses=None) -> jnp.ndarr
     return jnp.where(total == 0, jnp.int8(SKIP), st)
 
 
-def eval_node(d: _DocArrays, node, sel, rule_statuses) -> jnp.ndarray:
+def eval_node(d: _DocArrays, node, sel, rule_statuses, scalar: bool = False) -> jnp.ndarray:
     if isinstance(node, CClause):
-        return eval_clause(d, node, sel, rule_statuses)
+        return eval_clause(d, node, sel, rule_statuses, scalar=scalar)
     if isinstance(node, CBlockClause):
-        return eval_block_clause(d, node, sel, rule_statuses)
+        return eval_block_clause(d, node, sel, rule_statuses, scalar=scalar)
     if isinstance(node, CWhenBlock):
-        block = eval_conjunctions(d, node.inner, sel, rule_statuses)
+        block = eval_conjunctions(d, node.inner, sel, rule_statuses, scalar=scalar)
         if node.conditions is None:
             # ungated grouping (inline-expanded parameterized rule body)
             return block
-        cond = eval_conjunctions(d, node.conditions, sel, rule_statuses)
+        cond = eval_conjunctions(d, node.conditions, sel, rule_statuses, scalar=scalar)
         return jnp.where(cond == PASS, block, jnp.int8(SKIP))
     if isinstance(node, CNamedRef):
         st = rule_statuses[node.rule_index]
@@ -640,23 +672,26 @@ def eval_node(d: _DocArrays, node, sel, rule_statuses) -> jnp.ndarray:
             out = jnp.where(st == PASS, jnp.int8(FAIL), jnp.int8(PASS))
         else:
             out = jnp.where(st == PASS, jnp.int8(PASS), jnp.int8(FAIL))
+        if scalar:
+            return out
         return jnp.full((d.n + 1,), out, dtype=jnp.int8)
     raise TypeError(f"unknown node {node!r}")
 
 
-def eval_block_clause(d: _DocArrays, b: CBlockClause, sel, rule_statuses=None):
+def eval_block_clause(d: _DocArrays, b: CBlockClause, sel, rule_statuses=None,
+                      scalar: bool = False):
     """eval.rs:1303-1426 (+ type blocks, eval.rs:1649-1822)."""
-    unres0 = jnp.zeros(d.n + 1, jnp.int32)
-    leaves, unres = run_steps(d, b.query_steps, sel, unres0, rule_statuses)
+    leaves, unres = run_steps(d, b.query_steps, sel, rule_statuses, scalar=scalar)
     idx = jnp.arange(d.n, dtype=jnp.int32)
     inner_sel = jnp.where(leaves > 0, idx + 1, 0)
+    # inner conjunctions evaluate per leaf: always node mode
     inner_status = eval_conjunctions(d, b.inner, inner_sel, rule_statuses)
     leaf_status = inner_status[1:]  # (N,) status per leaf node
     is_leaf = leaves > 0
     # regroup by OUTER origin (labels carried in `leaves`)
-    n_pass = _segment_count(d, leaves, is_leaf & (leaf_status == PASS))
-    n_fail = _segment_count(d, leaves, is_leaf & (leaf_status == FAIL))
-    n_res = _segment_count(d, leaves, is_leaf)
+    n_pass = _agg(d, leaves, is_leaf & (leaf_status == PASS), scalar)
+    n_fail = _agg(d, leaves, is_leaf & (leaf_status == FAIL), scalar)
+    n_res = _agg(d, leaves, is_leaf, scalar)
     n_fail = n_fail + unres  # unresolved block values count as fails
     total = n_res + unres
     if b.match_all:
@@ -697,22 +732,34 @@ def _combine_conjunction(statuses: List[jnp.ndarray]) -> jnp.ndarray:
     ).astype(jnp.int8)
 
 
-def eval_conjunctions(d: _DocArrays, conjunctions, sel, rule_statuses=None):
+def eval_conjunctions(d: _DocArrays, conjunctions, sel, rule_statuses=None,
+                      scalar: bool = False):
     conj_statuses = []
     for disj in conjunctions:
-        disj_statuses = [eval_node(d, n, sel, rule_statuses) for n in disj]
+        disj_statuses = [
+            eval_node(d, n, sel, rule_statuses, scalar=scalar) for n in disj
+        ]
         conj_statuses.append(_combine_disjunction(disj_statuses))
     return _combine_conjunction(conj_statuses)
 
 
 def eval_rule(d: _DocArrays, rule: CRule, rule_statuses) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(status, unsure) of one rule for one document. `unsure` ORs the
-    bits clauses in this rule's body appended to d.unsure_acc."""
+    bits clauses in this rule's body appended to d.unsure_acc.
+
+    Rule-level conjunctions evaluate in single-origin scalar mode (the
+    selection is the document root): every per-origin (N+1, N) one-hot
+    aggregation collapses to an O(N) masked sum; only filter and block
+    interiors (genuinely per-node) pay for origin-labeled histograms."""
     mark = len(d.unsure_acc)
     sel_root = (jnp.arange(d.n, dtype=jnp.int32) == 0).astype(jnp.int32)
-    body = eval_conjunctions(d, rule.conjunctions, sel_root, rule_statuses)[1]
+    body = eval_conjunctions(
+        d, rule.conjunctions, sel_root, rule_statuses, scalar=True
+    )
     if rule.conditions is not None:
-        cond = eval_conjunctions(d, rule.conditions, sel_root, rule_statuses)[1]
+        cond = eval_conjunctions(
+            d, rule.conditions, sel_root, rule_statuses, scalar=True
+        )
         status = jnp.where(cond == PASS, body, jnp.int8(SKIP))
     else:
         status = body

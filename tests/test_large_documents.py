@@ -1,0 +1,111 @@
+"""Large-document device path: docs up to the 8192-node bucket evaluate
+on device (VERDICT round 1, item 2 — previously >2048 nodes fell back to
+the CPU oracle) and stay bit-exact against the oracle."""
+
+import numpy as np
+
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.core.scopes import RootScope
+from guard_tpu.core.evaluator import eval_rules_file
+from guard_tpu.core.values import from_plain
+from guard_tpu.ops.encoder import NODE_BUCKETS, encode_batch, split_batch_by_size
+from guard_tpu.ops.ir import compile_rules_file
+from guard_tpu.ops.kernels import BatchEvaluator
+
+RULES = """
+let creates = resource_changes[ change.actions[*] == 'create' ]
+
+rule no_destroys when resource_changes exists {
+    resource_changes[*].change.actions[*] != 'delete'
+}
+
+rule buckets_private when %creates !empty {
+    resource_changes[ type == 'aws_s3_bucket' ].change.after.acl != 'public-read'
+}
+
+rule deep_leaf_tagged when %creates !empty {
+    some resource_changes[*].change.after.tags.env == 'prod'
+}
+"""
+
+STATUS = {0: "PASS", 1: "FAIL", 2: "SKIP"}
+
+
+def _big_plan(rng, n_changes: int, depth: int) -> dict:
+    changes = []
+    for j in range(n_changes):
+        after = {
+            "acl": str(rng.choice(["private", "public-read"])),
+            "tags": {"env": str(rng.choice(["prod", "qa"]))},
+        }
+        node = after
+        for k in range(depth):
+            node[f"n{k}"] = {"leaf": f"v{j}-{k}", "idx": int(k)}
+            node = node[f"n{k}"]
+        changes.append(
+            {
+                "address": f"r{j}",
+                "type": str(rng.choice(["aws_s3_bucket", "aws_instance"])),
+                "change": {
+                    "actions": [str(rng.choice(["create", "update", "delete"]))],
+                    "after": after,
+                },
+            }
+        )
+    return {"resource_changes": changes}
+
+
+def _oracle(rf, doc):
+    from guard_tpu.commands.report import rule_statuses_from_root
+
+    scope = RootScope(rf, doc)
+    eval_rules_file(rf, scope, None)
+    root = scope.reset_recorder().extract()
+    return {n: s.value for n, s in rule_statuses_from_root(root).items()}
+
+
+def test_4096_and_8192_buckets_stay_on_device_and_match_oracle():
+    rng = np.random.default_rng(11)
+    rf = parse_rules_file(RULES, "big.guard")
+    # ~40 nodes per change: 80 changes -> ~3.3k nodes (4096 bucket),
+    # 180 changes -> ~7.4k nodes (8192 bucket), 16 -> small bucket
+    docs_plain = [
+        _big_plan(rng, 16, 6),
+        _big_plan(rng, 80, 6),
+        _big_plan(rng, 180, 6),
+    ]
+    docs = [from_plain(p) for p in docs_plain]
+    batch, interner = encode_batch(docs)
+    n_real = (batch.node_kind >= 0).sum(axis=1)
+    assert n_real[1] > 2048 and n_real[1] <= 4096
+    assert n_real[2] > 4096 and n_real[2] <= 8192
+
+    groups, oversize = split_batch_by_size(batch)
+    assert len(oversize) == 0, "all three docs must stay on device"
+    bucket_sizes = sorted(sub.n_nodes for sub, _ in groups)
+    # the last bucket is capped at the batch's own padded width
+    assert bucket_sizes[-2] == 4096
+    assert int(n_real[2]) <= bucket_sizes[-1] <= 8192
+
+    compiled = compile_rules_file(rf, interner)
+    assert not compiled.host_rules
+    evaluator = BatchEvaluator(compiled)
+    statuses = np.full((batch.n_docs, len(compiled.rules)), 2, np.int8)
+    for sub, idx in groups:
+        statuses[idx] = evaluator(sub)
+
+    for di, doc in enumerate(docs):
+        oracle = _oracle(rf, doc)
+        for ri, crule in enumerate(compiled.rules):
+            assert STATUS[int(statuses[di, ri])] == oracle[crule.name], (
+                f"doc {di} rule {crule.name}"
+            )
+
+
+def test_beyond_last_bucket_routes_to_oracle():
+    rng = np.random.default_rng(12)
+    doc = from_plain(_big_plan(rng, 300, 6))
+    batch, _ = encode_batch([doc])
+    assert (batch.node_kind[0] >= 0).sum() > NODE_BUCKETS[-1]
+    groups, oversize = split_batch_by_size(batch)
+    assert set(int(i) for i in oversize) == {0} and not groups

@@ -533,7 +533,8 @@ def test_split_batch_by_size_groups_and_oversize():
 
     small = {"a": 1}
     medium = {"Resources": {f"r{i}": {"Type": "T", "Properties": {"x": i}} for i in range(30)}}
-    giant = {"Resources": {f"r{i}": {"Type": "T"} for i in range(1100)}}
+    # beyond the 8192-node last bucket (each resource is 2 nodes)
+    giant = {"Resources": {f"r{i}": {"Type": "T"} for i in range(4200)}}
     docs = [from_plain(d) for d in (small, medium, giant, small)]
     batch, _ = encode_batch(docs)
     groups, oversize = split_batch_by_size(batch)
