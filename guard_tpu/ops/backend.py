@@ -17,7 +17,10 @@ as `validate --backend=tpu`"):
 from __future__ import annotations
 
 import json
+import logging
 from typing import List
+
+log = logging.getLogger("guard_tpu.backend")
 
 from ..core.errors import GuardError
 from ..core.evaluator import eval_rules_file
@@ -73,6 +76,11 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
 
     for rule_file in rule_files:
         compiled = compile_rules_file(rule_file.rules, interner)
+        n_dev, n_host = len(compiled.rules), len(compiled.host_rules)
+        log.info(
+            "%s: %d/%d rules lowered to device kernels (%d host-fallback)",
+            rule_file.name, n_dev, n_dev + n_host, n_host,
+        )
         statuses = None
         unsure = None
         if compiled.rules:

@@ -385,7 +385,48 @@ class DocBatch:
                     table[key] = sid
                 out[di, i] = sid
         self._struct_ids = out
+        self._struct_table = table
         return out
+
+    def literal_struct_ids(self, literals, interner) -> np.ndarray:
+        """(D, L) int32: each RHS struct literal canonicalized into this
+        batch's struct-id space via the SAME key scheme struct_ids uses
+        (loose_eq classes). A literal whose canonical key never occurs
+        in the batch maps to -1 — it can match no document node. The
+        row is identical for every doc (the table is batch-global); the
+        leading doc axis exists so the array vmaps/shards like every
+        other device input."""
+        self.struct_ids()  # ensure the table exists
+        table = self._struct_table
+
+        def key_of(pv):
+            k = pv.kind
+            if k == LIST:
+                return ("l",) + tuple(lookup(e) for e in pv.val)
+            if k == MAP:
+                return (
+                    "m",
+                    frozenset(
+                        (interner.lookup(key), lookup(v))
+                        for key, v in pv.val.values.items()
+                    ),
+                )
+            if k in (STRING, REGEX, CHAR):
+                return ("s", interner.lookup(pv.val))
+            if k in (INT, FLOAT, BOOL):
+                nk = num_key(
+                    INT if k == BOOL else k,
+                    (1 if pv.val else 0) if k == BOOL else pv.val,
+                )
+                return (k, nk[0], nk[1]) if nk is not None else ("x",)
+            return ("n",)
+
+        def lookup(pv) -> int:
+            sid = table.get(key_of(pv))
+            return -1 if sid is None else sid
+
+        row = np.array([lookup(pv) for pv in literals], dtype=np.int32)
+        return np.broadcast_to(row, (self.node_kind.shape[0], len(literals))).copy()
 
 
 def _round_up(n: int, multiple: int = 8) -> int:

@@ -85,6 +85,29 @@ class StepKey:
 
 
 @dataclass
+class StepKeyInterpLit:
+    """`.%var` where %var is a LITERAL string / list of strings
+    (eval_context.rs:421-526 via scopes._retrieve_key:545-632): each
+    string is a separate EXACT key lookup (no case-converter retry) —
+    hits concatenate, each miss is its own UnResolved entry."""
+
+    key_ids: List[int]  # one interned id per literal string (-99 absent)
+
+
+@dataclass
+class StepKeyInterpVar:
+    """`.%var` where %var is a QUERY over the same document: the
+    variable resolves from the root scope at evaluation time, list
+    values flatten one level, and each resolved string is an exact key
+    lookup per selected map (one UnResolved per missing (map, key)
+    pair). Non-string key values raise on the oracle
+    (scopes._retrieve_key:621-631) — the kernel flags the document
+    unsure instead."""
+
+    var_steps: List["Step"]
+
+
+@dataclass
 class StepAllValues:
     pass
 
@@ -123,7 +146,16 @@ class StepKeysMatch:
     op_not: bool
 
 
-Step = Union[StepKey, StepAllValues, StepAllIndices, StepIndex, StepFilter, StepKeysMatch]
+Step = Union[
+    StepKey,
+    StepKeyInterpLit,
+    StepKeyInterpVar,
+    StepAllValues,
+    StepAllIndices,
+    StepIndex,
+    StepFilter,
+    StepKeysMatch,
+]
 
 
 # ---------------------------------------------------------------------------
@@ -157,6 +189,13 @@ class RhsSpec:
     range_incl: int = 0
     range_kind: int = RANGE_INT
     items: Optional[List["RhsSpec"]] = None  # for 'list'
+    # 'struct' literals (map / nested-list RHS): index into
+    # CompiledRules.struct_literals; resolved per batch to a canonical
+    # struct id (DocBatch.struct_ids classes = loose_eq)
+    struct_slot: int = -1
+    # the struct literal is itself a LIST: an In-rhs whose FIRST item
+    # is a list switches to whole-list membership (operators.rs:317-327)
+    struct_is_list: bool = False
 
 
 @dataclass
@@ -217,14 +256,19 @@ class CompiledRules:
     interner: Interner
     # empty-string bit table for the EMPTY check on strings
     str_empty_bits: np.ndarray
-    # any rule compares against a query RHS: kernels need the canonical
-    # struct-id column (DocBatch.struct_ids) and may emit per-(doc,rule)
-    # "unsure" bits that route those docs to the oracle
+    # any rule compares against a query RHS or a struct literal:
+    # kernels need the canonical struct-id column (DocBatch.struct_ids)
     needs_struct_ids: bool = False
+    # any rule may emit per-(doc, rule) "unsure" bits routing those
+    # docs to the oracle (query-RHS compares, key interpolation)
+    needs_unsure: bool = False
     # (table, target) per slot; target "scalar" applies the (S,) table
     # through scalar_id, "key" through node_key_id
     bit_tables: List[Tuple[np.ndarray, str]] = field(default_factory=list)
     str_empty_slot: int = -1
+    # map / nested-list RHS literals, canonicalized per batch into the
+    # batch's struct-id space ('lit_struct' device array)
+    struct_literals: List[PV] = field(default_factory=list)
 
     def device_arrays(self, batch) -> dict:
         """Everything the kernel reads, as a flat dict of (D, ...)
@@ -245,6 +289,10 @@ class CompiledRules:
         }
         if self.needs_struct_ids:
             out["struct_id"] = batch.struct_ids()
+        if self.struct_literals:
+            out["lit_struct"] = batch.literal_struct_ids(
+                self.struct_literals, self.interner
+            )
         for i, (table, target) in enumerate(self.bit_tables):
             ids = batch.scalar_id if target == "scalar" else batch.node_key_id
             if len(table) == 0:
@@ -321,6 +369,8 @@ class _RuleLowering:
         self._scope = 0  # 0 = rule root (document root selection)
         self._scope_counter = 0
         self.needs_struct_ids = False
+        self.needs_unsure = False
+        self.struct_literals: List[PV] = []
 
     def _push_scope(self):
         self._scope_counter += 1
@@ -368,19 +418,76 @@ class _RuleLowering:
             if idx < len(parts) and isinstance(parts[idx], QAllIndices):
                 idx += 1
         for i in range(idx, len(parts)):
-            step = self.lower_part(parts[i], block_vars, _prev_class(parts, i))
+            nxt = parts[i + 1] if i + 1 < len(parts) else None
+            step = self.lower_part(
+                parts[i], block_vars, _prev_class(parts, i), nxt
+            )
             if step is not None:
                 steps.append(step)
         return steps
 
-    def lower_part(self, part, block_vars, prev="start") -> Optional[Step]:
+    def _lower_key_interpolation(self, part, block_vars, nxt) -> Step:
+        """`.%var` mid-query (scopes._retrieve_key:545-632)."""
+        # following-part restrictions: QIndex picks the k-th variable
+        # value; anything except QKey/[*]/end raises on the oracle
+        if isinstance(nxt, QIndex):
+            raise Unlowerable("indexed variable key interpolation")
+        if nxt is not None and not isinstance(nxt, (QKey, QAllIndices)):
+            raise Unlowerable("unsupported part after key interpolation")
+        var = part_variable(part)
+        if var in self.var_literals:
+            lit = self.var_literals[var]
+            vals = lit.val if lit.kind == 7 else [lit]  # LIST
+            ids = []
+            for v in vals:
+                if v.kind != STRING:
+                    # non-string keys raise NotComparable on the oracle
+                    raise Unlowerable("non-string literal key interpolation")
+                ids.append(self.interner.lookup(v.val))
+            return StepKeyInterpLit(key_ids=[i if i >= 0 else -99 for i in ids])
+        if var in (block_vars or {}):
+            v, tok = block_vars[var]
+            if isinstance(v, PV):
+                if tok != self._scope:
+                    raise Unlowerable(f"variable {var} crosses value scopes")
+                vals = v.val if v.kind == 7 else [v]
+                ids = []
+                for each in vals:
+                    if each.kind != STRING:
+                        raise Unlowerable("non-string literal key interpolation")
+                    ids.append(self.interner.lookup(each.val))
+                return StepKeyInterpLit(
+                    key_ids=[i if i >= 0 else -99 for i in ids]
+                )
+            raise Unlowerable("block-scoped query variable interpolation")
+        q = self.var_queries.get(var)
+        if q is None or not isinstance(q, AccessQuery):
+            raise Unlowerable(f"variable {var} not interpolatable")
+        if self._scope != 0:
+            # the variable resolves against the ROOT scope; inside a
+            # value scope the kernel's current-selection basis differs
+            raise Unlowerable(f"variable {var} crosses value scopes")
+        self.needs_unsure = True  # non-string key values flag unsure
+        inner = self.lower_query(q.query, {})
+        if not q.match_all:
+            # `some`-marked assignments drop UnResolved entries
+            # (eval_context.rs:1117-1163)
+            inner = [
+                copy.copy(s) if isinstance(s, StepKey) else s for s in inner
+            ]
+            for s in inner:
+                if isinstance(s, StepKey):
+                    s.drop_unres = True
+        return StepKeyInterpVar(var_steps=inner)
+
+    def lower_part(self, part, block_vars, prev="start", nxt=None) -> Optional[Step]:
         if isinstance(part, QThis):
             # identity in the query walk (scopes.py query_retrieval,
             # eval_context.rs: This continues with the current value)
             return None
         if isinstance(part, QKey):
             if part_is_variable(part):
-                raise Unlowerable("variable key interpolation")
+                return self._lower_key_interpolation(part, block_vars, nxt)
             try:
                 return StepIndex(abs(int(part.name)))
             except ValueError:
@@ -431,24 +538,25 @@ class _RuleLowering:
         if isinstance(part, QMapKeyFilter):
             if part.name is not None:
                 raise Unlowerable("variable capture in keys filter")
-            if part.clause.comparator not in (CmpOperator.Eq, CmpOperator.In):
-                # keys ordering runs full operator semantics on the
-                # oracle (eval_context.rs:830-922); the id-table match
-                # only covers Eq/In
-                raise Unlowerable("keys filter with ordering comparator")
-            rhs = self.lower_rhs(part.clause.compare_with, block_vars)
+            op = part.clause.comparator
+            if op not in (CmpOperator.Eq, CmpOperator.In):
+                # the grammar only produces ==/!=/in/not-in after
+                # `keys` (parser.rs:810-835); anything else could only
+                # arrive from a hand-built AST
+                raise Unlowerable(f"keys filter with {op} comparator")
+            rhs = self.lower_rhs(part.clause.compare_with, block_vars, op=op)
             ok_kinds = ("str", "regex")
             if rhs.kind == "list":
                 if any(it.kind not in ok_kinds for it in rhs.items):
                     raise Unlowerable("keys filter list with non-string items")
-                if part.clause.comparator == CmpOperator.Eq:
+                if op == CmpOperator.Eq:
                     # scalar key == list literal has len-1-unwrap /
                     # NotComparable semantics (operators.rs:512-528)
                     raise Unlowerable("keys == list literal")
             elif rhs.kind not in ok_kinds:
                 raise Unlowerable(f"keys filter rhs kind {rhs.kind}")
             return StepKeysMatch(
-                rhs=rhs, op=part.clause.comparator, op_not=part.clause.comparator_inverse
+                rhs=rhs, op=op, op_not=part.clause.comparator_inverse
             )
         raise Unlowerable(f"query part {part!r}")
 
@@ -539,12 +647,58 @@ class _RuleLowering:
                 num_kind=nk,
             )
         if k == 7:  # LIST
-            items = [self.lower_rhs(e) for e in cw.val]
+            items = []
+            for e in cw.val:
+                if e.kind in (7, 8):  # nested LIST / MAP element
+                    items.append(self._struct_literal(e))
+                else:
+                    items.append(self.lower_rhs(e))
             for it in items:
-                if it.kind not in ("str", "regex", "num", "bool", "null", "range", "never"):
-                    raise Unlowerable("nested list in RHS list literal")
+                if it.kind not in (
+                    "str", "regex", "num", "bool", "null", "range", "never",
+                    "struct",
+                ):
+                    raise Unlowerable("unsupported RHS list literal item")
             return RhsSpec(kind="list", items=items)
+        if k == 8:  # MAP literal
+            return self._struct_literal(cw)
         raise Unlowerable(f"RHS literal kind {cw.type_info()}")
+
+    def _struct_literal(self, pv: PV) -> RhsSpec:
+        """Map / nested-list literal -> canonical-struct-id equality.
+
+        Valid only where the oracle's comparison degrades to loose
+        structural equality: REGEX values would regex-match inside
+        compare_eq (path_value.rs:1083-1105) and RANGE/CHAR have
+        coercion semantics, so literals containing them refuse."""
+
+        def check(v: PV) -> None:
+            if v.kind in (REGEX, CHAR, RANGE_INT, RANGE_FLOAT, RANGE_CHAR):
+                raise Unlowerable("regex/range/char inside struct literal")
+            if v.kind in (INT, FLOAT):
+                from .encoder import num_key as _nk
+
+                if _nk(v.kind, v.val) is None:
+                    raise Unlowerable("struct literal number without exact encoding")
+            if v.kind == 7:
+                for e in v.val:
+                    check(e)
+            elif v.kind == 8:
+                for e in v.val.values.values():
+                    check(e)
+
+        check(pv)
+        self.needs_struct_ids = True
+        is_list = pv.kind == 7
+        for i, existing in enumerate(self.struct_literals):
+            if existing is pv:
+                return RhsSpec(kind="struct", struct_slot=i, struct_is_list=is_list)
+        self.struct_literals.append(pv)
+        return RhsSpec(
+            kind="struct",
+            struct_slot=len(self.struct_literals) - 1,
+            struct_is_list=is_list,
+        )
 
     # -- clause lowering ----------------------------------------------
     def lower_guard_clause_as_cclause(self, clause, block_vars) -> "CClause":
@@ -566,6 +720,21 @@ class _RuleLowering:
         if not ac.comparator.is_unary():
             try:
                 rhs = self.lower_rhs(ac.compare_with, block_vars, op=ac.comparator)
+                if rhs.kind == "struct" and (
+                    ac.comparator != CmpOperator.Eq or ac.comparator_inverse
+                ):
+                    # struct-id equality == compare_eq only on the
+                    # plain == path: `!=`/`not` keeps NotComparable
+                    # FAIL while loose-id inequality would PASS
+                    raise Unlowerable("struct literal RHS outside plain ==")
+                if (
+                    rhs.kind == "list"
+                    and rhs.items
+                    and ac.comparator == CmpOperator.Eq
+                    and ac.comparator_inverse
+                    and any(it.kind == "struct" for it in rhs.items)
+                ):
+                    raise Unlowerable("struct items in negated list equality")
             except Unlowerable:
                 # non-literal RHS: a query compared per document in the
                 # same scope as the LHS (eval_guard_access_clause
@@ -746,19 +915,24 @@ def compile_rules_file(rules_file: RulesFile, interner: Interner) -> CompiledRul
     for r in rules_file.guard_rules:
         names_seen[r.rule_name] = names_seen.get(r.rule_name, 0) + 1
     needs_struct = False
+    needs_unsure = False
     for rule in rules_file.guard_rules:
         if names_seen[rule.rule_name] > 1:
             host.append(rule)
             continue
         lowering.needs_struct_ids = False
+        lowering.needs_unsure = False
+        mark = len(lowering.struct_literals)
         try:
             cr = lowering.lower_rule(rule)
         except Unlowerable:
+            del lowering.struct_literals[mark:]  # drop orphan slots
             host.append(rule)
             continue
         lowering.rule_index[rule.rule_name] = len(compiled)
         compiled.append(cr)
         needs_struct = needs_struct or lowering.needs_struct_ids
+        needs_unsure = needs_unsure or lowering.needs_unsure
     str_empty_bits = np.array(
         [len(s) == 0 for s in interner.strings], dtype=bool
     )
@@ -768,6 +942,8 @@ def compile_rules_file(rules_file: RulesFile, interner: Interner) -> CompiledRul
         interner=interner,
         str_empty_bits=str_empty_bits,
         needs_struct_ids=needs_struct,
+        needs_unsure=needs_unsure or needs_struct,
+        struct_literals=lowering.struct_literals,
     )
     _assign_bit_slots(out)
     return out
@@ -817,6 +993,8 @@ def _assign_bit_slots(compiled: CompiledRules) -> None:
                 do_rhs(s.rhs, "key", s.op)
             elif isinstance(s, StepFilter):
                 do_conjs(s.conjunctions)
+            elif isinstance(s, StepKeyInterpVar):
+                do_steps(s.var_steps)
 
     def do_node(n) -> None:
         if isinstance(n, CClause):

@@ -56,6 +56,8 @@ from .ir import (
     StepFilter,
     StepIndex,
     StepKey,
+    StepKeyInterpLit,
+    StepKeyInterpVar,
     StepKeysMatch,
 )
 from ..core.exprs import CmpOperator
@@ -75,6 +77,7 @@ class _DocArrays:
         self.node_index = arrays["node_index"]
         self.node_parent_kind = arrays["node_parent_kind"]
         self.struct_id = arrays.get("struct_id")  # only for query-RHS rules
+        self.lit_struct = arrays.get("lit_struct")  # (L,) struct-literal ids
         # host-precomputed per-node bool columns, one per bit-table slot
         self.bits = {
             int(k[4:]): v for k, v in arrays.items() if k.startswith("bits")
@@ -137,24 +140,38 @@ def _agg(d: _DocArrays, sel, pred, scalar: bool):
 class _UnresAcc:
     """Deferred UnResolved accounting for one query walk.
 
-    A node can become unresolved at most once along a walk (it leaves
-    the selection when it does, and selection only moves down the
-    tree), and its origin label is constant while selected — so instead
-    of one (N+1, N) histogram per STEP, each step just records the
-    miss labels and the walk pays for a single histogram (or a single
-    masked sum in scalar mode) at the end."""
+    A node can leave the selection at most once along a walk (selection
+    only moves down the tree) and its origin label is constant while
+    selected — so instead of one (N+1, N) histogram per STEP, each step
+    records miss labels/counts and the walk pays for a single weighted
+    histogram (or one masked sum in scalar mode) at the end. Counts
+    matter: key interpolation charges one UnResolved per missing
+    (map, key) pair, so a single node can carry several miss events."""
 
-    __slots__ = ("miss_labels",)
+    __slots__ = ("miss_labels", "miss_count")
 
     def __init__(self, d: _DocArrays):
         self.miss_labels = jnp.zeros(d.n, jnp.int32)
+        self.miss_count = jnp.zeros(d.n, jnp.int32)
 
     def add(self, sel, miss) -> None:
         # every call site's `miss` implies sel > 0
         self.miss_labels = jnp.where(miss, sel, self.miss_labels)
+        self.miss_count = self.miss_count + miss.astype(jnp.int32)
+
+    def add_count(self, sel, counts) -> None:
+        """Charge `counts` (int32 per node, 0 where none) miss events."""
+        self.miss_labels = jnp.where(counts > 0, sel, self.miss_labels)
+        self.miss_count = self.miss_count + counts
 
     def finalize(self, d: _DocArrays, scalar: bool):
-        return _agg(d, self.miss_labels, self.miss_labels > 0, scalar)
+        if scalar:
+            return jnp.sum(self.miss_count, dtype=jnp.int32)
+        weight = jnp.where(self.miss_labels > 0, self.miss_count, 0)
+        mask = self.miss_labels[None, :] == jnp.arange(
+            d.n + 1, dtype=jnp.int32
+        )[:, None]
+        return jnp.sum(jnp.where(mask, weight[None, :], 0), axis=1, dtype=jnp.int32)
 
 
 def run_steps(d: _DocArrays, steps: List[Step], sel, rule_statuses=None,
@@ -179,6 +196,66 @@ def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None)
         if not step.drop_unres:
             acc.add(sel, miss)
         return new_sel
+
+    if isinstance(step, StepKeyInterpLit):
+        # `.%var` with literal strings: each string is an EXACT key
+        # lookup (no converter retry); one UnResolved per missing
+        # (map, key) pair; non-map candidates UnResolve first
+        # (scopes._retrieve_key:533-632)
+        is_map_sel = (sel > 0) & (d.node_kind == MAP)
+        acc.add(sel, (sel > 0) & (d.node_kind != MAP))
+        kh_any = jnp.zeros(d.n, bool)
+        for kid in step.key_ids:
+            kh = d.node_key_id == kid
+            kh_any = kh_any | kh
+            has = _count_children(d, kh) > 0
+            acc.add(sel, is_map_sel & ~has)
+        # a key id implies a map parent, so psel needs no extra guard
+        return jnp.where(kh_any, psel, 0)
+
+    if isinstance(step, StepKeyInterpVar):
+        # `.%var` with a query variable: resolve it from the ROOT
+        # scope, flatten one level of lists, then exact-match each
+        # string against the selected maps' keys
+        sel_root = (jnp.arange(d.n, dtype=jnp.int32) == 0).astype(jnp.int32)
+        var_sel, var_unres = run_steps(
+            d, step.var_steps, sel_root, rule_statuses, scalar=True
+        )
+        direct = var_sel > 0
+        is_list = d.node_kind == LIST
+        pvar = _parent_select(d, var_sel)
+        elem = (pvar > 0) & (d.node_parent_kind == LIST)
+        flat = (direct & ~is_list) | elem
+        is_str = d.node_kind == STRING
+        good = flat & is_str
+        # non-string key values raise NotComparable on the oracle
+        # (scopes._retrieve_key:621-631): flag the document unsure
+        d.unsure_acc.append(jnp.any(flat & ~is_str))
+        # match[c, v]: child c sits under a key equal to var string v
+        vids = jnp.where(good, d.scalar_id, -7)
+        match = (d.node_key_id[:, None] == vids[None, :]) & good[None, :]
+        kh = jnp.any(match, axis=1)
+        is_map_sel = (sel > 0) & (d.node_kind == MAP)
+        acc.add(sel, (sel > 0) & (d.node_kind != MAP))
+        # found[s, v]: map s has a child under key v — one boolean
+        # matmul on the MXU instead of an (N, N, N) reduction
+        oh = _parent_onehot(d)  # [c, p]
+        found = (
+            jnp.matmul(
+                oh.astype(jnp.float32).T,
+                match.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            > 0.0
+        )  # (p, v)
+        miss_counts = jnp.sum(
+            (~found) & good[None, :], axis=1, dtype=jnp.int32
+        )
+        acc.add_count(sel, jnp.where(is_map_sel, miss_counts, 0))
+        # every UnResolved entry in the variable's own resolution is
+        # re-reported per selected candidate
+        acc.add_count(sel, jnp.where(sel > 0, var_unres, 0))
+        return jnp.where(kh, psel, 0)
 
     if isinstance(step, StepAllValues):
         # `.*`: all children of maps AND lists; scalars pass through;
@@ -254,8 +331,9 @@ def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None)
 
 def _rhs_match_on_keys(d: _DocArrays, rhs: RhsSpec, op: CmpOperator) -> jnp.ndarray:
     """(N,) bool: does this node's map key match the RHS. Lowering
-    restricts keys-filter RHS to Eq/In over str/regex/list; bit columns
-    here are registered with the "key" target."""
+    restricts keys-filter RHS to Eq/In over str/regex/list (the only
+    comparators the grammar produces after `keys`, parser.rs:810-835);
+    bit columns here are registered with the "key" target."""
     if rhs.kind == "str":
         if op == CmpOperator.In:
             # `keys in 'lit'`: substring containment (operators.rs:218-230)
@@ -304,6 +382,13 @@ def _compare_scalar_full(d: _DocArrays, rhs: RhsSpec, op: CmpOperator):
         # ranges, char literals): NotComparable -> FAIL everywhere
         never = jnp.zeros(d.n, bool)
         return never, never
+
+    if rhs.kind == "struct":
+        # map / nested-list literal: canonical-struct-id equality
+        # (loose_eq classes; lowering gates the op/not combinations
+        # where compare_eq and loose_eq could diverge)
+        m = d.struct_id == d.lit_struct[rhs.struct_slot]
+        return m, m
 
     if op == CmpOperator.Eq or op == CmpOperator.In:
         if rhs.kind == "str":
@@ -429,8 +514,12 @@ def _eval_binary_outcomes(d: _DocArrays, c: CClause, sel_leaf):
         # any-element, hence the (outcome_all, outcome_any) pair.
         outcome = jnp.where(is_list_leaf, n_child_ok == n_child, match)
         outcome_any = jnp.where(is_list_leaf, n_child_ok > 0, match)
-        outcome = jnp.where(is_map_leaf, False, outcome)
-        outcome_any = jnp.where(is_map_leaf, False, outcome_any)
+        if rhs.kind != "struct":
+            # map leaves vs scalar literals are NotComparable -> FAIL;
+            # vs a struct (map) literal they compare directly
+            # (compare_eq map-vs-map does not raise)
+            outcome = jnp.where(is_map_leaf, False, outcome)
+            outcome_any = jnp.where(is_map_leaf, False, outcome_any)
         return (outcome, outcome_any), (sel_leaf > 0)
 
     if op == CmpOperator.In:
@@ -450,6 +539,12 @@ def _eval_binary_outcomes(d: _DocArrays, c: CClause, sel_leaf):
             m = jnp.zeros(d.n, bool)
             for item in rhs.items:
                 m = m | _compare_scalar(d, item, CmpOperator.Eq)
+            if rhs.items and rhs.items[0].kind == "struct" and rhs.items[0].struct_is_list:
+                # rhs's first item is a LIST: whole-value membership
+                # for every leaf kind (operators.rs:317-327 list-of-
+                # lists branch; scalars/maps use the value_in branch)
+                outcome = ~m if c.op_not else m
+                return outcome, (sel_leaf > 0)
             # scalar: in == any match; list leaf: ALL elements in rhs
             # (contained_in, operators.rs:256-321); not_in: NO element
             in_child = _count_children(d, m)
@@ -806,7 +901,7 @@ class BatchEvaluator:
 
     def __init__(self, compiled: CompiledRules):
         self.compiled = compiled
-        self._with_unsure = compiled.needs_struct_ids
+        self._with_unsure = compiled.needs_unsure
         self._fn = jax.jit(
             jax.vmap(build_doc_evaluator(compiled, with_unsure=self._with_unsure))
         )

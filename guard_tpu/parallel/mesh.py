@@ -64,7 +64,7 @@ class ShardedBatchEvaluator:
     def __init__(self, compiled: CompiledRules, mesh: Optional[Mesh] = None):
         self.compiled = compiled
         self.mesh = mesh if mesh is not None else default_mesh()
-        self._with_unsure = compiled.needs_struct_ids
+        self._with_unsure = compiled.needs_unsure
         doc_eval = build_doc_evaluator(compiled, with_unsure=self._with_unsure)
         # every input array is doc-major: one sharding as a pytree
         # prefix covers the whole arrays dict

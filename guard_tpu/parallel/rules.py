@@ -158,8 +158,10 @@ def _slice_compiled(compiled: CompiledRules, indices: List[int]) -> CompiledRule
         interner=compiled.interner,
         str_empty_bits=compiled.str_empty_bits,
         needs_struct_ids=compiled.needs_struct_ids,
+        needs_unsure=compiled.needs_unsure,
         bit_tables=compiled.bit_tables,  # slots stay valid: shared specs
         str_empty_slot=compiled.str_empty_slot,
+        struct_literals=compiled.struct_literals,
     )
 
 
@@ -206,5 +208,5 @@ class RuleShardedEvaluator:
                 unsure[:, idx] = np.asarray(un)[:d]
             else:
                 statuses[:, idx] = np.asarray(out)[:d]
-        self.last_unsure = unsure if self.compiled.needs_struct_ids else None
+        self.last_unsure = unsure if self.compiled.needs_unsure else None
         return statuses

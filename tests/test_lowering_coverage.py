@@ -1,0 +1,237 @@
+"""Differential tests for lowering coverage added in round 2
+(VERDICT item 6): keys-ordering filters, literal and query variable
+key interpolation, and map / nested-list struct literals as RHS.
+Every case must lower (no host fallback) and match the CPU oracle."""
+
+import numpy as np
+import pytest
+
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.core.scopes import RootScope
+from guard_tpu.core.evaluator import eval_rules_file
+from guard_tpu.core.values import from_plain
+from guard_tpu.ops.encoder import encode_batch
+from guard_tpu.ops.ir import Unlowerable, compile_rules_file
+from guard_tpu.ops.kernels import BatchEvaluator
+
+STATUS = {0: "PASS", 1: "FAIL", 2: "SKIP"}
+
+
+def _oracle(rf, doc):
+    from guard_tpu.commands.report import rule_statuses_from_root
+
+    scope = RootScope(rf, doc)
+    eval_rules_file(rf, scope, None)
+    root = scope.reset_recorder().extract()
+    return {n: s.value for n, s in rule_statuses_from_root(root).items()}
+
+
+def _differential(rules_text, docs_plain, expect_host=0, allow_unsure=False):
+    rf = parse_rules_file(rules_text, "cov.guard")
+    docs = [from_plain(d) for d in docs_plain]
+    batch, interner = encode_batch(docs)
+    compiled = compile_rules_file(rf, interner)
+    assert len(compiled.host_rules) == expect_host, [
+        r.rule_name for r in compiled.host_rules
+    ]
+    if not compiled.rules:
+        return
+    evaluator = BatchEvaluator(compiled)
+    statuses = evaluator(batch)
+    unsure = evaluator.last_unsure
+    for di, doc in enumerate(docs):
+        oracle = _oracle(rf, doc)
+        for ri, crule in enumerate(compiled.rules):
+            if unsure is not None and bool(unsure[di, ri]):
+                assert allow_unsure, "unexpected unsure flag"
+                continue
+            dev = STATUS[int(statuses[di, ri])]
+            assert dev == oracle[crule.name], (
+                f"doc {di} ({docs_plain[di]}) rule {crule.name}: "
+                f"device={dev} oracle={oracle[crule.name]}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# keys filters: the grammar (like the reference's, parser.rs:810-835)
+# only produces ==/!=/in/not-in after `keys` — ordering comparators are
+# a parse error, so no ordering lowering gap exists
+# ---------------------------------------------------------------------------
+def test_keys_ordering_is_a_parse_error_like_reference():
+    from guard_tpu.core.errors import ParseError
+
+    with pytest.raises(ParseError):
+        parse_rules_file("rule r { Resources[ keys > 'm' ].x exists }", "x")
+
+
+# ---------------------------------------------------------------------------
+# literal variable key interpolation
+# ---------------------------------------------------------------------------
+def test_literal_var_key_interpolation():
+    _differential(
+        """
+let wanted = ['BucketA', 'BucketB']
+let single = 'BucketA'
+
+rule both_encrypted { Resources.%wanted.Encrypted == true }
+rule one_encrypted { Resources.%single.Encrypted exists }
+""",
+        [
+            {
+                "Resources": {
+                    "BucketA": {"Encrypted": True},
+                    "BucketB": {"Encrypted": True},
+                }
+            },
+            {"Resources": {"BucketA": {"Encrypted": True}}},  # B missing
+            {"Resources": {"BucketA": {"Encrypted": False}, "BucketB": {"Encrypted": True}}},
+            {"Resources": "not-a-map"},
+        ],
+    )
+
+
+# ---------------------------------------------------------------------------
+# query variable key interpolation
+# ---------------------------------------------------------------------------
+def test_query_var_key_interpolation():
+    _differential(
+        """
+let names = Selection.targets
+
+rule selected_typed { Resources.%names.Type == 'Good' }
+rule selected_exists { Resources.%names exists }
+""",
+        [
+            {
+                "Selection": {"targets": ["a", "b"]},
+                "Resources": {"a": {"Type": "Good"}, "b": {"Type": "Good"}},
+            },
+            {
+                "Selection": {"targets": ["a", "b"]},
+                "Resources": {"a": {"Type": "Good"}},  # b missing
+            },
+            {
+                "Selection": {"targets": ["a"]},
+                "Resources": {"a": {"Type": "Bad"}, "b": {"Type": "Good"}},
+            },
+            {
+                "Selection": {"targets": "a"},  # scalar string value
+                "Resources": {"a": {"Type": "Good"}},
+            },
+        ],
+    )
+
+
+def test_query_var_interpolation_non_string_flags_unsure():
+    rules = """
+let names = Selection.targets
+
+rule r { Resources.%names exists }
+"""
+    rf = parse_rules_file(rules, "x")
+    docs = [from_plain({"Selection": {"targets": [3]}, "Resources": {"a": 1}})]
+    batch, interner = encode_batch(docs)
+    compiled = compile_rules_file(rf, interner)
+    assert not compiled.host_rules and compiled.needs_unsure
+    evaluator = BatchEvaluator(compiled)
+    evaluator(batch)
+    assert evaluator.last_unsure is not None and bool(evaluator.last_unsure[0, 0])
+
+
+# ---------------------------------------------------------------------------
+# struct literals (map / nested-list RHS)
+# ---------------------------------------------------------------------------
+def test_map_literal_rhs():
+    _differential(
+        """
+rule tags_exact { Resources.*.Tags == { env: "prod", owner: "infra" } }
+rule in_with_maps { some Resources.*.Tags IN [{ env: "prod", owner: "infra" }, { env: "qa" }] }
+""",
+        [
+            {"Resources": {"a": {"Tags": {"env": "prod", "owner": "infra"}}}},
+            {"Resources": {"a": {"Tags": {"owner": "infra", "env": "prod"}}}},
+            {"Resources": {"a": {"Tags": {"env": "qa"}}}},
+            {"Resources": {"a": {"Tags": {"env": "prod"}}}},
+            {"Resources": {"a": {"Tags": "prod"}}},
+        ],
+    )
+
+
+def test_nested_list_literal_rhs():
+    _differential(
+        """
+rule ports_allowed { some Resources.*.Ports IN [[22, 443], [80]] }
+""",
+        [
+            {"Resources": {"a": {"Ports": [22, 443]}}},
+            {"Resources": {"a": {"Ports": [80]}}},
+            {"Resources": {"a": {"Ports": [22, 8080]}}},
+            {"Resources": {"a": {"Ports": 80}}},
+        ],
+    )
+
+
+def test_struct_literal_refusals_route_to_host():
+    # != vs map literal: NotComparable-keeps-FAIL semantics the id
+    # compare cannot mirror -> host
+    rf = parse_rules_file(
+        'rule r { Resources.*.Tags != { env: "prod" } }', "x"
+    )
+    batch, interner = encode_batch(
+        [from_plain({"Resources": {"a": {"Tags": {"env": "qa"}}}})]
+    )
+    compiled = compile_rules_file(rf, interner)
+    assert len(compiled.host_rules) == 1
+
+    # regex inside the literal regex-matches in compare_eq -> host
+    rf2 = parse_rules_file(
+        "rule r { Resources.*.Tags == { env: /pr/ } }", "x"
+    )
+    compiled2 = compile_rules_file(rf2, interner)
+    assert len(compiled2.host_rules) == 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the backend: both paths agree
+# ---------------------------------------------------------------------------
+def test_backend_cli_parity_interpolation(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    rules = tmp_path / "r.guard"
+    rules.write_text(
+        "let names = Selection.targets\n"
+        "rule r { Resources.%names.Type == 'Good' }\n"
+    )
+    data = tmp_path / "data"
+    data.mkdir()
+    (data / "d0.json").write_text(
+        json.dumps(
+            {
+                "Selection": {"targets": ["a", "b"]},
+                "Resources": {"a": {"Type": "Good"}, "b": {"Type": "Good"}},
+            }
+        )
+    )
+    (data / "d1.json").write_text(
+        json.dumps(
+            {
+                "Selection": {"targets": ["a", "b"]},
+                "Resources": {"a": {"Type": "Good"}},
+            }
+        )
+    )
+
+    def run(extra):
+        return subprocess.run(
+            [sys.executable, "-m", "guard_tpu.cli", "validate", "-r",
+             str(rules), "-d", str(data), "--structured", "-o", "json",
+             "--show-summary", "none"] + extra,
+            capture_output=True, text=True, timeout=300,
+        )
+
+    cpu = run([])
+    tpu = run(["--backend", "tpu"])
+    assert cpu.returncode == tpu.returncode == 19
+    assert json.loads(cpu.stdout) == json.loads(tpu.stdout)
