@@ -172,6 +172,15 @@ def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reade
     except GuardError as e:
         writer.writeln_err(f"Error: {e}")
         return 5
+    except BrokenPipeError:
+        # preserved for main()'s quiet-SIGPIPE handling (exit 141)
+        raise
+    except OSError as e:
+        # nonexistent/unreadable paths exit 5 with a clean message, as
+        # in the reference ("any of the specified paths do not exist",
+        # parse_tree.rs:44)
+        writer.writeln_err(f"Error: {e}")
+        return 5
     return 0
 
 

@@ -435,8 +435,8 @@ class _RuleLowering:
         if nxt is not None and not isinstance(nxt, (QKey, QAllIndices)):
             raise Unlowerable("unsupported part after key interpolation")
         var = part_variable(part)
-        if var in self.var_literals:
-            lit = self.var_literals[var]
+
+        def lit_step(lit: PV) -> StepKeyInterpLit:
             vals = lit.val if lit.kind == 7 else [lit]  # LIST
             ids = []
             for v in vals:
@@ -445,21 +445,18 @@ class _RuleLowering:
                     raise Unlowerable("non-string literal key interpolation")
                 ids.append(self.interner.lookup(v.val))
             return StepKeyInterpLit(key_ids=[i if i >= 0 else -99 for i in ids])
+
+        # innermost scope first — block lets shadow file-level lets
+        # (BlockScope.resolve_variable checks its own scope first)
         if var in (block_vars or {}):
             v, tok = block_vars[var]
             if isinstance(v, PV):
                 if tok != self._scope:
                     raise Unlowerable(f"variable {var} crosses value scopes")
-                vals = v.val if v.kind == 7 else [v]
-                ids = []
-                for each in vals:
-                    if each.kind != STRING:
-                        raise Unlowerable("non-string literal key interpolation")
-                    ids.append(self.interner.lookup(each.val))
-                return StepKeyInterpLit(
-                    key_ids=[i if i >= 0 else -99 for i in ids]
-                )
+                return lit_step(v)
             raise Unlowerable("block-scoped query variable interpolation")
+        if var in self.var_literals:
+            return lit_step(self.var_literals[var])
         q = self.var_queries.get(var)
         if q is None or not isinstance(q, AccessQuery):
             raise Unlowerable(f"variable {var} not interpolatable")

@@ -295,3 +295,14 @@ def test_completions_track_argparse_surface():
     for flags in surface.values():
         for f in flags:
             assert f in out
+
+
+def test_missing_file_paths_exit_5_cleanly():
+    for args in (
+        ["parse-tree", "-r", "/nonexistent/file.guard"],
+        ["rulegen", "-t", "/nonexistent/template.yaml"],
+        ["test", "-r", "/nonexistent/r.guard", "-t", "/nonexistent/t.yaml"],
+    ):
+        code, _out, err = run_cli(args)
+        assert code in (1, 5), args  # test command uses its own error code
+        assert "nonexistent" in err and "Traceback" not in err, args

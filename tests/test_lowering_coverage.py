@@ -235,3 +235,22 @@ def test_backend_cli_parity_interpolation(tmp_path):
     tpu = run(["--backend", "tpu"])
     assert cpu.returncode == tpu.returncode == 19
     assert json.loads(cpu.stdout) == json.loads(tpu.stdout)
+
+
+def test_interpolation_block_let_shadows_file_let():
+    """Block-scoped lets shadow file-level lets (BlockScope resolves
+    innermost first) — the lowering must match."""
+    _differential(
+        """
+let names = 'FileLevel'
+
+rule shadowed {
+    let names = 'BlockLevel'
+    Resources.%names exists
+}
+""",
+        [
+            {"Resources": {"BlockLevel": 1}},
+            {"Resources": {"FileLevel": 1}},
+        ],
+    )

@@ -291,3 +291,20 @@ def test_parse_tree_goldens(rules, golden, extra):
     code, out = _run(["parse-tree", "-r", str(TEST_REF / rules)] + extra)
     assert code == 0
     assert out == (TEST_REF / golden).read_text()
+
+
+@needs_reference
+def test_structured_payload_golden():
+    """validate.rs test_structured_output_payload: stdin payload with
+    --structured -o json, pinned to structured-payload.json. The
+    payload is extracted from the reference test source at run time."""
+    src = pathlib.Path("/root/reference/guard/tests/validate.rs").read_text()
+    m = re.search(r'const COMPLIANT_PAYLOAD: &str = r#"(.*?)"#;', src, re.S)
+    payload = m.group(1)
+    code, out = _run(
+        ["validate", "--payload", "--structured", "-o", "json",
+         "--show-summary", "none"],
+        stdin=payload,
+    )
+    assert code == 0
+    assert out == _golden("structured-payload.json")
