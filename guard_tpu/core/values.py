@@ -326,16 +326,25 @@ _GLOBAL_FLAGS_RE = re.compile(r"\(\?([aiLmsux]+)\)")
 @lru_cache(maxsize=4096)
 def compiled_regex(pattern: str):
     try:
-        return re.compile(pattern)
-    except re.error:
-        # Rust regex crates allow inline global flags anywhere in the
-        # pattern (e.g. `^(?i)name$`); Python requires them at the start.
-        # Hoist them to the front and retry.
-        flags = "".join(sorted(set("".join(_GLOBAL_FLAGS_RE.findall(pattern)))))
-        if not flags:
-            raise
-        stripped = _GLOBAL_FLAGS_RE.sub("", pattern)
-        return re.compile(f"(?{flags})" + stripped)
+        try:
+            return re.compile(pattern)
+        except re.error:
+            # Rust regex crates allow inline global flags anywhere in
+            # the pattern (e.g. `^(?i)name$`); Python requires them at
+            # the start. Hoist them to the front and retry.
+            flags = "".join(
+                sorted(set("".join(_GLOBAL_FLAGS_RE.findall(pattern))))
+            )
+            if not flags:
+                raise
+            stripped = _GLOBAL_FLAGS_RE.sub("", pattern)
+            return re.compile(f"(?{flags})" + stripped)
+    except OverflowError as e:
+        # CPython raises OverflowError (not re.error) for repetition
+        # counts beyond its limit (x{9999999999}); normalize so every
+        # caller's re.error handling applies — the reference rejects
+        # such patterns at parse time (parser.rs:273-277)
+        raise re.error(f"invalid regex {pattern!r}: {e}")
 
 
 def regex_matches(pattern: str, s: str) -> bool:
@@ -555,7 +564,14 @@ def rust_debug_pv(pv: "PV") -> str:
     if k == INT:
         return f"Int(({path}, {pv.val}))"
     if k == FLOAT:
-        return f"Float(({path}, {_rust_num(pv.val)}.0))" if float(pv.val) == int(pv.val) else f"Float(({path}, {pv.val}))"
+        fv = float(pv.val)
+        if fv != fv or fv in (float("inf"), float("-inf")):
+            # Rust {:?} renders non-finite f64 as NaN / inf / -inf
+            s = "NaN" if fv != fv else ("inf" if fv > 0 else "-inf")
+            return f"Float(({path}, {s}))"
+        if fv == int(fv):
+            return f"Float(({path}, {_rust_num(pv.val)}.0))"
+        return f"Float(({path}, {pv.val}))"
     if k == NULL:
         return f"Null({path})"
     if k == LIST:

@@ -1,0 +1,60 @@
+"""Smoke tier for the coverage-guided fuzzer (tools/fuzz.py): a short
+in-CI run per target must execute cleanly with zero crashes and show
+the coverage feedback actually growing the corpus. The 420 s/target
+soak runs in the nightly workflow (.github/workflows/nightly.yml),
+mirroring the reference's libFuzzer gate (pr.yml:109-127)."""
+
+import pathlib
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.mark.parametrize("target", ["dsl", "yaml"])
+def test_fuzz_smoke(target, tmp_path):
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "tools" / "fuzz.py"),
+            "--target", target, "--time", "8",
+            "--crash-dir", str(tmp_path / "crashes"),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    m = re.search(
+        r"executions=(\d+) corpus=(\d+) coverage=(\d+) crashes=(\d+)",
+        proc.stdout,
+    )
+    assert m, proc.stdout
+    executions, corpus, coverage, crashes = map(int, m.groups())
+    assert crashes == 0
+    assert executions > 1000, "fuzzer throughput collapsed"
+    assert coverage > 300, "coverage feedback not wired"
+    assert not (tmp_path / "crashes").exists()
+
+
+def test_nonfinite_float_report_regression():
+    """Reproducer for the OverflowError the fuzzer found: non-finite
+    floats inside failure reports (rust_debug_pv) must format like
+    Rust's {:?} instead of crashing."""
+    from guard_tpu.api import run_checks
+
+    # plain scalars type like Rust's f64 FromStr (loader.rs:86-98):
+    # "inf"/"-inf"/"1e999" are floats; ".inf" stays a string — and the
+    # rust-debug renderer (the crash site) must format them like {:?}
+    from guard_tpu.core.loader import load_document
+    from guard_tpu.core.values import rust_debug_pv
+
+    doc = load_document("a: 1e999\nb: -inf\nc: nan\n", "f.yaml")
+    rendered = rust_debug_pv(doc)
+    assert "Float((" in rendered
+    assert "inf" in rendered and "-inf" in rendered and "NaN" in rendered
+
+    out = run_checks("a: 1e999\nb: -inf\nc: nan\n", "a exists\nb == 5.0\nc exists")
+    assert '"status": "FAIL"' in out  # evaluated, no crash
