@@ -1,0 +1,194 @@
+"""GitHub Action dispatch tests with a recording fake API — the
+equivalent of the reference's jest suites
+(`/root/reference/action/__tests__/main.test.ts`) over the three
+dispatch modes of `main.ts:31-50`: analyze (code-scanning upload),
+pull_request (review comments), and push (summary only)."""
+
+import base64
+import gzip
+import importlib.util
+import json
+import pathlib
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+RES = pathlib.Path("/root/reference/guard/resources/validate")
+
+spec = importlib.util.spec_from_file_location(
+    "guard_action_main", REPO / "action" / "main.py"
+)
+action = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(action)
+
+needs_reference = pytest.mark.skipif(
+    not RES.exists(), reason="reference checkout not available"
+)
+
+
+class FakeApi:
+    """Records every request; returns scripted responses."""
+
+    def __init__(self, responses=None):
+        self.calls = []
+        self.responses = responses or {}
+
+    def request(self, method, path, body=None):
+        self.calls.append((method, path, body))
+        for (m, frag), resp in self.responses.items():
+            if m == method and frag in path:
+                return resp
+        return {}
+
+
+@pytest.fixture
+def env(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)  # SARIF file lands in cwd
+    monkeypatch.setenv("GITHUB_REPOSITORY", "octo/repo")
+    monkeypatch.setenv("GITHUB_SHA", "deadbeef")
+    monkeypatch.setenv("GITHUB_REF", "refs/heads/main")
+    monkeypatch.setenv(
+        "GITHUB_STEP_SUMMARY", str(tmp_path / "summary.md")
+    )
+    monkeypatch.setenv(
+        "INPUT_RULES",
+        str(RES / "rules-dir" / "s3_bucket_public_read_prohibited.guard"),
+    )
+    monkeypatch.setenv(
+        "INPUT_DATA",
+        str(RES / "data-dir" /
+            "s3-public-read-prohibited-template-non-compliant.yaml"),
+    )
+    monkeypatch.setenv("INPUT_TOKEN", "tok")
+    for k in ("INPUT_ANALYZE", "INPUT_CREATE_REVIEW", "INPUT_PATH",
+              "GITHUB_EVENT_PATH"):
+        monkeypatch.delenv(k, raising=False)
+    return tmp_path
+
+
+def _violating_uri(tmp_path):
+    sarif = json.loads((tmp_path / "guard-tpu.sarif").read_text())
+    return sarif["runs"][0]["results"][0]["locations"][0][
+        "physicalLocation"]["artifactLocation"]["uri"]
+
+
+@needs_reference
+def test_analyze_mode_uploads_code_scan(env, monkeypatch):
+    monkeypatch.setenv("INPUT_ANALYZE", "true")
+    monkeypatch.setenv("GITHUB_EVENT_NAME", "push")
+    api = FakeApi()
+    assert action.main(api=api) == 1
+    (method, path, body), = api.calls
+    assert method == "POST"
+    assert path == "/repos/octo/repo/code-scanning/sarifs"
+    assert body["commit_sha"] == "deadbeef"
+    assert body["ref"] == "refs/heads/main"
+    decoded = json.loads(gzip.decompress(base64.b64decode(body["sarif"])))
+    assert decoded["runs"][0]["results"], "uploaded SARIF has the findings"
+
+
+@needs_reference
+def test_push_mode_writes_summary_without_api_calls(env, monkeypatch):
+    monkeypatch.setenv("GITHUB_EVENT_NAME", "push")
+    api = FakeApi()
+    assert action.main(api=api) == 1
+    assert api.calls == []
+    summary = (env / "summary.md").read_text()
+    assert "Validation Failures" in summary
+    assert "S3_BUCKET_PUBLIC_READ_PROHIBITED" in summary
+
+
+@needs_reference
+def test_pull_request_mode_posts_review_comments(env, monkeypatch):
+    monkeypatch.setenv("GITHUB_EVENT_NAME", "pull_request")
+    monkeypatch.setenv("INPUT_CREATE_REVIEW", "true")
+    event = env / "event.json"
+    # the changed-file list must include the violating file for comments
+    # to post; first run once in push mode to learn the URI
+    monkeypatch.setenv("GITHUB_EVENT_NAME", "push")
+    action.main(api=FakeApi())
+    uri = _violating_uri(env)
+    monkeypatch.setenv("GITHUB_EVENT_NAME", "pull_request")
+    event.write_text(json.dumps(
+        {"pull_request": {"number": 7, "head": {"sha": "abc123"}}}
+    ))
+    monkeypatch.setenv("GITHUB_EVENT_PATH", str(event))
+
+    stale = {"id": 99, "body": None, "path": uri, "position": None}
+    api = FakeApi(responses={
+        ("GET", "/pulls/7/files"): [{"filename": uri}],
+        ("GET", "/pulls/7/comments"): [stale],
+    })
+    assert action.main(api=api) == 1
+
+    posts = [c for c in api.calls if c[0] == "POST"]
+    assert posts, "review comments must be created"
+    for method, path, body in posts:
+        assert path == "/repos/octo/repo/pulls/7/reviews"
+        assert body["commit_id"] == "abc123"
+        assert body["event"] == "COMMENT"
+        (comment,) = body["comments"]
+        assert comment["path"] == uri
+        assert comment["position"] >= 1
+        assert comment["body"].strip()
+    summary = (env / "summary.md").read_text()
+    assert "Validation Failures" in summary
+
+
+@needs_reference
+def test_pull_request_mode_deletes_stale_duplicate_comments(env, monkeypatch):
+    monkeypatch.setenv("GITHUB_EVENT_NAME", "push")
+    action.main(api=FakeApi())
+    uri = _violating_uri(env)
+    sarif = json.loads((env / "guard-tpu.sarif").read_text())
+    first = sarif["runs"][0]["results"][0]
+    dup = {
+        "id": 42,
+        "body": first["message"]["text"],
+        "path": uri,
+        "position": first["locations"][0]["physicalLocation"]["region"]["startLine"],
+    }
+    event = env / "event.json"
+    event.write_text(json.dumps(
+        {"pull_request": {"number": 7, "head": {"sha": "abc123"}}}
+    ))
+    monkeypatch.setenv("GITHUB_EVENT_PATH", str(event))
+    monkeypatch.setenv("GITHUB_EVENT_NAME", "pull_request")
+    monkeypatch.setenv("INPUT_CREATE_REVIEW", "true")
+    api = FakeApi(responses={
+        ("GET", "/pulls/7/files"): [{"filename": uri}],
+        ("GET", "/pulls/7/comments"): [dup],
+    })
+    assert action.main(api=api) == 1
+    deletes = [c for c in api.calls if c[0] == "DELETE"]
+    assert deletes == [("DELETE", "/repos/octo/repo/pulls/comments/42", None)]
+
+
+@needs_reference
+def test_pull_request_unrelated_files_pass(env, monkeypatch):
+    """Violations outside the PR's changed files do not fail the job
+    (handlePullRequestRun returns no rows)."""
+    event = env / "event.json"
+    event.write_text(json.dumps(
+        {"pull_request": {"number": 7, "head": {"sha": "abc123"}}}
+    ))
+    monkeypatch.setenv("GITHUB_EVENT_PATH", str(event))
+    monkeypatch.setenv("GITHUB_EVENT_NAME", "pull_request")
+    api = FakeApi(responses={
+        ("GET", "/pulls/7/files"): [{"filename": "unrelated.yaml"}],
+    })
+    assert action.main(api=api) == 0
+
+
+@needs_reference
+def test_compliant_data_passes(env, monkeypatch):
+    monkeypatch.setenv(
+        "INPUT_DATA",
+        str(RES / "data-dir" /
+            "s3-public-read-prohibited-template-compliant.yaml"),
+    )
+    monkeypatch.setenv("GITHUB_EVENT_NAME", "push")
+    api = FakeApi()
+    assert action.main(api=api) == 0
+    assert api.calls == []
