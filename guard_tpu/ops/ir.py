@@ -75,6 +75,13 @@ class Unlowerable(Exception):
     """Raised when a rule uses semantics outside the kernel's coverage."""
 
 
+class CrossScopeRootVar(Unlowerable):
+    """A query head references a variable bound at the ROOT scope from
+    inside a value scope. The query then resolves against the document
+    root regardless of the current selection, so the owning clause can
+    evaluate once from root and broadcast (CClause.eval_from_root)."""
+
+
 # ---------------------------------------------------------------------------
 # Step IR
 # ---------------------------------------------------------------------------
@@ -214,6 +221,17 @@ class CClause:
     # scope as the LHS): set-comparison semantics, operators.rs:552-594
     # (Eq query_in) and :434-451 (In). Only for Eq/In.
     rhs_query_steps: Optional[List[Step]] = None
+    # LHS head is a root-bound variable used inside a value scope: the
+    # query result set is origin-independent, so the clause evaluates
+    # once from the document root and the status broadcasts to every
+    # origin (the oracle resolves the variable against its binding
+    # scope, eval_context.rs:1117-1163)
+    eval_from_root: bool = False
+    # the RHS query's head is a root-bound variable (`x IN %allowed`
+    # inside a filter): the RHS set resolves once from the root and is
+    # shared by every origin; In-only (Eq needs per-origin reverse
+    # membership)
+    rhs_query_from_root: bool = False
 
 
 @dataclass
@@ -392,6 +410,10 @@ class _RuleLowering:
             else:
                 raise Unlowerable(f"unknown variable {var}")
             if tok != self._scope:
+                if tok == 0:
+                    # root-bound variable inside a value scope: the
+                    # owning clause may re-lower from the root basis
+                    raise CrossScopeRootVar(var)
                 raise Unlowerable(f"variable {var} crosses value scopes")
             if isinstance(v, _PreloweredQuery):
                 match_all = v.match_all
@@ -697,6 +719,15 @@ class _RuleLowering:
             struct_is_list=is_list,
         )
 
+    def _lower_query_from_root(self, parts, block_vars) -> List[Step]:
+        """Re-lower a query whose head is a root-bound variable from
+        the root basis (CrossScopeRootVar recovery)."""
+        prev_scope, self._scope = self._scope, 0
+        try:
+            return self.lower_query(parts, block_vars)
+        finally:
+            self._scope = prev_scope
+
     # -- clause lowering ----------------------------------------------
     def lower_guard_clause_as_cclause(self, clause, block_vars) -> "CClause":
         if not isinstance(clause, GuardAccessClause):
@@ -711,9 +742,17 @@ class _RuleLowering:
         empty_on_expr = isinstance(last, (QFilter, QMapKeyFilter)) or (
             part_is_variable(last) and len(parts) == 1
         )
-        steps = self.lower_query(parts, block_vars)
+        eval_from_root = False
+        try:
+            steps = self.lower_query(parts, block_vars)
+        except CrossScopeRootVar:
+            # re-lower from the root basis; the clause status is
+            # origin-independent and broadcasts (kernels.eval_clause)
+            steps = self._lower_query_from_root(parts, block_vars)
+            eval_from_root = True
         rhs = None
         rhs_query_steps = None
+        rhs_query_from_root = False
         if not ac.comparator.is_unary():
             try:
                 rhs = self.lower_rhs(ac.compare_with, block_vars, op=ac.comparator)
@@ -740,10 +779,22 @@ class _RuleLowering:
                     raise
                 if ac.comparator not in (CmpOperator.Eq, CmpOperator.In):
                     raise Unlowerable("ordering comparison with query RHS")
-                rhs_query_steps = self.lower_query(
-                    ac.compare_with.query, block_vars
-                )
+                try:
+                    rhs_query_steps = self.lower_query(
+                        ac.compare_with.query, block_vars
+                    )
+                except CrossScopeRootVar:
+                    if ac.comparator != CmpOperator.In:
+                        # Eq needs per-origin reverse membership
+                        raise Unlowerable("root-bound query RHS outside IN")
+                    rhs_query_steps = self._lower_query_from_root(
+                        ac.compare_with.query, block_vars
+                    )
+                    rhs_query_from_root = True
                 self.needs_struct_ids = True
+        if eval_from_root and rhs_query_steps is not None:
+            # a per-origin RHS against a root-based LHS cannot broadcast
+            raise Unlowerable("root-based LHS with query RHS")
         return CClause(
             steps=steps,
             op=ac.comparator,
@@ -753,6 +804,8 @@ class _RuleLowering:
             rhs=rhs,
             empty_on_expr=empty_on_expr,
             rhs_query_steps=rhs_query_steps,
+            eval_from_root=eval_from_root,
+            rhs_query_from_root=rhs_query_from_root,
         )
 
     def lower_guard_clause(self, clause, block_vars) -> CNode:

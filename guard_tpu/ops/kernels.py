@@ -98,6 +98,11 @@ class _DocArrays:
 # ---------------------------------------------------------------------------
 
 
+def _sel_root(d: _DocArrays) -> jnp.ndarray:
+    """(N,) selection of the document root (node 0, origin label 1)."""
+    return (jnp.arange(d.n, dtype=jnp.int32) == 0).astype(jnp.int32)
+
+
 def _parent_onehot(d: _DocArrays) -> jnp.ndarray:
     """(N, N) bool: [c, p] = node p is the parent of node c. Cheap to
     recompute per use — XLA CSEs the compare and fuses it into each
@@ -217,7 +222,7 @@ def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None)
         # `.%var` with a query variable: resolve it from the ROOT
         # scope, flatten one level of lists, then exact-match each
         # string against the selected maps' keys
-        sel_root = (jnp.arange(d.n, dtype=jnp.int32) == 0).astype(jnp.int32)
+        sel_root = _sel_root(d)
         var_sel, var_unres = run_steps(
             d, step.var_steps, sel_root, rule_statuses, scalar=True
         )
@@ -576,16 +581,34 @@ def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses) -> jnp
     `operator_compare`). Membership tests are canonical struct-id
     equality (= loose_eq, encoder.DocBatch.struct_ids)."""
     lhs_sel, lhs_unres = run_steps(d, c.steps, sel, rule_statuses)
-    rhs_sel, rhs_unres = run_steps(d, c.rhs_query_steps, sel, rule_statuses)
+    if c.rhs_query_from_root:
+        # root-bound RHS variable: one shared result set for every
+        # origin (resolved against the binding scope)
+        sel_root = _sel_root(d)
+        rhs_sel, rhs_unres_s = run_steps(
+            d, c.rhs_query_steps, sel_root, rule_statuses, scalar=True
+        )
+        rhs_unres = jnp.full((d.n + 1,), rhs_unres_s, jnp.int32)
+    else:
+        rhs_sel, rhs_unres = run_steps(d, c.rhs_query_steps, sel, rule_statuses)
     ones = jnp.ones(d.n, bool)
     n_lhs = _segment_count(d, lhs_sel, ones)
-    n_rhs = _segment_count(d, rhs_sel, ones)
+    if c.rhs_query_from_root:
+        n_rhs = jnp.full(
+            (d.n + 1,), jnp.sum(rhs_sel > 0, dtype=jnp.int32), jnp.int32
+        )
+    else:
+        n_rhs = _segment_count(d, rhs_sel, ones)
     lhs_total = n_lhs + lhs_unres
     rhs_total = n_rhs + rhs_unres
 
     sid = d.struct_id
     eq = (sid[:, None] == sid[None, :]) & (sid[:, None] >= 0)  # (N,N) loose_eq
-    same_origin = (lhs_sel[:, None] == rhs_sel[None, :]) & (lhs_sel[:, None] > 0)
+    if c.rhs_query_from_root:
+        # every (lhs, rhs) pair is in scope — the RHS set is shared
+        same_origin = (lhs_sel[:, None] > 0) & (rhs_sel[None, :] > 0)
+    else:
+        same_origin = (lhs_sel[:, None] == rhs_sel[None, :]) & (lhs_sel[:, None] > 0)
 
     if c.op == CmpOperator.Eq:
         contained = eq  # loose_eq membership both directions
@@ -655,6 +678,13 @@ def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses) -> jnp
 
 def eval_clause(d: _DocArrays, c: CClause, sel, rule_statuses=None,
                 scalar: bool = False) -> jnp.ndarray:
+    if c.eval_from_root and not scalar:
+        # root-bound variable head inside a value scope: the result set
+        # is origin-independent — evaluate once from the document root
+        # and broadcast the status to every origin
+        sel_root = _sel_root(d)
+        st = eval_clause(d, c, sel_root, rule_statuses, scalar=True)
+        return jnp.full((d.n + 1,), st, dtype=jnp.int8)
     if c.rhs_query_steps is not None:
         st = _eval_query_rhs_clause(d, c, sel, rule_statuses)
         return st[1] if scalar else st
@@ -847,7 +877,7 @@ def eval_rule(d: _DocArrays, rule: CRule, rule_statuses) -> Tuple[jnp.ndarray, j
     aggregation collapses to an O(N) masked sum; only filter and block
     interiors (genuinely per-node) pay for origin-labeled histograms."""
     mark = len(d.unsure_acc)
-    sel_root = (jnp.arange(d.n, dtype=jnp.int32) == 0).astype(jnp.int32)
+    sel_root = _sel_root(d)
     body = eval_conjunctions(
         d, rule.conjunctions, sel_root, rule_statuses, scalar=True
     )

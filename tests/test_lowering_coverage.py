@@ -254,3 +254,64 @@ rule shadowed {
             {"Resources": {"FileLevel": 1}},
         ],
     )
+
+
+# ---------------------------------------------------------------------------
+# cross-scope root variables (previously host-only)
+# ---------------------------------------------------------------------------
+def test_root_variable_inside_filter():
+    _differential(
+        """
+let allowed = Parameters.AllowedZones
+
+rule zones_ok {
+    Resources.*[ Properties.Zone IN %allowed ] !empty
+}
+""",
+        [
+            {"Parameters": {"AllowedZones": ["us-1", "us-2"]},
+             "Resources": {"a": {"Type": "T", "Properties": {"Zone": "us-1"}}}},
+            {"Parameters": {"AllowedZones": ["us-1"]},
+             "Resources": {"a": {"Type": "T", "Properties": {"Zone": "eu-9"}}}},
+        ],
+        allow_unsure=True,
+    )
+
+
+def test_root_variable_inside_block_body():
+    _differential(
+        """
+let flag = Parameters.Strict
+
+rule strict_typed {
+    Resources.* {
+        Type exists
+        %flag == true
+    }
+}
+""",
+        [
+            {"Parameters": {"Strict": True},
+             "Resources": {"a": {"Type": "T"}, "b": {"Type": "U"}}},
+            {"Parameters": {"Strict": False},
+             "Resources": {"a": {"Type": "T"}}},
+            {"Resources": {"a": {"Type": "T"}}},  # unresolved var
+        ],
+    )
+
+
+def test_root_variable_unary_inside_filter():
+    _differential(
+        """
+let probe = Parameters.Probe
+
+rule gated_sel {
+    Resources.*[ %probe exists Type == 'T' ] !empty
+}
+""",
+        [
+            {"Parameters": {"Probe": 1},
+             "Resources": {"a": {"Type": "T"}}},
+            {"Resources": {"a": {"Type": "T"}}},
+        ],
+    )

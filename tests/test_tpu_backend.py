@@ -301,10 +301,10 @@ def test_not_in_scalar_rhs_not_comparable_parity():
     assert_parity(rules, docs)
 
 
-def test_variable_crossing_value_scope_refuses():
-    # a binding spliced at a narrower selection than its scope must
-    # refuse lowering (the oracle resolves it at the binding scope)
-    from guard_tpu.ops.encoder import Interner
+def test_root_variable_crossing_value_scope_lowers_and_matches():
+    # root-bound variables used inside value scopes lower via the
+    # evaluate-once-from-root broadcast (previously host-only); the
+    # oracle resolves them at the binding scope — statuses must match
     from guard_tpu.ops.ir import compile_rules_file as cmp_rules
 
     rules = (
@@ -314,10 +314,15 @@ def test_variable_crossing_value_scope_refuses():
         "rule filevar {\n  Resources.* {\n    %mode == 'strict'\n  }\n}\n"
     )
     rf = parse_rules_file(rules, "t.guard")
-    doc = from_plain({"Config": {"Mode": "strict"}, "Resources": {"r": {"Type": "T"}}})
-    batch, interner = encode_batch([doc])
+    docs = [
+        from_plain({"Config": {"Mode": "strict"}, "Resources": {"r": {"Type": "T"}}}),
+        from_plain({"Config": {"Mode": "lax"}, "Resources": {"r": {"Type": "T"}}}),
+        from_plain({"Resources": {"r": {"Type": "T"}}}),
+    ]
+    batch, interner = encode_batch(docs)
     compiled = cmp_rules(rf, interner)
-    assert {r.rule_name for r in compiled.host_rules} == {"caller", "filevar"}
+    assert not compiled.host_rules
+    assert_parity(rules, [d.to_plain() for d in docs])
 
 
 def test_string_ordering_parity():
