@@ -16,10 +16,16 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 
 # platform=None: default platform untouched (may resolve to a TPU
 # backend). platform="cpu": the env var is set but NOT honored on hosts
-# whose TPU plugin self-registers (axon) — the dry run must force the
-# platform programmatically either way.
-@pytest.mark.parametrize("platform", [None, "cpu"])
-def test_dryrun_multichip_subprocess_no_platform_forcing(platform):
+# whose TPU plugin self-registers (axon). platform="axon": the ambient
+# environment names a TPU plugin outright — the real driver host does
+# exactly this — and the dry run must still force CPU programmatically.
+# with_flag=False: XLA_FLAGS carries no device-count flag at all; the
+# dry run must inject it itself before backend init.
+@pytest.mark.parametrize(
+    "platform,with_flag",
+    [(None, True), ("cpu", True), ("axon", True), (None, False), ("axon", False)],
+)
+def test_dryrun_multichip_subprocess_no_platform_forcing(platform, with_flag):
     env = os.environ.copy()
     env.pop("JAX_PLATFORMS", None)
     if platform is not None:
@@ -29,9 +35,9 @@ def test_dryrun_multichip_subprocess_no_platform_forcing(platform):
         for f in env.get("XLA_FLAGS", "").split()
         if "xla_force_host_platform_device_count" not in f
     ]
-    env["XLA_FLAGS"] = " ".join(
-        flags + ["--xla_force_host_platform_device_count=8"]
-    )
+    if with_flag:
+        flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
     proc = subprocess.run(
         [
             sys.executable,
@@ -45,7 +51,10 @@ def test_dryrun_multichip_subprocess_no_platform_forcing(platform):
         timeout=420,
     )
     assert proc.returncode == 0, proc.stderr[-4000:]
-    assert "dryrun_multichip OK" in proc.stdout
+    # the platform in the OK line proves the run was hermetic: a
+    # regression to real TPU devices would also print "OK" on a
+    # healthy multi-chip host, but not with cpu devices
+    assert "dryrun_multichip OK: 8 cpu devices" in proc.stdout
 
 
 def test_dryrun_multichip_in_process():
