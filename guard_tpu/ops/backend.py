@@ -94,7 +94,16 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
             if statuses is not None and di not in host_docs:
                 for ri, crule in enumerate(compiled.rules):
                     st = _STATUS[int(statuses[di, ri])]
-                    rule_statuses[crule.name] = st
+                    # same-name merge as the report layer
+                    # (report.rule_statuses_from_root): non-SKIP beats
+                    # SKIP, FAIL dominates
+                    prev = rule_statuses.get(crule.name)
+                    if prev is None or (
+                        prev == Status.SKIP and st != Status.SKIP
+                    ):
+                        rule_statuses[crule.name] = st
+                    elif st == Status.FAIL:
+                        rule_statuses[crule.name] = Status.FAIL
                     doc_status = doc_status.and_(st)
                     if unsure is not None and bool(unsure[di, ri]):
                         unsure_rules.add(crule.name)

@@ -139,11 +139,19 @@ class StepFilter:
     Lists always iterate. Filters after `[*]` refuse lowering: list
     elements are NOT re-scoped (accumulate, eval_context.rs:142-178),
     so map candidates there evaluate the filter against the *outer*
-    scope — semantics the kernel does not model."""
+    scope — semantics the kernel does not model.
+
+    After a VARIABLE head (`%var[ ... ]`, scopes.py:390-408 wraps each
+    resolved value in its own ValueScope before the implicit-`[*]`-
+    skipped walk reaches the filter) maps and scalars both filter
+    THEMSELVES in their own scope and lists iterate — `scalar_self`
+    marks that mode (no candidate is ever UnResolved there)."""
 
     conjunctions: List[List["CClause"]]
     # prev was a key / query start: map candidates expand to their values
     expand_maps: bool = False
+    # prev was a spliced variable head: scalars self-filter too
+    scalar_self: bool = False
 
 
 @dataclass
@@ -206,6 +214,35 @@ class RhsSpec:
 
 
 @dataclass
+class CCountClause:
+    """A clause whose LHS is a `count()` function variable
+    (`let n = count(q)` then `%n == 2`): the reference resolves the
+    function once per scope into a single synthetic INT value
+    (functions/collections.rs:6-23 counts the RESOLVED entries of the
+    argument query; eval_context.rs:1286-1472 dispatch), so the clause
+    reduces to one integer comparison. `steps` is the argument query
+    lowered from the ROOT basis (file- and rule-level lets both bind at
+    the root scope, eval_context.rs:926-997).
+
+    `static_status`: unary ops over the count value depend only on the
+    value's kind (always exactly one resolved INT), so their tri-state
+    outcome is a compile-time constant and `steps` is not even run.
+
+    `cmp` encodes the binary comparison against the count:
+      ('int', v, op, op_not)        exact integer compare
+      ('range', lo, hi, incl, op_not)  INT range membership (In)
+      ('in', [ints], op_not)        list membership via loose_eq — only
+                                    INT items can ever equal the count
+      ('never',)                    NotComparable RHS kinds -> FAIL both
+                                    with and without `not`
+                                    (operators.rs:195-206)"""
+
+    steps: List[Step]
+    static_status: Optional[int] = None
+    cmp: Optional[tuple] = None
+
+
+@dataclass
 class CClause:
     """One guard access clause over a relative query."""
 
@@ -252,11 +289,14 @@ class CWhenBlock:
 
 @dataclass
 class CNamedRef:
-    rule_index: int  # index into the compiled-rules list
+    # compiled-rules indices of every rule with the referenced name, in
+    # file order: the reference takes the FIRST non-SKIP status among
+    # same-named rules (eval_context.rs:1087-1115)
+    rule_indices: List[int]
     negation: bool
 
 
-CNode = Union[CClause, CBlockClause, CWhenBlock, CNamedRef]
+CNode = Union[CClause, CCountClause, CBlockClause, CWhenBlock, CNamedRef]
 
 
 @dataclass
@@ -371,15 +411,33 @@ class _RuleLowering:
         self.interner = interner
         self.var_queries = {}
         self.var_literals = {}
+        # count() assignments (`let n = count(q)`): the one function
+        # the kernel lowers — value = number of RESOLVED entries of the
+        # argument query (functions/collections.rs:6-23)
+        self.var_counts = {}
         for let in rules_file.assignments:
             if isinstance(let.value, AccessQuery):
                 self.var_queries[let.var] = let.value
             elif isinstance(let.value, PV):
                 self.var_literals[let.var] = let.value
             else:
-                # function-call assignment: rules touching it go host-side
+                # function-call assignment: rules touching it go
+                # host-side, except count-over-query (see var_counts)
                 self.var_queries[let.var] = None
-        self.rule_index = {}
+                fx = let.value
+                if (
+                    isinstance(fx, FunctionExpr)
+                    and fx.name == "count"
+                    and len(fx.parameters) == 1
+                    and isinstance(fx.parameters[0], AccessQuery)
+                ):
+                    self.var_counts[let.var] = fx.parameters[0]
+        self.rule_index = {}  # name -> [compiled indices], file order
+        self.names_total = {}
+        for r in rules_file.guard_rules:
+            self.names_total[r.rule_name] = (
+                self.names_total.get(r.rule_name, 0) + 1
+            )
         self.param_rules = {
             p.rule.rule_name: p for p in rules_file.parameterized_rules
         }
@@ -437,13 +495,19 @@ class _RuleLowering:
             steps.extend(inner)
             idx = 1
             # skip the implicit [*] the parser inserted after the variable
+            # (the oracle skips it identically, scopes.py:399-400 /
+            # eval_context.rs:348-385 — even an EXPLICIT `%var[*]` is
+            # consumed there, so `%var[*][f]` == `%var[f]`)
             if idx < len(parts) and isinstance(parts[idx], QAllIndices):
                 idx += 1
+        spliced_at = idx if idx > 0 else None
         for i in range(idx, len(parts)):
             nxt = parts[i + 1] if i + 1 < len(parts) else None
-            step = self.lower_part(
-                parts[i], block_vars, _prev_class(parts, i), nxt
-            )
+            # the first part after a variable splice sees the var's
+            # resolved values each wrapped in its own ValueScope, not
+            # the [*] accumulate path — filters behave differently there
+            prev = "varhead" if i == spliced_at else _prev_class(parts, i)
+            step = self.lower_part(parts[i], block_vars, prev, nxt)
             if step is not None:
                 steps.append(step)
         return steps
@@ -468,6 +532,30 @@ class _RuleLowering:
                 ids.append(self.interner.lookup(v.val))
             return StepKeyInterpLit(key_ids=[i if i >= 0 else -99 for i in ids])
 
+        def query_interp(q: AccessQuery, q_vars) -> StepKeyInterpVar:
+            # the variable resolves against its BINDING scope, which for
+            # file- and rule-level lets is the document root
+            # (scopes._resolve_variable_in:256 uses ctx.root()); the
+            # kernel runs var_steps from the root selection regardless
+            # of the use site's scope, so lower them at the root basis
+            self.needs_unsure = True  # non-string key values flag unsure
+            prev_scope, self._scope = self._scope, 0
+            try:
+                inner = self.lower_query(q.query, q_vars)
+            finally:
+                self._scope = prev_scope
+            if not q.match_all:
+                # `some`-marked assignments drop UnResolved entries
+                # (eval_context.rs:1117-1163)
+                inner = [
+                    copy.copy(s) if isinstance(s, StepKey) else s
+                    for s in inner
+                ]
+                for s in inner:
+                    if isinstance(s, StepKey):
+                        s.drop_unres = True
+            return StepKeyInterpVar(var_steps=inner)
+
         # innermost scope first — block lets shadow file-level lets
         # (BlockScope.resolve_variable checks its own scope first)
         if var in (block_vars or {}):
@@ -476,28 +564,16 @@ class _RuleLowering:
                 if tok != self._scope:
                     raise Unlowerable(f"variable {var} crosses value scopes")
                 return lit_step(v)
+            if isinstance(v, AccessQuery) and tok == 0:
+                # rule-body let: binds at the root basis like file lets
+                return query_interp(v, block_vars)
             raise Unlowerable("block-scoped query variable interpolation")
         if var in self.var_literals:
             return lit_step(self.var_literals[var])
         q = self.var_queries.get(var)
         if q is None or not isinstance(q, AccessQuery):
             raise Unlowerable(f"variable {var} not interpolatable")
-        if self._scope != 0:
-            # the variable resolves against the ROOT scope; inside a
-            # value scope the kernel's current-selection basis differs
-            raise Unlowerable(f"variable {var} crosses value scopes")
-        self.needs_unsure = True  # non-string key values flag unsure
-        inner = self.lower_query(q.query, {})
-        if not q.match_all:
-            # `some`-marked assignments drop UnResolved entries
-            # (eval_context.rs:1117-1163)
-            inner = [
-                copy.copy(s) if isinstance(s, StepKey) else s for s in inner
-            ]
-            for s in inner:
-                if isinstance(s, StepKey):
-                    s.drop_unres = True
-        return StepKeyInterpVar(var_steps=inner)
+        return query_interp(q, {})
 
     def lower_part(self, part, block_vars, prev="start", nxt=None) -> Optional[Step]:
         if isinstance(part, QThis):
@@ -553,6 +629,7 @@ class _RuleLowering:
             return StepFilter(
                 conjunctions=conjunctions,
                 expand_maps=prev in ("start", "key"),
+                scalar_self=prev == "varhead",
             )
         if isinstance(part, QMapKeyFilter):
             if part.name is not None:
@@ -734,9 +811,163 @@ class _RuleLowering:
             raise Unlowerable(f"filter clause {type(clause).__name__}")
         return self.lower_access_clause(clause, block_vars)
 
+    def _count_arg_query(self, parts, block_vars) -> Optional[AccessQuery]:
+        """The argument query when `parts` is exactly a count-variable
+        reference (`%n` / `%n[*]`), else None. Only root-basis bindings
+        qualify (file lets always; rule-body lets bind at scope 0)."""
+        if not parts or not part_is_variable(parts[0]):
+            return None
+        rest = parts[1:]
+        if rest and isinstance(rest[0], QAllIndices):
+            rest = rest[1:]
+        if rest:
+            # walking INTO the synthetic int (e.g. `%n.foo`) UnResolves
+            # on the oracle — host fallback, it is never meaningful
+            return None
+        var = part_variable(parts[0])
+        if block_vars and var in block_vars:
+            v, tok = block_vars[var]
+            if (
+                isinstance(v, FunctionExpr)
+                and v.name == "count"
+                and len(v.parameters) == 1
+                and isinstance(v.parameters[0], AccessQuery)
+                and tok == 0
+            ):
+                return v.parameters[0]
+            return None
+        return self.var_counts.get(var)
+
+    def _lower_count_clause(
+        self, gac: GuardAccessClause, arg_query: AccessQuery, block_vars
+    ) -> CCountClause:
+        """`%n <op> rhs` where n is a count() let: one synthetic INT
+        value, always resolved (fn_count never UnResolves), compared
+        with the reference's exact comparison table
+        (path_value.rs:1047-1191 compare_*, operators.rs EqOperation /
+        InOperation / CommonOperator)."""
+        ac = gac.access_clause
+        prev_scope, self._scope = self._scope, 0
+        try:
+            steps = self.lower_query(arg_query.query, block_vars)
+        finally:
+            self._scope = prev_scope
+        op, op_not = ac.comparator, ac.comparator_inverse
+
+        if op.is_unary():
+            # outcomes depend only on the value's kind (a single
+            # resolved INT): compile-time constants (eval.rs:174-405)
+            if op == CmpOperator.Empty:
+                # `%n` alone is empty-on-expr (eval.rs:193-196): tests
+                # zero RESOLVED values — count always yields one
+                base = False
+            elif op == CmpOperator.Exists:
+                base = True
+            elif op == CmpOperator.IsInt:
+                base = True
+            elif op in (
+                CmpOperator.IsString,
+                CmpOperator.IsList,
+                CmpOperator.IsMap,
+                CmpOperator.IsFloat,
+                CmpOperator.IsBool,
+                CmpOperator.IsNull,
+            ):
+                base = False
+            else:
+                raise Unlowerable(f"count variable with {op}")
+            outcome = base
+            if op_not:
+                outcome = not outcome
+            if gac.negation:
+                outcome = not outcome
+            return CCountClause(
+                steps=steps, static_status=PASS if outcome else FAIL
+            )
+
+        cw = ac.compare_with
+        # literal-variable RHS resolves at compile time like lower_rhs
+        if isinstance(cw, AccessQuery):
+            cparts = cw.query
+            if cparts and part_is_variable(cparts[0]):
+                cvar = part_variable(cparts[0])
+                lit = None
+                if block_vars and cvar in block_vars:
+                    bound = block_vars[cvar][0]
+                    if isinstance(bound, PV):
+                        lit = bound
+                elif cvar in self.var_literals:
+                    lit = self.var_literals[cvar]
+                crest = cparts[1:]
+                if crest and isinstance(crest[0], QAllIndices):
+                    crest = crest[1:]
+                if lit is not None and not crest:
+                    cw = lit
+        if not isinstance(cw, PV):
+            raise Unlowerable("count compare against non-literal RHS")
+
+        i32 = lambda v: int(np.clip(int(v), -(2**31), 2**31 - 1))
+
+        def int_range(r):
+            lo, hi = int(r.lower), int(r.upper)
+            if abs(lo) >= 2**31 or abs(hi) >= 2**31:
+                raise Unlowerable("count range bound beyond i32")
+            return lo, hi
+
+        if op in (CmpOperator.Eq, CmpOperator.In) and cw.kind == RANGE_INT:
+            # compare_eq(INT, RANGE_INT) is range membership — a
+            # COMPARABLE pair, so `not` is a pure inversion
+            # (path_value.rs compare_eq WithinRange arm)
+            lo, hi = int_range(cw.val)
+            cmp = ("range", lo, hi, cw.val.inclusive, op_not)
+        elif op in (
+            CmpOperator.Eq,
+            CmpOperator.Gt,
+            CmpOperator.Ge,
+            CmpOperator.Lt,
+            CmpOperator.Le,
+        ):
+            if cw.kind == INT:
+                # counts are bounded by the node bucket (< 2^31), so a
+                # clamped literal preserves every comparison outcome
+                cmp = ("int", i32(cw.val), op, op_not)
+            else:
+                # INT vs any other kind (incl. ordering vs ranges):
+                # NotComparable -> FAIL, surviving the `not` inversion
+                # (operators.rs:195-206)
+                cmp = ("never",)
+        elif op == CmpOperator.In:
+            if cw.kind == 7:  # LIST: membership via loose_eq
+                only_plain = all(
+                    e.kind in (INT, FLOAT, STRING, BOOL, NULL)
+                    for e in cw.val
+                )
+                if not only_plain:
+                    # range/regex/nested items have their own loose_eq
+                    # arms — keep the host oracle authoritative there
+                    raise Unlowerable("count IN list with non-scalar items")
+                # only INT items can ever loose_eq the count
+                ints = [
+                    i32(e.val)
+                    for e in cw.val
+                    if e.kind == INT and abs(int(e.val)) < 2**31
+                ]
+                cmp = ("in", ints, op_not)
+            elif cw.kind == INT:
+                # scalar RHS goes through compare_eq: INT vs INT only
+                cmp = ("int", i32(cw.val), CmpOperator.Eq, op_not)
+            else:
+                cmp = ("never",)
+        else:
+            raise Unlowerable(f"count variable with {op}")
+        return CCountClause(steps=steps, cmp=cmp)
+
     def lower_access_clause(self, gac: GuardAccessClause, block_vars) -> CClause:
         ac = gac.access_clause
         parts = ac.query.query
+        count_arg = self._count_arg_query(parts, block_vars)
+        if count_arg is not None:
+            return self._lower_count_clause(gac, count_arg, block_vars)
         # the `empty`-on-expression special case (eval.rs:193-196)
         last = parts[-1]
         empty_on_expr = isinstance(last, (QFilter, QMapKeyFilter)) or (
@@ -779,22 +1010,33 @@ class _RuleLowering:
                     raise
                 if ac.comparator not in (CmpOperator.Eq, CmpOperator.In):
                     raise Unlowerable("ordering comparison with query RHS")
+                rhs_root_basis = False
                 try:
                     rhs_query_steps = self.lower_query(
                         ac.compare_with.query, block_vars
                     )
                 except CrossScopeRootVar:
-                    if ac.comparator != CmpOperator.In:
-                        # Eq needs per-origin reverse membership
-                        raise Unlowerable("root-bound query RHS outside IN")
                     rhs_query_steps = self._lower_query_from_root(
                         ac.compare_with.query, block_vars
                     )
-                    rhs_query_from_root = True
+                    rhs_root_basis = True
+                    if not eval_from_root:
+                        # per-origin LHS vs one shared root-resolved
+                        # RHS set (kernels handle Eq via per-origin
+                        # reverse membership, In via the shared set)
+                        rhs_query_from_root = True
+                    # else: the whole clause evaluates once from the
+                    # root selection — both sides resolve there with
+                    # the same origin label, so the ordinary per-origin
+                    # machinery is already exact
                 self.needs_struct_ids = True
-        if eval_from_root and rhs_query_steps is not None:
-            # a per-origin RHS against a root-based LHS cannot broadcast
-            raise Unlowerable("root-based LHS with query RHS")
+                if eval_from_root and not rhs_root_basis:
+                    # the RHS resolves per origin inside the value
+                    # scope while the LHS broadcasts from the root —
+                    # genuinely origin-dependent, cannot lower
+                    raise Unlowerable(
+                        "root-based LHS with per-origin query RHS"
+                    )
         return CClause(
             steps=steps,
             op=ac.comparator,
@@ -843,10 +1085,15 @@ class _RuleLowering:
                 ],
             )
         if isinstance(clause, GuardNamedRuleClause):
-            target = self.rule_index.get(clause.dependent_rule)
-            if target is None:
+            # every same-named rule must already be compiled (the
+            # first-non-SKIP scan needs all of them, and kernel rule
+            # statuses are produced in file order)
+            targets = self.rule_index.get(clause.dependent_rule)
+            if not targets or len(targets) != self.names_total.get(
+                clause.dependent_rule, 0
+            ):
                 raise Unlowerable(f"named rule {clause.dependent_rule} not lowerable")
-            return CNamedRef(rule_index=target, negation=clause.negation)
+            return CNamedRef(rule_indices=list(targets), negation=clause.negation)
         if isinstance(clause, ParameterizedNamedRuleClause):
             return self.lower_parameterized_call(clause, block_vars)
         if isinstance(clause, TypeBlock):
@@ -933,10 +1180,10 @@ class _RuleLowering:
         """Bindings carry the scope token they were made under."""
         merged = dict(outer)
         for let in block.assignments:
-            if isinstance(let.value, (AccessQuery, PV)):
+            if isinstance(let.value, (AccessQuery, PV, FunctionExpr)):
                 merged[let.var] = (let.value, self._scope)
             else:
-                merged[let.var] = (None, self._scope)  # function call: bail if used
+                merged[let.var] = (None, self._scope)  # unknown: bail if used
         return merged
 
     def lower_rule(self, rule: Rule) -> CRule:
@@ -960,16 +1207,9 @@ def compile_rules_file(rules_file: RulesFile, interner: Interner) -> CompiledRul
     lowering = _RuleLowering(rules_file, interner)
     compiled: List[CRule] = []
     host: List[Rule] = []
-    # duplicate rule names can't use CNamedRef's first-non-SKIP semantics
-    names_seen = {}
-    for r in rules_file.guard_rules:
-        names_seen[r.rule_name] = names_seen.get(r.rule_name, 0) + 1
     needs_struct = False
     needs_unsure = False
     for rule in rules_file.guard_rules:
-        if names_seen[rule.rule_name] > 1:
-            host.append(rule)
-            continue
         lowering.needs_struct_ids = False
         lowering.needs_unsure = False
         mark = len(lowering.struct_literals)
@@ -979,7 +1219,9 @@ def compile_rules_file(rules_file: RulesFile, interner: Interner) -> CompiledRul
             del lowering.struct_literals[mark:]  # drop orphan slots
             host.append(rule)
             continue
-        lowering.rule_index[rule.rule_name] = len(compiled)
+        lowering.rule_index.setdefault(rule.rule_name, []).append(
+            len(compiled)
+        )
         compiled.append(cr)
         needs_struct = needs_struct or lowering.needs_struct_ids
         needs_unsure = needs_unsure or lowering.needs_unsure
@@ -1054,6 +1296,8 @@ def _assign_bit_slots(compiled: CompiledRules) -> None:
                 uses_empty[0] = True
             if n.rhs_query_steps is not None:
                 do_steps(n.rhs_query_steps)
+        elif isinstance(n, CCountClause):
+            do_steps(n.steps)
         elif isinstance(n, CBlockClause):
             do_steps(n.query_steps)
             do_conjs(n.inner)

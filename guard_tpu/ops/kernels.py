@@ -45,6 +45,7 @@ from .ir import (
     SKIP,
     CBlockClause,
     CClause,
+    CCountClause,
     CNamedRef,
     CompiledRules,
     CRule,
@@ -301,9 +302,15 @@ def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None)
         if step.expand_maps:
             expand_parent = expand_parent | (d.node_parent_kind == MAP)
         elems = jnp.where(expand_parent, psel, 0)
-        # scalar candidates are UnResolved either way
-        acc.add(sel, is_scalar)
-        if step.expand_maps:
+        if not step.scalar_self:
+            # scalar candidates are UnResolved either way
+            acc.add(sel, is_scalar)
+        if step.scalar_self:
+            # after a variable head: maps AND scalars filter themselves
+            # in their own value scope (scopes.py:390-408 + 708-714 +
+            # 749-757); lists still iterate
+            keep = jnp.where((sel > 0) & ~is_list, sel, 0)
+        elif step.expand_maps:
             # maps expanded to values
             keep = jnp.zeros_like(sel)
         else:
@@ -637,9 +644,27 @@ def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses) -> jnp
     cnt_lhs_not_in = _segment_count(d, lhs_sel, lhs_here & ~m_lhs_in_rhs)
 
     if c.op == CmpOperator.Eq:
-        rl_origin = (rhs_sel[:, None] == lhs_sel[None, :]) & (rhs_sel[:, None] > 0)
-        m_rhs_in_lhs = jnp.any(rl_origin & (lhs_sel[None, :] > 0) & eq, axis=1)
-        cnt_rhs_not_in = _segment_count(d, rhs_sel, rhs_here & ~m_rhs_in_lhs)
+        if c.rhs_query_from_root:
+            # one shared RHS set vs per-origin LHS sets: reverse
+            # membership is per (origin, rhs-node) — a boolean matmul
+            # on the MXU instead of an (N+1, N, N) reduction
+            origins = jnp.arange(d.n + 1, dtype=jnp.int32)
+            lhs_oh = (lhs_sel[None, :] == origins[:, None]) & lhs_here[None, :]
+            eq_f = eq.astype(jnp.float32)
+            rhs_in_lhs = (
+                jnp.matmul(
+                    lhs_oh.astype(jnp.float32), eq_f,
+                    preferred_element_type=jnp.float32,
+                )
+                > 0.0
+            )  # (N+1, N)[o, r]: rhs node r loose_eq some lhs of origin o
+            cnt_rhs_not_in = jnp.sum(
+                rhs_here[None, :] & ~rhs_in_lhs, axis=1, dtype=jnp.int32
+            )
+        else:
+            rl_origin = (rhs_sel[:, None] == lhs_sel[None, :]) & (rhs_sel[:, None] > 0)
+            m_rhs_in_lhs = jnp.any(rl_origin & (lhs_sel[None, :] > 0) & eq, axis=1)
+            cnt_rhs_not_in = _segment_count(d, rhs_sel, rhs_here & ~m_rhs_in_lhs)
         use_lhs_diff = n_lhs > n_rhs
         diff_cnt = jnp.where(use_lhs_diff, cnt_lhs_not_in, cnt_rhs_not_in)
         q_success = diff_cnt == 0
@@ -647,13 +672,26 @@ def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses) -> jnp
             # reverse-diff: rdiff over lhs when diff came from lhs,
             # else over rhs (operators.rs:637-646 + operator_compare)
             diff_lhs = lhs_here & ~m_lhs_in_rhs  # diff membership (lhs case)
-            diff_rhs = rhs_here & ~m_rhs_in_lhs
             ll_origin = (lhs_sel[:, None] == lhs_sel[None, :]) & (lhs_sel[:, None] > 0)
-            rr_origin = (rhs_sel[:, None] == rhs_sel[None, :]) & (rhs_sel[:, None] > 0)
             in_diff_a = jnp.any(ll_origin & diff_lhs[None, :] & eq, axis=1)
-            in_diff_b = jnp.any(rr_origin & diff_rhs[None, :] & eq, axis=1)
             rdiff_a = _segment_count(d, lhs_sel, lhs_here & ~in_diff_a)
-            rdiff_b = _segment_count(d, rhs_sel, rhs_here & ~in_diff_b)
+            if c.rhs_query_from_root:
+                diff_rhs_o = rhs_here[None, :] & ~rhs_in_lhs  # (N+1, N)
+                in_diff_b_o = (
+                    jnp.matmul(
+                        diff_rhs_o.astype(jnp.float32), eq_f,
+                        preferred_element_type=jnp.float32,
+                    )
+                    > 0.0
+                )
+                rdiff_b = jnp.sum(
+                    rhs_here[None, :] & ~in_diff_b_o, axis=1, dtype=jnp.int32
+                )
+            else:
+                diff_rhs = rhs_here & ~m_rhs_in_lhs
+                rr_origin = (rhs_sel[:, None] == rhs_sel[None, :]) & (rhs_sel[:, None] > 0)
+                in_diff_b = jnp.any(rr_origin & diff_rhs[None, :] & eq, axis=1)
+                rdiff_b = _segment_count(d, rhs_sel, rhs_here & ~in_diff_b)
             rdiff_cnt = jnp.where(use_lhs_diff, rdiff_a, rdiff_b)
             q_success = jnp.where(q_success, False, rdiff_cnt == 0)
     else:  # In
@@ -777,9 +815,63 @@ def eval_clause(d: _DocArrays, c: CClause, sel, rule_statuses=None,
     return jnp.where(total == 0, jnp.int8(SKIP), st)
 
 
+def eval_count_clause(d: _DocArrays, c: CCountClause, rule_statuses,
+                      scalar: bool) -> jnp.ndarray:
+    """`%n <op> rhs` for a count() variable (ir.CCountClause): resolve
+    the argument query from the ROOT (the binding basis), count the
+    RESOLVED leaves (fn_count skips UnResolved entries,
+    functions/collections.rs:6-23), and compare. The status is origin-
+    independent — one scalar, broadcast in node mode."""
+    if c.static_status is not None:
+        st = jnp.int8(c.static_status)
+    else:
+        sel_leaf, _ = run_steps(
+            d, c.steps, _sel_root(d), rule_statuses, scalar=True
+        )
+        cnt = jnp.sum(sel_leaf > 0, dtype=jnp.int32)
+        tag = c.cmp[0]
+        if tag == "never":
+            ok = jnp.asarray(False)
+        elif tag == "int":
+            _, v, op, op_not = c.cmp
+            v = jnp.int32(v)
+            if op == CmpOperator.Eq:
+                ok = cnt == v
+            elif op == CmpOperator.Gt:
+                ok = cnt > v
+            elif op == CmpOperator.Ge:
+                ok = cnt >= v
+            elif op == CmpOperator.Lt:
+                ok = cnt < v
+            else:
+                ok = cnt <= v
+            if op_not:
+                ok = ~ok
+        elif tag == "range":
+            _, lo, hi, incl, op_not = c.cmp
+            lo_ok = cnt >= lo if incl & LOWER_INCLUSIVE else cnt > lo
+            hi_ok = cnt <= hi if incl & UPPER_INCLUSIVE else cnt < hi
+            ok = lo_ok & hi_ok
+            if op_not:
+                ok = ~ok
+        else:  # "in" list
+            _, ints, op_not = c.cmp
+            ok = jnp.asarray(False)
+            for v in ints:
+                ok = ok | (cnt == jnp.int32(v))
+            if op_not:
+                ok = ~ok
+        st = jnp.where(ok, jnp.int8(PASS), jnp.int8(FAIL))
+    if scalar:
+        return st
+    return jnp.full((d.n + 1,), st, dtype=jnp.int8)
+
+
 def eval_node(d: _DocArrays, node, sel, rule_statuses, scalar: bool = False) -> jnp.ndarray:
     if isinstance(node, CClause):
         return eval_clause(d, node, sel, rule_statuses, scalar=scalar)
+    if isinstance(node, CCountClause):
+        return eval_count_clause(d, node, rule_statuses, scalar)
     if isinstance(node, CBlockClause):
         return eval_block_clause(d, node, sel, rule_statuses, scalar=scalar)
     if isinstance(node, CWhenBlock):
@@ -790,9 +882,14 @@ def eval_node(d: _DocArrays, node, sel, rule_statuses, scalar: bool = False) -> 
         cond = eval_conjunctions(d, node.conditions, sel, rule_statuses, scalar=scalar)
         return jnp.where(cond == PASS, block, jnp.int8(SKIP))
     if isinstance(node, CNamedRef):
-        st = rule_statuses[node.rule_index]
-        # an unsure dependency makes the referencing rule unsure too
-        d.unsure_acc.append(d.rule_unsure[node.rule_index])
+        # first non-SKIP status among same-named rules, file order
+        # (eval_context.rs:1087-1115); SKIP if every one SKIPs
+        st = rule_statuses[node.rule_indices[0]]
+        d.unsure_acc.append(d.rule_unsure[node.rule_indices[0]])
+        for idx in node.rule_indices[1:]:
+            st = jnp.where(st == SKIP, rule_statuses[idx], st)
+            # an unsure dependency makes the referencing rule unsure too
+            d.unsure_acc.append(d.rule_unsure[idx])
         if node.negation:
             out = jnp.where(st == PASS, jnp.int8(FAIL), jnp.int8(PASS))
         else:

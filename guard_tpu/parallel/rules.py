@@ -29,10 +29,12 @@ from ..ops.encoder import DocBatch
 from ..ops.ir import (
     CBlockClause,
     CClause,
+    CCountClause,
     CNamedRef,
     CompiledRules,
     CWhenBlock,
     StepFilter,
+    StepKeyInterpVar,
     compile_rules_file,
 )
 from .mesh import Mesh, ShardedBatchEvaluator
@@ -43,17 +45,22 @@ def _rule_dependencies(compiled: CompiledRules) -> List[set]:
 
     deps: List[set] = []
 
+    def walk_steps(steps, acc: set) -> None:
+        for s in steps:
+            if isinstance(s, StepFilter):
+                walk_conjs(s.conjunctions, acc)
+            elif isinstance(s, StepKeyInterpVar):
+                walk_steps(s.var_steps, acc)
+
     def walk_node(n, acc: set) -> None:
         if isinstance(n, CNamedRef):
-            acc.add(n.rule_index)
+            acc.update(n.rule_indices)
         elif isinstance(n, CClause):
-            for s in n.steps + (n.rhs_query_steps or []):
-                if isinstance(s, StepFilter):
-                    walk_conjs(s.conjunctions, acc)
+            walk_steps(n.steps + (n.rhs_query_steps or []), acc)
+        elif isinstance(n, CCountClause):
+            walk_steps(n.steps, acc)
         elif isinstance(n, CBlockClause):
-            for s in n.query_steps:
-                if isinstance(s, StepFilter):
-                    walk_conjs(s.conjunctions, acc)
+            walk_steps(n.query_steps, acc)
             walk_conjs(n.inner, acc)
         elif isinstance(n, CWhenBlock):
             if n.conditions is not None:
@@ -114,12 +121,19 @@ def _slice_compiled(compiled: CompiledRules, indices: List[int]) -> CompiledRule
 
     def fix_node(n):
         if isinstance(n, CNamedRef):
-            return CNamedRef(rule_index=remap[n.rule_index], negation=n.negation)
+            return CNamedRef(
+                rule_indices=[remap[i] for i in n.rule_indices],
+                negation=n.negation,
+            )
         if isinstance(n, CClause):
             c = copy.copy(n)
             c.steps = [fix_step(s) for s in n.steps]
             if n.rhs_query_steps is not None:
                 c.rhs_query_steps = [fix_step(s) for s in n.rhs_query_steps]
+            return c
+        if isinstance(n, CCountClause):
+            c = copy.copy(n)
+            c.steps = [fix_step(s) for s in n.steps]
             return c
         if isinstance(n, CBlockClause):
             b = copy.copy(n)
@@ -139,6 +153,10 @@ def _slice_compiled(compiled: CompiledRules, indices: List[int]) -> CompiledRule
             f = copy.copy(s)
             f.conjunctions = fix_conjs(s.conjunctions)
             return f
+        if isinstance(s, StepKeyInterpVar):
+            v = copy.copy(s)
+            v.var_steps = [fix_step(x) for x in s.var_steps]
+            return v
         return s
 
     def fix_conjs(conjs):

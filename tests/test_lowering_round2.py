@@ -1,0 +1,591 @@
+"""Differential tests for the second batch of round-2 lowering
+coverage: filters applied directly after a variable head
+(`%var[ ... ]`, scopes.py:390-408 ValueScope-wraps each resolved value
+so maps AND scalars self-filter while lists iterate), key interpolation
+through rule-body (root-basis) `let`s, interpolation inside value
+scopes, and `count()` function variables compared against numeric
+literals. Every case must lower (no host fallback) and match the CPU
+oracle bit-for-bit."""
+
+import pathlib
+
+import pytest
+
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.core.scopes import RootScope
+from guard_tpu.core.evaluator import eval_rules_file
+from guard_tpu.core.values import from_plain
+from guard_tpu.ops.encoder import Interner, encode_batch
+from guard_tpu.ops.ir import compile_rules_file
+from guard_tpu.ops.kernels import BatchEvaluator
+
+STATUS = {0: "PASS", 1: "FAIL", 2: "SKIP"}
+
+
+def _oracle(rf, doc):
+    from guard_tpu.commands.report import rule_statuses_from_root
+
+    scope = RootScope(rf, doc)
+    eval_rules_file(rf, scope, None)
+    root = scope.reset_recorder().extract()
+    return {n: s.value for n, s in rule_statuses_from_root(root).items()}
+
+
+def _differential(rules_text, docs_plain, expect_host=0, allow_unsure=False):
+    rf = parse_rules_file(rules_text, "cov2.guard")
+    docs = [from_plain(d) for d in docs_plain]
+    batch, interner = encode_batch(docs)
+    compiled = compile_rules_file(rf, interner)
+    assert len(compiled.host_rules) == expect_host, [
+        r.rule_name for r in compiled.host_rules
+    ]
+    if not compiled.rules:
+        return
+    evaluator = BatchEvaluator(compiled)
+    statuses = evaluator(batch)
+    unsure = evaluator.last_unsure
+    for di, doc in enumerate(docs):
+        oracle = _oracle(rf, doc)
+        # device statuses merged by name exactly like the report layer
+        # (report.rule_statuses_from_root): non-SKIP beats SKIP, FAIL
+        # dominates — for unique names this is the identity
+        merged = {}
+        skip_names = set()
+        for ri, crule in enumerate(compiled.rules):
+            if unsure is not None and bool(unsure[di, ri]):
+                assert allow_unsure, "unexpected unsure flag"
+                skip_names.add(crule.name)
+                continue
+            dev = STATUS[int(statuses[di, ri])]
+            prev = merged.get(crule.name)
+            if prev is None or (prev == "SKIP" and dev != "SKIP"):
+                merged[crule.name] = dev
+            elif dev == "FAIL":
+                merged[crule.name] = "FAIL"
+        for name, dev in merged.items():
+            if name in skip_names:
+                continue
+            assert dev == oracle[name], (
+                f"doc {di} ({docs_plain[di]}) rule {name}: "
+                f"device={dev} oracle={oracle[name]}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# filter after a variable head: `%var[ ... ]`
+# ---------------------------------------------------------------------------
+def test_filter_after_var_maps_self_filter():
+    # each var value (a map) filters ITSELF — not its children
+    _differential(
+        """
+let tasks = Resources.*[ Type == 'Task' ]
+let shared = %tasks[ Properties.Arn is_string ]
+
+rule shared_tagged when %shared !empty {
+    %shared.Metadata.Shared exists
+}
+""",
+        [
+            # one task matches the inner filter and has Metadata.Shared
+            {
+                "Resources": {
+                    "a": {
+                        "Type": "Task",
+                        "Properties": {"Arn": "arn:x"},
+                        "Metadata": {"Shared": True},
+                    },
+                    "b": {"Type": "Task", "Properties": {"Arn": {"Ref": "r"}}},
+                }
+            },
+            # matches the filter but lacks Metadata -> FAIL
+            {
+                "Resources": {
+                    "a": {"Type": "Task", "Properties": {"Arn": "arn:x"}}
+                }
+            },
+            # no task passes the filter -> when gate SKIPs
+            {
+                "Resources": {
+                    "a": {"Type": "Task", "Properties": {"Arn": {"Ref": "r"}}}
+                }
+            },
+            # no tasks at all -> SKIP
+            {"Resources": {"x": {"Type": "Other"}}},
+        ],
+    )
+
+
+def test_filter_after_var_trailing_parts_and_nested_filters():
+    _differential(
+        """
+let buckets = Resources.*[ Type == 'Bucket' ]
+
+rule prod_encrypted when %buckets[ Props.Env == 'prod' ] !empty {
+    %buckets[ Props.Env == 'prod' ].Props.Enc == true
+}
+""",
+        [
+            {
+                "Resources": {
+                    "p": {"Type": "Bucket", "Props": {"Env": "prod", "Enc": True}},
+                    "d": {"Type": "Bucket", "Props": {"Env": "dev", "Enc": False}},
+                }
+            },
+            {
+                "Resources": {
+                    "p": {"Type": "Bucket", "Props": {"Env": "prod", "Enc": False}}
+                }
+            },
+            {
+                "Resources": {
+                    "d": {"Type": "Bucket", "Props": {"Env": "dev", "Enc": True}}
+                }
+            },
+        ],
+    )
+
+
+def test_filter_after_var_list_values_iterate():
+    # var values that are LISTS iterate their elements through the
+    # filter (scopes.py:727-747), each element in its own scope
+    _differential(
+        """
+let perms = Resources.*.Ingress
+
+rule only_https when %perms !empty {
+    %perms[ Port == 443 ].Cidr == '0.0.0.0/0'
+}
+""",
+        [
+            {
+                "Resources": {
+                    "sg": {
+                        "Ingress": [
+                            {"Port": 443, "Cidr": "0.0.0.0/0"},
+                            {"Port": 22, "Cidr": "10.0.0.0/8"},
+                        ]
+                    }
+                }
+            },
+            {
+                "Resources": {
+                    "sg": {"Ingress": [{"Port": 443, "Cidr": "10.1.0.0/16"}]}
+                }
+            },
+            # filter selects nothing -> clause SKIPs inside the rule
+            {"Resources": {"sg": {"Ingress": [{"Port": 22, "Cidr": "x"}]}}},
+        ],
+    )
+
+
+def test_filter_after_var_scalar_values_self_filter():
+    # scalar var values evaluate the filter on THEMSELVES
+    # (scopes.py:749-757) instead of UnResolving like `.*[...]` scalars
+    _differential(
+        """
+let names = Resources.*.Name
+
+rule has_prod when %names[ this == 'prod' ] !empty {
+    Resources exists
+}
+""",
+        [
+            {"Resources": {"a": {"Name": "prod"}, "b": {"Name": "dev"}}},
+            {"Resources": {"a": {"Name": "dev"}}},
+        ],
+    )
+
+
+def test_explicit_star_after_var_equals_implicit():
+    # `%var[*][f]` hits the same skip as the implicit form
+    # (scopes.py:399-400): identical statuses
+    _differential(
+        """
+let tasks = Resources.*[ Type == 'T' ]
+
+rule r when %tasks !empty { %tasks[*][ P exists ].P == 1 }
+""",
+        [
+            {"Resources": {"a": {"Type": "T", "P": 1}}},
+            {"Resources": {"a": {"Type": "T", "P": 2}, "b": {"Type": "T"}}},
+        ],
+    )
+
+
+# ---------------------------------------------------------------------------
+# key interpolation: rule-body lets and value scopes
+# ---------------------------------------------------------------------------
+def test_interpolation_rule_body_let():
+    # `let refs = some ...` bound INSIDE the rule body resolves from
+    # the document root (BlockScope root), so it lowers like file lets
+    _differential(
+        """
+rule subnets_are_subnets when Resources exists {
+    let refs = some Resources.*[ Type == 'Assoc' ].SubnetId.Ref
+    Resources.%refs.Type == 'Subnet'
+}
+""",
+        [
+            {
+                "Resources": {
+                    "s1": {"Type": "Subnet"},
+                    "a1": {"Type": "Assoc", "SubnetId": {"Ref": "s1"}},
+                }
+            },
+            {
+                "Resources": {
+                    "s1": {"Type": "Gateway"},
+                    "a1": {"Type": "Assoc", "SubnetId": {"Ref": "s1"}},
+                }
+            },
+            {"Resources": {"x": {"Type": "Other"}}},
+        ],
+    )
+
+
+def test_interpolation_inside_value_scope():
+    # a root-bound query variable interpolated INSIDE a filter: the
+    # variable still resolves from the root basis
+    _differential(
+        """
+let keys = some Settings.Required[*]
+
+rule all_have_required when Resources exists {
+    Resources.*[ Type == 'T' ].Props.%keys exists
+}
+""",
+        [
+            {
+                "Settings": {"Required": ["Enc", "Ver"]},
+                "Resources": {
+                    "a": {"Type": "T", "Props": {"Enc": 1, "Ver": 2}}
+                },
+            },
+            {
+                "Settings": {"Required": ["Enc", "Ver"]},
+                "Resources": {"a": {"Type": "T", "Props": {"Enc": 1}}},
+            },
+        ],
+    )
+
+
+# ---------------------------------------------------------------------------
+# count() function variables
+# ---------------------------------------------------------------------------
+COUNT_DOCS = [
+    {"Resources": {"a": {"P": {"Name": "x"}}, "b": {"P": {"Name": "y"}}}},
+    {"Resources": {"a": {"P": {"Name": "x"}}}},
+    {"Resources": {"a": {"P": {}}, "b": {"P": {"Name": "y"}}}},
+    {"Other": 1},
+]
+
+
+def test_count_eq_and_ordering():
+    _differential(
+        """
+let names = Resources.*.P.Name
+let n = count(%names)
+
+rule has_two when %n == 2 { Resources exists }
+rule has_not_two when %n != 2 { Resources exists }
+rule more_than_one when %n > 1 { Resources exists }
+rule at_most_one when %n <= 1 { Resources exists }
+""",
+        COUNT_DOCS,
+    )
+
+
+def test_count_in_list_and_range():
+    _differential(
+        """
+let names = Resources.*.P.Name
+let n = count(%names)
+
+rule one_or_two when %n in [1, 2] { Resources exists }
+rule not_one_or_two when %n not in [1, 2] { Resources exists }
+rule in_range when %n in r[1, 2] { Resources exists }
+rule eq_range when %n == r(0, 2] { Resources exists }
+rule ne_range when %n != r(0, 2] { Resources exists }
+""",
+        COUNT_DOCS,
+    )
+
+
+def test_count_not_comparable_kinds():
+    # INT vs float/string: NotComparable -> FAIL surviving `not`
+    _differential(
+        """
+let n = count(Resources.*)
+
+rule f1 when %n == 2.0 { Resources exists }
+rule f2 when %n != 2.0 { Resources exists }
+rule f3 when %n > 'a' { Resources exists }
+rule f4 when %n in [1.5, 'x'] { Resources exists }
+""",
+        COUNT_DOCS,
+    )
+
+
+def test_count_unary_ops():
+    _differential(
+        """
+let n = count(Resources.*)
+
+rule e1 when %n exists { Resources exists }
+rule e2 when %n !exists { Resources exists }
+rule e3 when %n empty { Resources exists }
+rule e4 when %n !empty { Resources exists }
+rule e5 when %n is_int { Resources exists }
+rule e6 when %n is_string { Resources exists }
+""",
+        COUNT_DOCS,
+    )
+
+
+def test_count_in_rule_body_and_literal_rhs_var():
+    _differential(
+        """
+let want = 2
+
+rule body_count when Resources exists {
+    let n = count(Resources.*.P.Name)
+    %n == %want
+}
+""",
+        COUNT_DOCS,
+    )
+
+
+def test_count_of_filtered_query():
+    _differential(
+        """
+let n = count(Resources.*[ Type == 'T' ])
+
+rule two_ts when %n >= 2 { Resources exists }
+""",
+        [
+            {"Resources": {"a": {"Type": "T"}, "b": {"Type": "T"}}},
+            {"Resources": {"a": {"Type": "T"}, "b": {"Type": "U"}}},
+            {"Other": 1},
+        ],
+    )
+
+
+# ---------------------------------------------------------------------------
+# previously-host reference examples now lower end to end
+# ---------------------------------------------------------------------------
+REF_EX = pathlib.Path("/root/reference/guard-examples")
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["ecs-taskdef.guard", "dynamodb-table-sse.guard",
+     "redshift-clustersubnetgroup.guard"],
+)
+def test_reference_examples_fully_lower(name):
+    matches = list(REF_EX.rglob(name))
+    if not matches:
+        pytest.skip("reference examples unavailable")
+    rf = parse_rules_file(matches[0].read_text(), name)
+    compiled = compile_rules_file(rf, Interner())
+    assert not compiled.host_rules, [r.rule_name for r in compiled.host_rules]
+
+
+def test_corpus_count_files_fully_lower():
+    corpus = pathlib.Path(__file__).resolve().parent.parent / "corpus" / "rules"
+    files = sorted(corpus.glob("functions_count*.guard"))
+    assert files, "corpus count files missing"
+    for f in files:
+        rf = parse_rules_file(f.read_text(), f.name)
+        compiled = compile_rules_file(rf, Interner())
+        assert not compiled.host_rules, (
+            f.name,
+            [r.rule_name for r in compiled.host_rules],
+        )
+
+
+def test_redshift_example_differential():
+    """The redshift example end to end on synthetic docs (its rule
+    chains two levels of Ref-indirection through rule-body lets)."""
+    matches = list(REF_EX.rglob("redshift-clustersubnetgroup.guard"))
+    if not matches:
+        pytest.skip("reference examples unavailable")
+    rules = matches[0].read_text()
+    docs = [
+        {
+            "Resources": {
+                "subnet": {"Type": "AWS::EC2::Subnet"},
+                "grp": {
+                    "Type": "AWS::Redshift::ClusterSubnetGroup",
+                    "Properties": {"SubnetIds": [{"Ref": "subnet"}]},
+                },
+                "assoc": {
+                    "Type": "AWS::EC2::SubnetRouteTableAssociation",
+                    "Properties": {
+                        "SubnetId": {"Ref": "subnet"},
+                        "RouteTableId": {"Ref": "rt"},
+                    },
+                },
+                "rt": {"Type": "AWS::EC2::RouteTable"},
+                "route": {
+                    "Type": "AWS::EC2::Route",
+                    "Properties": {
+                        "GatewayId": {"Ref": "gw"},
+                        "RouteTableId": {"Ref": "rt"},
+                    },
+                },
+                "gw": {"Type": "AWS::EC2::InternetGateway"},
+            }
+        },
+        {
+            "Resources": {
+                "subnet": {"Type": "AWS::EC2::Subnet"},
+                "grp": {
+                    "Type": "AWS::Redshift::ClusterSubnetGroup",
+                    "Properties": {"SubnetIds": [{"Ref": "subnet"}]},
+                },
+                "assoc": {
+                    "Type": "AWS::EC2::SubnetRouteTableAssociation",
+                    "Properties": {
+                        "SubnetId": {"Ref": "subnet"},
+                        "RouteTableId": {"Ref": "rt"},
+                    },
+                },
+                "rt": {"Type": "AWS::EC2::RouteTable"},
+                "route": {
+                    "Type": "AWS::EC2::Route",
+                    "Properties": {
+                        "GatewayId": {"Ref": "gw"},
+                        "RouteTableId": {"Ref": "rt"},
+                    },
+                },
+                "gw": {"Type": "AWS::EC2::VPNGateway"},
+            }
+        },
+        {"Resources": {"x": {"Type": "Other"}}},
+    ]
+    _differential(rules, docs)
+
+
+# ---------------------------------------------------------------------------
+# duplicate rule names (first-non-SKIP named-ref semantics)
+# ---------------------------------------------------------------------------
+def test_duplicate_rule_names_lower():
+    _differential(
+        """
+rule checks when Resources.A exists { Resources.A == 1 }
+rule checks when Resources.B exists { Resources.B == 2 }
+
+rule uses when checks { Resources exists }
+rule negates when !checks { Resources exists }
+""",
+        [
+            {"Resources": {"A": 1}},           # first PASS
+            {"Resources": {"A": 9}},           # first FAIL
+            {"Resources": {"B": 2}},           # first SKIP, second PASS
+            {"Resources": {"B": 9}},           # first SKIP, second FAIL
+            {"Resources": {"C": 0}},           # both SKIP
+            {"Resources": {"A": 1, "B": 9}},   # PASS then FAIL -> first wins
+        ],
+    )
+
+
+# ---------------------------------------------------------------------------
+# root-bound query RHS combinations
+# ---------------------------------------------------------------------------
+def test_eq_against_root_bound_query_rhs():
+    # per-origin LHS == one shared root-resolved RHS set
+    _differential(
+        """
+let allowed = Settings.Allowed[*]
+
+rule zones_match when Resources exists {
+    Resources.*[ Type == 'T' ].Zones.* == %allowed
+}
+""",
+        [
+            {
+                "Settings": {"Allowed": ["a", "b"]},
+                "Resources": {"x": {"Type": "T", "Zones": {"z1": "a", "z2": "b"}}},
+            },
+            {
+                "Settings": {"Allowed": ["a", "b"]},
+                "Resources": {"x": {"Type": "T", "Zones": {"z1": "a"}}},
+            },
+            {
+                "Settings": {"Allowed": ["a"]},
+                "Resources": {"x": {"Type": "T", "Zones": {"z1": "a", "z2": "c"}}},
+            },
+            {"Settings": {"Allowed": ["a"]}, "Resources": {"x": {"Type": "U"}}},
+        ],
+    )
+
+
+def test_ne_against_root_bound_query_rhs():
+    _differential(
+        """
+let banned = Settings.Banned[*]
+
+rule no_banned when Resources exists {
+    Resources.*[ Type == 'T' ].Zones.* != %banned
+}
+""",
+        [
+            {
+                "Settings": {"Banned": ["x"]},
+                "Resources": {"r": {"Type": "T", "Zones": {"z": "a"}}},
+            },
+            {
+                "Settings": {"Banned": ["a"]},
+                "Resources": {"r": {"Type": "T", "Zones": {"z": "a"}}},
+            },
+            {
+                "Settings": {"Banned": ["a", "b"]},
+                "Resources": {"r": {"Type": "T", "Zones": {"z1": "a", "z2": "c"}}},
+            },
+        ],
+    )
+
+
+def test_both_sides_root_bound_inside_filter():
+    # `%a IN %b` (and ==) inside a value scope with both vars root-bound:
+    # the clause broadcasts from the root
+    _differential(
+        """
+let open_ports = Resources.*.Open[*]
+let allowed_ports = Settings.Allowed[*]
+
+rule gated when Resources exists {
+    Resources.*[ Type == 'SG' ].Props {
+        %open_ports IN %allowed_ports
+        Level exists
+    }
+}
+
+rule gated_eq when Resources exists {
+    Resources.*[ Type == 'SG' ].Props {
+        %open_ports == %allowed_ports
+    }
+}
+""",
+        [
+            {
+                "Settings": {"Allowed": [80, 443]},
+                "Resources": {
+                    "sg": {"Type": "SG", "Open": [80], "Props": {"Level": 1}}
+                },
+            },
+            {
+                "Settings": {"Allowed": [80, 443]},
+                "Resources": {
+                    "sg": {"Type": "SG", "Open": [22], "Props": {"Level": 1}}
+                },
+            },
+            {
+                "Settings": {"Allowed": [80]},
+                "Resources": {
+                    "sg": {"Type": "SG", "Open": [80], "Props": {"Level": 1}}
+                },
+            },
+        ],
+    )
