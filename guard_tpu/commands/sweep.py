@@ -237,6 +237,23 @@ class Sweep:
 
         errors = 0
         for rf in rule_files:
+            from ..ops.fnvars import precompute_fn_values, precomputable_fn_vars
+
+            rf_batch = batch
+            if precomputable_fn_vars(rf.rules):
+                # precomputed function lets: re-encode with per-doc
+                # results before compile (ops/fnvars.py)
+                fn_vars, fn_vals, fn_err = precompute_fn_values(
+                    rf.rules, [df.path_value for df in data_files]
+                )
+                rf_batch, _ = encode_batch(
+                    [df.path_value for df in data_files],
+                    interner,
+                    fn_values=fn_vals,
+                    fn_var_order=fn_vars,
+                )
+                if fn_err:
+                    rf_batch.num_exotic[sorted(fn_err)] = True
             compiled = compile_rules_file(rf.rules, interner)
             unsure = None
             host_docs = set()
@@ -249,12 +266,12 @@ class Sweep:
                         compiled, rule_shards=self.rule_shards
                     )
                     statuses, unsure, host_docs = evaluate_bucketed(
-                        ev, len(compiled.rules), batch
+                        ev, len(compiled.rules), rf_batch
                     )
                 else:
                     evaluator = ShardedBatchEvaluator(compiled)
                     statuses, unsure, host_docs = evaluator.evaluate_bucketed(
-                        batch
+                        rf_batch
                     )
                 for di in range(len(data_files)):
                     if di in host_docs:

@@ -75,6 +75,23 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
     host_docs = set()
 
     for rule_file in rule_files:
+        # rule files with precomputable function lets (ops/fnvars.py)
+        # re-encode the batch with the per-doc function results BEFORE
+        # compile, so result strings are interned under the bit tables
+        from .fnvars import precompute_fn_values, precomputable_fn_vars
+
+        rbatch = batch
+        if precomputable_fn_vars(rule_file.rules):
+            fn_vars, fn_vals, fn_err = precompute_fn_values(
+                rule_file.rules, docs
+            )
+            rbatch, _ = encode_batch(
+                docs, interner, fn_values=fn_vals, fn_var_order=fn_vars
+            )
+            if fn_err:
+                # a function raised on these docs: route them to the
+                # oracle, which reproduces the error path
+                rbatch.num_exotic[sorted(fn_err)] = True
         compiled = compile_rules_file(rule_file.rules, interner)
         n_dev, n_host = len(compiled.rules), len(compiled.host_rules)
         log.info(
@@ -85,7 +102,7 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
         unsure = None
         if compiled.rules:
             evaluator = ShardedBatchEvaluator(compiled)
-            statuses, unsure, host_docs = evaluator.evaluate_bucketed(batch)
+            statuses, unsure, host_docs = evaluator.evaluate_bucketed(rbatch)
 
         for di, data_file in enumerate(data_files):
             rule_statuses = {}

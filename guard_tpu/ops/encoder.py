@@ -145,9 +145,15 @@ class EncodedDoc:
     # document contains a number with no exact device encoding (NaN or
     # an int outside i64): must be evaluated by the CPU oracle
     num_exotic: bool = False
+    # (slot, node index) of each precomputed function-result ROOT
+    # (ops/fnvars.py): orphan subtrees appended after the document,
+    # tagged post-batch with the reserved fn_key_id(slot)
+    fn_roots: list = field(default_factory=list)
 
 
-def encode_document(doc: PV, interner: Interner) -> EncodedDoc:
+def encode_document(
+    doc: PV, interner: Interner, fn_results=None
+) -> EncodedDoc:
     kinds: List[int] = []
     parents: List[int] = []
     scalar_ids: List[int] = []
@@ -225,7 +231,14 @@ def encode_document(doc: PV, interner: Interner) -> EncodedDoc:
         return idx
 
     visit(doc, -1)
+    # precomputed function results: orphan subtrees (parent -1 -> no
+    # traversal step ever reaches them; internal edges are real so
+    # walks INTO the results work normally)
+    fn_roots = []
+    for slot, pv in fn_results or []:
+        fn_roots.append((slot, visit(pv, -1)))
     return EncodedDoc(
+        fn_roots=fn_roots,
         node_kind=np.array(kinds, dtype=np.int32),
         node_parent=np.array(parents, dtype=np.int32),
         scalar_id=np.array(scalar_ids, dtype=np.int32),
@@ -497,15 +510,32 @@ def split_batch_by_size(
 
 
 def encode_batch(docs: List[PV], interner: Optional[Interner] = None,
-                 pad_nodes: Optional[int] = None, pad_edges: Optional[int] = None
+                 pad_nodes: Optional[int] = None, pad_edges: Optional[int] = None,
+                 fn_values=None, fn_var_order=None,
                  ) -> Tuple[DocBatch, Interner]:
     """Encode + pad a list of documents into one batch.
 
     Pads node/edge axes to bucket sizes (multiples of 8) so XLA sees a
     small number of distinct shapes across batches.
+
+    `fn_values` (per-doc {var: [PV]}, ops/fnvars.precompute_fn_values)
+    with `fn_var_order` (the slot order) appends each function result
+    as an orphan subtree and tags its root with the reserved
+    fn_key_id(slot) in the derived node_key_id column.
     """
     interner = interner if interner is not None else Interner()
-    encoded = [encode_document(d, interner) for d in docs]
+    if fn_values is not None and fn_var_order:
+        encoded = []
+        for i, d in enumerate(docs):
+            per = fn_values[i]
+            flat = [
+                (slot, pv)
+                for slot, var in enumerate(fn_var_order)
+                for pv in per.get(var, [])
+            ]
+            encoded.append(encode_document(d, interner, fn_results=flat))
+    else:
+        encoded = [encode_document(d, interner) for d in docs]
     n = pad_nodes or _round_up(max((e.n_nodes for e in encoded), default=1))
     e_max = pad_edges or _round_up(max((e.n_edges for e in encoded), default=1))
     d = len(encoded)
@@ -548,4 +578,14 @@ def encode_batch(docs: List[PV], interner: Optional[Interner] = None,
             [enc.num_exotic for enc in encoded], dtype=bool
         ),
     )
+    # tag function-result roots AFTER the derived-column pass: the ids
+    # live in a reserved negative namespace (ops/fnvars.fn_key_id)
+    # that no interned key or sentinel uses, and carrying them in the
+    # derived column (not the edge arrays) keeps the results out of
+    # struct-id child grouping and parent-kind derivation
+    from .fnvars import fn_key_id
+
+    for i, enc in enumerate(encoded):
+        for slot, idx in enc.fn_roots:
+            batch.node_key_id[i, idx] = fn_key_id(slot)
     return batch, interner

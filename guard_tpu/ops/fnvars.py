@@ -1,0 +1,418 @@
+"""Host-precomputed function variables (SURVEY.md §7 hard-part 7).
+
+The reference's built-in functions (`guard/src/rules/functions/` —
+strings.rs, converters.rs, date_time.rs, collections.rs) are stateful,
+string-producing transforms that cannot run on device. Instead of
+sending every rule that touches one to the CPU oracle, the device path
+PRECOMPUTES each file-level function `let` per document on the host
+(via the same oracle resolution the CPU engine uses,
+eval_context.rs:1286-1472 dispatch) and encodes the resulting values as
+EXTRA ORPHAN SUBTREES in the columnar batch:
+
+  * result nodes are appended after the document's own nodes with
+    `node_parent = -1`, so no traversal step can ever reach them —
+    they are invisible to `.*`, `[*]`, keys filters and `empty`;
+  * each result ROOT is tagged with a reserved negative key id
+    (`fn_key_id(slot)` — a namespace that can never collide with
+    interned map keys, which are >= 0), and a dedicated `StepFnVar`
+    selects exactly those roots;
+  * everything downstream — comparisons, regex bit columns, struct
+    ids, key walks INTO `json_parse` trees — is ordinary kernel
+    machinery, because the results ARE nodes.
+
+Function variables never contain UnResolved entries (resolve_function
+drops None results, scopes.py:343-356), so `StepFnVar` charges no
+UnResolved accounting. Functions that RAISE on a document (e.g.
+`parse_int('abc')`, converters.rs error paths) mark that document
+host-only; the oracle rerun then reproduces the reference's error
+behavior exactly.
+
+Excluded from precompute (rules touching them fall back to the CPU
+oracle):
+  * `count`   — lowered natively as an integer compare (ir.CCountClause);
+  * `now`     — nondeterministic: precomputing at encode time and
+                re-resolving in the oracle rerun could straddle a
+                second boundary and diverge;
+  * `parse_char` — produces CHAR nodes, which documents otherwise
+                never contain; kernel comparability tables assume so.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Set, Tuple
+
+from ..core.errors import GuardError
+from ..core.exprs import (
+    AccessQuery,
+    FunctionExpr,
+    RulesFile,
+    part_is_variable,
+    part_variable,
+)
+from ..core.qresult import RESOLVED
+from ..core.values import CHAR, PV, REGEX
+
+_EXCLUDED = {"count", "now", "parse_char"}
+
+# reserved node_key_id namespace: interned ids are >= 0, list elements
+# -1, root/padding -2 — function slots live at -1000 - slot
+_FN_KEY_BASE = -1000
+
+
+def fn_key_id(slot: int) -> int:
+    return _FN_KEY_BASE - slot
+
+
+def _query_vars(q: AccessQuery) -> Set[str]:
+    out: Set[str] = set()
+    for part in q.query:
+        if part_is_variable(part):
+            out.add(part_variable(part))
+    return out
+
+
+def _expr_refs(fx: FunctionExpr, acc_vars: Set[str], acc_names: Set[str]) -> None:
+    acc_names.add(fx.name)
+    for p in fx.parameters:
+        if isinstance(p, FunctionExpr):
+            _expr_refs(p, acc_vars, acc_names)
+        elif isinstance(p, AccessQuery):
+            acc_vars.update(_query_vars(p))
+
+
+def _fn_lets(rf: RulesFile) -> List[Tuple[int, str, FunctionExpr]]:
+    """Every function `let` with a root binding basis: file-level
+    (rule_idx -1) and rule-BODY lets (rule_idx = index into
+    rf.guard_rules — rule blocks evaluate with the document root as
+    scope basis, eval_context.rs:980-997). Lets inside when-blocks /
+    type blocks / nested blocks are not enumerated (value scopes)."""
+    out: List[Tuple[int, str, FunctionExpr]] = []
+    for let in rf.assignments:
+        if isinstance(let.value, FunctionExpr):
+            out.append((-1, let.var, let.value))
+    for ri, rule in enumerate(rf.guard_rules):
+        for let in rule.block.assignments:
+            if isinstance(let.value, FunctionExpr):
+                out.append((ri, let.var, let.value))
+    return out
+
+
+def _excluded_fn_vars(rf: RulesFile) -> Set[str]:
+    """Function-let NAMES excluded from precompute (conservative,
+    name-level, fixpoint over possibly-forward var references)."""
+    info = []
+    for ri, var, fx in _fn_lets(rf):
+        vars_, names = set(), set()
+        _expr_refs(fx, vars_, names)
+        info.append((var, vars_, names))
+    excluded = {var for var, _, names in info if names & _EXCLUDED}
+    changed = True
+    while changed:
+        changed = False
+        for var, vars_, _ in info:
+            if var not in excluded and vars_ & excluded:
+                excluded.add(var)
+                changed = True
+    return excluded
+
+
+def _encodable_literal(pv: PV) -> bool:
+    """Only value kinds the document encoder models exactly may become
+    synthetic nodes (no REGEX/RANGE/CHAR literals)."""
+    k = pv.kind
+    if k in (REGEX, CHAR) or k in (9, 10, 11):  # RANGE_*
+        return False
+    if k == 7:  # LIST
+        return all(_encodable_literal(e) for e in pv.val)
+    if k == 8:  # MAP
+        return all(_encodable_literal(v) for v in pv.val.values.values())
+    return True
+
+
+def _walk_clauses(conjunctions, fn):
+    from ..core.exprs import (
+        BlockGuardClause,
+        GuardAccessClause,
+        ParameterizedNamedRuleClause,
+        TypeBlock,
+        WhenBlockClause,
+    )
+
+    for disj in conjunctions or []:
+        for c in disj:
+            fn(c)
+            if isinstance(c, BlockGuardClause):
+                _walk_clauses(c.block.conjunctions, fn)
+            elif isinstance(c, WhenBlockClause):
+                _walk_clauses(c.conditions, fn)
+                _walk_clauses(c.block.conjunctions, fn)
+            elif isinstance(c, TypeBlock):
+                _walk_clauses(c.conditions, fn)
+                _walk_clauses(c.block.conjunctions, fn)
+
+
+def _walk_queries(conjunctions, fn):
+    """Call fn(query_parts) for every AccessQuery under the clauses
+    (including filters nested inside queries)."""
+    from ..core.exprs import (
+        BlockGuardClause,
+        GuardAccessClause,
+        ParameterizedNamedRuleClause,
+        QFilter,
+        TypeBlock,
+    )
+
+    def do_parts(parts):
+        fn(parts)
+        for part in parts:
+            if isinstance(part, QFilter):
+                _walk_queries(part.conjunctions, fn)
+
+    def visit(c):
+        if isinstance(c, GuardAccessClause):
+            do_parts(c.access_clause.query.query)
+            if isinstance(c.access_clause.compare_with, AccessQuery):
+                do_parts(c.access_clause.compare_with.query)
+        elif isinstance(c, ParameterizedNamedRuleClause):
+            for p in c.parameters:
+                if isinstance(p, AccessQuery):
+                    do_parts(p.query)
+        elif isinstance(c, BlockGuardClause):
+            do_parts(c.query.query)
+        elif isinstance(c, TypeBlock):
+            do_parts(c.query)  # a plain parts list, not an AccessQuery
+
+    _walk_clauses(conjunctions, visit)
+
+
+def _head_var_names(rf: RulesFile) -> Set[str]:
+    """Variable names used as a query HEAD anywhere in the file."""
+    heads: Set[str] = set()
+
+    def on_query(parts):
+        if parts and part_is_variable(parts[0]):
+            heads.add(part_variable(parts[0]))
+
+    for rule in rf.guard_rules:
+        _walk_queries(rule.conditions, on_query)
+        _walk_queries(rule.block.conjunctions, on_query)
+    for prule in rf.parameterized_rules:
+        _walk_queries(prule.rule.conditions, on_query)
+        _walk_queries(prule.rule.block.conjunctions, on_query)
+    for let in rf.assignments:
+        if isinstance(let.value, AccessQuery):
+            on_query(let.value.query)
+    return heads
+
+
+@dataclass
+class _Slot:
+    key: tuple  # opaque encode-order key
+    kind: str  # 'fn' | 'lit' | 'expr'
+    rule_idx: int  # -1 = file scope
+    var: str = ""  # fn/lit
+    pv: object = None  # lit
+    fx: object = None  # expr (FunctionExpr)
+
+
+@dataclass
+class FnSlots:
+    """Everything the encoder / lowering / precompute agree on."""
+
+    slots: List[_Slot]
+    var_slots: Dict[Tuple[int, str], int]  # function lets
+    lit_slots: Dict[Tuple[int, str], int]  # literal lets used as heads
+    expr_slots: Dict[int, int]  # id(FunctionExpr) -> slot (inline uses)
+    pv_slots: Dict[int, int]  # id(PV) -> slot (literal call arguments)
+
+    @property
+    def keys(self) -> List[tuple]:
+        return [s.key for s in self.slots]
+
+
+def fn_slots(rf: RulesFile) -> FnSlots:
+    """Enumerate every precomputable slot, in deterministic order:
+
+      * function `let`s (file-level and rule-body) — resolved per doc;
+      * literal `let`s whose NAME is used as a query head anywhere
+        (their value becomes a synthetic subtree so `%lit.x` /
+        `%lit == query` walks work) — constant across docs;
+      * inline FunctionExpr uses in TOP-LEVEL rule clauses: clause RHS
+        (`"a,b" == join(%c, ',')`) and parameterized-call arguments
+        (eval.rs:1574-1599 resolves them in the caller's scope) —
+        keyed by expression identity, resolved per doc in the owning
+        rule's scope.
+    """
+    excluded = _excluded_fn_vars(rf)
+    slots: List[_Slot] = []
+    var_slots: Dict[Tuple[int, str], int] = {}
+    lit_slots: Dict[Tuple[int, str], int] = {}
+    expr_slots: Dict[int, int] = {}
+    pv_slots: Dict[int, int] = {}
+
+    def add(slot: _Slot) -> int:
+        slots.append(slot)
+        return len(slots) - 1
+
+    for ri, var, fx in _fn_lets(rf):
+        if var in excluded:
+            continue
+        var_slots[(ri, var)] = add(
+            _Slot(key=("fn", ri, var), kind="fn", rule_idx=ri, var=var)
+        )
+
+    heads = _head_var_names(rf)
+    for let in rf.assignments:
+        if (
+            isinstance(let.value, PV)
+            and let.var in heads
+            and _encodable_literal(let.value)
+        ):
+            lit_slots[(-1, let.var)] = add(
+                _Slot(
+                    key=("lit", -1, let.var), kind="lit", rule_idx=-1,
+                    var=let.var, pv=let.value,
+                )
+            )
+    for ri, rule in enumerate(rf.guard_rules):
+        for let in rule.block.assignments:
+            if (
+                isinstance(let.value, PV)
+                and let.var in heads
+                and _encodable_literal(let.value)
+            ):
+                lit_slots[(ri, let.var)] = add(
+                    _Slot(
+                        key=("lit", ri, let.var), kind="lit", rule_idx=ri,
+                        var=let.var, pv=let.value,
+                    )
+                )
+
+    def usable_expr(fx: FunctionExpr) -> bool:
+        vars_, names = set(), set()
+        _expr_refs(fx, vars_, names)
+        # count is excluded from LET precompute only because lets have
+        # the cheaper native CCountClause path; inline there is none,
+        # and its single-int result encodes exactly
+        return not (names & (_EXCLUDED - {"count"})) and not (
+            vars_ & excluded
+        )
+
+    from ..core.exprs import GuardAccessClause, ParameterizedNamedRuleClause
+
+    for ri, rule in enumerate(rf.guard_rules):
+
+        def on_clause(c, ri=ri):
+            if isinstance(c, GuardAccessClause):
+                cw = c.access_clause.compare_with
+                if isinstance(cw, FunctionExpr) and usable_expr(cw):
+                    expr_slots[id(cw)] = add(
+                        _Slot(
+                            key=("expr", ri, len(expr_slots)), kind="expr",
+                            rule_idx=ri, fx=cw,
+                        )
+                    )
+            elif isinstance(c, ParameterizedNamedRuleClause):
+                for p in c.parameters:
+                    if isinstance(p, FunctionExpr) and usable_expr(p):
+                        expr_slots[id(p)] = add(
+                            _Slot(
+                                key=("expr", ri, len(expr_slots)),
+                                kind="expr", rule_idx=ri, fx=p,
+                            )
+                        )
+                    elif isinstance(p, PV) and _encodable_literal(p):
+                        # literal call argument: the callee may use the
+                        # parameter as a query head
+                        pv_slots[id(p)] = add(
+                            _Slot(
+                                key=("plit", ri, len(pv_slots)),
+                                kind="lit", rule_idx=ri, pv=p,
+                            )
+                        )
+
+        # TOP-LEVEL clauses only: nested scopes resolve against value
+        # scopes the rule-level precompute cannot reproduce
+        for disj in (rule.conditions or []):
+            for c in disj:
+                on_clause(c)
+        for disj in rule.block.conjunctions:
+            for c in disj:
+                on_clause(c)
+
+    return FnSlots(
+        slots=slots, var_slots=var_slots, lit_slots=lit_slots,
+        expr_slots=expr_slots, pv_slots=pv_slots,
+    )
+
+
+def precomputable_fn_vars(rf: RulesFile) -> List[tuple]:
+    """Slot keys in encode order (empty = nothing to precompute)."""
+    return fn_slots(rf).keys
+
+
+def precompute_fn_values(
+    rf: RulesFile, docs: List[PV]
+) -> Tuple[List[tuple], List[Dict[tuple, List[PV]]], Set[int]]:
+    """(slot keys in encode order, per-doc {slot key: [result PVs]},
+    error doc indices).
+
+    Resolution goes through the same RootScope/BlockScope machinery
+    the CPU engine uses, so chained lets (`let b = to_upper(%a)`),
+    references to file- and rule-level query lets, and literal/query
+    arguments behave identically. A document on which any function
+    raises lands in the error set — the caller routes it to the CPU
+    oracle, which reproduces the error through the normal path. (The
+    precompute is eager, so a document whose erroring rule would have
+    been when-gated to SKIP still lands in the error set — it then
+    merely evaluates on the oracle, with identical statuses.)"""
+    layout = fn_slots(rf)
+    keys = layout.keys
+    values: List[Dict[tuple, List[PV]]] = []
+    errors: Set[int] = set()
+    if not layout.slots:
+        return keys, [{} for _ in docs], errors
+    from ..core.scopes import BlockScope, RootScope, resolve_function  # lazy
+
+    for i, doc in enumerate(docs):
+        per: Dict[tuple, List[PV]] = {}
+        root = RootScope(rf, doc)
+        rule_scopes: Dict[int, BlockScope] = {}
+
+        def scope_of(ri: int):
+            if ri < 0:
+                return root
+            s = rule_scopes.get(ri)
+            if s is None:
+                s = BlockScope(rf.guard_rules[ri].block, doc, root)
+                rule_scopes[ri] = s
+            return s
+
+        try:
+            for slot in layout.slots:
+                if slot.kind == "lit":
+                    per[slot.key] = [slot.pv]
+                elif slot.kind == "fn":
+                    per[slot.key] = [
+                        q.value
+                        for q in scope_of(slot.rule_idx).resolve_variable(
+                            slot.var
+                        )
+                        if q.tag == RESOLVED
+                    ]
+                else:  # inline expression
+                    per[slot.key] = [
+                        q.value
+                        for q in resolve_function(
+                            slot.fx.name,
+                            slot.fx.parameters,
+                            scope_of(slot.rule_idx),
+                        )
+                        if q.tag == RESOLVED
+                    ]
+        except GuardError:
+            errors.add(i)
+            per = {}
+        values.append(per)
+    return keys, values, errors

@@ -161,6 +161,18 @@ class StepKeysMatch:
     op_not: bool
 
 
+@dataclass
+class StepFnVar:
+    """Select the precomputed result roots of a function variable
+    (ops/fnvars.py): orphan nodes tagged with the reserved negative
+    key id. Only reachable from the root basis (function lets bind at
+    the root scope), so the selection carries origin label 1. Function
+    variables never hold UnResolved entries (scopes.resolve_function
+    drops None results), so no UnResolved accounting applies."""
+
+    key_id: int
+
+
 Step = Union[
     StepKey,
     StepKeyInterpLit,
@@ -170,6 +182,7 @@ Step = Union[
     StepIndex,
     StepFilter,
     StepKeysMatch,
+    StepFnVar,
 ]
 
 
@@ -327,6 +340,16 @@ class CompiledRules:
     # map / nested-list RHS literals, canonicalized per batch into the
     # batch's struct-id space ('lit_struct' device array)
     struct_literals: List[PV] = field(default_factory=list)
+    # non-empty when a lowered rule reads a precomputed function
+    # variable (StepFnVar): the batch must be encoded with
+    # encode_batch(fn_values=precompute_fn_values(rf, docs),
+    # fn_var_order=this) BEFORE compile (function results intern new
+    # strings the bit tables must cover)
+    fn_vars: List[str] = field(default_factory=list)
+    # ordering comparisons against query RHS need string-vs-string
+    # order between arbitrary document strings: a per-node rank column
+    # over the lexicographically sorted intern table
+    needs_str_rank: bool = False
 
     def device_arrays(self, batch) -> dict:
         """Everything the kernel reads, as a flat dict of (D, ...)
@@ -351,6 +374,17 @@ class CompiledRules:
             out["lit_struct"] = batch.literal_struct_ids(
                 self.struct_literals, self.interner
             )
+        if self.needs_str_rank:
+            strings = self.interner.strings
+            rank = np.zeros(max(len(strings), 1), dtype=np.int32)
+            for r, i in enumerate(sorted(range(len(strings)),
+                                         key=strings.__getitem__)):
+                rank[i] = r
+            ids = batch.scalar_id
+            safe = np.clip(ids, 0, len(rank) - 1)
+            out["str_rank"] = np.where(
+                (ids >= 0) & (ids < len(rank)), rank[safe], -1
+            ).astype(np.int32)
         for i, (table, target) in enumerate(self.bit_tables):
             ids = batch.scalar_id if target == "scalar" else batch.node_key_id
             if len(table) == 0:
@@ -432,6 +466,17 @@ class _RuleLowering:
                     and isinstance(fx.parameters[0], AccessQuery)
                 ):
                     self.var_counts[let.var] = fx.parameters[0]
+        # other function lets / literal query-heads / inline function
+        # expressions: precomputed per document on the host and encoded
+        # as orphan result subtrees (ops/fnvars.py). Slot numbering
+        # MUST match the encoder's (both derive from fn_slots;
+        # count/now/parse_char are excluded there, so count stays on
+        # its native CCountClause path)
+        from .fnvars import fn_slots
+
+        self.fn_layout = fn_slots(rules_file)
+        self.var_functions = self.fn_layout.var_slots
+        self._cur_rule_idx = -1  # set per rule by compile_rules_file
         self.rule_index = {}  # name -> [compiled indices], file order
         self.names_total = {}
         for r in rules_file.guard_rules:
@@ -446,6 +491,7 @@ class _RuleLowering:
         self._scope_counter = 0
         self.needs_struct_ids = False
         self.needs_unsure = False
+        self.needs_str_rank = False
         self.struct_literals: List[PV] = []
 
     def _push_scope(self):
@@ -459,11 +505,54 @@ class _RuleLowering:
         idx = 0
         if parts and part_is_variable(parts[0]):
             var = part_variable(parts[0])
+
+            def fn_var_steps(slot: int) -> List[Step]:
+                # precomputed function variable: select its encoded
+                # result roots. Root-bound like every root-basis let —
+                # inside a value scope the owning clause broadcasts.
+                if self._scope != 0:
+                    raise CrossScopeRootVar(var)
+                from .fnvars import fn_key_id
+
+                steps.append(StepFnVar(key_id=fn_key_id(slot)))
+                j = 1
+                if j < len(parts) and isinstance(parts[j], QAllIndices):
+                    j += 1
+                for i in range(j, len(parts)):
+                    nxt = parts[i + 1] if i + 1 < len(parts) else None
+                    prev = "varhead" if i == j else _prev_class(parts, i)
+                    step = self.lower_part(parts[i], block_vars, prev, nxt)
+                    if step is not None:
+                        steps.append(step)
+                return steps
+
             if var in block_vars:
                 v, tok = block_vars[var]
+                if isinstance(v, FunctionExpr):
+                    key = (self._cur_rule_idx, var)
+                    if tok == 0 and key in self.var_functions:
+                        # rule-body function let (root binding basis)
+                        return fn_var_steps(self.var_functions[key])
+                    raise Unlowerable(
+                        f"function variable {var} outside precompute"
+                    )
+                if isinstance(v, PV) and tok == 0:
+                    # rule-body literal let / literal call argument as
+                    # query head: its value is a synthetic subtree
+                    slot = self.fn_layout.lit_slots.get(
+                        (self._cur_rule_idx, var)
+                    )
+                    if slot is None:
+                        slot = self.fn_layout.pv_slots.get(id(v))
+                    if slot is not None:
+                        return fn_var_steps(slot)
+            elif (-1, var) in self.var_functions:
+                return fn_var_steps(self.var_functions[(-1, var)])
             elif var in self.var_queries:
                 v, tok = self.var_queries[var], 0
             elif var in self.var_literals:
+                if (-1, var) in self.fn_layout.lit_slots:
+                    return fn_var_steps(self.fn_layout.lit_slots[(-1, var)])
                 raise Unlowerable(f"literal variable {var} used as query head")
             else:
                 raise Unlowerable(f"unknown variable {var}")
@@ -556,6 +645,16 @@ class _RuleLowering:
                         s.drop_unres = True
             return StepKeyInterpVar(var_steps=inner)
 
+        def fn_interp(slot: int) -> StepKeyInterpVar:
+            # function-variable interpolation (`Resources.%upper`):
+            # the interp machinery resolves var_steps from the root and
+            # exact-matches each resolved string — selecting the
+            # precomputed result roots composes directly
+            from .fnvars import fn_key_id
+
+            self.needs_unsure = True  # non-string results flag unsure
+            return StepKeyInterpVar(var_steps=[StepFnVar(key_id=fn_key_id(slot))])
+
         # innermost scope first — block lets shadow file-level lets
         # (BlockScope.resolve_variable checks its own scope first)
         if var in (block_vars or {}):
@@ -567,9 +666,15 @@ class _RuleLowering:
             if isinstance(v, AccessQuery) and tok == 0:
                 # rule-body let: binds at the root basis like file lets
                 return query_interp(v, block_vars)
+            if isinstance(v, FunctionExpr) and tok == 0:
+                key = (self._cur_rule_idx, var)
+                if key in self.var_functions:
+                    return fn_interp(self.var_functions[key])
             raise Unlowerable("block-scoped query variable interpolation")
         if var in self.var_literals:
             return lit_step(self.var_literals[var])
+        if (-1, var) in self.var_functions:
+            return fn_interp(self.var_functions[(-1, var)])
         q = self.var_queries.get(var)
         if q is None or not isinstance(q, AccessQuery):
             raise Unlowerable(f"variable {var} not interpolatable")
@@ -1003,13 +1108,40 @@ class _RuleLowering:
                 ):
                     raise Unlowerable("struct items in negated list equality")
             except Unlowerable:
-                # non-literal RHS: a query compared per document in the
-                # same scope as the LHS (eval_guard_access_clause
-                # resolves it with resolver.query)
+                # non-literal RHS: a query (resolved per document in
+                # the same scope as the LHS) or an inline function
+                # call (resolved in the clause's scope,
+                # eval_guard_access_clause -> resolve_function)
+                if isinstance(ac.compare_with, FunctionExpr):
+                    slot = self.fn_layout.expr_slots.get(
+                        id(ac.compare_with)
+                    )
+                    if slot is None:
+                        raise
+                    from .fnvars import fn_key_id
+
+                    rhs_query_steps = [StepFnVar(key_id=fn_key_id(slot))]
+                    rhs_root_basis = True
+                    if not eval_from_root:
+                        rhs_query_from_root = True
+                    if ac.comparator in (CmpOperator.Eq, CmpOperator.In):
+                        self.needs_struct_ids = True
+                    else:
+                        self.needs_str_rank = True
+                    return CClause(
+                        steps=steps,
+                        op=ac.comparator,
+                        op_not=ac.comparator_inverse,
+                        negation=gac.negation,
+                        match_all=ac.query.match_all,
+                        rhs=None,
+                        empty_on_expr=empty_on_expr,
+                        rhs_query_steps=rhs_query_steps,
+                        eval_from_root=eval_from_root,
+                        rhs_query_from_root=rhs_query_from_root,
+                    )
                 if not isinstance(ac.compare_with, AccessQuery):
                     raise
-                if ac.comparator not in (CmpOperator.Eq, CmpOperator.In):
-                    raise Unlowerable("ordering comparison with query RHS")
                 rhs_root_basis = False
                 try:
                     rhs_query_steps = self.lower_query(
@@ -1023,13 +1155,19 @@ class _RuleLowering:
                     if not eval_from_root:
                         # per-origin LHS vs one shared root-resolved
                         # RHS set (kernels handle Eq via per-origin
-                        # reverse membership, In via the shared set)
+                        # reverse membership, In and orderings via the
+                        # shared set)
                         rhs_query_from_root = True
                     # else: the whole clause evaluates once from the
                     # root selection — both sides resolve there with
                     # the same origin label, so the ordinary per-origin
                     # machinery is already exact
-                self.needs_struct_ids = True
+                if ac.comparator in (CmpOperator.Eq, CmpOperator.In):
+                    self.needs_struct_ids = True
+                else:
+                    # ordering: cartesian pair comparison needs the
+                    # string-rank column (operators.rs:146-176)
+                    self.needs_str_rank = True
                 if eval_from_root and not rhs_root_basis:
                     # the RHS resolves per origin inside the value
                     # scope while the LHS broadcasts from the root —
@@ -1156,6 +1294,24 @@ class _RuleLowering:
                     ),
                     self._scope,
                 )
+            elif isinstance(arg, FunctionExpr):
+                # function-call argument: resolved in the CALLER's
+                # scope (eval.rs:1574-1599) — precomputed like an
+                # inline RHS expression when a slot exists. Root-scope
+                # call sites only: StepFnVar selections carry origin
+                # label 1, which is only the caller's origin there.
+                slot = self.fn_layout.expr_slots.get(id(arg))
+                if slot is None or self._scope != 0:
+                    raise Unlowerable("function-call argument in rule call")
+                from .fnvars import fn_key_id
+
+                callee_vars[pname] = (
+                    _PreloweredQuery(
+                        steps=[StepFnVar(key_id=fn_key_id(slot))],
+                        match_all=True,
+                    ),
+                    self._scope,
+                )
             else:
                 raise Unlowerable("function-call argument in rule call")
         rule = prule.rule
@@ -1209,9 +1365,12 @@ def compile_rules_file(rules_file: RulesFile, interner: Interner) -> CompiledRul
     host: List[Rule] = []
     needs_struct = False
     needs_unsure = False
-    for rule in rules_file.guard_rules:
+    needs_rank = False
+    for rule_idx, rule in enumerate(rules_file.guard_rules):
         lowering.needs_struct_ids = False
         lowering.needs_unsure = False
+        lowering.needs_str_rank = False
+        lowering._cur_rule_idx = rule_idx
         mark = len(lowering.struct_literals)
         try:
             cr = lowering.lower_rule(rule)
@@ -1225,6 +1384,7 @@ def compile_rules_file(rules_file: RulesFile, interner: Interner) -> CompiledRul
         compiled.append(cr)
         needs_struct = needs_struct or lowering.needs_struct_ids
         needs_unsure = needs_unsure or lowering.needs_unsure
+        needs_rank = needs_rank or lowering.needs_str_rank
     str_empty_bits = np.array(
         [len(s) == 0 for s in interner.strings], dtype=bool
     )
@@ -1236,8 +1396,12 @@ def compile_rules_file(rules_file: RulesFile, interner: Interner) -> CompiledRul
         needs_struct_ids=needs_struct,
         needs_unsure=needs_unsure or needs_struct,
         struct_literals=lowering.struct_literals,
+        needs_str_rank=needs_rank,
     )
-    _assign_bit_slots(out)
+    if _assign_bit_slots(out):
+        from .fnvars import precomputable_fn_vars
+
+        out.fn_vars = precomputable_fn_vars(rules_file)
     return out
 
 
@@ -1253,6 +1417,7 @@ def _assign_bit_slots(compiled: CompiledRules) -> None:
     Empty clauses."""
     seen = {}
     uses_empty = [False]
+    uses_fn = [False]
 
     def slot(arr: np.ndarray, target: str) -> int:
         k = (id(arr), target)
@@ -1287,6 +1452,8 @@ def _assign_bit_slots(compiled: CompiledRules) -> None:
                 do_conjs(s.conjunctions)
             elif isinstance(s, StepKeyInterpVar):
                 do_steps(s.var_steps)
+            elif isinstance(s, StepFnVar):
+                uses_fn[0] = True
 
     def do_node(n) -> None:
         if isinstance(n, CClause):
@@ -1317,3 +1484,4 @@ def _assign_bit_slots(compiled: CompiledRules) -> None:
         do_conjs(r.conjunctions)
     if uses_empty[0]:
         compiled.str_empty_slot = slot(compiled.str_empty_bits, "scalar")
+    return uses_fn[0]

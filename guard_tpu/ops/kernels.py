@@ -55,6 +55,7 @@ from .ir import (
     StepAllIndices,
     StepAllValues,
     StepFilter,
+    StepFnVar,
     StepIndex,
     StepKey,
     StepKeyInterpLit,
@@ -79,6 +80,7 @@ class _DocArrays:
         self.node_parent_kind = arrays["node_parent_kind"]
         self.struct_id = arrays.get("struct_id")  # only for query-RHS rules
         self.lit_struct = arrays.get("lit_struct")  # (L,) struct-literal ids
+        self.str_rank = arrays.get("str_rank")  # only for ordering-RHS rules
         # host-precomputed per-node bool columns, one per bit-table slot
         self.bits = {
             int(k[4:]): v for k, v in arrays.items() if k.startswith("bits")
@@ -191,6 +193,14 @@ def run_steps(d: _DocArrays, steps: List[Step], sel, rule_statuses=None,
 
 
 def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None):
+    if isinstance(step, StepFnVar):
+        # precomputed function-result roots (ops/fnvars.py): orphan
+        # nodes tagged with the reserved key id. Reached only from the
+        # root basis, so the selection is origin label 1; function
+        # variables never carry UnResolved entries.
+        hit = d.node_key_id == step.key_id
+        return jnp.where(hit, jnp.int32(1), jnp.int32(0))
+
     psel = _parent_select(d, sel)  # label of each node's parent
     if isinstance(step, StepKey):
         kh = jnp.zeros(d.n, bool)
@@ -581,6 +591,98 @@ def _eval_binary_outcomes(d: _DocArrays, c: CClause, sel_leaf):
 # ---------------------------------------------------------------------------
 # clause / block / conjunction evaluation — all per-origin (N+1,) int8
 # ---------------------------------------------------------------------------
+def _flatten_one_level(d: _DocArrays, sel_v: jnp.ndarray) -> jnp.ndarray:
+    """selected()/flattened() (operators.rs:116-144): selected LIST
+    values are replaced by their elements (one level); everything else
+    keeps its label."""
+    psel = _parent_select(d, sel_v)
+    child = jnp.where((d.node_parent_kind == LIST) & (psel > 0), psel, 0)
+    keep = jnp.where((sel_v > 0) & (d.node_kind != LIST), sel_v, 0)
+    return jnp.maximum(child, keep)
+
+
+def _eval_query_rhs_ordering(d: _DocArrays, c: CClause, sel, rule_statuses) -> jnp.ndarray:
+    """Ordering ops (< <= > >=) against a query RHS: CommonOperator's
+    cartesian pair comparison over flattened value sets
+    (operators.rs:146-176 + evaluator._common_operation), with
+    same-kind-only total order (path_value.rs:1048-1070) — INT/FLOAT
+    by the exact (hi, lo) keys, STRING by the host-precomputed rank
+    column, NULLs all equal. The `not` inversion flips comparable
+    pairs; NotComparable pairs stay FAIL."""
+    lhs_sel, lhs_unres = run_steps(d, c.steps, sel, rule_statuses)
+    if c.rhs_query_from_root:
+        rhs_sel, rhs_unres_s = run_steps(
+            d, c.rhs_query_steps, _sel_root(d), rule_statuses, scalar=True
+        )
+        rhs_unres = jnp.full((d.n + 1,), rhs_unres_s, jnp.int32)
+    else:
+        rhs_sel, rhs_unres = run_steps(d, c.rhs_query_steps, sel, rule_statuses)
+    ones = jnp.ones(d.n, bool)
+    n_lhs = _segment_count(d, lhs_sel, ones)
+    if c.rhs_query_from_root:
+        n_rhs = jnp.full(
+            (d.n + 1,), jnp.sum(rhs_sel > 0, dtype=jnp.int32), jnp.int32
+        )
+    else:
+        n_rhs = _segment_count(d, rhs_sel, ones)
+
+    lf = _flatten_one_level(d, lhs_sel)
+    rf = _flatten_one_level(d, rhs_sel)
+    lhs_here = lf > 0
+    rhs_here = rf > 0
+
+    kind = d.node_kind
+    same_kind = kind[:, None] == kind[None, :]
+    orderable = (
+        (kind == INT) | (kind == FLOAT) | (kind == STRING) | (kind == NULL)
+    )
+    comp = same_kind & orderable[:, None]
+    # lt[i, j]: value i < value j, only meaningful on comparable pairs
+    num_lt = (d.num_hi[:, None] < d.num_hi[None, :]) | (
+        (d.num_hi[:, None] == d.num_hi[None, :])
+        & (d.num_lo[:, None] < d.num_lo[None, :])
+    )
+    is_str = kind == STRING
+    str_lt = d.str_rank[:, None] < d.str_rank[None, :]
+    lt = jnp.where(is_str[:, None] & is_str[None, :], str_lt, num_lt)
+    is_null = kind == NULL
+    lt = jnp.where(is_null[:, None] & is_null[None, :], False, lt)
+    gt = lt.T
+    if c.op == CmpOperator.Lt:
+        ok = lt
+    elif c.op == CmpOperator.Le:
+        ok = ~gt
+    elif c.op == CmpOperator.Gt:
+        ok = gt
+    else:
+        ok = ~lt
+    if c.op_not:
+        ok = ~ok
+    if c.rhs_query_from_root:
+        pair = lhs_here[:, None] & rhs_here[None, :]
+    else:
+        pair = (lf[:, None] == rf[None, :]) & lhs_here[:, None] & rhs_here[None, :]
+    success = pair & comp & ok
+    fail = pair & ~(comp & ok)
+    fail_per_i = jnp.any(fail, axis=1)
+    pass_per_i = jnp.any(success, axis=1)
+    cnt_fail = _segment_count(d, lf, fail_per_i)
+    cnt_pass = _segment_count(d, lf, pass_per_i)
+    n_lhs_flat = _segment_count(d, lf, ones)
+
+    any_fail = (
+        (cnt_fail > 0)
+        | (lhs_unres > 0)
+        | ((rhs_unres > 0) & (n_lhs_flat > 0))
+    )
+    if c.match_all:
+        st = jnp.where(any_fail, FAIL, PASS).astype(jnp.int8)
+    else:
+        st = jnp.where(cnt_pass > 0, PASS, FAIL).astype(jnp.int8)
+    skip = ((n_lhs + lhs_unres) == 0) | ((n_rhs + rhs_unres) == 0)
+    return jnp.where(skip, jnp.int8(SKIP), st)
+
+
 def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses) -> jnp.ndarray:
     """LHS query vs RHS query, per origin (operators.rs:552-594 Eq
     `query_in` set-difference; :434-451 In containment; the `not`
@@ -724,7 +826,10 @@ def eval_clause(d: _DocArrays, c: CClause, sel, rule_statuses=None,
         st = eval_clause(d, c, sel_root, rule_statuses, scalar=True)
         return jnp.full((d.n + 1,), st, dtype=jnp.int8)
     if c.rhs_query_steps is not None:
-        st = _eval_query_rhs_clause(d, c, sel, rule_statuses)
+        if c.op in (CmpOperator.Gt, CmpOperator.Ge, CmpOperator.Lt, CmpOperator.Le):
+            st = _eval_query_rhs_ordering(d, c, sel, rule_statuses)
+        else:
+            st = _eval_query_rhs_clause(d, c, sel, rule_statuses)
         return st[1] if scalar else st
     sel_leaf, unres = run_steps(d, c.steps, sel, rule_statuses, scalar=scalar)
     n_res = _agg(d, sel_leaf, jnp.ones(d.n, bool), scalar)

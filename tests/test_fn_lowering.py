@@ -1,0 +1,579 @@
+"""Differential tests for host-precomputed function variables
+(ops/fnvars.py): file-level function `let`s are resolved per document
+on the host and encoded as orphan result subtrees the kernels select
+via StepFnVar. Statuses must match the CPU oracle bit-for-bit;
+`now`/`parse_char` (excluded) must keep their rules on the host."""
+
+import pytest
+
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.core.scopes import RootScope
+from guard_tpu.core.evaluator import eval_rules_file
+from guard_tpu.core.values import from_plain
+from guard_tpu.ops.encoder import encode_batch
+from guard_tpu.ops.fnvars import precompute_fn_values, precomputable_fn_vars
+from guard_tpu.ops.ir import compile_rules_file
+from guard_tpu.ops.kernels import BatchEvaluator
+
+STATUS = {0: "PASS", 1: "FAIL", 2: "SKIP"}
+
+
+def _oracle(rf, doc):
+    from guard_tpu.commands.report import rule_statuses_from_root
+
+    scope = RootScope(rf, doc)
+    eval_rules_file(rf, scope, None)
+    root = scope.reset_recorder().extract()
+    return {n: s.value for n, s in rule_statuses_from_root(root).items()}
+
+
+def _differential(rules_text, docs_plain, expect_host=0, allow_unsure=False):
+    rf = parse_rules_file(rules_text, "fn.guard")
+    docs = [from_plain(d) for d in docs_plain]
+    fn_vars, fn_vals, fn_err = precompute_fn_values(rf, docs)
+    assert not fn_err, "unexpected function errors in differential docs"
+    batch, interner = encode_batch(
+        docs, fn_values=fn_vals, fn_var_order=fn_vars
+    )
+    compiled = compile_rules_file(rf, interner)
+    assert len(compiled.host_rules) == expect_host, [
+        r.rule_name for r in compiled.host_rules
+    ]
+    if not compiled.rules:
+        return
+    evaluator = BatchEvaluator(compiled)
+    statuses = evaluator(batch)
+    unsure = evaluator.last_unsure
+    for di, doc in enumerate(docs):
+        oracle = _oracle(rf, doc)
+        for ri, crule in enumerate(compiled.rules):
+            if unsure is not None and bool(unsure[di, ri]):
+                assert allow_unsure, "unexpected unsure flag"
+                continue
+            dev = STATUS[int(statuses[di, ri])]
+            assert dev == oracle[crule.name], (
+                f"doc {di} ({docs_plain[di]}) rule {crule.name}: "
+                f"device={dev} oracle={oracle[crule.name]}"
+            )
+
+
+DOCS = [
+    {
+        "Resources": {
+            "a": {"Name": "Prod-Logs", "Size": "42", "Flag": "true",
+                  "Blob": '{"x": 1, "y": [2, 3]}',
+                  "When": "2024-01-02T03:04:05Z"},
+            "b": {"Name": "dev-scratch", "Size": "7", "Flag": "false",
+                  "Blob": '{"x": 9}',
+                  "When": "2030-06-01T00:00:00Z"},
+        }
+    },
+    {
+        "Resources": {
+            "a": {"Name": "QA-Box", "Size": "100.5", "Flag": "true",
+                  "Blob": "[1, 2]", "When": "1999-12-31T23:59:59Z"}
+        }
+    },
+    {"Other": 1},
+]
+
+
+def test_to_upper_lower_eq_and_regex():
+    _differential(
+        """
+let upper = to_upper(Resources.*.Name)
+let lower = to_lower(Resources.*.Name)
+
+rule has_prod when Resources exists {
+    some %upper == /PROD/
+}
+rule all_lower_lc when Resources exists {
+    %lower == /^[a-z0-9-]+$/
+}
+rule upper_exact when Resources exists {
+    some %upper == 'PROD-LOGS'
+}
+""",
+        DOCS,
+    )
+
+
+def test_parse_int_float_bool_ordering():
+    _differential(
+        """
+let sizes = parse_float(Resources.*.Size)
+let flags = parse_boolean(Resources.*.Flag)
+
+rule big when Resources exists { some %sizes > 40.0 }
+rule all_small when Resources exists { %sizes < 1000.0 }
+rule any_on when Resources exists { some %flags == true }
+""",
+        DOCS,
+    )
+
+
+def test_join_and_substring():
+    _differential(
+        """
+let names = Resources.*.Name
+let n = count(%names)
+let joined = join(%names, ',')
+let prefix = substring(%names, 0, 3)
+
+rule joined_has_comma when %n >= 2 { %joined == /,/ }
+rule prefix_checks when Resources exists { some %prefix == /^(Pro|dev|QA-)$/ }
+""",
+        # join raises on UnResolved args (IncompatibleError,
+        # strings.rs join) — docs here always resolve Name
+        [DOCS[0], DOCS[1]],
+    )
+
+
+def test_regex_replace_and_url_decode():
+    _differential(
+        """
+let renamed = regex_replace(Resources.*.Name, '^(\\w+)-(\\w+)$', '${2}_${1}')
+
+rule swapped when Resources exists { some %renamed == 'Logs_Prod' }
+""",
+        DOCS,
+    )
+
+
+def test_json_parse_subtree_walk():
+    # json_parse results are TREES: walking into them uses ordinary
+    # key steps over the orphan subtree
+    _differential(
+        """
+let parsed = json_parse(Resources.*.Blob)
+
+rule x_is_one when Resources exists { some %parsed.x == 1 }
+rule y_second when Resources exists { some %parsed.y[1] == 3 }
+""",
+        DOCS,
+    )
+
+
+def test_parse_epoch_range():
+    _differential(
+        """
+let when = parse_epoch(Resources.*.When)
+
+rule before_2026 when Resources exists {
+    some %when < 1767225600
+}
+""",
+        DOCS,
+    )
+
+
+def test_chained_function_lets():
+    _differential(
+        """
+let upper = to_upper(Resources.*.Name)
+let swapped = regex_replace(%upper, '^(\\w+)-(\\w+)$', '$2/$1')
+
+rule chained when Resources exists { some %swapped == 'LOGS/PROD' }
+""",
+        DOCS,
+    )
+
+
+def test_fn_var_inside_filter_broadcasts():
+    _differential(
+        """
+let upper = to_upper(Resources.*.Name)
+
+rule gated when Resources exists {
+    Resources.*[ Size exists ] {
+        some %upper == /PROD/
+        Size exists
+    }
+}
+""",
+        DOCS,
+    )
+
+
+def test_fn_var_as_query_rhs():
+    _differential(
+        """
+let upper = to_upper(Resources.*.Name)
+
+rule in_upper when Resources exists {
+    Resources.*.AllCaps IN %upper
+}
+""",
+        [
+            {"Resources": {"a": {"Name": "prod", "AllCaps": "PROD"}}},
+            {"Resources": {"a": {"Name": "prod", "AllCaps": "DEV"}}},
+        ],
+    )
+
+
+def test_fn_var_interpolation():
+    _differential(
+        """
+let keyname = to_lower(Settings.Key)
+
+rule has_key when Settings exists { Resources.%keyname exists }
+""",
+        [
+            {"Settings": {"Key": "ALPHA"}, "Resources": {"alpha": 1}},
+            {"Settings": {"Key": "BETA"}, "Resources": {"alpha": 1}},
+        ],
+    )
+
+
+def test_fn_var_empty_results():
+    _differential(
+        """
+let upper = to_upper(Resources.*.Missing)
+
+rule any_upper when Resources exists { %upper !empty }
+rule empty_upper when Resources exists { %upper empty }
+""",
+        DOCS,
+    )
+
+
+def test_now_and_parse_char_stay_host():
+    rf = parse_rules_file(
+        """
+let t = now()
+let c = parse_char(Resources.*.Digit)
+
+rule time_ok when Resources exists { %t > 0 }
+rule char_ok when Resources exists { %c exists }
+""",
+        "x.guard",
+    )
+    assert precomputable_fn_vars(rf) == []
+    docs = [from_plain({"Resources": {"a": {"Digit": "5"}}})]
+    batch, interner = encode_batch(docs)
+    compiled = compile_rules_file(rf, interner)
+    assert {r.rule_name for r in compiled.host_rules} == {"time_ok", "char_ok"}
+
+
+def test_excluded_transitively_through_var_refs():
+    rf = parse_rules_file(
+        """
+let t = now()
+let u = to_upper(%t)
+let ok = to_upper(Resources.*.Name)
+
+rule r1 when Resources exists { %u exists }
+rule r2 when Resources exists { %ok exists }
+""",
+        "x.guard",
+    )
+    assert precomputable_fn_vars(rf) == [("fn", -1, "ok")]
+
+
+def test_fn_error_doc_reported():
+    # parse_int on an unparseable string raises on the oracle; the
+    # precompute pass must flag the doc instead of crashing
+    rf = parse_rules_file(
+        """
+let n = parse_int(Resources.*.Size)
+
+rule ok when Resources exists { some %n >= 0 }
+""",
+        "x.guard",
+    )
+    docs = [
+        from_plain({"Resources": {"a": {"Size": "42"}}}),
+        from_plain({"Resources": {"a": {"Size": "not-a-number"}}}),
+    ]
+    fn_vars, fn_vals, fn_err = precompute_fn_values(rf, docs)
+    assert fn_vars == [("fn", -1, "n")]
+    assert fn_err == {1}
+    assert fn_vals[0][("fn", -1, "n")][0].val == 42
+
+
+def test_backend_cli_fn_parity(tmp_path):
+    """End to end through `validate --backend tpu` vs the CPU path."""
+    import subprocess
+    import sys
+
+    rules = tmp_path / "r.guard"
+    rules.write_text(
+        """
+let upper = to_upper(Resources.*.Name)
+
+rule named_prod when Resources exists { some %upper == /PROD/ }
+"""
+    )
+    good = tmp_path / "good.json"
+    good.write_text('{"Resources": {"a": {"Name": "prod-x"}}}')
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"Resources": {"a": {"Name": "dev-x"}}}')
+    rcs = {}
+    for backend in ("tpu", "cpu"):
+        for df in (good, bad):
+            args = [sys.executable, "-m", "guard_tpu.cli", "validate",
+                    "-r", str(rules), "-d", str(df)]
+            if backend == "tpu":
+                args += ["--backend", "tpu"]
+            proc = subprocess.run(args, capture_output=True, text=True,
+                                  timeout=300)
+            rcs[(backend, df.name)] = proc.returncode
+    assert rcs[("tpu", "good.json")] == rcs[("cpu", "good.json")] == 0
+    assert rcs[("tpu", "bad.json")] == rcs[("cpu", "bad.json")] == 19
+
+
+def test_rule_body_function_lets():
+    """Rule-body function lets (the reference's join.guard /
+    converters.guard / string_manipulation.guard fixture shape)."""
+    _differential(
+        """
+let template = Resources.*[ Type == 'Svc' ]
+
+rule SOME_RULE when %template !empty {
+    let collection = %template.Collection.*
+    let res = join(%collection, ",")
+    %res == "a,b,c"
+}
+
+rule CONVERT when %template !empty {
+    let minv = parse_int(%template.Min)
+    %minv == 1
+    let lower = to_lower(%template.Name)
+    %lower == /^svc/
+}
+""",
+        [
+            {
+                "Resources": {
+                    "x": {
+                        "Type": "Svc",
+                        "Collection": {"p": "a", "q": "b", "r": "c"},
+                        "Min": "1",
+                        "Name": "SVC-MAIN",
+                    }
+                }
+            },
+            {
+                "Resources": {
+                    "x": {
+                        "Type": "Svc",
+                        "Collection": {"p": "a"},
+                        "Min": "2",
+                        "Name": "OTHER",
+                    }
+                }
+            },
+            {"Resources": {"y": {"Type": "Other"}}},
+        ],
+    )
+
+
+def test_rule_body_json_parse_block_walk():
+    """The reference's json_parse.guard inner shape: parse a policy
+    string in the rule body and walk the parsed tree with a block."""
+    _differential(
+        """
+let template = Resources.*[ Type == 'Svc' ]
+
+rule SOME_RULE when %template !empty {
+    let policy = %template.PolicyText
+    let res = json_parse(%policy)
+
+    %res !empty
+
+    %res.Statement[*] {
+        Effect == "Deny"
+        Resource == "arn:aws:s3:::s3-test-123/*"
+    }
+}
+""",
+        [
+            {
+                "Resources": {
+                    "x": {
+                        "Type": "Svc",
+                        "PolicyText": '{"Statement": [{"Effect": "Deny", "Resource": "arn:aws:s3:::s3-test-123/*"}]}',
+                    }
+                }
+            },
+            {
+                "Resources": {
+                    "x": {
+                        "Type": "Svc",
+                        "PolicyText": '{"Statement": [{"Effect": "Allow", "Resource": "arn:aws:s3:::s3-test-123/*"}]}',
+                    }
+                }
+            },
+            {"Resources": {"y": {"Type": "Other"}}},
+        ],
+    )
+
+
+def test_rule_body_fn_shadows_file_fn():
+    _differential(
+        """
+let name = to_upper(Settings.A)
+
+rule shadowed when Settings exists {
+    let name = to_upper(Settings.B)
+    some %name == 'BEE'
+}
+rule unshadowed when Settings exists {
+    some %name == 'AYE'
+}
+""",
+        [
+            {"Settings": {"A": "aye", "B": "bee"}},
+            {"Settings": {"A": "bee", "B": "aye"}},
+        ],
+    )
+
+
+def test_inline_fn_rhs_clause():
+    """The reference's join_with_message.guard shape: a function call
+    inline as clause RHS (the LHS string literal parses as a key
+    query, which UnResolves)."""
+    _differential(
+        """
+let template = Resources.*[ Type == 'Svc' ]
+
+rule TEST_COLLECTION when %template !empty {
+    let collection = %template.Collection.*
+    let res = join(%collection, ",")
+    %res == "a,b"
+    "a,b" == join(%collection, ",")
+}
+""",
+        [
+            {"Resources": {"x": {"Type": "Svc", "Collection": {"p": "a", "q": "b"}}}},
+            {"Resources": {"x": {"Type": "Svc", "Collection": {"p": "z"}}}},
+            {"Resources": {"y": {"Type": "Other"}}},
+        ],
+    )
+
+
+def test_literal_map_head_vs_fn_rhs():
+    """The reference's json_parse.guard shape: a literal-map let used
+    as a query head, compared against json_parse results."""
+    _differential(
+        """
+let template = Resources.*[ Type == 'Svc' ]
+
+let expected = {
+    "Principal": "*",
+    "Actions": ["s3*", "ec2*"]
+}
+
+rule SOME_RULE when %template !empty {
+    let policy = %template.Policy
+    let res = json_parse(%policy)
+
+    %expected == json_parse(%policy)
+    %res !empty
+    %res == %expected
+}
+""",
+        [
+            {
+                "Resources": {
+                    "x": {
+                        "Type": "Svc",
+                        "Policy": '{"Principal": "*", "Actions": ["s3*", "ec2*"]}',
+                    }
+                }
+            },
+            {
+                "Resources": {
+                    "x": {
+                        "Type": "Svc",
+                        "Policy": '{"Principal": "admin", "Actions": []}',
+                    }
+                }
+            },
+            {"Resources": {"y": {"Type": "Other"}}},
+        ],
+    )
+
+
+def test_parameterized_call_with_fn_args():
+    """The reference's complex_rules.guard shapes: count() and
+    regex_replace() as parameterized-rule-call arguments."""
+    _differential(
+        """
+rule compare_number_of_buckets(expected) {
+    %expected == 2
+}
+
+rule compare_replaced(replaced, expected) {
+    %replaced == %expected
+}
+
+let buckets = Resources.*[ Type == 'Bucket' ]
+
+rule COMBINED when %buckets !empty {
+    compare_number_of_buckets(count(%buckets))
+}
+
+rule WITH_REGEX when %buckets exists {
+    let arn = %buckets.Arn
+    let expected = "aws/123/us-west-2"
+    compare_replaced(regex_replace(%arn, "^arn:(\\w+):(\\d+):([\\w0-9-]+)$", "${1}/${2}/${3}"), %expected)
+}
+""",
+        [
+            {
+                "Resources": {
+                    "a": {"Type": "Bucket", "Arn": "arn:aws:123:us-west-2"},
+                    "b": {"Type": "Bucket", "Arn": "arn:aws:123:us-west-2"},
+                }
+            },
+            {
+                "Resources": {
+                    "a": {"Type": "Bucket", "Arn": "arn:aws:999:eu-west-1"}
+                }
+            },
+            {"Resources": {"y": {"Type": "Other"}}},
+        ],
+    )
+
+
+def test_literal_head_walk_into_subtree():
+    _differential(
+        """
+let expected = { "a": {"b": [1, 2]} }
+
+rule walk when Resources exists {
+    %expected.a.b[1] == 2
+    %expected.a.b[0] == Resources.First
+}
+""",
+        [
+            {"Resources": {"First": 1}},
+            {"Resources": {"First": 7}},
+        ],
+    )
+
+
+def test_literal_call_arg_as_callee_head():
+    """The reference's failing_complex_rule.guard shape: a string
+    literal passed as a call argument and used as a query head in the
+    callee."""
+    _differential(
+        """
+rule compare_replaced(replaced, expected) {
+    %expected == %replaced
+}
+
+let svcs = Resources.*[ Type == 'Svc' ]
+
+rule CALLS when %svcs exists {
+    let arn = %svcs.Arn
+    compare_replaced(regex_replace(%arn, "^arn:(\\w+):(\\d+)$", "${1}/${2}"), "aws/123")
+}
+""",
+        [
+            {"Resources": {"a": {"Type": "Svc", "Arn": "arn:aws:123"}}},
+            {"Resources": {"a": {"Type": "Svc", "Arn": "arn:aws:999"}}},
+            {"Resources": {"y": {"Type": "Other"}}},
+        ],
+    )

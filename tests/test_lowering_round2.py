@@ -32,9 +32,15 @@ def _oracle(rf, doc):
 
 
 def _differential(rules_text, docs_plain, expect_host=0, allow_unsure=False):
+    from guard_tpu.ops.fnvars import precompute_fn_values
+
     rf = parse_rules_file(rules_text, "cov2.guard")
     docs = [from_plain(d) for d in docs_plain]
-    batch, interner = encode_batch(docs)
+    fn_vars, fn_vals, fn_err = precompute_fn_values(rf, docs)
+    assert not fn_err, "unexpected function errors in differential docs"
+    batch, interner = encode_batch(
+        docs, fn_values=fn_vals, fn_var_order=fn_vars
+    )
     compiled = compile_rules_file(rf, interner)
     assert len(compiled.host_rules) == expect_host, [
         r.rule_name for r in compiled.host_rules
@@ -587,5 +593,94 @@ rule gated_eq when Resources exists {
                     "sg": {"Type": "SG", "Open": [80], "Props": {"Level": 1}}
                 },
             },
+        ],
+    )
+
+
+# ---------------------------------------------------------------------------
+# ordering comparisons against query RHS (CommonOperator cartesian)
+# ---------------------------------------------------------------------------
+def test_ordering_query_rhs_numbers():
+    _differential(
+        """
+rule caps when Resources exists {
+    Resources.*.Used < Resources.*.Limit
+}
+rule caps_some when Resources exists {
+    some Resources.*.Used >= Resources.*.Limit
+}
+""",
+        [
+            {"Resources": {"a": {"Used": 1, "Limit": 10}, "b": {"Used": 2, "Limit": 8}}},
+            {"Resources": {"a": {"Used": 9, "Limit": 5}}},
+            {"Resources": {"a": {"Used": 1}}},           # rhs unresolved
+            {"Resources": {"a": {"Limit": 5}}},          # lhs unresolved
+        ],
+    )
+
+
+def test_ordering_query_rhs_strings_and_mixed():
+    _differential(
+        """
+rule names_ordered when Resources exists {
+    Resources.*.First < Resources.*.Second
+}
+""",
+        [
+            {"Resources": {"a": {"First": "alpha", "Second": "beta"}}},
+            {"Resources": {"a": {"First": "zeta", "Second": "beta"}}},
+            # mixed kinds: NotComparable pairs FAIL
+            {"Resources": {"a": {"First": "alpha", "Second": 3}}},
+            {"Resources": {"a": {"First": 1, "Second": 2}}},
+        ],
+    )
+
+
+def test_ordering_query_rhs_list_flatten():
+    _differential(
+        """
+rule all_below when Resources exists {
+    Resources.*.Vals < Resources.*.Cap
+}
+""",
+        [
+            {"Resources": {"a": {"Vals": [1, 2, 3], "Cap": 10}}},
+            {"Resources": {"a": {"Vals": [1, 20], "Cap": 10}}},
+        ],
+    )
+
+
+def test_parse_epoch_fixture_shape():
+    """The reference's parse_epoch.guard: fn-var < fn-var ordering."""
+    _differential(
+        """
+let asg = Resources.*[ Type == 'ASG' ]
+let updated_at = parse_epoch(%asg.UpdatedAt)
+let limit = parse_epoch("3023-05-24T15:22:56.123Z")
+
+rule CHECK_UPDATED_AT when %asg !empty {
+  %limit < %updated_at
+}
+""",
+        [
+            {"Resources": {"a": {"Type": "ASG", "UpdatedAt": "2024-01-01T00:00:00Z"}}},
+            {"Resources": {"a": {"Type": "ASG", "UpdatedAt": "3024-01-01T00:00:00Z"}}},
+            {"Resources": {"a": {"Type": "Other"}}},
+        ],
+    )
+
+
+def test_ordering_root_bound_rhs_inside_filter():
+    _differential(
+        """
+let cap = Settings.Cap
+
+rule under_cap when Resources exists {
+    Resources.*[ Type == 'T' ].Size < %cap
+}
+""",
+        [
+            {"Settings": {"Cap": 10}, "Resources": {"a": {"Type": "T", "Size": 5}}},
+            {"Settings": {"Cap": 10}, "Resources": {"a": {"Type": "T", "Size": 15}}},
         ],
     )
