@@ -853,7 +853,24 @@ class _RuleLowering:
                 if e.kind in (7, 8):  # nested LIST / MAP element
                     items.append(self._struct_literal(e))
                 else:
-                    items.append(self.lower_rhs(e))
+                    # pass the clause op through: ordering clauses
+                    # compare each flattened item with the ordering op
+                    # (CommonOperator), so string items need lt/le
+                    # tables; Eq/In items compare by equality
+                    items.append(
+                        self.lower_rhs(
+                            e,
+                            op=op
+                            if op
+                            in (
+                                CmpOperator.Gt,
+                                CmpOperator.Ge,
+                                CmpOperator.Lt,
+                                CmpOperator.Le,
+                            )
+                            else None,
+                        )
+                    )
             for it in items:
                 if it.kind not in (
                     "str", "regex", "num", "bool", "null", "range", "never",
@@ -1086,6 +1103,10 @@ class _RuleLowering:
             # origin-independent and broadcasts (kernels.eval_clause)
             steps = self._lower_query_from_root(parts, block_vars)
             eval_from_root = True
+        if ac.comparator == CmpOperator.Empty and not empty_on_expr:
+            # elementwise EMPTY raises on int/float/null values — the
+            # kernel flags such documents unsure (oracle reruns them)
+            self.needs_unsure = True
         rhs = None
         rhs_query_steps = None
         rhs_query_from_root = False
@@ -1439,10 +1460,14 @@ def _assign_bit_slots(compiled: CompiledRules) -> None:
         if rhs.le_bits is not None:
             rhs.le_slot = slot(rhs.le_bits, target)
         if rhs.items:
+            ordering = op in (
+                CmpOperator.Gt, CmpOperator.Ge, CmpOperator.Lt, CmpOperator.Le,
+            )
             for it in rhs.items:
-                # list items always compare by Eq semantics (membership
-                # / elementwise list-literal compare)
-                do_rhs(it, target, CmpOperator.Eq)
+                # Eq/In list items compare by Eq semantics (membership
+                # / elementwise list-literal compare); ordering clauses
+                # compare each flattened item with the ordering op
+                do_rhs(it, target, op if ordering else CmpOperator.Eq)
 
     def do_steps(steps: List[Step]) -> None:
         for s in steps:

@@ -500,31 +500,67 @@ def _eval_binary_outcomes(d: _DocArrays, c: CClause, sel_leaf):
     n_child = d.child_count
 
     if op in (CmpOperator.Gt, CmpOperator.Ge, CmpOperator.Lt, CmpOperator.Le):
-        # CommonOperator flattens list leaves (operators.rs:132-144)
-        match = _compare_scalar(d, rhs, op)
-        n_child_ok = _count_children(d, match)
-        outcome = jnp.where(is_list_leaf, n_child_ok == n_child, match)
-        # map leaves: not comparable -> FAIL
-        outcome = jnp.where(is_map_leaf, False, outcome)
-        return outcome, (sel_leaf > 0)
+        # CommonOperator flattens BOTH sides one level and compares
+        # every (lhs value, rhs value) pair (operators.rs:132-176 +
+        # evaluator._common_operation): list leaves expand to their
+        # elements, a literal-list RHS expands to its items (an empty
+        # literal list means zero pairs — vacuously PASS under
+        # match_all, FAIL under some). NotComparable pairs FAIL.
+        items = rhs.items if rhs.kind == "list" else [rhs]
+        node_all = jnp.ones(d.n, bool)
+        node_any = jnp.zeros(d.n, bool)
+        for item in items:
+            if item.kind == "struct":
+                # compare_values(x, list/map) raises: NotComparable
+                s = jnp.zeros(d.n, bool)
+            else:
+                m_i, c_i = _compare_scalar_full(d, item, op)
+                s = c_i & m_i
+            node_all = node_all & s
+            node_any = node_any | s
+        cnt_all = _count_children(d, node_all)
+        cnt_any = _count_children(d, node_any)
+        outcome_all = jnp.where(is_list_leaf, cnt_all == n_child, node_all)
+        outcome_any = jnp.where(is_list_leaf, cnt_any > 0, node_any)
+        return (outcome_all, outcome_any), (sel_leaf > 0)
 
     if op == CmpOperator.Eq:
         if rhs.kind == "list":
-            # list literal RHS: list leaf -> ordered elementwise compare;
-            # scalar leaf vs len-1 list -> compare against the element
+            # list literal RHS (compare_eq list arm + operators.rs
+            # :512-528 len-1 unwrap): list leaf -> ordered elementwise
+            # compare_eq with SHORT-CIRCUIT NotComparable semantics —
+            # item j only evaluates if items 0..j-1 all matched, and a
+            # NotComparable pair there makes the whole compare raise
+            # (-> FAIL surviving `not`); a False pair just yields
+            # False (comparable, invertible). Scalar leaf vs len-1
+            # list compares against the element; any other leaf shape
+            # is NotComparable.
             items = rhs.items
-            ok_list = d.child_count == len(items)
+            n_items = len(items)
+            len_ok = d.child_count == n_items
+            prefix = len_ok  # all prior items returned True
+            raised = jnp.zeros(d.n, bool)
             for j, item in enumerate(items):
-                m = _compare_scalar(d, item, CmpOperator.Eq)
-                # child at index j must match item j
-                has = _count_children(d, m & (d.node_index == j)) > 0
-                ok_list = ok_list & has
-            outcome = jnp.where(is_list_leaf, ok_list, False)
-            if len(items) == 1:
-                scalar_ok = _compare_scalar(d, items[0], CmpOperator.Eq)
-                outcome = jnp.where(is_scalar_leaf, scalar_ok, outcome)
+                m_j, c_j = _compare_scalar_full(d, item, CmpOperator.Eq)
+                at_j = d.node_index == j
+                has_m = _count_children(d, m_j & at_j) > 0
+                has_c = _count_children(d, c_j & at_j) > 0
+                raised = raised | (prefix & ~has_c)
+                prefix = prefix & has_c & has_m
+            eq_true = prefix
+            comparable_list = ~raised
             if c.op_not:
-                outcome = jnp.where(sel_leaf > 0, ~outcome, outcome)
+                outcome = jnp.where(
+                    is_list_leaf, comparable_list & ~eq_true, False
+                )
+                if n_items == 1:
+                    m1, c1 = _compare_scalar_full(d, items[0], CmpOperator.Eq)
+                    outcome = jnp.where(is_scalar_leaf, c1 & ~m1, outcome)
+            else:
+                outcome = jnp.where(is_list_leaf, eq_true, False)
+                if n_items == 1:
+                    m1 = _compare_scalar(d, items[0], CmpOperator.Eq)
+                    outcome = jnp.where(is_scalar_leaf, m1, outcome)
             return outcome, (sel_leaf > 0)
         # scalar literal RHS: list leaves expand to elements
         match, comparable = _compare_scalar_full(d, rhs, CmpOperator.Eq)
@@ -546,15 +582,17 @@ def _eval_binary_outcomes(d: _DocArrays, c: CClause, sel_leaf):
 
     if op == CmpOperator.In:
         if rhs.kind == "str":
-            # string containment lhs in rhs (operators.rs:218-230);
-            # non-strings are NotComparable -> FAIL either way
+            # string containment lhs in rhs (operators.rs:218-230),
+            # one entry per flattened element; non-strings are
+            # NotComparable -> FAIL either way
             comparable = d.node_kind == STRING
             m = comparable & d.bits[rhs.bits_slot]
             if c.op_not:
                 m = comparable & ~m
             ok_child = _count_children(d, m)
-            outcome = jnp.where(is_list_leaf, ok_child == n_child, m)
-            return outcome, (sel_leaf > 0)
+            outcome_all = jnp.where(is_list_leaf, ok_child == n_child, m)
+            outcome_any = jnp.where(is_list_leaf, ok_child > 0, m)
+            return (outcome_all, outcome_any), (sel_leaf > 0)
         if rhs.kind == "list":
             # membership via loose_eq (never NotComparable): pure
             # inversion under `not` (operators.rs value_in/list_in)
@@ -569,9 +607,15 @@ def _eval_binary_outcomes(d: _DocArrays, c: CClause, sel_leaf):
                 return outcome, (sel_leaf > 0)
             # scalar: in == any match; list leaf: ALL elements in rhs
             # (contained_in, operators.rs:256-321); not_in: NO element
+            # in rhs AND the list is non-empty — an empty lhs list is
+            # a vacuous `in` success, and the inversion of a list_in
+            # success is an unconditional FAIL (operator_compare's
+            # negation arm emits ("fail", list(l.val)) for successes)
             in_child = _count_children(d, m)
             if c.op_not:
-                outcome = jnp.where(is_list_leaf, in_child == 0, ~m)
+                outcome = jnp.where(
+                    is_list_leaf, (in_child == 0) & (n_child > 0), ~m
+                )
             else:
                 outcome = jnp.where(is_list_leaf, in_child == n_child, m)
             return outcome, (sel_leaf > 0)
@@ -872,6 +916,14 @@ def eval_clause(d: _DocArrays, c: CClause, sel, rule_statuses=None,
                 str_is_empty,
             )
             unres_base = True
+            # elementwise EMPTY on int/float/null RAISES on the oracle
+            # (eval.rs:10-30 IncompatibleError): flag the document so
+            # the backend reruns it and reproduces the error path
+            supported = (
+                (kind == STRING) | (kind == LIST) | (kind == MAP)
+                | (kind == BOOL)
+            )
+            d.unsure_acc.append(jnp.any((sel_leaf > 0) & ~supported))
         else:
             target = {
                 CmpOperator.IsString: STRING,

@@ -1,0 +1,183 @@
+"""Systematic (LHS kind x operator x RHS literal kind) differential
+matrix: every combination evaluates on the device kernels AND the CPU
+oracle and must agree bit-for-bit. This densely pins the reference's
+comparison semantics (path_value.rs:1047-1191 typed compares,
+operators.rs EqOperation/InOperation/CommonOperator, the
+NotComparable-survives-`not` rule, and unary op outcomes,
+eval.rs:174-405) across the kernel's exact numeric keys, regex bit
+columns, string ordering tables and struct ids."""
+
+import pytest
+
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.core.scopes import RootScope
+from guard_tpu.core.evaluator import eval_rules_file
+from guard_tpu.core.values import from_plain
+from guard_tpu.ops.encoder import encode_batch
+from guard_tpu.ops.ir import compile_rules_file
+from guard_tpu.ops.kernels import BatchEvaluator
+
+STATUS = {0: "PASS", 1: "FAIL", 2: "SKIP"}
+
+# one document per LHS shape; `missing` exercises UnResolved paths
+LHS_DOCS = {
+    "str": {"Props": {"v": "a"}},
+    "str_empty": {"Props": {"v": ""}},
+    "str_num": {"Props": {"v": "1"}},
+    "int0": {"Props": {"v": 0}},
+    "int1": {"Props": {"v": 1}},
+    "int_big": {"Props": {"v": 16777217}},  # 2^24 + 1: f32 would collide
+    "float": {"Props": {"v": 1.5}},
+    "float_whole": {"Props": {"v": 1.0}},
+    "bool_t": {"Props": {"v": True}},
+    "bool_f": {"Props": {"v": False}},
+    "null": {"Props": {"v": None}},
+    "list_int": {"Props": {"v": [0, 1]}},
+    "list_str": {"Props": {"v": ["a", "b"]}},
+    "list_empty": {"Props": {"v": []}},
+    "map": {"Props": {"v": {"k": 1}}},
+    "map_empty": {"Props": {"v": {}}},
+    "missing": {"Props": {"w": 0}},
+}
+
+RHS_LITERALS = [
+    "'a'",
+    "''",
+    "'1'",
+    "/a/",
+    "/^$/",
+    "0",
+    "1",
+    "16777217",
+    "16777216",  # the f32-colliding neighbor
+    "1.0",
+    "1.5",
+    "true",
+    "false",
+    "null",
+    "r(0,2)",
+    "r[0,1]",
+    "r(0.5, 1.5]",
+    "['a', 'b']",
+    "[0, 1]",
+    "[1]",
+    "[]",
+    "{ 'k': 1 }",
+]
+
+BINARY_OPS = ["==", "!=", ">", ">=", "<", "<=", "in", "not in"]
+UNARY_OPS = [
+    "exists", "!exists", "empty", "!empty", "is_string", "is_list",
+    "is_struct", "is_int", "is_float", "is_bool", "is_null",
+]
+
+
+def _oracle(rf, doc):
+    """Rule statuses, or None when the oracle RAISES for this doc
+    (e.g. elementwise `empty` on an int, eval.rs IncompatibleError) —
+    the kernel must then have flagged the doc unsure so the backend
+    reruns it and reproduces the reference's error path."""
+    from guard_tpu.core.errors import GuardError
+    from guard_tpu.commands.report import rule_statuses_from_root
+
+    scope = RootScope(rf, doc)
+    try:
+        eval_rules_file(rf, scope, None)
+    except GuardError:
+        return None
+    root = scope.reset_recorder().extract()
+    return {n: s.value for n, s in rule_statuses_from_root(root).items()}
+
+
+def _run_matrix(rules_text):
+    rf = parse_rules_file(rules_text, "matrix.guard")
+    docs = [from_plain(d) for d in LHS_DOCS.values()]
+    batch, interner = encode_batch(docs)
+    compiled = compile_rules_file(rf, interner)
+    # documented host fallbacks (struct literals outside plain ==) are
+    # allowed — they evaluate on the oracle by design; everything that
+    # DID lower must agree with it
+    evaluator = BatchEvaluator(compiled)
+    statuses = evaluator(batch)
+    unsure = evaluator.last_unsure
+    mismatches = []
+    for di, (lhs_name, doc_plain) in enumerate(LHS_DOCS.items()):
+        oracle = _oracle(rf, docs[di])
+        if oracle is None:
+            # oracle raises for this doc: the kernel must have flagged
+            # it unsure on some rule (forcing the backend rerun that
+            # surfaces the error)
+            if unsure is None or not bool(unsure[di].any()):
+                mismatches.append(
+                    f"lhs={lhs_name}: oracle raises but no unsure flag"
+                )
+            continue
+        for ri, crule in enumerate(compiled.rules):
+            if unsure is not None and bool(unsure[di, ri]):
+                continue  # oracle-routed by design (e.g. list-in-list)
+            dev = STATUS[int(statuses[di, ri])]
+            if dev != oracle[crule.name]:
+                mismatches.append(
+                    f"lhs={lhs_name} {crule.name}: device={dev} "
+                    f"oracle={oracle[crule.name]}"
+                )
+    assert not mismatches, "\n".join(mismatches[:25])
+
+
+@pytest.mark.parametrize("op", BINARY_OPS)
+def test_binary_matrix(op):
+    rules = []
+    for j, rhs in enumerate(RHS_LITERALS):
+        rules.append(f"rule r{j} when Props exists {{ Props.v {op} {rhs} }}")
+        rules.append(
+            f"rule s{j} when Props exists {{ some Props.v {op} {rhs} }}"
+        )
+    _run_matrix("\n".join(rules))
+
+
+def test_unary_matrix():
+    rules = []
+    for j, op in enumerate(UNARY_OPS):
+        rules.append(f"rule r{j} when Props exists {{ Props.v {op} }}")
+        rules.append(f"rule s{j} when Props exists {{ some Props.v {op} }}")
+        if not op.startswith("!"):
+            rules.append(
+                f"rule n{j} when Props exists {{ not Props.v {op} }}"
+            )
+    _run_matrix("\n".join(rules))
+
+
+def test_query_rhs_matrix():
+    # every binary op against a query RHS resolving to each RHS shape
+    rules = []
+    for j, op in enumerate(BINARY_OPS):
+        rules.append(f"rule q{j} when Props exists {{ Props.v {op} Props.r }}")
+    docs = []
+    names = []
+    for lhs_name, lhs_doc in LHS_DOCS.items():
+        for r in ("a", 1, 1.5, True, None, [0, 1], {"k": 1}):
+            d = {"Props": dict(lhs_doc["Props"])}
+            d["Props"]["r"] = r
+            docs.append(d)
+            names.append(f"{lhs_name}-vs-{r!r}")
+    rf = parse_rules_file("\n".join(rules), "qmatrix.guard")
+    pv_docs = [from_plain(d) for d in docs]
+    batch, interner = encode_batch(pv_docs)
+    compiled = compile_rules_file(rf, interner)
+    assert not compiled.host_rules
+    evaluator = BatchEvaluator(compiled)
+    statuses = evaluator(batch)
+    unsure = evaluator.last_unsure
+    mismatches = []
+    for di, name in enumerate(names):
+        oracle = _oracle(rf, pv_docs[di])
+        for ri, crule in enumerate(compiled.rules):
+            if unsure is not None and bool(unsure[di, ri]):
+                continue
+            dev = STATUS[int(statuses[di, ri])]
+            if dev != oracle[crule.name]:
+                mismatches.append(
+                    f"{name} {crule.name}: device={dev} "
+                    f"oracle={oracle[crule.name]}"
+                )
+    assert not mismatches, "\n".join(mismatches[:25])
