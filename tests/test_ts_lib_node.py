@@ -1,0 +1,42 @@
+"""The npm package ships a runnable CommonJS build (ts_lib/dist/) the
+way the reference ships its generated wasm glue. When node is present
+these tests EXECUTE it end to end against the real engine; without
+node they assert the hand-maintained build stays in sync with the
+TypeScript source."""
+
+import pathlib
+import re
+import shutil
+import subprocess
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+TS = (REPO / "ts_lib" / "index.ts").read_text()
+JS = (REPO / "ts_lib" / "dist" / "index.js").read_text()
+
+
+def test_dist_build_in_sync_with_ts_source():
+    # the CLI argument contract and exit-code protocol must match
+    for token in [
+        '"validate"', '"--structured"', '"-S", "none"', '"-o", "sarif"',
+        "validationFailure: 19", "maxBuffer: 64 * 1024 * 1024",
+    ]:
+        assert token in TS and token in JS, token
+    # every extension the TS walks, the JS walks
+    for ext in re.findall(r'"\.(\w+)"', TS.split("const DATA_EXTENSIONS")[1].split(";")[0]):
+        assert f'".{ext}"' in JS
+    assert "exports.validate" in JS
+    assert (REPO / "ts_lib" / "dist" / "index.d.ts").exists()
+
+
+@pytest.mark.skipif(shutil.which("node") is None, reason="node unavailable")
+def test_smoke_under_node():
+    proc = subprocess.run(
+        ["node", str(REPO / "ts_lib" / "smoke.js")],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "ts_lib smoke OK" in proc.stdout

@@ -1,0 +1,80 @@
+/**
+ * Jest tests for the npm surface (mirrors the reference's ts-lib jest
+ * suite, /root/reference/guard/ts-lib/__tests__). Runs the REAL
+ * engine through the CLI — `python -m guard_tpu.cli` from the repo
+ * root — the same no-engine-mocks policy the reference follows.
+ */
+const fs = require("fs");
+const os = require("os");
+const path = require("path");
+const { validate, EXIT_CODES } = require("../dist/index.js");
+
+const REPO = path.resolve(__dirname, "..", "..");
+// a shim that invokes the in-repo CLI; validate() accepts any cliPath
+const CLI = path.join(os.tmpdir(), `guard-tpu-test-cli-${process.pid}.sh`);
+
+beforeAll(() => {
+  fs.writeFileSync(
+    CLI,
+    `#!/bin/sh\nexec python3 -m guard_tpu.cli "$@"\n`,
+    { mode: 0o755 }
+  );
+  process.env.PYTHONPATH = REPO + (process.env.PYTHONPATH ? ":" + process.env.PYTHONPATH : "");
+});
+
+afterAll(() => {
+  fs.rmSync(CLI, { force: true });
+});
+
+function writeFixtures(dir) {
+  fs.mkdirSync(path.join(dir, "rules"), { recursive: true });
+  fs.mkdirSync(path.join(dir, "data"), { recursive: true });
+  fs.writeFileSync(
+    path.join(dir, "rules", "s3.guard"),
+    "rule bucket_named { Resources.*.Properties.BucketName exists }\n"
+  );
+  fs.writeFileSync(
+    path.join(dir, "data", "good.json"),
+    JSON.stringify({ Resources: { b: { Properties: { BucketName: "x" } } } })
+  );
+  return dir;
+}
+
+test("validate() returns SARIF with real file uris", async () => {
+  const dir = writeFixtures(fs.mkdtempSync(path.join(os.tmpdir(), "gt-")));
+  const log = await validate({
+    rulesPath: path.join(dir, "rules"),
+    dataPath: path.join(dir, "data"),
+    cliPath: CLI,
+  });
+  expect(log.version).toBe("2.1.0");
+  expect(log.runs.length).toBe(1);
+  expect(log.runs[0].tool.driver.name).toBeTruthy();
+  fs.rmSync(dir, { recursive: true, force: true });
+});
+
+test("failing data yields SARIF results (exit 19 is a result)", async () => {
+  const dir = writeFixtures(fs.mkdtempSync(path.join(os.tmpdir(), "gt-")));
+  fs.writeFileSync(
+    path.join(dir, "data", "bad.json"),
+    JSON.stringify({ Resources: { b: { Properties: {} } } })
+  );
+  const log = await validate({
+    rulesPath: path.join(dir, "rules"),
+    dataPath: path.join(dir, "data"),
+    cliPath: CLI,
+  });
+  const texts = log.runs[0].results.map((r) => r.message.text).join("\n");
+  expect(texts).toContain("bucket_named");
+  fs.rmSync(dir, { recursive: true, force: true });
+});
+
+test("missing rules path rejects", async () => {
+  await expect(
+    validate({ rulesPath: "/nonexistent-gt", dataPath: "/tmp", cliPath: CLI })
+  ).rejects.toThrow();
+});
+
+test("exit-code protocol constants match the reference", () => {
+  expect(EXIT_CODES).toEqual({ success: 0, validationFailure: 19, error: 5 });
+});

@@ -1,0 +1,33 @@
+/**
+ * Hand-maintained declarations for dist/index.js (see ../index.ts for
+ * the annotated TypeScript source).
+ */
+export interface ValidateInput {
+  rulesPath: string;
+  dataPath: string;
+  cliPath?: string;
+  tpuBackend?: boolean;
+}
+export interface SarifLog {
+  version: string;
+  $schema: string;
+  runs: Array<{
+    tool: { driver: { name: string; rules?: unknown[] } };
+    results: Array<{
+      ruleId?: string;
+      message: { text: string };
+      locations?: Array<{
+        physicalLocation?: {
+          artifactLocation?: { uri?: string };
+          region?: { startLine?: number; startColumn?: number };
+        };
+      }>;
+    }>;
+  }>;
+}
+export declare function validate(input: ValidateInput): Promise<SarifLog>;
+export declare const EXIT_CODES: {
+  readonly success: 0;
+  readonly validationFailure: 19;
+  readonly error: 5;
+};

@@ -1,0 +1,95 @@
+"use strict";
+/**
+ * guard-tpu npm surface: validate() -> SARIF. Hand-maintained CommonJS
+ * build of ../index.ts (the reference ships its generated dist/ the
+ * same way, /root/reference/guard/ts-lib/guard.js); keep the two in
+ * sync — tests/test_satellites.py checks the exported contract and
+ * tests/test_ts_lib_node.py executes this file under node when
+ * available.
+ */
+Object.defineProperty(exports, "__esModule", { value: true });
+exports.EXIT_CODES = exports.validate = void 0;
+const child_process_1 = require("child_process");
+const fs_1 = require("fs");
+const path = require("path");
+
+const RULE_EXTENSIONS = new Set([".guard", ".ruleset"]);
+const DATA_EXTENSIONS = new Set([".json", ".yaml", ".yml", ".jsn", ".template"]);
+
+async function collectFiles(root, exts) {
+  const st = await fs_1.promises.stat(root);
+  if (st.isFile()) return [root];
+  const out = [];
+  for (const entry of await fs_1.promises.readdir(root, { withFileTypes: true })) {
+    const p = path.join(root, entry.name);
+    if (entry.isDirectory()) {
+      out.push(...(await collectFiles(p, exts)));
+    } else if (exts.has(path.extname(entry.name))) {
+      out.push(p);
+    }
+  }
+  return out.sort();
+}
+
+function runCli(cli, args, stdin) {
+  return new Promise((resolve, reject) => {
+    const child = (0, child_process_1.execFile)(
+      cli,
+      args,
+      { maxBuffer: 64 * 1024 * 1024 },
+      (err, stdout, stderr) => {
+        if (err) {
+          // validate exits 19 on rule failures — a result, not an error
+          if (typeof err.code === "number") {
+            resolve({ code: err.code, stdout: stdout ?? "", stderr: stderr ?? "" });
+            return;
+          }
+          if (err.code === "ENOENT") {
+            reject(new Error(`guard-tpu CLI not found at '${cli}'`));
+            return;
+          }
+          reject(new Error(`guard-tpu CLI failed to run: ${err.message}`));
+          return;
+        }
+        resolve({ code: 0, stdout: stdout ?? "", stderr: stderr ?? "" });
+      }
+    );
+    if (stdin !== undefined && child.stdin) {
+      child.stdin.write(stdin);
+      child.stdin.end();
+    }
+  });
+}
+
+/**
+ * Validate every data file against every rule file; returns the SARIF
+ * log (reference ts-lib formatOutput contract: ruleIds/uris refer to
+ * the real input file names).
+ */
+async function validate(input) {
+  const cli = input.cliPath ?? "guard-tpu";
+  const ruleFiles = await collectFiles(input.rulesPath, RULE_EXTENSIONS);
+  const dataFiles = await collectFiles(input.dataPath, DATA_EXTENSIONS);
+  if (ruleFiles.length === 0) throw new Error(`no rule files under ${input.rulesPath}`);
+  if (dataFiles.length === 0) throw new Error(`no data files under ${input.dataPath}`);
+
+  const args = [
+    "validate",
+    "--structured",
+    "-S", "none",
+    "-o", "sarif",
+    "-r", ...ruleFiles,
+    "-d", ...dataFiles,
+  ];
+  if (input.tpuBackend) args.push("--backend", "tpu");
+
+  const { code, stdout, stderr } = await runCli(cli, args);
+  if (code !== 0 && code !== 19) {
+    throw new Error(`guard-tpu validate failed (exit ${code}): ${stderr}`);
+  }
+  return JSON.parse(stdout);
+}
+exports.validate = validate;
+
+/** Exit-code protocol of the wrapped CLI (reference commands/mod.rs:69-73). */
+exports.EXIT_CODES = { success: 0, validationFailure: 19, error: 5 };

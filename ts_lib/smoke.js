@@ -1,0 +1,70 @@
+/**
+ * Dependency-free smoke test for dist/index.js (plain `node
+ * ts_lib/smoke.js` — no jest needed). Exercises validate() end to end
+ * against the in-repo CLI and asserts the SARIF contract; exits 0 on
+ * success. tests/test_ts_lib_node.py runs this when node is present.
+ */
+const assert = require("assert");
+const fs = require("fs");
+const os = require("os");
+const path = require("path");
+const { validate, EXIT_CODES } = require("./dist/index.js");
+
+const REPO = path.resolve(__dirname, "..");
+
+async function main() {
+  const cli = path.join(os.tmpdir(), `guard-tpu-smoke-${process.pid}.sh`);
+  fs.writeFileSync(cli, `#!/bin/sh\nexec python3 -m guard_tpu.cli "$@"\n`, {
+    mode: 0o755,
+  });
+  process.env.PYTHONPATH =
+    REPO + (process.env.PYTHONPATH ? ":" + process.env.PYTHONPATH : "");
+
+  const dir = fs.mkdtempSync(path.join(os.tmpdir(), "gt-smoke-"));
+  fs.mkdirSync(path.join(dir, "rules"));
+  fs.mkdirSync(path.join(dir, "data"));
+  fs.writeFileSync(
+    path.join(dir, "rules", "s3.guard"),
+    "rule bucket_named { Resources.*.Properties.BucketName exists }\n"
+  );
+  fs.writeFileSync(
+    path.join(dir, "data", "good.json"),
+    JSON.stringify({ Resources: { b: { Properties: { BucketName: "x" } } } })
+  );
+  fs.writeFileSync(
+    path.join(dir, "data", "bad.json"),
+    JSON.stringify({ Resources: { b: { Properties: {} } } })
+  );
+
+  const log = await validate({
+    rulesPath: path.join(dir, "rules"),
+    dataPath: path.join(dir, "data"),
+    cliPath: cli,
+  });
+  assert.strictEqual(log.version, "2.1.0");
+  assert.strictEqual(log.runs.length, 1);
+  const texts = log.runs[0].results.map((r) => r.message.text).join("\n");
+  assert.ok(texts.includes("bucket_named"), "failing rule must appear in SARIF");
+  assert.deepStrictEqual(EXIT_CODES, {
+    success: 0,
+    validationFailure: 19,
+    error: 5,
+  });
+
+  let rejected = false;
+  try {
+    await validate({ rulesPath: "/nonexistent-gt", dataPath: dir, cliPath: cli });
+  } catch (e) {
+    rejected = true;
+  }
+  assert.ok(rejected, "missing rules path must reject");
+
+  fs.rmSync(dir, { recursive: true, force: true });
+  fs.rmSync(cli, { force: true });
+  console.log("ts_lib smoke OK");
+}
+
+main().catch((e) => {
+  console.error(e);
+  process.exit(1);
+});
