@@ -1144,6 +1144,13 @@ class _RuleLowering:
                     rhs_query_steps = [StepFnVar(key_id=fn_key_id(slot))]
                     rhs_root_basis = True
                     if not eval_from_root:
+                        if (
+                            ac.comparator == CmpOperator.Eq
+                            and ac.comparator_inverse
+                        ):
+                            raise Unlowerable(
+                                "negated Eq against function RHS"
+                            )
                         rhs_query_from_root = True
                     if ac.comparator in (CmpOperator.Eq, CmpOperator.In):
                         self.needs_struct_ids = True
@@ -1178,6 +1185,16 @@ class _RuleLowering:
                         # RHS set (kernels handle Eq via per-origin
                         # reverse membership, In and orderings via the
                         # shared set)
+                        if (
+                            ac.comparator == CmpOperator.Eq
+                            and ac.comparator_inverse
+                        ):
+                            # != needs the 4-way diff/reverse-diff
+                            # complement against a per-origin view of
+                            # the shared set — host fallback
+                            raise Unlowerable(
+                                "negated Eq against root-bound query RHS"
+                            )
                         rhs_query_from_root = True
                     # else: the whole clause evaluates once from the
                     # root selection — both sides resolve there with

@@ -815,30 +815,41 @@ def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses) -> jnp
         diff_cnt = jnp.where(use_lhs_diff, cnt_lhs_not_in, cnt_rhs_not_in)
         q_success = diff_cnt == 0
         if c.op_not:
-            # reverse-diff: rdiff over lhs when diff came from lhs,
-            # else over rhs (operators.rs:637-646 + operator_compare)
-            diff_lhs = lhs_here & ~m_lhs_in_rhs  # diff membership (lhs case)
-            ll_origin = (lhs_sel[:, None] == lhs_sel[None, :]) & (lhs_sel[:, None] > 0)
-            in_diff_a = jnp.any(ll_origin & diff_lhs[None, :] & eq, axis=1)
-            rdiff_a = _segment_count(d, lhs_sel, lhs_here & ~in_diff_a)
-            if c.rhs_query_from_root:
-                diff_rhs_o = rhs_here[None, :] & ~rhs_in_lhs  # (N+1, N)
-                in_diff_b_o = (
-                    jnp.matmul(
-                        diff_rhs_o.astype(jnp.float32), eq_f,
-                        preferred_element_type=jnp.float32,
-                    )
-                    > 0.0
-                )
-                rdiff_b = jnp.sum(
-                    rhs_here[None, :] & ~in_diff_b_o, axis=1, dtype=jnp.int32
-                )
-            else:
-                diff_rhs = rhs_here & ~m_rhs_in_lhs
-                rr_origin = (rhs_sel[:, None] == rhs_sel[None, :]) & (rhs_sel[:, None] > 0)
-                in_diff_b = jnp.any(rr_origin & diff_rhs[None, :] & eq, axis=1)
-                rdiff_b = _segment_count(d, rhs_sel, rhs_here & ~in_diff_b)
-            rdiff_cnt = jnp.where(use_lhs_diff, rdiff_a, rdiff_b)
+            # reverse-diff (operator_compare's inversion arm): the
+            # FORWARD diff side is chosen by RESOLVED counts
+            # (use_lhs_diff above, :395), but the REVERSE complement
+            # side is chosen independently by TOTAL entry counts —
+            # `len(rhs) >= len(lhs)` INCLUDING unresolved entries
+            # (evaluator.operator_compare:525) — so all four
+            # (diff side, rdiff side) combinations occur. Build the
+            # per-origin diff membership over BOTH sides, then
+            # complement each side against it.
+            origins = jnp.arange(d.n + 1, dtype=jnp.int32)
+            use_l_at_lhs = jnp.any(
+                (lhs_sel[:, None] == origins[None, :]) & use_lhs_diff[None, :],
+                axis=1,
+            )
+            use_l_at_rhs = jnp.any(
+                (rhs_sel[:, None] == origins[None, :]) & use_lhs_diff[None, :],
+                axis=1,
+            )
+            diff_l = lhs_here & ~m_lhs_in_rhs & use_l_at_lhs
+            diff_r = rhs_here & ~m_rhs_in_lhs & ~use_l_at_rhs
+            # in_diff[x on side S] = x loose_eq some diff member of
+            # x's origin (diff members carry lhs OR rhs labels)
+            def in_diff(side_sel):
+                from_l = (lhs_sel[None, :] == side_sel[:, None]) & diff_l[None, :]
+                from_r = (rhs_sel[None, :] == side_sel[:, None]) & diff_r[None, :]
+                return jnp.any((from_l | from_r) & eq, axis=1)
+
+            rdiff_a = _segment_count(
+                d, lhs_sel, lhs_here & ~in_diff(lhs_sel)
+            )
+            rdiff_b = _segment_count(
+                d, rhs_sel, rhs_here & ~in_diff(rhs_sel)
+            )
+            use_rhs_rdiff = rhs_total >= lhs_total
+            rdiff_cnt = jnp.where(use_rhs_rdiff, rdiff_b, rdiff_a)
             q_success = jnp.where(q_success, False, rdiff_cnt == 0)
     else:  # In
         q_success = cnt_lhs_not_in == 0
@@ -855,7 +866,12 @@ def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses) -> jnp
     if c.match_all:
         st = jnp.where(entry_fail | ~q_success, FAIL, PASS).astype(jnp.int8)
     else:
-        st = jnp.where(q_success, PASS, FAIL).astype(jnp.int8)
+        # `some` needs at least one PASS *entry*: a query_in success
+        # records one pass per resolved lhs value
+        # (binary_operation's success handler iterates compare[2]), so
+        # a vacuous containment with ZERO resolved lhs values emits no
+        # passes and FAILs
+        st = jnp.where(q_success & (n_lhs > 0), PASS, FAIL).astype(jnp.int8)
     skip = (lhs_total == 0) | (rhs_total == 0)
     return jnp.where(skip, jnp.int8(SKIP), st)
 
