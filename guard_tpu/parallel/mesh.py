@@ -34,11 +34,32 @@ from ..ops.ir import CompiledRules
 from ..ops.kernels import build_doc_evaluator
 
 DOC_AXIS = "docs"
+DCN_AXIS = "dcn"  # cross-slice / cross-host axis
+ICI_AXIS = "ici"  # intra-slice axis
 
 
 def default_mesh(devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     return Mesh(np.array(devices), (DOC_AXIS,))
+
+
+def hierarchical_mesh(devices=None, n_slices: int = 1) -> Mesh:
+    """2-D (dcn, ici) mesh for multi-slice / multi-host topologies:
+    the document axis shards over BOTH axes (the batch splits first
+    across slices over DCN, then across each slice's chips over ICI).
+    Policy evaluation has no inter-document communication, so the only
+    cross-slice traffic is the final pass/fail count psum — exactly
+    the DCN-friendly layout the scaling model prescribes for
+    embarrassingly data-parallel work. Run under `jax.distributed` on
+    real multi-host topologies; on a single host this still validates
+    the sharding layout end to end."""
+    devices = list(devices) if devices is not None else jax.devices()
+    if len(devices) % n_slices:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {n_slices} slices"
+        )
+    arr = np.array(devices).reshape(n_slices, len(devices) // n_slices)
+    return Mesh(arr, (DCN_AXIS, ICI_AXIS))
 
 
 def pad_to_multiple(batch_arrays: Dict[str, np.ndarray], multiple: int) -> Tuple[Dict[str, np.ndarray], int]:
@@ -67,9 +88,12 @@ class ShardedBatchEvaluator:
         self._with_unsure = compiled.needs_unsure
         doc_eval = build_doc_evaluator(compiled, with_unsure=self._with_unsure)
         # every input array is doc-major: one sharding as a pytree
-        # prefix covers the whole arrays dict
-        in_spec = NamedSharding(self.mesh, P(DOC_AXIS))
-        out_spec = NamedSharding(self.mesh, P(DOC_AXIS))
+        # prefix covers the whole arrays dict. The doc axis shards
+        # over EVERY mesh axis, so the same evaluator runs on a flat
+        # 1-D mesh or a hierarchical (dcn, ici) multi-slice mesh.
+        doc_spec = P(tuple(self.mesh.axis_names))
+        in_spec = NamedSharding(self.mesh, doc_spec)
+        out_spec = NamedSharding(self.mesh, doc_spec)
         self._fn = jax.jit(
             jax.vmap(doc_eval),
             in_shardings=(in_spec,),

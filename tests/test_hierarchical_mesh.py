@@ -1,0 +1,78 @@
+"""Multi-slice (DCN x ICI) sharding: the hierarchical 2-D mesh must
+produce byte-identical statuses and summary counts to the flat 1-D
+mesh — the doc axis shards over both axes and the only cross-slice
+communication is the final count reduction (SURVEY.md §2.3)."""
+
+import jax
+import numpy as np
+import pytest
+
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.core.values import from_plain
+from guard_tpu.ops.encoder import encode_batch
+from guard_tpu.ops.ir import compile_rules_file
+from guard_tpu.parallel.mesh import (
+    ShardedBatchEvaluator,
+    default_mesh,
+    hierarchical_mesh,
+)
+
+RULES = """
+let buckets = Resources.*[ Type == 'Bucket' ]
+
+rule named when %buckets !empty { %buckets.Name exists }
+rule sized when %buckets !empty { %buckets.Size IN r[1, 100] }
+"""
+
+
+def _batch(n=24):
+    docs = []
+    for i in range(n):
+        docs.append(
+            from_plain(
+                {
+                    "Resources": {
+                        "b": {
+                            "Type": "Bucket" if i % 3 else "Other",
+                            "Name": f"b{i}" if i % 2 else None,
+                            "Size": (i % 120) + 1,
+                        }
+                    }
+                }
+            )
+        )
+    return encode_batch(docs)
+
+
+@pytest.mark.parametrize("n_slices", [2, 4])
+def test_hierarchical_matches_flat(n_slices):
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device test mesh")
+    batch, interner = _batch()
+    rf = parse_rules_file(RULES, "mesh.guard")
+    compiled = compile_rules_file(rf, interner)
+    assert not compiled.host_rules
+
+    flat = ShardedBatchEvaluator(compiled, mesh=default_mesh(devices[:8]))
+    hier = ShardedBatchEvaluator(
+        compiled, mesh=hierarchical_mesh(devices[:8], n_slices=n_slices)
+    )
+    st_flat, counts_flat = flat.with_summary(batch)
+    st_hier, counts_hier = hier.with_summary(batch)
+    np.testing.assert_array_equal(st_flat, st_hier)
+    np.testing.assert_array_equal(counts_flat, counts_hier)
+
+    # the plain evaluator path shards identically
+    np.testing.assert_array_equal(flat(batch), hier(batch))
+
+
+def test_hierarchical_mesh_shape_validation():
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device test mesh")
+    with pytest.raises(ValueError):
+        hierarchical_mesh(devices[:8], n_slices=3)
+    m = hierarchical_mesh(devices[:8], n_slices=2)
+    assert m.devices.shape == (2, 4)
+    assert m.axis_names == ("dcn", "ici")
