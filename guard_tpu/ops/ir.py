@@ -109,9 +109,18 @@ class StepKeyInterpVar:
     lookup per selected map (one UnResolved per missing (map, key)
     pair). Non-string key values raise on the oracle
     (scopes._retrieve_key:621-631) — the kernel flags the document
-    unsure instead."""
+    unsure instead.
+
+    `index`: `.%var[k]` picks the k-th entry of the variable's result
+    list BEFORE key matching — and the reference then ALSO walks the
+    `[k]` part into the resolved value (eval_context.rs:421-526 does
+    not consume the index), so the lowering keeps the following
+    StepIndex too. Out-of-bounds k UnResolves every candidate. Entry
+    order with UnResolved entries present is not representable on
+    device, so those documents flag unsure."""
 
     var_steps: List["Step"]
+    index: Optional[int] = None
 
 
 @dataclass
@@ -604,10 +613,13 @@ class _RuleLowering:
     def _lower_key_interpolation(self, part, block_vars, nxt) -> Step:
         """`.%var` mid-query (scopes._retrieve_key:545-632)."""
         # following-part restrictions: QIndex picks the k-th variable
-        # value; anything except QKey/[*]/end raises on the oracle
+        # ENTRY (and then still walks into the value, see
+        # StepKeyInterpVar.index); anything except QKey/[*]/QIndex/end
+        # raises on the oracle
+        interp_index = None
         if isinstance(nxt, QIndex):
-            raise Unlowerable("indexed variable key interpolation")
-        if nxt is not None and not isinstance(nxt, (QKey, QAllIndices)):
+            interp_index = abs(nxt.index)
+        elif nxt is not None and not isinstance(nxt, (QKey, QAllIndices)):
             raise Unlowerable("unsupported part after key interpolation")
         var = part_variable(part)
 
@@ -619,6 +631,12 @@ class _RuleLowering:
                     # non-string keys raise NotComparable on the oracle
                     raise Unlowerable("non-string literal key interpolation")
                 ids.append(self.interner.lookup(v.val))
+            if interp_index is not None and interp_index > 0:
+                # a literal var is ONE entry in the result list
+                # (the whole list literal), so any index but 0 is out
+                # of bounds: every candidate map UnResolves — the
+                # never-matching key id reproduces exactly that
+                return StepKeyInterpLit(key_ids=[-99])
             return StepKeyInterpLit(key_ids=[i if i >= 0 else -99 for i in ids])
 
         def query_interp(q: AccessQuery, q_vars) -> StepKeyInterpVar:
@@ -643,7 +661,7 @@ class _RuleLowering:
                 for s in inner:
                     if isinstance(s, StepKey):
                         s.drop_unres = True
-            return StepKeyInterpVar(var_steps=inner)
+            return StepKeyInterpVar(var_steps=inner, index=interp_index)
 
         def fn_interp(slot: int) -> StepKeyInterpVar:
             # function-variable interpolation (`Resources.%upper`):
@@ -653,7 +671,10 @@ class _RuleLowering:
             from .fnvars import fn_key_id
 
             self.needs_unsure = True  # non-string results flag unsure
-            return StepKeyInterpVar(var_steps=[StepFnVar(key_id=fn_key_id(slot))])
+            return StepKeyInterpVar(
+                var_steps=[StepFnVar(key_id=fn_key_id(slot))],
+                index=interp_index,
+            )
 
         # innermost scope first — block lets shadow file-level lets
         # (BlockScope.resolve_variable checks its own scope first)

@@ -237,6 +237,24 @@ def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None)
         var_sel, var_unres = run_steps(
             d, step.var_steps, sel_root, rule_statuses, scalar=True
         )
+        if step.index is not None:
+            # `.%var[k]`: pick the k-th entry of the result list
+            # (eval_context.rs:421-526). Resolved entries appear in
+            # node (= walk) order; with UnResolved entries present the
+            # entry order is ambiguous on device — flag unsure.
+            d.unsure_acc.append(var_unres > 0)
+            rank = jnp.cumsum((var_sel > 0).astype(jnp.int32))
+            kth = (var_sel > 0) & (rank == step.index + 1)
+            oob = jnp.int32(step.index) >= (
+                jnp.sum(var_sel > 0, dtype=jnp.int32) + var_unres
+            )
+            # out of bounds: one UnResolved per MAP candidate (the
+            # non-map check precedes interpolation and charges its
+            # own); in bounds, only the k-th entry participates (kth
+            # is empty when oob)
+            acc.add(sel, (sel > 0) & (d.node_kind == MAP) & oob)
+            var_sel = jnp.where(kth, var_sel, 0)
+            var_unres = jnp.int32(0)
         direct = var_sel > 0
         is_list = d.node_kind == LIST
         pvar = _parent_select(d, var_sel)
@@ -775,12 +793,14 @@ def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses) -> jnp
         contained = eq | (
             (~is_list)[:, None] & is_list[None, :] & in_list
         )
-        # l LIST in r LIST uses unordered-membership recursion the
-        # kernel does not model (unless identical): flag unsure
+        # l LIST in r LIST is mode-dependent (operators.rs:256-321):
+        # subset-of-elements normally, but MEMBERSHIP-among-elements
+        # when the rhs is a list of lists — identity does NOT imply
+        # containment there — and both recurse through loose_eq. The
+        # kernel does not model either; flag every list-vs-list pair
+        # unsure so the oracle decides.
         pair = same_origin & (rhs_sel[None, :] > 0)
-        unsure = jnp.any(
-            pair & is_list[:, None] & is_list[None, :] & ~eq
-        )
+        unsure = jnp.any(pair & is_list[:, None] & is_list[None, :])
         d.unsure_acc.append(unsure)
 
     # member tests within each origin

@@ -1,0 +1,306 @@
+"""Fourth batch of evaluation-semantics cases re-expressed from the
+reference's pinned suite (`/root/reference/guard/src/rules/
+eval_tests.rs` — variable_projections:1205, query_cross_joins:1339,
+cross_rule_clause_when_checks:1454, block_evaluation:1119,
+block_evaluation_fail:1158). Each case runs on BOTH the CPU oracle and
+the device kernels: statuses must match the reference's pinned
+expectations and each other."""
+
+import pytest
+
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.core.scopes import RootScope
+from guard_tpu.core.evaluator import eval_rules_file
+from guard_tpu.core.loader import load_document
+from guard_tpu.ops.encoder import encode_batch
+from guard_tpu.ops.fnvars import precompute_fn_values
+from guard_tpu.ops.ir import compile_rules_file
+from guard_tpu.ops.kernels import BatchEvaluator
+
+STATUS = {0: "PASS", 1: "FAIL", 2: "SKIP"}
+
+
+def _both_engines(rules_text, yaml_doc):
+    """{rule: status} from the oracle, asserted equal to the kernels
+    for every lowered rule."""
+    from guard_tpu.commands.report import rule_statuses_from_root
+
+    rf = parse_rules_file(rules_text, "ported4.guard")
+    doc = load_document(yaml_doc, "doc.yaml")
+    scope = RootScope(rf, doc)
+    overall = eval_rules_file(rf, scope, None)
+    root = scope.reset_recorder().extract()
+    oracle = {n: s.value for n, s in rule_statuses_from_root(root).items()}
+
+    fn_vars, fn_vals, fn_err = precompute_fn_values(rf, [doc])
+    assert not fn_err
+    batch, interner = encode_batch([doc], fn_values=fn_vals, fn_var_order=fn_vars)
+    compiled = compile_rules_file(rf, interner)
+    evaluator = BatchEvaluator(compiled)
+    statuses = evaluator(batch)
+    unsure = evaluator.last_unsure
+    for ri, crule in enumerate(compiled.rules):
+        if unsure is not None and bool(unsure[0, ri]):
+            continue
+        assert STATUS[int(statuses[0, ri])] == oracle[crule.name], crule.name
+    return overall.value, oracle
+
+
+PROJECTION_DOC_PASS = """
+Resources:
+  s3_bucket:
+    Type: AWS::S3::Bucket
+  s3_bucket_policy:
+    Type: AWS::S3::BucketPolicy
+    Properties:
+      Bucket:
+        Ref: s3_bucket
+  s3_bucket_policy_2:
+    Type: AWS::S3::BucketPolicy
+    Properties:
+      Bucket: aws:arn
+"""
+
+PROJECTION_RULES = """
+let policies = Resources[ Type == /BucketPolicy$/ ]
+rule policies_check when %policies not empty {
+  %policies.Properties.Bucket exists
+  %policies.Properties.Bucket not empty
+  some %policies.Properties.Bucket.Ref not empty
+}
+"""
+
+
+def test_variable_projections():
+    # eval_tests.rs:1205 — `some` saves the clause: one Ref resolves
+    overall, _ = _both_engines(PROJECTION_RULES, PROJECTION_DOC_PASS)
+    assert overall == "PASS"
+
+
+def test_variable_projections_failures():
+    # eval_tests.rs:1245 — Bucket: "" fails `not empty`
+    doc = PROJECTION_DOC_PASS.replace("Bucket: aws:arn", 'Bucket: ""')
+    overall, _ = _both_engines(PROJECTION_RULES, doc)
+    assert overall == "FAIL"
+
+
+CROSS_JOIN_DOC = """
+Resources:
+  s3_bucket:
+    Type: AWS::S3::Bucket
+  s3_bucket_policy:
+    Type: AWS::S3::BucketPolicy
+    Properties:
+      Bucket:
+        Ref: s3_bucket
+"""
+
+CROSS_JOIN_DOC_2 = CROSS_JOIN_DOC + """  s3_bucket_policy_2:
+    Type: AWS::S3::BucketPolicy
+    Properties:
+      Bucket: aws:arn...
+"""
+
+
+@pytest.mark.parametrize(
+    "rules,doc,expected",
+    [
+        # eval_tests.rs:1339 query_cross_joins, all five sub-cases
+        (
+            """rule s3_cross_query_join {
+   let policies = Resources[ Type == /BucketPolicy$/ ].Properties.Bucket.Ref
+   Resources.%policies {
+     Type == 'AWS::S3::Bucket'
+   }
+}""",
+            CROSS_JOIN_DOC,
+            "PASS",
+        ),
+        (
+            """rule s3_cross_query_join {
+   let policies = Resources[ Type == /NotBucketPolicy$/ ].Properties.Bucket.Ref
+   Resources.%policies {
+     Type == 'AWS::S3::Bucket'
+   }
+}""",
+            CROSS_JOIN_DOC,
+            "SKIP",
+        ),
+        # no `some` on the assignment: the unresolved Ref FAILs
+        (
+            """rule s3_cross_query_join {
+   let policies = Resources[ Type == /BucketPolicy$/ ].Properties.Bucket.Ref
+   Resources.%policies {
+     Type == 'AWS::S3::Bucket'
+   }
+}""",
+            CROSS_JOIN_DOC_2,
+            "FAIL",
+        ),
+        # `some` on the assignment drops the unresolved entry
+        (
+            """rule s3_cross_query_join {
+   let policies = some Resources[ Type == /BucketPolicy$/ ].Properties.Bucket.Ref
+   Resources.%policies {
+     Type == 'AWS::S3::Bucket'
+   }
+}""",
+            CROSS_JOIN_DOC_2,
+            "PASS",
+        ),
+        # `some` at the block level yields the same result
+        (
+            """rule s3_cross_query_join {
+   let policies = Resources[ Type == /BucketPolicy$/ ].Properties.Bucket.Ref
+   some Resources.%policies {
+     Type == 'AWS::S3::Bucket'
+   }
+}""",
+            CROSS_JOIN_DOC_2,
+            "PASS",
+        ),
+    ],
+)
+def test_query_cross_joins(rules, doc, expected):
+    overall, _ = _both_engines(rules, doc)
+    assert overall == expected
+
+
+CROSS_RULE_RULES = """
+rule skipped when skip !exists {
+    Resources.*.Properties.Tags !empty
+}
+
+rule dependent_on_skipped when skipped {
+    Resources.*.Properties exists
+}
+
+rule dependent_on_dependent when dependent_on_skipped {
+    Resources.*.Properties exists
+}
+
+rule dependent_on_not_skipped when !skipped {
+    Resources.*.Properties exists
+}
+"""
+
+CROSS_RULE_DOC_SKIP = """
+skip: true
+Resources:
+  first:
+    Type: 'WhackWhat'
+    Properties:
+      Tags:
+        - hi: "there"
+        - right: "way"
+"""
+
+
+def test_cross_rule_clause_when_checks_skipped():
+    # eval_tests.rs:1454 — `skip` present: gate rule SKIPs, dependents
+    # SKIP, the negated dependent PASSes
+    overall, statuses = _both_engines(CROSS_RULE_RULES, CROSS_RULE_DOC_SKIP)
+    assert overall == "PASS"
+    assert statuses == {
+        "skipped": "SKIP",
+        "dependent_on_skipped": "SKIP",
+        "dependent_on_dependent": "SKIP",
+        "dependent_on_not_skipped": "PASS",
+    }
+
+
+def test_cross_rule_clause_when_checks_not_skipped():
+    doc = CROSS_RULE_DOC_SKIP.replace("skip: true\n", "")
+    overall, statuses = _both_engines(CROSS_RULE_RULES, doc)
+    assert overall == "PASS"
+    assert statuses == {
+        "skipped": "PASS",
+        "dependent_on_skipped": "PASS",
+        "dependent_on_dependent": "PASS",
+        "dependent_on_not_skipped": "SKIP",
+    }
+
+
+BLOCK_EVAL_DOC = """
+Resources:
+  apiGw:
+    Type: 'AWS::ApiGateway::RestApi'
+    Properties:
+      EndpointConfiguration: ["PRIVATE"]
+      Policy:
+        Statement:
+          - Action: Allow
+            Resource: ['*', "aws:"]
+            Condition:
+                'aws:IsSecure': true
+                'aws:sourceVpc': ['vpc-1234']
+          - Action: Allow
+            Resource: ['*', "aws:"]
+"""
+
+BLOCK_EVAL_RULES = """
+rule api_private {
+    Resources.*[ Type == 'AWS::ApiGateway::RestApi' ].Properties {
+        EndpointConfiguration == ["PRIVATE"]
+        some Policy.Statement[*] {
+            Action == 'Allow'
+            Condition[ keys == 'aws:IsSecure' ] !empty
+        }
+    }
+}
+"""
+
+
+def test_block_evaluation():
+    # eval_tests.rs:1119
+    overall, _ = _both_engines(BLOCK_EVAL_RULES, BLOCK_EVAL_DOC)
+    assert overall == "PASS"
+
+
+def test_block_evaluation_fail():
+    # eval_tests.rs:1158 — a second RestApi with no IsSecure condition
+    doc = BLOCK_EVAL_DOC + """  apiGw2:
+    Type: 'AWS::ApiGateway::RestApi'
+    Properties:
+      EndpointConfiguration: ["PRIVATE"]
+      Policy:
+        Statement:
+          - Action: Allow
+            Resource: ['*', "aws:"]
+"""
+    overall, _ = _both_engines(BLOCK_EVAL_RULES, doc)
+    assert overall == "FAIL"
+
+
+def test_block_guard_custom_message_principal():
+    # eval_tests.rs:925 block_guard_pass — wildcard principal FAILs
+    doc = """
+Resources:
+  iam:
+    Type: AWS::IAM::Role
+    Properties:
+      PolicyDocument:
+        Statement:
+          - Principal: '*'
+            Effect: Allow
+            Resource: ['s3*']
+          - Principal: [aws-123, aws-345]
+            Effect: Allow
+            Resource: '*'
+  ecs:
+    Type: AWS::ECS::Task
+    Properties:
+      Role:
+        Ref: iam
+"""
+    rules = """
+rule no_wildcard {
+    Resources[ Type == /Role/ ].Properties.PolicyDocument {
+      Statement[*] {
+         Principal != '*' <<No wildcard allowed for Principals>>
+      }
+    }
+}
+"""
+    overall, _ = _both_engines(rules, doc)
+    assert overall == "FAIL"

@@ -684,3 +684,46 @@ rule under_cap when Resources exists {
             {"Settings": {"Cap": 10}, "Resources": {"a": {"Type": "T", "Size": 15}}},
         ],
     )
+
+
+# ---------------------------------------------------------------------------
+# indexed variable key interpolation: `.%names[k]`
+# ---------------------------------------------------------------------------
+def test_indexed_interpolation():
+    # the reference picks the k-th variable ENTRY and then ALSO walks
+    # the [k] part into the resolved value (eval_context.rs:421-526)
+    _differential(
+        """
+let names = Names[*]
+
+rule first_val when Names exists { Resources.%names[0] == 10 }
+rule second when Names exists { Resources.%names[1] exists }
+rule oob when Names exists { Resources.%names[9] exists }
+""",
+        [
+            {
+                "Names": ["alpha", "beta"],
+                "Resources": {"alpha": [10, 20], "beta": {"x": 1}},
+            },
+            {
+                "Names": ["beta", "alpha"],
+                "Resources": {"alpha": [10, 20], "beta": [7, 8]},
+            },
+            {"Names": ["missing"], "Resources": {"alpha": [10]}},
+        ],
+    )
+
+
+def test_indexed_interpolation_literal_var():
+    _differential(
+        """
+let names = ['alpha', 'beta']
+
+rule zero when Resources exists { Resources.%names[0] exists }
+rule one_oob when Resources exists { Resources.%names[1] exists }
+""",
+        [
+            {"Resources": {"alpha": [1], "beta": [2]}},
+            {"Resources": {"gamma": 1}},
+        ],
+    )
