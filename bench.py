@@ -292,10 +292,6 @@ def measure(rules_text: str, docs, min_rules: int, n_cpu: int = 256):
         k: jax.device_put(jnp.asarray(v))
         for k, v in compiled.device_arrays(batch).items()
     }
-    k_inner = 17
-    fn1, fnk = make_loop(1), make_loop(k_inner)
-    int(fn1(arrays))  # compile
-    int(fnk(arrays))
 
     def _med(fn, reps=3):
         ts = []
@@ -305,8 +301,21 @@ def measure(rules_text: str, docs, min_rules: int, n_cpu: int = 256):
             ts.append(time.perf_counter() - t0)
         return sorted(ts)[len(ts) // 2]
 
+    fn1 = make_loop(1)
+    int(fn1(arrays))  # compile
     t_1 = _med(fn1)
-    t_k = _med(fnk)
+    # auto-scale the inner loop until the k-loop clearly dominates the
+    # dispatch floor: with a fast kernel and a noisy remote tunnel a
+    # small k can make (t_k - t_1) indistinguishable from timing noise
+    # (observed as absurd throughput readings)
+    k_inner = 17
+    while True:
+        fnk = make_loop(k_inner)
+        int(fnk(arrays))
+        t_k = _med(fnk)
+        if t_k >= 2.5 * t_1 or k_inner >= 1025:
+            break
+        k_inner = (k_inner - 1) * 4 + 1
     per_iter = max((t_k - t_1) / (k_inner - 1), 1e-9)
     tpu_docs_per_sec = n_docs / per_iter
 
@@ -372,8 +381,9 @@ def main() -> None:
     v, r = measure(CONFIG_ITEM_RULES, items, min_rules=4)
     _emit("config3_config_items_per_sec", v, r)
 
-    # config 4: Terraform plans, deep trees
-    plans = [from_plain(make_tf_plan(rng, i)) for i in range(2048)]
+    # config 4: Terraform plans, deep trees (4096-doc steady-state
+    # batch measured ~10% over 2048 on v5e; 8192 regresses)
+    plans = [from_plain(make_tf_plan(rng, i)) for i in range(4096)]
     v, r = measure(TF_RULES, plans, min_rules=3)
     _emit("config4_tf_plans_per_sec", v, r)
 

@@ -93,6 +93,9 @@ TOKENS = [
     "rule ", "when ", "let ", "exists", "!empty", "IN ", "or ", "some ",
     "keys ", "this", "== ", "!= ", ">= ", "r[", "r(", "/x/", "%v", "[*]",
     ".*", "<<", ">>", "{", "}", "[", "]", '"', "'", ":", "-", "\n", "  ",
+    "count(", "join(", "to_upper(", "json_parse(", "parse_int(",
+    "regex_replace(", "substring(", "parse_epoch(", "now()", "not ",
+    "is_struct", "%v[0]", ".%v",
     "Resources", "Properties", "!Ref ", "Fn::", "&a", "*a", "null",
     "true", "1e+308", "9223372036854775807", "\\u0041", "\x00", "\xf0\x9f",
 ]
