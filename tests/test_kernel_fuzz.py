@@ -101,6 +101,10 @@ def _clause(rng, i):
         lambda: f"{some}Resources.*.{key} {_op(rng)} Resources.*.{key2}",
         lambda: f"{some}Resources.*[ {key} {_unary(rng)} ].{key2}[*] {_op(rng)} {_lit(rng)}",
         lambda: f"Resources[ keys == /r\\d/ ].{key} {_unary(rng)}",
+        lambda: f"Resources[ keys {rng.choice(['in', 'not in', '!='])} {rng.choice(['/r1/', chr(39) + 'r0' + chr(39)])} ].{key} {_unary(rng)}",
+        lambda: f"{some}Resources.*.{key}[0] {_op(rng)} {_lit(rng)}",
+        lambda: f"Resources.*.{key} {{ this {_op(rng)} {_lit(rng)} }}",
+        lambda: f"{some}Resources.*.Tags[*].{key} {_op(rng)} {_lit(rng)}",
     ]
     return rng.choice(shapes)()
 
@@ -149,6 +153,17 @@ def _rand_rules(rng, ti):
                                 f"%{vn}.{rng.choice(KEYS)} {_op(rng)} {_lit(rng)}",
                                 f"%{vn}[ {rng.choice(KEYS)} exists ].{rng.choice(KEYS)} {_unary(rng)}",
                                 f"%{vn} {_unary(rng)}",
+                            ]
+                        )
+                    )
+                elif kind < 0.6:  # string-set var (some Resources.*.key)
+                    body.append(
+                        rng.choice(
+                            [
+                                f"%{vn} {_op(rng)} {rng.choice(NUMS)}",
+                                f"Resources.%{vn} {_unary(rng)}",
+                                f"Resources.%{vn}[0] {_unary(rng)}",
+                                f"Resources.*.{rng.choice(KEYS)} IN %{vn}",
                             ]
                         )
                     )
