@@ -146,3 +146,40 @@ def test_sweep_rule_shards_matches_flat(tmp_path):
     flat = counts([], "flat.jsonl")
     sharded = counts(["--rule-shards", "2"], "sharded.jsonl")
     assert flat == sharded and sum(flat.values()) == 9
+
+
+def test_sweep_function_rules_tpu_matches_cpu(tmp_path):
+    """Function lets go through the per-rule-file precompute+re-encode
+    path inside the sweep (ops/fnvars.py); both backends must agree."""
+    rules = tmp_path / "fn.guard"
+    rules.write_text(
+        """let upper = to_upper(Resources.*.Name)
+let n = count(Resources.*)
+
+rule named_prod when %n >= 1 { some %upper == /PROD/ }
+"""
+    )
+    data = tmp_path / "data"
+    data.mkdir()
+    for i, name in enumerate(["prod-a", "dev-b", "prod-c"]):
+        (data / f"d{i}.json").write_text(
+            json.dumps({"Resources": {"r": {"Name": name}}})
+        )
+    results = {}
+    for backend in ("cpu", "tpu"):
+        mdir = tmp_path / backend
+        mdir.mkdir()
+        w = Writer.buffered()
+        code = run(
+            [
+                "sweep", "-r", str(rules), "-d", str(data),
+                "-M", str(mdir / "m.jsonl"), "-c", "2",
+                "--backend", backend,
+            ],
+            writer=w,
+            reader=Reader.from_string(""),
+        )
+        summary = json.loads(w.stripped().splitlines()[-1])
+        results[backend] = (code, summary["counts"], summary["failed"])
+    assert results["cpu"] == results["tpu"]
+    assert results["cpu"][1] == {"pass": 2, "fail": 1, "skip": 0}
