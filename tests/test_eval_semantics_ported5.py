@@ -1,0 +1,140 @@
+"""Fifth batch of reference-pinned semantics, re-expressed at rule
+level (`/root/reference/guard/src/rules/eval_tests.rs` —
+query_empty_and_non_empty:294, each_lhs_value_not_comparable:359,
+each_lhs_value_eq_compare:443, binary_comparisons_gt_ge:671 /
+lt_le:781 essences). The reference drives internal APIs
+(unary_operation / each_lhs_compare); the observable contract — the
+statuses those comparisons produce — is asserted here on BOTH
+engines."""
+
+import pytest
+
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.core.scopes import RootScope
+from guard_tpu.core.evaluator import eval_rules_file
+from guard_tpu.core.loader import load_document
+from guard_tpu.ops.encoder import encode_batch
+from guard_tpu.ops.fnvars import precompute_fn_values
+from guard_tpu.ops.ir import compile_rules_file
+from guard_tpu.ops.kernels import BatchEvaluator
+
+STATUS = {0: "PASS", 1: "FAIL", 2: "SKIP"}
+
+DOC = """
+Parameters:
+  allowed_images: [ami-123456789012, ami-01234567890]
+Resources:
+  s3:
+    Type: AWS::S3::Bucket
+  ec2:
+    Type: AWS::EC2::Instance
+    Properties:
+      ImageId: ami-123456789012
+"""
+
+
+def _both(rules_text, yaml_doc=DOC):
+    from guard_tpu.commands.report import rule_statuses_from_root
+
+    rf = parse_rules_file(rules_text, "ported5.guard")
+    doc = load_document(yaml_doc, "doc.yaml")
+    scope = RootScope(rf, doc)
+    eval_rules_file(rf, scope, None)
+    root = scope.reset_recorder().extract()
+    oracle = {n: s.value for n, s in rule_statuses_from_root(root).items()}
+
+    fn_vars, fn_vals, fn_err = precompute_fn_values(rf, [doc])
+    assert not fn_err
+    batch, interner = encode_batch([doc], fn_values=fn_vals, fn_var_order=fn_vars)
+    compiled = compile_rules_file(rf, interner)
+    evaluator = BatchEvaluator(compiled)
+    statuses = evaluator(batch)
+    unsure = evaluator.last_unsure
+    for ri, crule in enumerate(compiled.rules):
+        if unsure is not None and bool(unsure[0, ri]):
+            continue
+        assert STATUS[int(statuses[0, ri])] == oracle[crule.name], crule.name
+    return oracle
+
+
+def test_query_empty_and_non_empty():
+    # eval_tests.rs:294 — `not empty` on a filter query tests whether
+    # anything was selected
+    oracle = _both(
+        """
+rule has_bucket { Resources.*[ Type == /Bucket/ ] !empty }
+rule has_broker { Resources.*[ Type == /Broker/ ] !empty }
+"""
+    )
+    assert oracle == {"has_bucket": "PASS", "has_broker": "FAIL"}
+
+
+def test_each_lhs_value_vs_list_value():
+    # eval_tests.rs:359 — a string LHS against a resolved LIST value:
+    # Eq is NotComparable (FAIL), `in` membership PASSes, `not in`
+    # FAILs
+    oracle = _both(
+        """
+rule eq_list { Resources.ec2.Properties.ImageId == Parameters.allowed_images }
+rule in_list { Resources.ec2.Properties.ImageId in Parameters.allowed_images }
+rule not_in_list { Resources.ec2.Properties.ImageId not in Parameters.allowed_images }
+"""
+    )
+    assert oracle == {
+        "eq_list": "FAIL",
+        "in_list": "PASS",
+        "not_in_list": "FAIL",
+    }
+
+
+def test_each_lhs_value_eq_compare_flattened():
+    # eval_tests.rs:443 exercises each_lhs_compare pairwise; at RULE
+    # level Eq against a query is SET-difference (operators.rs:552-594
+    # query_in): {ami-123} vs {ami-123, ami-012} leaves ami-012 in the
+    # diff, so both forms FAIL — `some` has no pass entries to find.
+    # Containment is what `in` expresses (test above).
+    oracle = _both(
+        """
+rule all_match { Resources.ec2.Properties.ImageId == Parameters.allowed_images[*] }
+rule some_match { some Resources.ec2.Properties.ImageId == Parameters.allowed_images[*] }
+"""
+    )
+    assert oracle == {"all_match": "FAIL", "some_match": "FAIL"}
+
+
+NUM_DOC = """
+values:
+  int: 10
+  ints: [20, 10]
+  float: 1.0
+  string: "Hi"
+"""
+
+
+@pytest.mark.parametrize(
+    "clause,expected",
+    [
+        # binary_comparisons_gt_ge essence (eval_tests.rs:671)
+        ("values.int > 5", "PASS"),
+        ("values.int >= 10", "PASS"),
+        ("values.int > 10", "FAIL"),
+        ("values.ints[*] >= 10", "PASS"),
+        ("values.ints[*] > 10", "FAIL"),
+        ("some values.ints[*] > 10", "PASS"),
+        # binary_comparisons_lt_le essence (eval_tests.rs:781)
+        ("values.int < 20", "PASS"),
+        ("values.int <= 10", "PASS"),
+        ("values.int < 10", "FAIL"),
+        ("values.float <= 1.0", "PASS"),
+        ("values.string < 'Ji'", "PASS"),
+        ("values.string > 'Di'", "PASS"),
+        ("values.string < 'Di'", "FAIL"),
+        # cross-kind ordering is NotComparable -> FAIL
+        ("values.int > 'Hi'", "FAIL"),
+        ("values.string > 5", "FAIL"),
+        ("values.int > 1.0", "FAIL"),
+    ],
+)
+def test_binary_comparisons(clause, expected):
+    oracle = _both(f"rule r {{ {clause} }}", NUM_DOC)
+    assert oracle == {"r": expected}, clause
