@@ -328,6 +328,205 @@ def measure(rules_text: str, docs, min_rules: int, n_cpu: int = 256):
     return tpu_docs_per_sec, tpu_docs_per_sec / cpu_docs_per_sec
 
 
+def measure_corpus():
+    """Registry-scale config (BASELINE.md config 5's real workload):
+    every rule of the vendored 250-file corpus (corpus/rules) evaluated
+    over the union of the corpus's own test inputs, in ONE compiled
+    evaluator — per-file compiled rule programs traced back to back
+    inside a single jaxpr (the same grouping parallel/rules.py
+    dispatches across sub-meshes; on one chip all groups share it).
+    Returns (docs_per_sec, rules_total, vs_oracle)."""
+    import pathlib
+
+    import jax
+    import jax.numpy as jnp
+    import yaml
+    from jax import lax
+
+    from guard_tpu.core.parser import parse_rules_file
+    from guard_tpu.core.scopes import RootScope
+    from guard_tpu.core.evaluator import eval_rules_file
+    from guard_tpu.core.values import from_plain
+    from guard_tpu.ops.encoder import Interner, encode_batch
+    from guard_tpu.ops.ir import compile_rules_file
+    from guard_tpu.ops.kernels import build_doc_evaluator
+
+    corpus = pathlib.Path(__file__).parent / "corpus" / "rules"
+    rule_files = sorted(corpus.glob("*.guard"))
+    assert len(rule_files) >= 200, "vendored corpus missing"
+
+    docs_plain = []
+    for rf_path in rule_files:
+        spec = corpus / "tests" / f"{rf_path.stem}_tests.yaml"
+        if spec.exists():
+            for case in yaml.safe_load(spec.read_text()) or []:
+                if isinstance(case, dict) and "input" in case:
+                    docs_plain.append(case["input"])
+    docs = [from_plain(d) for d in docs_plain]
+    # replicate the input mix to a steady-state batch
+    reps = max(1, 2048 // max(len(docs), 1))
+    docs = (docs * reps)[:2048]
+    n_docs = len(docs)
+
+    interner = Interner()
+    batch, interner = encode_batch(docs, interner)
+    compiled_files = []
+    rules_total = 0
+    host_total = 0
+    for rf_path in rule_files:
+        rf = parse_rules_file(rf_path.read_text(), rf_path.name)
+        c = compile_rules_file(rf, interner)
+        host_total += len(c.host_rules)
+        if c.rules:
+            compiled_files.append(c)
+            rules_total += len(c.rules)
+    assert host_total == 0, f"{host_total} corpus rules fell back to host"
+
+    evals = [build_doc_evaluator(c) for c in compiled_files]
+    per_file_arrays = [c.device_arrays(batch) for c in compiled_files]
+    # shared base columns once; per-file extras (bit tables) prefixed
+    flat = {}
+    base = per_file_arrays[0]
+    for k in (
+        "node_kind", "node_parent", "scalar_id", "num_hi", "num_lo",
+        "child_count", "node_key_id", "node_index", "node_parent_kind",
+    ):
+        flat[k] = base[k]
+    base_keys = set(flat)
+    for i, arrs in enumerate(per_file_arrays):
+        for k, v in arrs.items():
+            if k not in base_keys:
+                flat[f"f{i}_{k}"] = v
+
+    def combined(arrays):
+        outs = []
+        for i, ev in enumerate(evals):
+            sub = {k: arrays[k] for k in base_keys}
+            prefix = f"f{i}_"
+            for k, v in arrays.items():
+                if k.startswith(prefix):
+                    sub[k[len(prefix):]] = v
+            outs.append(ev(sub))
+        return jnp.concatenate(outs) if outs else jnp.zeros((0,), jnp.int8)
+
+    def make_loop(iters: int):
+        @jax.jit
+        def loop(arrays):
+            def body(_, acc):
+                dep = jnp.minimum(acc % 2, 0).astype(jnp.int32)
+                arr2 = dict(arrays)
+                arr2["node_kind"] = arrays["node_kind"] + dep
+                st = jax.vmap(combined)(arr2)
+                return acc + jnp.sum(st.astype(jnp.int32))
+
+            return lax.fori_loop(0, iters, body, jnp.int32(0))
+
+        return loop
+
+    arrays = {k: jax.device_put(jnp.asarray(v)) for k, v in flat.items()}
+
+    def _med(fn, reps=3):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            int(fn(arrays))
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2]
+
+    fn1 = make_loop(1)
+    int(fn1(arrays))
+    t_1 = _med(fn1)
+    k_inner = 5
+    while True:
+        fnk = make_loop(k_inner)
+        int(fnk(arrays))
+        t_k = _med(fnk)
+        if t_k >= 2.5 * t_1 or k_inner >= 257:
+            break
+        k_inner = (k_inner - 1) * 4 + 1
+    per_iter = max((t_k - t_1) / (k_inner - 1), 1e-9)
+    docs_per_sec = n_docs / per_iter
+
+    # oracle: all corpus rule files over a sample of docs — with the
+    # per-file error isolation the validate loop applies (a rule that
+    # raises on a foreign input writes stderr and continues,
+    # validate.rs:406-434)
+    from guard_tpu.core.errors import GuardError
+
+    n_cpu = 8
+    rfs = [
+        parse_rules_file(p.read_text(), p.name) for p in rule_files
+    ]
+    t0 = time.perf_counter()
+    for doc in docs[:n_cpu]:
+        for rf in rfs:
+            try:
+                scope = RootScope(rf, doc)
+                eval_rules_file(rf, scope, None)
+            except GuardError:
+                pass
+    t1 = time.perf_counter()
+    cpu_docs_per_sec = n_cpu / (t1 - t0)
+    return docs_per_sec, rules_total, docs_per_sec / cpu_docs_per_sec
+
+
+def measure_fail_heavy(frac_fail: float, statuses_only: bool, n_docs: int = 1024):
+    """End-to-end docs/sec through the backend decision flow on a
+    workload where `frac_fail` of the documents FAIL: device statuses
+    plus (unless statuses_only) the per-failing-doc oracle rerun that
+    produces rich reports — the fail-rerun bound VERDICT r2 flagged."""
+    from guard_tpu.core.parser import parse_rules_file
+    from guard_tpu.core.scopes import RootScope
+    from guard_tpu.core.evaluator import eval_rules_file
+    from guard_tpu.commands.report import simplified_report_from_root
+    from guard_tpu.core.values import from_plain
+    from guard_tpu.ops.encoder import encode_batch
+    from guard_tpu.ops.ir import compile_rules_file
+    from guard_tpu.ops.kernels import BatchEvaluator
+
+    rng = np.random.default_rng(11)
+    rf = parse_rules_file(ENCRYPTION_RULES, "fh.guard")
+    docs_plain = []
+    for i in range(n_docs):
+        fail = rng.random() < frac_fail
+        docs_plain.append({
+            "Resources": {
+                "b": {
+                    "Type": "AWS::S3::Bucket",
+                    "Properties": {
+                        "BucketEncryption": {
+                            "ServerSideEncryptionConfiguration": [{
+                                "ServerSideEncryptionByDefault": {
+                                    "SSEAlgorithm": "none" if fail else "aws:kms"
+                                }
+                            }]
+                        }
+                    },
+                }
+            }
+        })
+    docs = [from_plain(d) for d in docs_plain]
+    batch, interner = encode_batch(docs)
+    compiled = compile_rules_file(rf, interner)
+    ev = BatchEvaluator(compiled)
+    ev(batch)  # compile
+
+    t0 = time.perf_counter()
+    statuses = ev(batch)
+    n_fail_rerun = 0
+    if not statuses_only:
+        for di in range(n_docs):
+            if (statuses[di] == 1).any():
+                scope = RootScope(rf, docs[di])
+                eval_rules_file(rf, scope, None)
+                simplified_report_from_root(
+                    scope.reset_recorder().extract(), f"d{di}"
+                )
+                n_fail_rerun += 1
+    t1 = time.perf_counter()
+    return n_docs / (t1 - t0)
+
+
 def _emit(metric: str, value: float, vs: float) -> None:
     # `vs_baseline` is required by the driver contract; `vs_oracle` is
     # the honest name: the divisor is this framework's own pure-Python
@@ -390,6 +589,29 @@ def main() -> None:
     # config 5: regex-heavy registry-style ruleset
     v, r = measure(regex_heavy_rules(16), docs, min_rules=16)
     _emit("config5_regex_registry_templates_per_sec", v, r)
+
+    # config 5b: the REAL registry scale — all rules of the vendored
+    # 250-file corpus in one compiled evaluator (the per-file rule
+    # groups parallel/rules.py shards across sub-meshes, here back to
+    # back on one chip)
+    v, rules_total, r = measure_corpus()
+    _emit("config5b_corpus_250files_templates_per_sec", v, r)
+    _emit(
+        "config5b_corpus_doc_rule_pairs_per_sec", v * rules_total, r
+    )
+
+    # config 6: fail-heavy cliff — end-to-end docs/sec including the
+    # oracle fail-rerun (rich reports per failing doc) vs the
+    # --statuses-only escape hatch
+    for frac, tag in ((0.5, "50pct"), (1.0, "allfail")):
+        full = measure_fail_heavy(frac, statuses_only=False)
+        lean = measure_fail_heavy(frac, statuses_only=True)
+        _emit(f"config6_fail_{tag}_full_docs_per_sec", full, full / max(full, 1e-9))
+        _emit(
+            f"config6_fail_{tag}_statuses_only_docs_per_sec",
+            lean,
+            lean / max(full, 1e-9),
+        )
 
 
 if __name__ == "__main__":

@@ -88,6 +88,7 @@ class ValidateBuilder(CommandBuilder):
     _payload: bool = False
     _structured: bool = False
     _backend: str = "cpu"
+    _statuses_only: bool = False
 
     def rules(self, rules: List[str]):
         self._rules = rules
@@ -143,6 +144,10 @@ class ValidateBuilder(CommandBuilder):
         self._backend = b
         return self
 
+    def statuses_only(self, v: bool = True):
+        self._statuses_only = v
+        return self
+
     def try_build(self) -> Validate:
         return Validate(
             rules=self._rules,
@@ -157,6 +162,7 @@ class ValidateBuilder(CommandBuilder):
             payload=self._payload,
             structured=self._structured,
             backend=self._backend,
+            statuses_only=self._statuses_only,
         )
 
 

@@ -55,6 +55,7 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("--payload", "-P", action="store_true")
     v.add_argument("--structured", "-z", action="store_true")
     v.add_argument("--backend", default="cpu", choices=["cpu", "tpu"])
+    v.add_argument("--statuses-only", action="store_true")
 
     t = sub.add_parser("test", help="Test rules against expectations")
     t.add_argument("--rules-file", "-r", dest="rules", default=None)
@@ -104,6 +105,16 @@ def build_parser() -> argparse.ArgumentParser:
     c = sub.add_parser("completions", help="Generate shell completions")
     c.add_argument("--shell", "-s", default="bash", choices=["bash", "zsh", "fish"])
 
+    sv = sub.add_parser(
+        "serve",
+        help="Persistent validate session: newline-delimited JSON "
+        "payload requests on stdin, one JSON response line each "
+        "(amortizes startup for embedders, e.g. the npm package)",
+    )
+    # the transport must be chosen explicitly; stdio is the only one
+    # today, so `serve` without it is an error, not a silent default
+    sv.add_argument("--stdio", action="store_true")
+
     return p
 
 
@@ -132,6 +143,7 @@ def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reade
                 payload=args.payload,
                 structured=args.structured,
                 backend=args.backend,
+                statuses_only=args.statuses_only,
             )
             return cmd.execute(writer, reader)
         if args.command == "test":
@@ -169,6 +181,13 @@ def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reade
             )
         if args.command == "completions":
             return Completions(shell=args.shell).execute(writer, reader)
+        if args.command == "serve":
+            if not args.stdio:
+                writer.writeln_err("serve requires --stdio (the only transport)")
+                return 5
+            from .commands.serve import Serve
+
+            return Serve(stdio=True).execute(writer, reader)
     except GuardError as e:
         writer.writeln_err(f"Error: {e}")
         return 5

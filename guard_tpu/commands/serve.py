@@ -1,0 +1,77 @@
+"""`guard-tpu serve --stdio`: a persistent validate session.
+
+The npm surface (ts_lib) — like any embedder paying per-call process
+spawn — loses ~seconds of Python+JAX import per `validate()` when it
+shells out to the CLI. The reference avoids this by linking the engine
+into the caller's process as wasm
+(/root/reference/guard/ts-lib/index.ts:156-178 driving
+`tryBuildAndExecute`, lib.rs:318-347). This command is the
+process-boundary equivalent: spawn ONCE, then stream newline-delimited
+JSON requests over stdin and read one JSON response line per request —
+warm interpreter, warm JAX, warm compile caches across calls.
+
+Protocol (one line in, one line out):
+
+  request:  {"rules": [..], "data": [..]}          (payload contract,
+            validate.rs:507-513) plus optional
+            {"output_format": "sarif"|"json"|"yaml",
+             "backend": "cpu"|"tpu", "verbose": bool}
+  response: {"code": <exit code 0|19|5>, "output": "<stdout text>",
+             "error": "<stderr text>"}
+
+An empty line or EOF ends the session with exit code 0; a malformed
+request produces a response with code 5 and keeps the session alive.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from ..utils.io import Reader, Writer
+
+
+@dataclass
+class Serve:
+    stdio: bool = True
+
+    def execute(self, writer: Writer, reader: Reader) -> int:
+        from .validate import Validate
+
+        stream = reader.stream()
+        for line in stream:
+            line = line.strip()
+            if not line:
+                break
+            try:
+                req = json.loads(line)
+                if not isinstance(req, dict):
+                    raise ValueError("request must be a JSON object")
+                payload = json.dumps(
+                    {
+                        "rules": req.get("rules", []),
+                        "data": req.get("data", []),
+                    }
+                )
+                out_fmt = req.get("output_format", "sarif")
+                structured = out_fmt in ("sarif", "json", "yaml", "junit")
+                cmd = Validate(
+                    payload=True,
+                    structured=structured,
+                    output_format=out_fmt,
+                    show_summary=["none"] if structured else ["fail"],
+                    verbose=bool(req.get("verbose", False)),
+                    backend=req.get("backend", "cpu"),
+                )
+                buf = Writer.buffered()
+                code = cmd.execute(buf, Reader.from_string(payload))
+                resp = {
+                    "code": code,
+                    "output": buf.out.getvalue(),
+                    "error": buf.err.getvalue(),
+                }
+            except Exception as e:  # malformed request: keep serving
+                resp = {"code": 5, "output": "", "error": str(e)}
+            writer.writeln(json.dumps(resp))
+            writer.flush()
+        return 0

@@ -76,6 +76,10 @@ class Validate:
     payload: bool = False
     structured: bool = False
     backend: str = "cpu"  # cpu | tpu
+    # TPU backend only: skip the oracle fail-rerun — failing documents
+    # report rule-level statuses without per-clause detail, so
+    # fail-heavy corpora stay device-bound instead of oracle-bound
+    statuses_only: bool = False
 
     # -- argument validation (validate.rs:205-232) --------------------
     def _validate_args(self) -> None:
@@ -100,6 +104,19 @@ class Validate:
             raise GuardError("must specify rules or payload")
         if self.alphabetical and self.last_modified:
             raise GuardError("alphabetical conflicts with last-modified")
+        if self.statuses_only:
+            if self.backend != "tpu":
+                raise GuardError("statuses-only requires the tpu backend")
+            if (
+                self.structured
+                or self.verbose
+                or self.print_json
+                or self.output_format != "single-line-summary"
+            ):
+                raise GuardError(
+                    "statuses-only conflicts with structured/verbose/"
+                    "print-json and non-default output formats"
+                )
 
     # -- input loading ------------------------------------------------
     def _load_data_files(self, reader: Reader, writer: Writer) -> List[DataFile]:

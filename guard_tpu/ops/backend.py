@@ -33,9 +33,84 @@ from ..commands.report import rule_statuses_from_root, simplified_report_from_ro
 
 _STATUS = {PASS: Status.PASS, FAIL: Status.FAIL, SKIP: Status.SKIP}
 
+# spawn-pool state: each worker parses the rule files once (initializer)
+# and never imports jax — oracle reruns are pure-Python CPU work
+_WORKER_RULES: dict = {}
+
+# reruns below this count stay inline (spawn + import cost dominates)
+_POOL_MIN_JOBS = 48
+
+
+def _oracle_pool_init(rule_texts) -> None:
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    global _WORKER_RULES
+    from ..core.parser import parse_rules_file
+
+    _WORKER_RULES = {}
+    for key, name, text in rule_texts:
+        _WORKER_RULES[key] = parse_rules_file(text, name)
+
+
+def _oracle_job(args):
+    """One oracle rerun in a worker process: returns
+    (doc_key, status_value, report, {rule: status_value}, error)."""
+    rules_key, doc_key, doc_name, doc_content = args
+    rf = _WORKER_RULES[rules_key]
+    try:
+        from ..core.loader import load_document
+
+        doc = load_document(doc_content, doc_name)
+        scope = RootScope(rf, doc)
+        status = eval_rules_file(rf, scope, doc_name)
+    except GuardError as e:
+        return (doc_key, None, None, None, str(e))
+    root = scope.reset_recorder().extract()
+    report = simplified_report_from_root(root, doc_name)
+    statuses = {
+        n: s.value for n, s in rule_statuses_from_root(root).items()
+    }
+    return (doc_key, status.value, report, statuses, None)
+
+
+def _run_oracle_jobs(rules_key, rule_file, jobs, workers: int) -> dict:
+    """Fan the oracle reruns over a spawn pool (fork would inherit the
+    initialized JAX runtime; spawn workers import only the pure-Python
+    core). Returns {doc_key: job result}. The fail-rerun design makes
+    fail-heavy workloads oracle-bound — this turns that bound from one
+    core into all of them."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    results = {}
+    with ctx.Pool(
+        processes=workers,
+        initializer=_oracle_pool_init,
+        initargs=([(rules_key, rule_file.name, rule_file.content)],),
+    ) as pool:
+        for res in pool.imap_unordered(_oracle_job, jobs, chunksize=8):
+            results[res[0]] = res
+    return results
+
+
+def _honor_platform_env() -> None:
+    """`JAX_PLATFORMS=cpu` in the environment is NOT reliably honored
+    by plugin discovery (a wedged TPU tunnel can hang device init even
+    then); only `jax.config.update` before the first device query is.
+    Mirror the env var programmatically so CLI subprocesses with
+    JAX_PLATFORMS=cpu never touch the TPU plugin."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
 
 def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
     """Drop-in body for Validate.execute's evaluation loop."""
+    _honor_platform_env()
     from ..commands.validate import (
         ERROR_STATUS_CODE,
         FAILURE_STATUS_CODE,
@@ -104,6 +179,10 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
             evaluator = ShardedBatchEvaluator(compiled)
             statuses, unsure, host_docs = evaluator.evaluate_bucketed(rbatch)
 
+        # pass A: device statuses + which docs need the oracle
+        statuses_only = getattr(validate, "statuses_only", False)
+        doc_infos = []
+        oracle_dis = []
         for di, data_file in enumerate(data_files):
             rule_statuses = {}
             unsure_rules = set()
@@ -126,8 +205,9 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                         unsure_rules.add(crule.name)
 
             # host fallback for unlowerable rules + rich reporting:
-            # rerun the oracle when anything failed, output needs
-            # detail, or the kernel flagged a shape it can't decide
+            # rerun the oracle when anything failed (unless
+            # --statuses-only), output needs detail, or the kernel
+            # flagged a shape it can't decide
             need_oracle = (
                 bool(compiled.host_rules)
                 or bool(unsure_rules)
@@ -135,13 +215,73 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                 or validate.structured
                 or validate.verbose
                 or validate.print_json
-                or any(s == Status.FAIL for s in rule_statuses.values())
+                or (
+                    not statuses_only
+                    and any(
+                        s == Status.FAIL for s in rule_statuses.values()
+                    )
+                )
             )
+            doc_infos.append((rule_statuses, unsure_rules, doc_status))
+            if need_oracle:
+                oracle_dis.append(di)
+
+        # the oracle reruns are independent pure-Python work: fan them
+        # over a process pool when there are enough to amortize spawn
+        # (fail-heavy corpora would otherwise be bound by ONE core).
+        # Workers rebuild documents from raw content, so merged
+        # --input-params docs keep the inline path.
+        pooled_results = {}
+        if (
+            len(oracle_dis) >= _POOL_MIN_JOBS
+            and not validate.input_params
+        ):
+            import os
+
+            workers = min(len(oracle_dis), os.cpu_count() or 1, 16)
+            if workers > 1:
+                jobs = [
+                    (0, di, data_files[di].name, data_files[di].content)
+                    for di in oracle_dis
+                ]
+                try:
+                    pooled_results = _run_oracle_jobs(
+                        0, rule_file, jobs, workers
+                    )
+                except Exception as e:  # pool bootstrap can fail when
+                    # an embedder's unguarded __main__ re-executes
+                    # under spawn — the inline path is always safe
+                    log.warning(
+                        "oracle rerun pool unavailable (%s); "
+                        "falling back to inline reruns", e,
+                    )
+                    pooled_results = {}
+
+        # pass B: emit per-doc output in order, using pooled results
+        # where available and the inline oracle otherwise
+        oracle_set = set(oracle_dis)
+        for di, data_file in enumerate(data_files):
+            rule_statuses, unsure_rules, doc_status = doc_infos[di]
+            need_oracle = di in oracle_set
             report = {
                 "name": data_file.name,
                 "metadata": {},
                 "status": doc_status.value,
-                "not_compliant": [],
+                "not_compliant": [
+                    {
+                        "Rule": {
+                            "name": n,
+                            "metadata": {},
+                            "messages": {
+                                "custom_message": None,
+                                "error_message": None,
+                            },
+                            "checks": [],
+                        }
+                    }
+                    for n, s in sorted(rule_statuses.items())
+                    if s == Status.FAIL
+                ],
                 "not_applicable": sorted(
                     n for n, s in rule_statuses.items() if s == Status.SKIP
                 ),
@@ -150,18 +290,32 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                 ),
             }
             if need_oracle:
-                try:
-                    scope = RootScope(rule_file.rules, data_file.path_value)
-                    oracle_status = eval_rules_file(
-                        rule_file.rules, scope, data_file.name
+                if di in pooled_results:
+                    (_key, st_val, p_report, p_statuses, err) = pooled_results[di]
+                    if err is not None:
+                        writer.writeln_err(err)
+                        errors += 1
+                        continue
+                    oracle_status = Status(st_val)
+                    report = p_report
+                    oracle_rule_statuses = {
+                        n: Status(v) for n, v in p_statuses.items()
+                    }
+                else:
+                    try:
+                        scope = RootScope(rule_file.rules, data_file.path_value)
+                        oracle_status = eval_rules_file(
+                            rule_file.rules, scope, data_file.name
+                        )
+                    except GuardError as e:
+                        writer.writeln_err(str(e))
+                        errors += 1
+                        continue
+                    root_record = scope.reset_recorder().extract()
+                    report = simplified_report_from_root(
+                        root_record, data_file.name
                     )
-                except GuardError as e:
-                    writer.writeln_err(str(e))
-                    errors += 1
-                    continue
-                root_record = scope.reset_recorder().extract()
-                report = simplified_report_from_root(root_record, data_file.name)
-                oracle_rule_statuses = rule_statuses_from_root(root_record)
+                    oracle_rule_statuses = rule_statuses_from_root(root_record)
                 # parity assertion: kernel statuses must agree with the
                 # oracle (except results the kernel flagged unsure —
                 # those use the oracle's answer by design)

@@ -37,6 +37,8 @@ from ..core.values import (
     LIST,
     MAP,
     NULL,
+    RANGE_FLOAT,
+    RANGE_INT,
     REGEX,
     STRING,
     PV,
@@ -348,11 +350,26 @@ class DocBatch:
         ordered lists, unordered maps). Used by query-RHS comparisons
         so set membership is an id-equality test on device. Computed
         lazily (only rules with query RHS pay for it) and cached."""
+        self._canonicalize()
+        return self._struct_ids
+
+    def _canonicalize(self) -> None:
+        """Builds BOTH canonical id spaces in one bottom-up pass:
+        `_struct_ids` (loose_eq classes, see struct_ids) and
+        `_ord_ids` — ORDER-PRESERVING classes where two nodes share an
+        id iff `compare_eq(node, lit)` behaves identically for every
+        possible literal (map entries keep document insertion order
+        because compare_eq short-circuits per entry,
+        values.compare_eq:386-399; finer than loose_eq, which collapses
+        map order). The ord space feeds the struct-literal tri-state
+        tables (struct_literal_tri)."""
         if getattr(self, "_struct_ids", None) is not None:
-            return self._struct_ids
+            return
         d_n = self.node_kind.shape
         out = np.full(d_n, -1, dtype=np.int32)
+        oout = np.full(d_n, -1, dtype=np.int32)
         table: dict = {}
+        otable: dict = {}
         for di in range(d_n[0]):
             kinds = self.node_kind[di]
             sids = self.scalar_id[di]
@@ -380,82 +397,218 @@ class DocBatch:
                 if k == LIST:
                     elems = sorted(children.get(i, []))
                     key = ("l",) + tuple(int(out[di, c]) for _, _, c in elems)
+                    okey = ("L",) + tuple(int(oout[di, c]) for _, _, c in elems)
                 elif k == MAP:
                     entries = children.get(i, [])
                     key = ("m", frozenset(
                         (kid, int(out[di, c])) for _, kid, c in entries
                     ))
+                    # encoder visit order == document insertion order
+                    # (child node index ascends in insertion order)
+                    okey = ("M",) + tuple(
+                        (kid, int(oout[di, c]))
+                        for _, kid, c in sorted(entries, key=lambda t: t[2])
+                    )
                 elif k in (STRING, REGEX, CHAR):
                     key = ("s", int(sids[i]))
+                    okey = key
                 elif k in (INT, FLOAT, BOOL):
                     # the exact key pair: no float32 collisions
                     key = (k, int(nhi[i]), int(nlo[i]))
+                    okey = key
                 else:  # NULL
                     key = ("n",)
+                    okey = key
                 sid = table.get(key)
                 if sid is None:
                     sid = len(table)
                     table[key] = sid
                 out[di, i] = sid
+                oid = otable.get(okey)
+                if oid is None:
+                    oid = len(otable)
+                    otable[okey] = oid
+                oout[di, i] = oid
         self._struct_ids = out
         self._struct_table = table
+        self._ord_ids = oout
+        self._ord_table = otable
+
+    def struct_literal_tri(self, literals, interner) -> list:
+        """Per struct literal: ((D, N) match, (D, N) comparable,
+        (D, N) loose_match) bool columns.
+
+        match/comparable carry exact `compare_eq(doc_node, literal)`
+        tri-state semantics (path_value.rs:1071-1146 incl. regex
+        matching inside maps, range membership, and NotComparable
+        propagation with the reference's per-entry short-circuit
+        order); loose_match is `loose_eq(doc_node, literal)`
+        (path_value.rs:245-291 — never raises, maps compare values
+        order-insensitively via MapValue PartialEq, regex members
+        match). Evaluated ONCE per order-preserving canonical class
+        (ord_ids) on the host, then broadcast to nodes — the kernel
+        reads plain bool columns."""
+        self._canonicalize()
+        otable = self._ord_table
+        strings = interner.strings
+        # reconstruct each canonical entry's scalar value lazily from
+        # the exact (hi, lo) key (num_key is bijective off NaN)
+        T, F, R = 1, 0, 2  # tri-states: True / False / Raise
+
+        def unkey(kind: int, hi: int, lo: int):
+            u = ((hi + _BIAS32) << 32) | ((lo + _BIAS32) & 0xFFFFFFFF)
+            if kind == FLOAT:
+                b = (u ^ _BIAS64) if (u >> 63) else (u ^ 0xFFFFFFFFFFFFFFFF)
+                return struct.unpack("<d", struct.pack("<Q", b))[0]
+            return u - _BIAS64
+
+        rev = {oid: okey for okey, oid in otable.items()}
+        out = []
+        for lit in literals:
+            memo: Dict[tuple, int] = {}
+
+            def tri(okey, pv) -> int:
+                mk = (okey, id(pv))
+                got = memo.get(mk)
+                if got is not None:
+                    return got
+                memo[mk] = r = _tri(okey, pv)
+                return r
+
+            def _tri(okey, pv) -> int:
+                tag = okey[0]
+                k = pv.kind
+                if tag == "s":  # document STRING node
+                    s = strings[okey[1]]
+                    if k == STRING:
+                        return T if s == pv.val else F
+                    if k == REGEX:
+                        return T if compiled_regex(pv.val).search(s) else F
+                    return R
+                if tag == "n":
+                    return T if k == NULL else R
+                if tag == INT:
+                    v = unkey(INT, okey[1], okey[2])
+                    if k == INT:
+                        return T if v == pv.val else F
+                    if k == RANGE_INT:
+                        return T if pv.val.contains(v) else F
+                    return R
+                if tag == FLOAT:
+                    v = unkey(FLOAT, okey[1], okey[2])
+                    if k == FLOAT:
+                        return T if v == pv.val else F
+                    if k == RANGE_FLOAT:
+                        return T if pv.val.contains(v) else F
+                    return R
+                if tag == BOOL:
+                    v = bool(unkey(INT, okey[1], okey[2]))
+                    return (T if v == pv.val else F) if k == BOOL else R
+                if tag == "L":
+                    if k != LIST:
+                        return R
+                    elems = okey[1:]
+                    if len(elems) != len(pv.val):
+                        return F
+                    # okey elements here are ord ids: resolve back to
+                    # keys via the reverse table built below
+                    for oid, e in zip(elems, pv.val):
+                        r = tri(rev[oid], e)
+                        if r != T:
+                            return r
+                    return T
+                if tag == "M":
+                    if k != MAP:
+                        return R
+                    entries = okey[1:]
+                    if len(entries) != len(pv.val.values):
+                        return F
+                    for kid, oid in entries:
+                        v2 = pv.val.values.get(strings[kid])
+                        if v2 is None:
+                            return F
+                        r = tri(rev[oid], v2)
+                        if r != T:
+                            return r
+                    return T
+                raise AssertionError(f"canonical tag {tag}")
+
+            lmemo: Dict[tuple, bool] = {}
+
+            def loose(okey, pv) -> bool:
+                mk = (okey, id(pv))
+                got = lmemo.get(mk)
+                if got is not None:
+                    return got
+                lmemo[mk] = r = _loose(okey, pv)
+                return r
+
+            def _loose(okey, pv) -> bool:
+                tag = okey[0]
+                k = pv.kind
+                if tag == "M":
+                    # MapValue PartialEq: same size, every doc entry
+                    # loose_eq the literal's same-key value
+                    if k != MAP:
+                        return False
+                    entries = okey[1:]
+                    if len(entries) != len(pv.val.values):
+                        return False
+                    for kid, oid in entries:
+                        v2 = pv.val.values.get(strings[kid])
+                        if v2 is None or not loose(rev[oid], v2):
+                            return False
+                    return True
+                if tag == "L":
+                    if k != LIST:
+                        return False
+                    elems = okey[1:]
+                    if len(elems) != len(pv.val):
+                        return False
+                    return all(
+                        loose(rev[oid], e) for oid, e in zip(elems, pv.val)
+                    )
+                if tag == "s" and k == REGEX:
+                    # loose_eq guards regex compile errors itself
+                    try:
+                        return bool(
+                            compiled_regex(pv.val).search(strings[okey[1]])
+                        )
+                    except Exception:
+                        return False
+                return tri(okey, pv) == T
+
+            tri_of = np.zeros(max(len(otable), 1), dtype=np.int8)
+            loose_of = np.zeros(max(len(otable), 1), dtype=bool)
+            for okey, oid in otable.items():
+                tri_of[oid] = tri(okey, lit)
+                loose_of[oid] = loose(okey, lit)
+            ids = self._ord_ids
+            safe = np.clip(ids, 0, len(tri_of) - 1)
+            vals = np.where(ids >= 0, tri_of[safe], R)
+            lvals = np.where(ids >= 0, loose_of[safe], False)
+            out.append((vals == T, vals != R, lvals))
         return out
 
-    def literal_struct_ids(self, literals, interner) -> np.ndarray:
-        """(D, L) int32: each RHS struct literal canonicalized into this
-        batch's struct-id space via the SAME key scheme struct_ids uses
-        (loose_eq classes). A literal whose canonical key never occurs
-        in the batch maps to -1 — it can match no document node. The
-        row is identical for every doc (the table is batch-global); the
-        leading doc axis exists so the array vmaps/shards like every
-        other device input."""
-        self.struct_ids()  # ensure the table exists
-        table = self._struct_table
-
-        def key_of(pv):
-            k = pv.kind
-            if k == LIST:
-                return ("l",) + tuple(lookup(e) for e in pv.val)
-            if k == MAP:
-                return (
-                    "m",
-                    frozenset(
-                        (interner.lookup(key), lookup(v))
-                        for key, v in pv.val.values.items()
-                    ),
-                )
-            if k in (STRING, REGEX, CHAR):
-                return ("s", interner.lookup(pv.val))
-            if k in (INT, FLOAT, BOOL):
-                nk = num_key(
-                    INT if k == BOOL else k,
-                    (1 if pv.val else 0) if k == BOOL else pv.val,
-                )
-                return (k, nk[0], nk[1]) if nk is not None else ("x",)
-            return ("n",)
-
-        def lookup(pv) -> int:
-            sid = table.get(key_of(pv))
-            return -1 if sid is None else sid
-
-        row = np.array([lookup(pv) for pv in literals], dtype=np.int32)
-        return np.broadcast_to(row, (self.node_kind.shape[0], len(literals))).copy()
 
 
 def _round_up(n: int, multiple: int = 8) -> int:
     return max(multiple, ((n + multiple - 1) // multiple) * multiple)
 
 
-# node-capacity buckets for the kernel path: the kernels' fused one-hot
-# traversal is O(N^2) per doc per step, which is the fastest known
-# formulation on TPU up to at least 4096 nodes (gather- and scatter-
-# based alternatives re-measured 2026-07: flat ~3.5-5ms per primitive
-# regardless of N, losing to the fused one-hot everywhere below ~8k
-# nodes). Deferred UnResolved histograms + scalar root-mode aggregation
-# (kernels.py) keep the N^2 term count low, so giant documents stay on
-# device through the 8192 bucket; beyond that they route to the CPU
-# oracle (ops/backend.py)
+# node-capacity buckets for the kernel path. Small buckets use the
+# fused one-hot traversal (O(N^2) lanes per doc per step — fastest
+# below kernels.GATHER_MIN_NODES where the compare fuses into the
+# consuming reduction); buckets at and above that threshold trace the
+# O(N) gather/segment-sum formulation instead, so the per-doc cost
+# stays proportional to document size. Rule files that build pairwise
+# (N, N) matrices (query-RHS compares, variable key interpolation —
+# CompiledRules.needs_pairwise) stop at the standard ceiling; all other
+# rule files evaluate documents up to 64k nodes on device via the
+# extended buckets, and only documents beyond the active ceiling route
+# to the CPU oracle (ops/backend.py)
 NODE_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096, 8192)
+NODE_BUCKETS_EXTENDED = NODE_BUCKETS + (16384, 32768, 65536)
 
 
 def split_batch_by_size(

@@ -81,20 +81,43 @@ def _expr_refs(fx: FunctionExpr, acc_vars: Set[str], acc_names: Set[str]) -> Non
             acc_vars.update(_query_vars(p))
 
 
-def _fn_lets(rf: RulesFile) -> List[Tuple[int, str, FunctionExpr]]:
+def _when_chains(rule):
+    """Yield (chain, block) for the rule body and every when-block
+    nested through ROOT-BASIS paths only: when-blocks keep the
+    enclosing selection (eval.rs:1428-1502), so a when-block reached
+    without crossing a value scope still evaluates — and binds its
+    `let`s — at the document root. `chain` is the list of enclosing
+    Blocks from the rule body down (exclusive of `block`)."""
+    from ..core.exprs import WhenBlockClause
+
+    def walk(block, chain):
+        yield chain, block
+        for disj in block.conjunctions:
+            for c in disj:
+                if isinstance(c, WhenBlockClause):
+                    yield from walk(c.block, chain + [block])
+
+    yield from walk(rule.block, [])
+
+
+def _fn_lets(rf: RulesFile) -> List[Tuple[int, str, FunctionExpr, list]]:
     """Every function `let` with a root binding basis: file-level
-    (rule_idx -1) and rule-BODY lets (rule_idx = index into
-    rf.guard_rules — rule blocks evaluate with the document root as
-    scope basis, eval_context.rs:980-997). Lets inside when-blocks /
-    type blocks / nested blocks are not enumerated (value scopes)."""
-    out: List[Tuple[int, str, FunctionExpr]] = []
+    (rule_idx -1), rule-BODY lets, and lets in when-blocks reached
+    without crossing a value scope (rule blocks and their when-blocks
+    evaluate with the document root as scope basis,
+    eval_context.rs:980-997). Lets inside type blocks / nested
+    query blocks are not enumerated (value scopes). The last element
+    is the enclosing-Block chain for scope reconstruction (empty for
+    file/rule-body lets)."""
+    out: List[Tuple[int, str, FunctionExpr, list]] = []
     for let in rf.assignments:
         if isinstance(let.value, FunctionExpr):
-            out.append((-1, let.var, let.value))
+            out.append((-1, let.var, let.value, []))
     for ri, rule in enumerate(rf.guard_rules):
-        for let in rule.block.assignments:
-            if isinstance(let.value, FunctionExpr):
-                out.append((ri, let.var, let.value))
+        for chain, block in _when_chains(rule):
+            for let in block.assignments:
+                if isinstance(let.value, FunctionExpr):
+                    out.append((ri, let.var, let.value, chain + [block]))
     return out
 
 
@@ -102,7 +125,7 @@ def _excluded_fn_vars(rf: RulesFile) -> Set[str]:
     """Function-let NAMES excluded from precompute (conservative,
     name-level, fixpoint over possibly-forward var references)."""
     info = []
-    for ri, var, fx in _fn_lets(rf):
+    for ri, var, fx, _chain in _fn_lets(rf):
         vars_, names = set(), set()
         _expr_refs(fx, vars_, names)
         info.append((var, vars_, names))
@@ -214,6 +237,9 @@ class _Slot:
     var: str = ""  # fn/lit
     pv: object = None  # lit
     fx: object = None  # expr (FunctionExpr)
+    # enclosing-Block chain (rule body + nested when-blocks) the
+    # precompute folds into a scope stack; empty = file/rule scope
+    chain: tuple = ()
 
 
 @dataclass
@@ -255,11 +281,22 @@ def fn_slots(rf: RulesFile) -> FnSlots:
         slots.append(slot)
         return len(slots) - 1
 
-    for ri, var, fx in _fn_lets(rf):
-        if var in excluded:
+    # function lets, incl. when-block lets at root basis; a (rule, name)
+    # bound more than once (body + when block, or two when blocks) is
+    # ambiguous under the lowering's (rule_idx, var) lookup — skip both
+    # so rules touching the name stay host-side
+    fn_lets = [t for t in _fn_lets(rf) if t[1] not in excluded]
+    name_counts: Dict[Tuple[int, str], int] = {}
+    for ri, var, _fx, _chain in fn_lets:
+        name_counts[(ri, var)] = name_counts.get((ri, var), 0) + 1
+    for ri, var, fx, chain in fn_lets:
+        if name_counts[(ri, var)] > 1:
             continue
         var_slots[(ri, var)] = add(
-            _Slot(key=("fn", ri, var), kind="fn", rule_idx=ri, var=var)
+            _Slot(
+                key=("fn", ri, var), kind="fn", rule_idx=ri, var=var,
+                chain=tuple(chain),
+            )
         )
 
     heads = _head_var_names(rf)
@@ -276,10 +313,19 @@ def fn_slots(rf: RulesFile) -> FnSlots:
                 )
             )
     for ri, rule in enumerate(rf.guard_rules):
-        for let in rule.block.assignments:
+        lit_lets = [
+            (chain, let)
+            for chain, block in _when_chains(rule)
+            for let in block.assignments
+            if isinstance(let.value, PV)
+        ]
+        lit_counts: Dict[str, int] = {}
+        for _chain, let in lit_lets:
+            lit_counts[let.var] = lit_counts.get(let.var, 0) + 1
+        for _chain, let in lit_lets:
             if (
-                isinstance(let.value, PV)
-                and let.var in heads
+                let.var in heads
+                and lit_counts[let.var] == 1
                 and _encodable_literal(let.value)
             ):
                 lit_slots[(ri, let.var)] = add(
@@ -299,47 +345,133 @@ def fn_slots(rf: RulesFile) -> FnSlots:
             vars_ & excluded
         )
 
-    from ..core.exprs import GuardAccessClause, ParameterizedNamedRuleClause
+    file_let_names = {let.var for let in rf.assignments}
+
+    def _root_safe(fx: FunctionExpr, bound: Set[str], vs_bound: Set[str]) -> bool:
+        """Inside a VALUE scope an inline call only precomputes when its
+        result is origin-independent: every query parameter must be
+        headed by a variable whose binding lives on the root-basis
+        chain (file / rule / enclosing when-block lets), with no name
+        shadowed by a value-scope binding."""
+        vars_, _names = set(), set()
+        _expr_refs(fx, vars_, _names)
+        if vars_ & vs_bound or not vars_ <= bound:
+            return False
+
+        def check(f: FunctionExpr) -> bool:
+            for p in f.parameters:
+                if isinstance(p, AccessQuery):
+                    if not (p.query and part_is_variable(p.query[0])):
+                        return False
+                elif isinstance(p, FunctionExpr) and not check(p):
+                    return False
+            return True
+
+        return check(fx)
+
+    from ..core.exprs import (
+        BlockGuardClause,
+        GuardAccessClause,
+        ParameterizedNamedRuleClause,
+        QFilter,
+        TypeBlock,
+        WhenBlockClause,
+    )
 
     for ri, rule in enumerate(rf.guard_rules):
 
-        def on_clause(c, ri=ri):
+        def bound_names(chain) -> Set[str]:
+            names = set(file_let_names)
+            for b in chain:
+                names.update(let.var for let in b.assignments)
+            return names
+
+        def on_expr(fx, chain, in_vs, vs_bound, ri=ri):
+            if id(fx) in expr_slots or not usable_expr(fx):
+                return
+            if in_vs and not _root_safe(fx, bound_names(chain), vs_bound):
+                return
+            expr_slots[id(fx)] = add(
+                _Slot(
+                    key=("expr", ri, len(expr_slots)), kind="expr",
+                    rule_idx=ri, fx=fx, chain=tuple(chain),
+                )
+            )
+
+        def walk_parts(parts, chain, vs_bound, ri=ri):
+            for part in parts:
+                if isinstance(part, QFilter):
+                    for disj in part.conjunctions:
+                        for cc in disj:
+                            walk_clause(cc, chain, True, vs_bound)
+
+        def walk_clause(c, chain, in_vs, vs_bound, ri=ri):
             if isinstance(c, GuardAccessClause):
                 cw = c.access_clause.compare_with
-                if isinstance(cw, FunctionExpr) and usable_expr(cw):
-                    expr_slots[id(cw)] = add(
-                        _Slot(
-                            key=("expr", ri, len(expr_slots)), kind="expr",
-                            rule_idx=ri, fx=cw,
-                        )
-                    )
+                if isinstance(cw, FunctionExpr):
+                    on_expr(cw, chain, in_vs, vs_bound)
+                walk_parts(c.access_clause.query.query, chain, vs_bound)
+                if isinstance(cw, AccessQuery):
+                    walk_parts(cw.query, chain, vs_bound)
             elif isinstance(c, ParameterizedNamedRuleClause):
                 for p in c.parameters:
-                    if isinstance(p, FunctionExpr) and usable_expr(p):
-                        expr_slots[id(p)] = add(
-                            _Slot(
-                                key=("expr", ri, len(expr_slots)),
-                                kind="expr", rule_idx=ri, fx=p,
-                            )
-                        )
-                    elif isinstance(p, PV) and _encodable_literal(p):
+                    if isinstance(p, FunctionExpr):
+                        # rule-call args lower at root scope only
+                        # (ir.lower_parameterized_call)
+                        if not in_vs:
+                            on_expr(p, chain, in_vs, vs_bound)
+                    elif isinstance(p, PV):
                         # literal call argument: the callee may use the
                         # parameter as a query head
-                        pv_slots[id(p)] = add(
-                            _Slot(
-                                key=("plit", ri, len(pv_slots)),
-                                kind="lit", rule_idx=ri, pv=p,
+                        if (
+                            not in_vs
+                            and id(p) not in pv_slots
+                            and _encodable_literal(p)
+                        ):
+                            pv_slots[id(p)] = add(
+                                _Slot(
+                                    key=("plit", ri, len(pv_slots)),
+                                    kind="lit", rule_idx=ri, pv=p,
+                                )
                             )
-                        )
+                    elif isinstance(p, AccessQuery):
+                        walk_parts(p.query, chain, vs_bound)
+            elif isinstance(c, WhenBlockClause):
+                for disj in c.conditions or []:
+                    for cc in disj:
+                        walk_clause(cc, chain, in_vs, vs_bound)
+                if in_vs:
+                    vb = vs_bound | {
+                        let.var for let in c.block.assignments
+                    }
+                    for disj in c.block.conjunctions:
+                        for cc in disj:
+                            walk_clause(cc, chain, True, vb)
+                else:
+                    ch = chain + (c.block,)
+                    for disj in c.block.conjunctions:
+                        for cc in disj:
+                            walk_clause(cc, ch, False, vs_bound)
+            elif isinstance(c, (BlockGuardClause, TypeBlock)):
+                if isinstance(c, BlockGuardClause):
+                    walk_parts(c.query.query, chain, vs_bound)
+                else:
+                    walk_parts(c.query, chain, vs_bound)
+                    for disj in c.conditions or []:
+                        for cc in disj:
+                            walk_clause(cc, chain, in_vs, vs_bound)
+                vb = vs_bound | {let.var for let in c.block.assignments}
+                for disj in c.block.conjunctions:
+                    for cc in disj:
+                        walk_clause(cc, chain, True, vb)
 
-        # TOP-LEVEL clauses only: nested scopes resolve against value
-        # scopes the rule-level precompute cannot reproduce
-        for disj in (rule.conditions or []):
+        base_chain = (rule.block,)
+        for disj in rule.conditions or []:
             for c in disj:
-                on_clause(c)
+                walk_clause(c, base_chain, False, set())
         for disj in rule.block.conjunctions:
             for c in disj:
-                on_clause(c)
+                walk_clause(c, base_chain, False, set())
 
     return FnSlots(
         slots=slots, var_slots=var_slots, lit_slots=lit_slots,
@@ -378,15 +510,19 @@ def precompute_fn_values(
     for i, doc in enumerate(docs):
         per: Dict[tuple, List[PV]] = {}
         root = RootScope(rf, doc)
-        rule_scopes: Dict[int, BlockScope] = {}
+        chain_scopes: Dict[tuple, BlockScope] = {}
 
-        def scope_of(ri: int):
-            if ri < 0:
+        def scope_for(chain):
+            """Fold the slot's enclosing-Block chain (rule body +
+            nested when-blocks, all root-basis) into a scope stack so
+            chained lets and shadowing resolve like the oracle's."""
+            if not chain:
                 return root
-            s = rule_scopes.get(ri)
+            key = tuple(id(b) for b in chain)
+            s = chain_scopes.get(key)
             if s is None:
-                s = BlockScope(rf.guard_rules[ri].block, doc, root)
-                rule_scopes[ri] = s
+                s = BlockScope(chain[-1], doc, scope_for(chain[:-1]))
+                chain_scopes[key] = s
             return s
 
         try:
@@ -396,7 +532,7 @@ def precompute_fn_values(
                 elif slot.kind == "fn":
                     per[slot.key] = [
                         q.value
-                        for q in scope_of(slot.rule_idx).resolve_variable(
+                        for q in scope_for(slot.chain).resolve_variable(
                             slot.var
                         )
                         if q.tag == RESOLVED
@@ -407,7 +543,7 @@ def precompute_fn_values(
                         for q in resolve_function(
                             slot.fx.name,
                             slot.fx.parameters,
-                            scope_of(slot.rule_idx),
+                            scope_for(slot.chain),
                         )
                         if q.tag == RESOLVED
                     ]

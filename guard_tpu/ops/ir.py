@@ -89,6 +89,11 @@ class CrossScopeRootVar(Unlowerable):
 class StepKey:
     key_ids: List[int]  # original key id + case-converted aliases
     drop_unres: bool = False  # `some`-marked variable splice
+    # slot into CompiledRules.kidc_tables: host-precomputed (D, N)
+    # "this node has a child under one of key_ids" column — the
+    # resolved/miss check is static per node, so the kernel never pays
+    # a count-children reduction for it
+    kc_slot: int = -1
 
 
 @dataclass
@@ -136,6 +141,8 @@ class StepAllIndices:
 @dataclass
 class StepIndex:
     index: int  # already abs()'d (eval_context.rs:119-140)
+    # host-precomputed "has a child at this list index" column slot
+    kc_slot: int = -1
 
 
 @dataclass
@@ -346,9 +353,15 @@ class CompiledRules:
     # through scalar_id, "key" through node_key_id
     bit_tables: List[Tuple[np.ndarray, str]] = field(default_factory=list)
     str_empty_slot: int = -1
-    # map / nested-list RHS literals, canonicalized per batch into the
-    # batch's struct-id space ('lit_struct' device array)
+    # map / nested-list RHS literals, evaluated per batch into the
+    # 'stri_m{i}'/'stri_c{i}'/'stri_l{i}' tri-state/loose columns
+    # (encoder.struct_literal_tri)
     struct_literals: List[PV] = field(default_factory=list)
+    # has-child column specs, one (D, N) bool device column each:
+    # ("k", key_id, ...) = node has a child under one of the key ids;
+    # ("i", index) = node has a child at the list index. Deduped across
+    # steps (_assign_bit_slots); computed per batch in device_arrays.
+    kidc_tables: List[tuple] = field(default_factory=list)
     # non-empty when a lowered rule reads a precomputed function
     # variable (StepFnVar): the batch must be encoded with
     # encode_batch(fn_values=precompute_fn_values(rf, docs),
@@ -359,6 +372,12 @@ class CompiledRules:
     # order between arbitrary document strings: a per-node rank column
     # over the lexicographically sorted intern table
     needs_str_rank: bool = False
+    # any rule builds (N, N)-shaped pairwise matrices (query-RHS
+    # compares, variable key interpolation): such rule files keep the
+    # standard node-bucket ceiling; files without them evaluate on the
+    # extended buckets (encoder.NODE_BUCKETS_EXTENDED) since every
+    # remaining primitive is O(N) in gather mode
+    needs_pairwise: bool = False
 
     def device_arrays(self, batch) -> dict:
         """Everything the kernel reads, as a flat dict of (D, ...)
@@ -380,9 +399,16 @@ class CompiledRules:
         if self.needs_struct_ids:
             out["struct_id"] = batch.struct_ids()
         if self.struct_literals:
-            out["lit_struct"] = batch.literal_struct_ids(
-                self.struct_literals, self.interner
-            )
+            # exact compare_eq tri-state (match, comparable) + loose_eq
+            # membership column per literal — host-evaluated once per
+            # canonical class (encoder.struct_literal_tri), read by the
+            # kernels' struct arm
+            for i, (m, c, lo) in enumerate(
+                batch.struct_literal_tri(self.struct_literals, self.interner)
+            ):
+                out[f"stri_m{i}"] = m
+                out[f"stri_c{i}"] = c
+                out[f"stri_l{i}"] = lo
         if self.needs_str_rank:
             strings = self.interner.strings
             rank = np.zeros(max(len(strings), 1), dtype=np.int32)
@@ -405,6 +431,26 @@ class CompiledRules:
                 safe = np.clip(ids, 0, len(table) - 1)
                 col = table[safe] & (ids >= 0) & (ids < len(table))
             out[f"bits{i}"] = col
+        if self.kidc_tables:
+            d, n = batch.node_kind.shape
+            flat = (
+                np.arange(d, dtype=np.int64)[:, None] * n
+                + np.maximum(batch.edge_parent, 0)
+            )
+            for i, spec in enumerate(self.kidc_tables):
+                if spec[0] == "k":
+                    match = np.isin(
+                        batch.edge_key_id, np.asarray(spec[1:])
+                    )
+                else:  # ("i", index)
+                    match = batch.edge_index == spec[1]
+                match &= batch.edge_valid
+                col = (
+                    np.bincount(flat[match], minlength=d * n)
+                    .reshape(d, n)
+                    .astype(bool)
+                )
+                out[f"kidc{i}"] = col
         return out
 
 
@@ -904,30 +950,12 @@ class _RuleLowering:
         raise Unlowerable(f"RHS literal kind {cw.type_info()}")
 
     def _struct_literal(self, pv: PV) -> RhsSpec:
-        """Map / nested-list literal -> canonical-struct-id equality.
-
-        Valid only where the oracle's comparison degrades to loose
-        structural equality: REGEX values would regex-match inside
-        compare_eq (path_value.rs:1083-1105) and RANGE/CHAR have
-        coercion semantics, so literals containing them refuse."""
-
-        def check(v: PV) -> None:
-            if v.kind in (REGEX, CHAR, RANGE_INT, RANGE_FLOAT, RANGE_CHAR):
-                raise Unlowerable("regex/range/char inside struct literal")
-            if v.kind in (INT, FLOAT):
-                from .encoder import num_key as _nk
-
-                if _nk(v.kind, v.val) is None:
-                    raise Unlowerable("struct literal number without exact encoding")
-            if v.kind == 7:
-                for e in v.val:
-                    check(e)
-            elif v.kind == 8:
-                for e in v.val.values.values():
-                    check(e)
-
-        check(pv)
-        self.needs_struct_ids = True
+        """Map / nested-list literal -> two device encodings, chosen by
+        the kernel per use: canonical-struct-id equality (loose_eq, for
+        IN membership) and the exact compare_eq tri-state columns
+        (encoder.struct_literal_tri — covers regex matching inside maps
+        (path_value.rs:1083-1105), range membership, and NotComparable
+        propagation with the reference's per-entry short-circuit)."""
         is_list = pv.kind == 7
         for i, existing in enumerate(self.struct_literals):
             if existing is pv:
@@ -1134,21 +1162,12 @@ class _RuleLowering:
         if not ac.comparator.is_unary():
             try:
                 rhs = self.lower_rhs(ac.compare_with, block_vars, op=ac.comparator)
-                if rhs.kind == "struct" and (
-                    ac.comparator != CmpOperator.Eq or ac.comparator_inverse
+                if rhs.kind == "struct" and ac.comparator not in (
+                    CmpOperator.Eq, CmpOperator.In,
                 ):
-                    # struct-id equality == compare_eq only on the
-                    # plain == path: `!=`/`not` keeps NotComparable
-                    # FAIL while loose-id inequality would PASS
-                    raise Unlowerable("struct literal RHS outside plain ==")
-                if (
-                    rhs.kind == "list"
-                    and rhs.items
-                    and ac.comparator == CmpOperator.Eq
-                    and ac.comparator_inverse
-                    and any(it.kind == "struct" for it in rhs.items)
-                ):
-                    raise Unlowerable("struct items in negated list equality")
+                    # ordering vs map literal: NotComparable -> FAIL
+                    # both ways (compare_values raises on MAP kinds)
+                    rhs = RhsSpec(kind="never")
             except Unlowerable:
                 # non-literal RHS: a query (resolved per document in
                 # the same scope as the LHS) or an inline function
@@ -1165,13 +1184,6 @@ class _RuleLowering:
                     rhs_query_steps = [StepFnVar(key_id=fn_key_id(slot))]
                     rhs_root_basis = True
                     if not eval_from_root:
-                        if (
-                            ac.comparator == CmpOperator.Eq
-                            and ac.comparator_inverse
-                        ):
-                            raise Unlowerable(
-                                "negated Eq against function RHS"
-                            )
                         rhs_query_from_root = True
                     if ac.comparator in (CmpOperator.Eq, CmpOperator.In):
                         self.needs_struct_ids = True
@@ -1203,19 +1215,10 @@ class _RuleLowering:
                     rhs_root_basis = True
                     if not eval_from_root:
                         # per-origin LHS vs one shared root-resolved
-                        # RHS set (kernels handle Eq via per-origin
-                        # reverse membership, In and orderings via the
-                        # shared set)
-                        if (
-                            ac.comparator == CmpOperator.Eq
-                            and ac.comparator_inverse
-                        ):
-                            # != needs the 4-way diff/reverse-diff
-                            # complement against a per-origin view of
-                            # the shared set — host fallback
-                            raise Unlowerable(
-                                "negated Eq against root-bound query RHS"
-                            )
+                        # RHS set (kernels handle Eq — incl. the
+                        # negated 4-way diff/reverse-diff complement —
+                        # via per-origin reverse membership, In and
+                        # orderings via the shared set)
                         rhs_query_from_root = True
                     # else: the whole clause evaluates once from the
                     # root selection — both sides resolve there with
@@ -1475,8 +1478,16 @@ def _assign_bit_slots(compiled: CompiledRules) -> None:
     ordering clauses); the empty-string table only for elementwise
     Empty clauses."""
     seen = {}
+    seen_kidc = {}
     uses_empty = [False]
     uses_fn = [False]
+    uses_interp = [False]
+
+    def kidc_slot(spec: tuple) -> int:
+        if spec not in seen_kidc:
+            seen_kidc[spec] = len(compiled.kidc_tables)
+            compiled.kidc_tables.append(spec)
+        return seen_kidc[spec]
 
     def slot(arr: np.ndarray, target: str) -> int:
         k = (id(arr), target)
@@ -1514,9 +1525,15 @@ def _assign_bit_slots(compiled: CompiledRules) -> None:
             elif isinstance(s, StepFilter):
                 do_conjs(s.conjunctions)
             elif isinstance(s, StepKeyInterpVar):
+                uses_interp[0] = True
                 do_steps(s.var_steps)
             elif isinstance(s, StepFnVar):
                 uses_fn[0] = True
+            elif isinstance(s, StepKey):
+                if not s.drop_unres:
+                    s.kc_slot = kidc_slot(("k",) + tuple(s.key_ids))
+            elif isinstance(s, StepIndex):
+                s.kc_slot = kidc_slot(("i", s.index))
 
     def do_node(n) -> None:
         if isinstance(n, CClause):
@@ -1547,4 +1564,9 @@ def _assign_bit_slots(compiled: CompiledRules) -> None:
         do_conjs(r.conjunctions)
     if uses_empty[0]:
         compiled.str_empty_slot = slot(compiled.str_empty_bits, "scalar")
+    compiled.needs_pairwise = (
+        compiled.needs_struct_ids
+        or compiled.needs_str_rank
+        or uses_interp[0]
+    )
     return uses_fn[0]

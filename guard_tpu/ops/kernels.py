@@ -30,11 +30,19 @@ mesh by guard_tpu/parallel/mesh.py.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# node-bucket size at and above which the traversal primitives switch
+# from fused one-hot masked reductions (O(N^2) lanes, fastest for small
+# docs where the compare fuses into the consuming reduction) to XLA
+# gather / segment-sum (O(N) work, the only formulation whose cost
+# scales linearly with document size). Overridable for bake-off probes.
+GATHER_MIN_NODES = int(os.environ.get("GUARD_TPU_GATHER_MIN_NODES", "4096"))
 
 from ..core.values import BOOL, FLOAT, INT, LIST, MAP, NULL, STRING
 from ..core.values import LOWER_INCLUSIVE, UPPER_INCLUSIVE
@@ -66,9 +74,20 @@ from ..core.exprs import CmpOperator
 
 
 class _DocArrays:
-    """Unbatched (per-document) views used inside the vmap'd kernel."""
+    """Unbatched (per-document) views used inside the vmap'd kernel.
 
-    def __init__(self, arrays: Dict[str, jnp.ndarray]):
+    `gather_mode` selects the traversal-primitive formulation:
+    False = fused one-hot masked reductions (O(N^2) lanes per
+    primitive, fastest below ~2k nodes where the compare fuses into
+    the consuming reduction and XLA streams it on the VPU); True =
+    XLA gather/scatter (O(N) work per primitive — `jnp.take` on the
+    static parent column and sorted segment-sums — the only
+    formulation whose cost stays proportional to document size, used
+    for the big buckets where the one-hot's quadratic lane count
+    collapses MFU). Chosen per node bucket by BatchEvaluator."""
+
+    def __init__(self, arrays: Dict[str, jnp.ndarray], gather_mode: bool = False):
+        self.gather_mode = gather_mode
         self.node_kind = arrays["node_kind"]
         self.node_parent = arrays["node_parent"]
         self.scalar_id = arrays["scalar_id"]
@@ -79,11 +98,27 @@ class _DocArrays:
         self.node_index = arrays["node_index"]
         self.node_parent_kind = arrays["node_parent_kind"]
         self.struct_id = arrays.get("struct_id")  # only for query-RHS rules
-        self.lit_struct = arrays.get("lit_struct")  # (L,) struct-literal ids
+        # per-struct-literal (N,) bool columns (encoder.struct_literal_tri):
+        # exact compare_eq match/comparable + loose_eq membership
+        self.stri_m = {
+            int(k[6:]): v for k, v in arrays.items() if k.startswith("stri_m")
+        }
+        self.stri_c = {
+            int(k[6:]): v for k, v in arrays.items() if k.startswith("stri_c")
+        }
+        self.stri_l = {
+            int(k[6:]): v for k, v in arrays.items() if k.startswith("stri_l")
+        }
         self.str_rank = arrays.get("str_rank")  # only for ordering-RHS rules
         # host-precomputed per-node bool columns, one per bit-table slot
         self.bits = {
             int(k[4:]): v for k, v in arrays.items() if k.startswith("bits")
+        }
+        # host-precomputed has-child columns (ir.CompiledRules
+        # .kidc_tables): the StepKey/StepIndex resolved checks are
+        # static per node, so no count-children reduction is paid
+        self.kidc = {
+            int(k[4:]): v for k, v in arrays.items() if k.startswith("kidc")
         }
         self.empty_slot = -1  # set by build_doc_evaluator
         self.n = self.node_kind.shape[0]
@@ -116,6 +151,9 @@ def _parent_onehot(d: _DocArrays) -> jnp.ndarray:
 def _parent_select(d: _DocArrays, vec: jnp.ndarray) -> jnp.ndarray:
     """(N,) int32 per-node values -> (N,) value of each node's parent
     (0 where there is no parent: root and padding)."""
+    if d.gather_mode:
+        got = jnp.take(vec, jnp.maximum(d.node_parent, 0))
+        return jnp.where(d.node_parent >= 0, got, 0)
     oh = _parent_onehot(d)
     return jnp.sum(jnp.where(oh, vec[None, :], 0), axis=1)
 
@@ -123,6 +161,14 @@ def _parent_select(d: _DocArrays, vec: jnp.ndarray) -> jnp.ndarray:
 def _count_children(d: _DocArrays, pred: jnp.ndarray) -> jnp.ndarray:
     """(N,) bool per-node predicate -> (N,) int32 count of each node's
     children satisfying it."""
+    if d.gather_mode:
+        # scatter-add onto parents; the root's own lane (parent -1 ->
+        # clamped 0) never carries pred (pred at the root reflects the
+        # root node, whose parent clamp targets itself) — mask it out
+        val = (pred & (d.node_parent >= 0)).astype(jnp.int32)
+        return jax.ops.segment_sum(
+            val, jnp.maximum(d.node_parent, 0), num_segments=d.n
+        )
     oh = _parent_onehot(d)
     return jnp.sum(oh & pred[:, None], axis=0, dtype=jnp.int32)
 
@@ -130,6 +176,12 @@ def _count_children(d: _DocArrays, pred: jnp.ndarray) -> jnp.ndarray:
 def _segment_count(d: _DocArrays, sel, pred) -> jnp.ndarray:
     """(N+1,) counts of pred-true selected nodes per origin label."""
     active = pred & (sel > 0)
+    if d.gather_mode:
+        return jax.ops.segment_sum(
+            active.astype(jnp.int32),
+            jnp.where(active, sel, 0),
+            num_segments=d.n + 1,
+        )
     labels = jnp.where(active, sel, 0)
     mask = labels[None, :] == jnp.arange(d.n + 1, dtype=jnp.int32)[:, None]
     return jnp.sum(mask & active[None, :], axis=1, dtype=jnp.int32)
@@ -176,6 +228,11 @@ class _UnresAcc:
         if scalar:
             return jnp.sum(self.miss_count, dtype=jnp.int32)
         weight = jnp.where(self.miss_labels > 0, self.miss_count, 0)
+        if d.gather_mode:
+            return jax.ops.segment_sum(
+                weight, jnp.maximum(self.miss_labels, 0),
+                num_segments=d.n + 1,
+            )
         mask = self.miss_labels[None, :] == jnp.arange(
             d.n + 1, dtype=jnp.int32
         )[:, None]
@@ -207,10 +264,15 @@ def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None)
         for kid in step.key_ids:
             kh = kh | (d.node_key_id == kid)
         new_sel = jnp.where(kh, psel, 0)
-        resolved = _count_children(d, kh) > 0
-        miss = (sel > 0) & ~resolved
         if not step.drop_unres:
-            acc.add(sel, miss)
+            # resolved = "has a child under one of the key ids" — a
+            # static per-node fact, host-precomputed (step.kc_slot)
+            resolved = (
+                d.kidc[step.kc_slot]
+                if step.kc_slot >= 0
+                else _count_children(d, kh) > 0
+            )
+            acc.add(sel, (sel > 0) & ~resolved)
         return new_sel
 
     if isinstance(step, StepKeyInterpLit):
@@ -314,7 +376,11 @@ def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None)
     if isinstance(step, StepIndex):
         at_idx = d.node_index == step.index
         new_sel = jnp.where(at_idx, psel, 0)
-        resolved = _count_children(d, at_idx & (psel > 0)) > 0
+        resolved = (
+            d.kidc[step.kc_slot]
+            if step.kc_slot >= 0
+            else _count_children(d, at_idx & (psel > 0)) > 0
+        )
         miss = (sel > 0) & ((d.node_kind != LIST) | ~resolved)
         acc.add(sel, miss)
         return new_sel
@@ -410,11 +476,13 @@ def _num_gt(d: _DocArrays, key) -> jnp.ndarray:
     return (d.num_hi > hi) | ((d.num_hi == hi) & (d.num_lo > lo))
 
 
-def _compare_scalar_full(d: _DocArrays, rhs: RhsSpec, op: CmpOperator):
+def _compare_scalar_full(d: _DocArrays, rhs: RhsSpec, op: CmpOperator,
+                         loose: bool = False):
     """(match (N,), comparable (N,)) of `node <op> literal` per node.
     Non-comparable pairs FAIL regardless of `not` inversion
     (operators.rs:195-206 keeps NotComparable through the inversion pass,
-    operators.rs:774-777)."""
+    operators.rs:774-777). `loose` switches struct literals to loose_eq
+    membership semantics (never NotComparable — IN containment)."""
     kind = d.node_kind
 
     if rhs.kind == "never":
@@ -424,11 +492,13 @@ def _compare_scalar_full(d: _DocArrays, rhs: RhsSpec, op: CmpOperator):
         return never, never
 
     if rhs.kind == "struct":
-        # map / nested-list literal: canonical-struct-id equality
-        # (loose_eq classes; lowering gates the op/not combinations
-        # where compare_eq and loose_eq could diverge)
-        m = d.struct_id == d.lit_struct[rhs.struct_slot]
-        return m, m
+        # map / nested-list literal: host-precomputed per-node columns
+        # with exact compare_eq tri-state (or loose_eq membership)
+        # semantics, encoder.struct_literal_tri
+        if loose:
+            m = d.stri_l[rhs.struct_slot]
+            return m, m
+        return d.stri_m[rhs.struct_slot], d.stri_c[rhs.struct_slot]
 
     if op == CmpOperator.Eq or op == CmpOperator.In:
         if rhs.kind == "str":
@@ -500,8 +570,9 @@ def _compare_scalar_full(d: _DocArrays, rhs: RhsSpec, op: CmpOperator):
     return comparable & out, comparable
 
 
-def _compare_scalar(d: _DocArrays, rhs: RhsSpec, op: CmpOperator):
-    return _compare_scalar_full(d, rhs, op)[0]
+def _compare_scalar(d: _DocArrays, rhs: RhsSpec, op: CmpOperator,
+                    loose: bool = False):
+    return _compare_scalar_full(d, rhs, op, loose=loose)[0]
 
 
 def _eval_binary_outcomes(d: _DocArrays, c: CClause, sel_leaf):
@@ -616,7 +687,7 @@ def _eval_binary_outcomes(d: _DocArrays, c: CClause, sel_leaf):
             # inversion under `not` (operators.rs value_in/list_in)
             m = jnp.zeros(d.n, bool)
             for item in rhs.items:
-                m = m | _compare_scalar(d, item, CmpOperator.Eq)
+                m = m | _compare_scalar(d, item, CmpOperator.Eq, loose=True)
             if rhs.items and rhs.items[0].kind == "struct" and rhs.items[0].struct_is_list:
                 # rhs's first item is a LIST: whole-value membership
                 # for every leaf kind (operators.rs:317-327 list-of-
@@ -790,18 +861,31 @@ def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses) -> jnp
             (d.node_parent[None, :] == jnp.arange(d.n)[:, None]).T
         ).astype(jnp.float32)  # childmat[c, j] = 1 iff parent(c) == j
         in_list = (eq.astype(jnp.float32) @ childmat) > 0  # (i, j)
-        contained = eq | (
-            (~is_list)[:, None] & is_list[None, :] & in_list
+        # l LIST in r LIST is mode-dependent (operators.rs:256-321 /
+        # evaluator._contained_in): MEMBERSHIP-among-elements when the
+        # rhs's FIRST element is itself a list (identity does NOT imply
+        # containment there), SUBSET-of-elements otherwise (an empty
+        # lhs is a vacuous success); both recurse through loose_eq
+        # (= canonical struct-id equality between document values).
+        first_is_list = (
+            _count_children(d, (d.node_index == 0) & is_list) > 0
         )
-        # l LIST in r LIST is mode-dependent (operators.rs:256-321):
-        # subset-of-elements normally, but MEMBERSHIP-among-elements
-        # when the rhs is a list of lists — identity does NOT imply
-        # containment there — and both recurse through loose_eq. The
-        # kernel does not model either; flag every list-vs-list pair
-        # unsure so the oracle decides.
-        pair = same_origin & (rhs_sel[None, :] > 0)
-        unsure = jnp.any(pair & is_list[:, None] & is_list[None, :])
-        d.unsure_acc.append(unsure)
+        membership_mode = first_is_list & (d.child_count > 0)
+        # subset[l, r]: no child of l fails membership among r's
+        # children — in_list[c, r] is defined for every node c, so one
+        # more boolean matmul regroups it by l's children
+        notin = (~in_list).astype(jnp.float32)
+        bad = jnp.matmul(
+            childmat.T, notin, preferred_element_type=jnp.float32
+        )  # (l, r): count of l's children not loose_eq-in r
+        subset = bad == 0.0
+        ll = jnp.where(membership_mode[None, :], in_list, subset)
+        ll_pair = is_list[:, None] & is_list[None, :]
+        contained = jnp.where(
+            ll_pair,
+            ll,
+            eq | ((~is_list)[:, None] & is_list[None, :] & in_list),
+        )
 
     # member tests within each origin
     m_lhs_in_rhs = jnp.any(same_origin & (rhs_sel[None, :] > 0) & contained, axis=1)
@@ -834,7 +918,40 @@ def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses) -> jnp
         use_lhs_diff = n_lhs > n_rhs
         diff_cnt = jnp.where(use_lhs_diff, cnt_lhs_not_in, cnt_rhs_not_in)
         q_success = diff_cnt == 0
-        if c.op_not:
+        if c.op_not and c.rhs_query_from_root:
+            # reverse-diff with ONE shared root-resolved RHS set: the
+            # diff membership is per (origin, node) — (N+1, N) masks
+            # built by boolean matmuls on the MXU (see the non-root arm
+            # below for the 4-way side-choice semantics)
+            eq_f = eq.astype(jnp.float32)
+            diff_l_oh = lhs_oh & (lhs_here & ~m_lhs_in_rhs)[None, :]
+            diff_r_oh = rhs_here[None, :] & ~rhs_in_lhs  # (N+1, N)[o, r]
+            # in-diff-of-origin-o tests, for ANY node x:
+            #   L[o, x] = x loose_eq some lhs-side diff member of o
+            #   M[o, x] = x loose_eq some rhs-side diff member of o
+            L = (
+                jnp.matmul(
+                    diff_l_oh.astype(jnp.float32), eq_f,
+                    preferred_element_type=jnp.float32,
+                )
+                > 0.0
+            )
+            M = (
+                jnp.matmul(
+                    diff_r_oh.astype(jnp.float32), eq_f,
+                    preferred_element_type=jnp.float32,
+                )
+                > 0.0
+            )
+            in_diff = jnp.where(use_lhs_diff[:, None], L, M)
+            rdiff_a = jnp.sum(lhs_oh & ~in_diff, axis=1, dtype=jnp.int32)
+            rdiff_b = jnp.sum(
+                rhs_here[None, :] & ~in_diff, axis=1, dtype=jnp.int32
+            )
+            use_rhs_rdiff = rhs_total >= lhs_total
+            rdiff_cnt = jnp.where(use_rhs_rdiff, rdiff_b, rdiff_a)
+            q_success = jnp.where(q_success, False, rdiff_cnt == 0)
+        elif c.op_not:
             # reverse-diff (operator_compare's inversion arm): the
             # FORWARD diff side is chosen by RESOLVED counts
             # (use_lhs_diff above, :395), but the REVERSE complement
@@ -1189,11 +1306,18 @@ def eval_rule(d: _DocArrays, rule: CRule, rule_statuses) -> Tuple[jnp.ndarray, j
 def build_doc_evaluator(compiled: CompiledRules, with_unsure: bool = False):
     """Returns fn(per-doc arrays dict) -> (num_rules,) int8 statuses,
     or (statuses, unsure (num_rules,) bool) when with_unsure. The
-    arrays dict is CompiledRules.device_arrays(batch) sliced per doc."""
+    arrays dict is CompiledRules.device_arrays(batch) sliced per doc.
+
+    The traversal-primitive formulation is picked at TRACE time from
+    the node-bucket shape: one-hot masked reductions below
+    GATHER_MIN_NODES, O(N) gather/segment-sum at and above it (the
+    one-hot's N^2 lane count is quadratic in bucket size while the
+    walk only ever touches N parent edges)."""
     empty_slot = compiled.str_empty_slot
 
     def evaluate(arrays: Dict[str, jnp.ndarray]):
-        d = _DocArrays(arrays)
+        n = arrays["node_kind"].shape[-1]
+        d = _DocArrays(arrays, gather_mode=n >= GATHER_MIN_NODES)
         d.empty_slot = empty_slot
         d.rule_unsure = []
         statuses: List[jnp.ndarray] = []

@@ -167,14 +167,26 @@ def evaluate_bucketed(evaluator, n_rules: int, batch: DocBatch):
     `last_unsure` attribute (ShardedBatchEvaluator, RuleShardedEvaluator).
 
     Returns (statuses (D, R) int8, unsure (D, R) bool, host_docs): each
-    size-bucket group evaluates at its own padded shape (the kernel is
-    O(N^2)/doc/step, so padding everyone to the largest document wastes
-    quadratic work); documents beyond the largest bucket are left
-    SKIP-filled and returned in `host_docs` for CPU-oracle evaluation."""
-    from ..ops.encoder import split_batch_by_size
+    size-bucket group evaluates at its own padded shape (padding
+    everyone to the largest document wastes quadratic work in the
+    one-hot buckets); documents beyond the active ceiling are left
+    SKIP-filled and returned in `host_docs` for CPU-oracle evaluation.
+    Rule files without pairwise (N, N) matrices use the extended
+    buckets — documents up to 64k nodes stay on device."""
+    from ..ops.encoder import (
+        NODE_BUCKETS,
+        NODE_BUCKETS_EXTENDED,
+        split_batch_by_size,
+    )
     from ..ops.ir import SKIP
 
-    groups, oversize = split_batch_by_size(batch)
+    compiled = getattr(evaluator, "compiled", None)
+    buckets = (
+        NODE_BUCKETS
+        if compiled is None or compiled.needs_pairwise
+        else NODE_BUCKETS_EXTENDED
+    )
+    groups, oversize = split_batch_by_size(batch, buckets)
     statuses = np.full((batch.n_docs, n_rules), SKIP, np.int8)
     unsure = np.zeros((batch.n_docs, n_rules), bool)
     for sub, idx in groups:

@@ -178,8 +178,12 @@ def _slice_compiled(compiled: CompiledRules, indices: List[int]) -> CompiledRule
         needs_struct_ids=compiled.needs_struct_ids,
         needs_unsure=compiled.needs_unsure,
         bit_tables=compiled.bit_tables,  # slots stay valid: shared specs
+        kidc_tables=compiled.kidc_tables,  # ditto (has-child columns)
         str_empty_slot=compiled.str_empty_slot,
         struct_literals=compiled.struct_literals,
+        needs_str_rank=compiled.needs_str_rank,
+        needs_pairwise=compiled.needs_pairwise,
+        fn_vars=compiled.fn_vars,
     )
 
 

@@ -28,6 +28,10 @@ class Reader:
     def read(self) -> str:
         return self._source.read()
 
+    def stream(self):
+        """Iterate lines without waiting for EOF (serve --stdio)."""
+        return iter(self._source.readline, "")
+
 
 class Writer:
     def __init__(self, out: Optional[TextIO] = None, err: Optional[TextIO] = None):
@@ -49,6 +53,9 @@ class Writer:
 
     def writeln_err(self, s: str = "") -> None:
         self.err.write(s + "\n")
+
+    def flush(self) -> None:
+        self.out.flush()
 
     def stripped(self) -> str:
         """Captured stdout contents (buffered writers only)."""

@@ -1,0 +1,53 @@
+# Install guard-tpu and smoke-test the CLI (Windows PowerShell).
+#
+# Equivalent of the reference's install-guard.ps1
+# (/root/reference/install-guard.ps1, which downloads a pinned release
+# binary and symlinks it into ~\.guard\bin); guard-tpu is a Python
+# package, so the install path is pip. Shares the smoke-test contract
+# with install-guard-tpu.sh: `--version` must print, and a tiny payload
+# validate must exit 0.
+#
+#   powershell -File install-guard-tpu.ps1              # this checkout
+#   powershell -File install-guard-tpu.ps1 guard-tpu==0.1.0
+
+param(
+    [string]$Requirement = ""
+)
+
+$ErrorActionPreference = "Stop"
+
+function err($msg) {
+    Write-Error $msg
+    exit 1
+}
+
+function check_requirements {
+    if (-not (Get-Command python -ErrorAction SilentlyContinue)) {
+        err "python not found on PATH"
+    }
+}
+
+function main {
+    check_requirements
+
+    $req = $Requirement
+    if ([string]::IsNullOrEmpty($req)) {
+        $req = $PSScriptRoot
+    }
+
+    Write-Host "installing guard-tpu from: $req"
+    python -m pip install --upgrade $req
+    if ($LASTEXITCODE -ne 0) { err "pip install failed" }
+
+    # smoke test: version + a tiny payload validate (exit 0 expected)
+    guard-tpu --version
+    if ($LASTEXITCODE -ne 0) { err "guard-tpu --version failed" }
+
+    $payload = '{"rules":["rule ok { this exists }"],"data":["{\"a\":1}"]}'
+    $payload | guard-tpu validate --payload -S none | Out-Null
+    if ($LASTEXITCODE -ne 0) { err "payload validate smoke test failed" }
+
+    Write-Host "guard-tpu installed and working"
+}
+
+main

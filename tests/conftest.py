@@ -3,7 +3,12 @@ tests exercise real multi-device paths without TPU hardware."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# HARD set (not setdefault): the ambient environment on TPU driver
+# hosts exports JAX_PLATFORMS=axon, and test SUBPROCESSES (CLI parity
+# tests) inherit os.environ — with a wedged TPU tunnel they would hang
+# at device discovery. Tests that exercise ambient-platform handling
+# (test_multichip_dryrun) build their own env explicitly.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,196 +1,21 @@
-"""Seeded randomized differential fuzz of the DEVICE lowering: random
-rule files over random documents, every (doc, rule) status compared
-between the compiled kernels and the CPU oracle. This explores
-interactions the fixed matrices cannot (filters over function vars,
-orderings against query RHS inside when gates, interpolation chained
-with membership, ...). Deterministic seeds keep CI stable; bump TRIALS
-locally for deeper soaks."""
+"""Seeded randomized differential fuzz of the DEVICE lowering — the CI
+smoke tier of tools/kernel_fuzz.py (the nightly tier runs the same
+generator for a 420 s budget plus corpus-seeded trials). Random rule
+files over random documents; every (doc, rule) status compared between
+the compiled kernels and the CPU oracle. Deterministic seeds keep CI
+stable; the tagged grammar lets the test assert the generator really
+exercises every lowered construct family."""
 
+import pathlib
 import random
+import sys
 
 import pytest
 
-from guard_tpu.core.errors import GuardError
-from guard_tpu.core.parser import parse_rules_file
-from guard_tpu.core.scopes import RootScope
-from guard_tpu.core.evaluator import eval_rules_file
-from guard_tpu.core.values import from_plain
-from guard_tpu.ops.encoder import encode_batch
-from guard_tpu.ops.fnvars import precompute_fn_values
-from guard_tpu.ops.ir import compile_rules_file
-from guard_tpu.ops.kernels import BatchEvaluator
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
 
-STATUS = {0: "PASS", 1: "FAIL", 2: "SKIP"}
-
-KEYS = ["Type", "Name", "Size", "Enc", "Tags", "Props", "Env", "Arn", "Vals"]
-TYPES = ["Bucket", "Volume", "Task", "Other"]
-STRS = ["prod", "dev", "a", "arn:aws:s3", "PROD-1", ""]
-NUMS = [0, 1, 7, 443, 16777217, -3]
-
-
-def _rand_value(rng, depth=0):
-    r = rng.random()
-    if depth < 2 and r < 0.25:
-        return {
-            rng.choice(KEYS): _rand_value(rng, depth + 1)
-            for _ in range(rng.randint(1, 3))
-        }
-    if depth < 2 and r < 0.4:
-        return [_rand_value(rng, depth + 1) for _ in range(rng.randint(0, 3))]
-    r = rng.random()
-    if r < 0.35:
-        return rng.choice(STRS)
-    if r < 0.6:
-        return rng.choice(NUMS)
-    if r < 0.7:
-        return rng.random() * 100
-    if r < 0.8:
-        return rng.choice([True, False])
-    if r < 0.9:
-        return None
-    return rng.choice(STRS)
-
-
-def _rand_doc(rng):
-    resources = {}
-    for i in range(rng.randint(1, 4)):
-        res = {"Type": rng.choice(TYPES)}
-        for _ in range(rng.randint(1, 4)):
-            res[rng.choice(KEYS)] = _rand_value(rng)
-        resources[f"r{i}"] = res
-    doc = {"Resources": resources}
-    if rng.random() < 0.4:
-        doc["Settings"] = {"Allowed": rng.sample(STRS, 2), "Cap": rng.choice(NUMS)}
-    return doc
-
-
-def _lit(rng):
-    r = rng.random()
-    if r < 0.3:
-        return f"'{rng.choice(STRS)}'"
-    if r < 0.5:
-        return str(rng.choice(NUMS))
-    if r < 0.6:
-        return rng.choice(["true", "false", "null", "1.5"])
-    if r < 0.7:
-        return rng.choice(["/prod/", "/^arn:/", "/\\d+/"])
-    if r < 0.8:
-        return rng.choice(["r(0,100)", "r[1,443]"])
-    return rng.choice(["['prod', 'dev']", "[0, 1, 443]", "[]"])
-
-
-def _op(rng):
-    return rng.choice(["==", "!=", ">", ">=", "<", "<=", "in", "not in"])
-
-
-def _unary(rng):
-    return rng.choice(
-        ["exists", "!exists", "empty", "!empty", "is_string", "is_list", "is_int"]
-    )
-
-
-def _clause(rng, i):
-    key = rng.choice(KEYS)
-    key2 = rng.choice(KEYS)
-    some = rng.choice(["", "some "])
-    shapes = [
-        lambda: f"{some}Resources.*.{key} {_op(rng)} {_lit(rng)}",
-        lambda: f"{some}Resources.*.{key} {_unary(rng)}",
-        lambda: f"{some}Resources.*[ Type == '{rng.choice(TYPES)}' ].{key} {_op(rng)} {_lit(rng)}",
-        lambda: f"{some}Resources.*.{key}.{key2} {_op(rng)} {_lit(rng)}",
-        lambda: f"{some}Resources.*.{key} {_op(rng)} Resources.*.{key2}",
-        lambda: f"{some}Resources.*[ {key} {_unary(rng)} ].{key2}[*] {_op(rng)} {_lit(rng)}",
-        lambda: f"Resources[ keys == /r\\d/ ].{key} {_unary(rng)}",
-        lambda: f"Resources[ keys {rng.choice(['in', 'not in', '!='])} {rng.choice(['/r1/', chr(39) + 'r0' + chr(39)])} ].{key} {_unary(rng)}",
-        lambda: f"{some}Resources.*.{key}[0] {_op(rng)} {_lit(rng)}",
-        lambda: f"Resources.*.{key} {{ this {_op(rng)} {_lit(rng)} }}",
-        lambda: f"{some}Resources.*.Tags[*].{key} {_op(rng)} {_lit(rng)}",
-    ]
-    return rng.choice(shapes)()
-
-
-def _rand_rules(rng, ti):
-    parts = []
-    nv = rng.randint(0, 2)
-    var_names = []
-    for v in range(nv):
-        kind = rng.random()
-        key = rng.choice(KEYS)
-        if kind < 0.4:
-            parts.append(
-                f"let v{v} = Resources.*[ Type == '{rng.choice(TYPES)}' ]"
-            )
-        elif kind < 0.6:
-            parts.append(f"let v{v} = some Resources.*.{key}")
-        elif kind < 0.75:
-            parts.append(f"let v{v} = count(Resources.*.{key})")
-        elif kind < 0.9:
-            parts.append(f"let v{v} = to_upper(Resources.*.Name)")
-        else:
-            parts.append(f"let v{v} = parse_int(Resources.*.Size)")
-        var_names.append((f"v{v}", kind))
-    for ri in range(rng.randint(2, 4)):
-        gate = ""
-        if rng.random() < 0.5:
-            if var_names and rng.random() < 0.5:
-                vn, kind = rng.choice(var_names)
-                if kind < 0.6:
-                    gate = f" when %{vn} !empty"
-                elif kind < 0.75:
-                    gate = f" when %{vn} {rng.choice(['==', '>', '<='])} {rng.choice(NUMS)}"
-                else:
-                    gate = f" when %{vn} !empty"
-            else:
-                gate = " when Resources exists"
-        body = []
-        for ci in range(rng.randint(1, 3)):
-            if var_names and rng.random() < 0.35:
-                vn, kind = rng.choice(var_names)
-                if kind < 0.4:  # resource-set var
-                    body.append(
-                        rng.choice(
-                            [
-                                f"%{vn}.{rng.choice(KEYS)} {_op(rng)} {_lit(rng)}",
-                                f"%{vn}[ {rng.choice(KEYS)} exists ].{rng.choice(KEYS)} {_unary(rng)}",
-                                f"%{vn} {_unary(rng)}",
-                            ]
-                        )
-                    )
-                elif kind < 0.6:  # string-set var (some Resources.*.key)
-                    body.append(
-                        rng.choice(
-                            [
-                                f"%{vn} {_op(rng)} {rng.choice(NUMS)}",
-                                f"Resources.%{vn} {_unary(rng)}",
-                                f"Resources.%{vn}[0] {_unary(rng)}",
-                                f"Resources.*.{rng.choice(KEYS)} IN %{vn}",
-                            ]
-                        )
-                    )
-                elif kind < 0.75:
-                    body.append(f"%{vn} {_op(rng)} {rng.choice(NUMS)}")
-                else:
-                    body.append(f"{rng.choice(['some ', ''])}%{vn} {_op(rng)} {_lit(rng)}")
-            else:
-                body.append(_clause(rng, ci))
-        joiner = " or\n    " if rng.random() < 0.25 else "\n    "
-        parts.append(
-            f"rule t{ti}_r{ri}{gate} {{\n    " + joiner.join(body) + "\n}"
-        )
-    return "\n\n".join(parts)
-
-
-def _oracle(rf, doc):
-    from guard_tpu.commands.report import rule_statuses_from_root
-
-    scope = RootScope(rf, doc)
-    try:
-        eval_rules_file(rf, scope, None)
-    except GuardError:
-        return None
-    root = scope.reset_recorder().extract()
-    return {n: s.value for n, s in rule_statuses_from_root(root).items()}
-
+import kernel_fuzz  # noqa: E402
 
 TRIALS = 30
 
@@ -198,43 +23,35 @@ TRIALS = 30
 @pytest.mark.parametrize("seed", [11, 222, 3333])
 def test_kernel_differential_fuzz(seed):
     rng = random.Random(seed)
+    tags = set()
     checked = 0
+    divergences = []
     for ti in range(TRIALS):
-        rules_text = _rand_rules(rng, ti)
-        try:
-            rf = parse_rules_file(rules_text, "fuzz.guard")
-        except GuardError:
-            continue  # generator produced an unparseable combination
-        docs_plain = [_rand_doc(rng) for _ in range(6)]
-        docs = [from_plain(d) for d in docs_plain]
-        fn_vars, fn_vals, fn_err = precompute_fn_values(rf, docs)
-        batch, interner = encode_batch(
-            docs, fn_values=fn_vals, fn_var_order=fn_vars
-        )
-        compiled = compile_rules_file(rf, interner)
-        if not compiled.rules:
-            continue
-        evaluator = BatchEvaluator(compiled)
-        statuses = evaluator(batch)
-        unsure = evaluator.last_unsure
-        for di in range(len(docs)):
-            if di in fn_err:
-                continue  # routed to the oracle (error path) by design
-            oracle = _oracle(rf, docs[di])
-            if oracle is None:
-                assert unsure is not None and bool(unsure[di].any()), (
-                    f"seed={seed} trial={ti} doc={di}: oracle raises but "
-                    f"no unsure flag\n{rules_text}\n{docs_plain[di]}"
-                )
-                continue
-            for ri, crule in enumerate(compiled.rules):
-                if unsure is not None and bool(unsure[di, ri]):
-                    continue
-                dev = STATUS[int(statuses[di, ri])]
-                assert dev == oracle[crule.name], (
-                    f"seed={seed} trial={ti} doc={di} rule={crule.name}: "
-                    f"device={dev} oracle={oracle[crule.name]}\n"
-                    f"RULES:\n{rules_text}\nDOC: {docs_plain[di]}"
-                )
-                checked += 1
+        c, div = kernel_fuzz.run_trial(rng, ti, tags)
+        checked += c
+        divergences.extend(div)
+    assert not divergences, f"seed={seed}:\n" + "\n---\n".join(divergences[:3])
     assert checked > 100, f"fuzz exercised too little: {checked}"
+
+
+def test_generator_covers_every_tagged_construct():
+    """Across a fixed seed set the generator must emit every construct
+    family the kernels lower (ALL_TAGS) — a shrunken grammar would
+    silently stop testing shapes."""
+    tags = set()
+    for seed in range(24):
+        rng = random.Random(seed)
+        for ti in range(12):
+            kernel_fuzz.rand_rules(rng, ti, tags)
+        if kernel_fuzz.ALL_TAGS <= tags:
+            break
+    missing = kernel_fuzz.ALL_TAGS - tags
+    assert not missing, sorted(missing)
+
+
+def test_corpus_seeded_trial_runs():
+    rng = random.Random(7)
+    corpus = sorted((REPO / "corpus" / "rules").glob("*.guard"))
+    assert corpus
+    checked, div = kernel_fuzz.run_corpus_trial(rng, corpus[0])
+    assert not div, div[:2]

@@ -171,24 +171,26 @@ rule ports_allowed { some Resources.*.Ports IN [[22, 443], [80]] }
     )
 
 
-def test_struct_literal_refusals_route_to_host():
-    # != vs map literal: NotComparable-keeps-FAIL semantics the id
-    # compare cannot mirror -> host
-    rf = parse_rules_file(
-        'rule r { Resources.*.Tags != { env: "prod" } }', "x"
+def test_struct_literals_lower_with_tri_state_columns():
+    # round 3: != vs map literal and regex members lower exactly via
+    # the host-precomputed compare_eq tri-state columns
+    # (encoder.struct_literal_tri); full differential coverage in
+    # tests/test_lowering_round3.py
+    _differential(
+        'rule r { Resources.*.Tags != { env: "prod" } }',
+        [
+            {"Resources": {"a": {"Tags": {"env": "qa"}}}},
+            {"Resources": {"a": {"Tags": {"env": "prod"}}}},
+            {"Resources": {"a": {"Tags": "flat"}}},  # raises -> FAIL
+        ],
     )
-    batch, interner = encode_batch(
-        [from_plain({"Resources": {"a": {"Tags": {"env": "qa"}}}})]
+    _differential(
+        "rule r { Resources.*.Tags == { env: /pr/ } }",
+        [
+            {"Resources": {"a": {"Tags": {"env": "prod"}}}},
+            {"Resources": {"a": {"Tags": {"env": "qa"}}}},
+        ],
     )
-    compiled = compile_rules_file(rf, interner)
-    assert len(compiled.host_rules) == 1
-
-    # regex inside the literal regex-matches in compare_eq -> host
-    rf2 = parse_rules_file(
-        "rule r { Resources.*.Tags == { env: /pr/ } }", "x"
-    )
-    compiled2 = compile_rules_file(rf2, interner)
-    assert len(compiled2.host_rules) == 1
 
 
 # ---------------------------------------------------------------------------

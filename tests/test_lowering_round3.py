@@ -1,0 +1,353 @@
+"""Differential tests for the round-3 lowering batch (VERDICT item 3):
+struct literals with exact compare_eq tri-state columns (`!=` against
+map literals, regex/range members), list-vs-list IN decided on device,
+negated Eq against root-bound RHS inside value scopes, and function
+lets / inline calls in when blocks. Every case must lower (no host
+fallback unless stated) and match the CPU oracle bit-for-bit."""
+
+from test_lowering_round2 import _differential
+
+
+# ---------------------------------------------------------------------------
+# struct literals: != / not, NotComparable propagation, short-circuit
+# ---------------------------------------------------------------------------
+def test_neq_map_literal_tri_state():
+    # compare_eq(doc, lit) raising keeps FAIL through the inversion;
+    # plain False inverts to PASS (operators.rs:195-206)
+    rules = 'rule r { x != {"a": 1} }'
+    docs = [
+        {"x": {"a": 1}},          # equal -> FAIL
+        {"x": {"a": 2}},          # unequal -> PASS
+        {"x": "str"},             # STRING vs MAP raises -> FAIL
+        {"x": {"a": 1.0}},        # INT-vs-FLOAT member raises -> FAIL
+        {"x": {"b": 1}},          # missing key -> False -> PASS
+        {"x": {"a": 1, "b": 2}},  # size mismatch -> False -> PASS
+    ]
+    _differential(rules, docs)
+
+
+def test_neq_map_literal_short_circuit_order():
+    # iteration follows DOC insertion order (values.compare_eq:391):
+    # a False entry before a raising one returns False (PASS under !=);
+    # a raising entry hit first keeps FAIL
+    rules = 'rule r { x != {"a": 1, "b": "x"} }'
+    docs = [
+        {"x": {"a": "s", "b": 5}},   # 'a' raises first -> FAIL
+        {"x": {"b": "y", "a": "s"}}, # 'b' False first -> PASS
+        {"x": {"a": 1, "b": "x"}},   # equal -> FAIL
+    ]
+    _differential(rules, docs)
+
+
+def test_eq_map_literal_regex_member():
+    rules = 'rule r { x == {"name": /^prod/} }'
+    docs = [
+        {"x": {"name": "prod-1"}},
+        {"x": {"name": "dev-1"}},
+        {"x": {"name": 4}},  # INT vs REGEX raises -> FAIL
+    ]
+    _differential(rules, docs)
+
+
+def test_eq_map_literal_range_member():
+    rules = 'rule r { x == {"n": r(1, 5]} }'
+    docs = [
+        {"x": {"n": 3}},
+        {"x": {"n": 1}},   # exclusive lower bound -> False
+        {"x": {"n": 5}},   # inclusive upper bound -> True
+        {"x": {"n": 99}},
+    ]
+    _differential(rules, docs)
+
+
+def test_in_list_of_maps_with_regex_member():
+    # IN membership is loose_eq: maps compare values order-insensitively
+    # and regex members match (MapValue PartialEq -> loose_eq)
+    rules = 'rule r { x IN [{"k": /v/}, {"k": "w"}] }'
+    docs = [
+        {"x": {"k": "value"}},
+        {"x": {"k": "w"}},
+        {"x": {"k": "zzz"}},
+        {"x": 3},
+    ]
+    _differential(rules, docs)
+
+
+def test_not_in_list_of_maps():
+    rules = 'rule r { x not IN [{"a": 1}] }'
+    docs = [
+        {"x": {"a": 1}},
+        {"x": {"a": 2}},
+        {"x": "s"},
+    ]
+    _differential(rules, docs)
+
+
+def test_neq_list_literal_with_struct_item():
+    # ordered elementwise compare with short-circuit NotComparable
+    rules = 'rule r { x != [{"a": 1}, 2] }'
+    docs = [
+        {"x": [{"a": 1}, 2]},    # equal -> FAIL
+        {"x": [{"a": 1}, 3]},    # second unequal -> PASS
+        {"x": [{"a": "s"}, 2]},  # first member False (not raise) -> PASS
+        {"x": [3, 2]},           # INT vs MAP raises at item 0 -> FAIL
+        {"x": [{"a": 1}]},       # length mismatch -> PASS
+    ]
+    _differential(rules, docs)
+
+
+def test_in_scalar_map_rhs_compare_eq():
+    # `x IN {map}` goes through _match_value(compare_eq): raising pairs
+    # keep FAIL under not in
+    rules = (
+        'rule r { x IN {"a": 1} }\n'
+        'rule s { x not IN {"a": 1} }'
+    )
+    docs = [
+        {"x": {"a": 1}},
+        {"x": {"a": 2}},
+        {"x": "s"},  # raises: FAIL both rules
+    ]
+    _differential(rules, docs)
+
+
+def test_ordering_vs_map_literal_not_comparable():
+    rules = 'rule r { x > {"a": 1} }'
+    docs = [{"x": 5}, {"x": {"a": 1}}]
+    _differential(rules, docs)
+
+
+def test_map_literal_nested_struct_members():
+    rules = 'rule r { x == {"outer": {"inner": [1, 2]}} }\n' \
+            'rule s { x != {"outer": {"inner": [1, 2]}} }'
+    docs = [
+        {"x": {"outer": {"inner": [1, 2]}}},
+        {"x": {"outer": {"inner": [1, 2, 3]}}},
+        {"x": {"outer": {"inner": [1, 2.0]}}},  # nested raise
+        {"x": {"outer": "flat"}},
+    ]
+    _differential(rules, docs)
+
+
+# ---------------------------------------------------------------------------
+# list-vs-list IN between query results, decided on device
+# ---------------------------------------------------------------------------
+def test_list_in_list_subset_mode():
+    # rhs first element is a scalar: subset-of-elements semantics
+    rules = "rule r { x IN y }"
+    docs = [
+        {"x": [1, 2], "y": [1, 2, 3]},      # subset -> PASS
+        {"x": [1, 9], "y": [1, 2, 3]},      # 9 missing -> FAIL
+        {"x": [], "y": [1]},                # vacuous subset -> PASS
+        {"x": [1, 1], "y": [1]},            # duplicates still subset
+    ]
+    _differential(rules, docs)
+
+
+def test_list_in_list_membership_mode():
+    # rhs first element is itself a list: whole-list membership, and
+    # identity does NOT imply containment
+    rules = "rule r { x IN y }"
+    docs = [
+        {"x": [1, 2], "y": [[1, 2], [3]]},   # member -> PASS
+        {"x": [1, 2], "y": [[1], [2]]},      # not a member -> FAIL
+        {"x": [3], "y": [[1, 2], [3]]},      # member -> PASS
+        # mixed rhs: first element list decides the mode
+        {"x": [5], "y": [[5], 5]},           # membership: [5] in rhs -> PASS
+    ]
+    _differential(rules, docs)
+
+
+def test_list_not_in_list():
+    rules = "rule r { x not IN y }"
+    docs = [
+        {"x": [1, 2], "y": [1, 2, 3]},
+        {"x": [1, 9], "y": [1, 2, 3]},
+        {"x": [1, 2], "y": [[1, 2]]},
+        {"x": [1, 2], "y": [[1], [2]]},
+    ]
+    _differential(rules, docs)
+
+
+def test_scalar_in_empty_and_nested_lists():
+    rules = "rule r { x IN y }"
+    docs = [
+        {"x": "a", "y": ["a", "b"]},
+        {"x": "z", "y": ["a", "b"]},
+        {"x": [1], "y": []},                  # subset mode, diff=[1] -> FAIL
+        {"x": [], "y": []},                   # vacuous -> PASS
+        {"x": {"k": 1}, "y": [{"k": 1}]},     # map membership
+    ]
+    _differential(rules, docs)
+
+
+# ---------------------------------------------------------------------------
+# negated Eq against a root-bound RHS inside a value scope
+# ---------------------------------------------------------------------------
+def test_neq_root_variable_inside_filter():
+    rules = """
+let allowed = Parameters.Zones
+
+rule r {
+    Resources.*[ Properties.Zone != %allowed ] empty
+}
+"""
+    docs = [
+        {"Parameters": {"Zones": ["us-1"]},
+         "Resources": {"a": {"Properties": {"Zone": "us-1"}}}},
+        {"Parameters": {"Zones": ["us-1"]},
+         "Resources": {"a": {"Properties": {"Zone": "eu-9"}}}},
+        {"Parameters": {"Zones": ["us-1", "us-2"]},
+         "Resources": {"a": {"Properties": {"Zone": "us-1"}},
+                       "b": {"Properties": {"Zone": "us-2"}}}},
+        # multi-value LHS per origin vs larger shared RHS
+        {"Parameters": {"Zones": ["us-1", "us-2", "us-3"]},
+         "Resources": {"a": {"Properties": {"Zone": ["us-1", "us-2"]}}}},
+    ]
+    _differential(rules, docs)
+
+
+def test_neq_root_variable_inside_block():
+    rules = """
+let expected = Parameters.Expected
+
+rule r {
+    Resources.* {
+        Properties.Tag != %expected
+    }
+}
+"""
+    docs = [
+        {"Parameters": {"Expected": "prod"},
+         "Resources": {"a": {"Properties": {"Tag": "prod"}},
+                       "b": {"Properties": {"Tag": "dev"}}}},
+        {"Parameters": {"Expected": "prod"},
+         "Resources": {"a": {"Properties": {"Tag": "dev"}}}},
+        # NotComparable stays FAIL through the inversion
+        {"Parameters": {"Expected": "prod"},
+         "Resources": {"a": {"Properties": {"Tag": 5}}}},
+    ]
+    _differential(rules, docs)
+
+
+def test_neq_function_rhs_inside_block():
+    # inline call in a NESTED clause: precomputable because every
+    # query argument is headed by a root-bound variable
+    rules = """
+let sep = Parameters.Sep
+let parts = Parameters.Parts[*]
+
+rule r {
+    Resources.* {
+        Properties.Joined != join(%parts, %sep)
+    }
+}
+"""
+    docs = [
+        {"Parameters": {"Sep": ",", "Parts": ["a", "b"]},
+         "Resources": {"x": {"Properties": {"Joined": "a,b"}}}},
+        {"Parameters": {"Sep": ",", "Parts": ["a", "b"]},
+         "Resources": {"x": {"Properties": {"Joined": "a-b"}}}},
+    ]
+    _differential(rules, docs)
+
+
+def test_inline_call_inside_filter():
+    rules = """
+let pre = Parameters.Prefix
+
+rule r {
+    Resources.*[ Properties.Name == to_upper(%pre) ] !empty
+}
+"""
+    docs = [
+        {"Parameters": {"Prefix": "app"},
+         "Resources": {"a": {"Properties": {"Name": "APP"}}}},
+        {"Parameters": {"Prefix": "app"},
+         "Resources": {"a": {"Properties": {"Name": "app"}}}},
+    ]
+    _differential(rules, docs)
+
+
+# ---------------------------------------------------------------------------
+# function lets and inline calls inside when blocks (root basis)
+# ---------------------------------------------------------------------------
+def test_function_let_inside_when_block():
+    rules = """
+rule r {
+    when Parameters.Env exists {
+        let upper_env = to_upper(Parameters.Env)
+        Resources.Tag == %upper_env
+    }
+}
+"""
+    docs = [
+        {"Parameters": {"Env": "prod"}, "Resources": {"Tag": "PROD"}},
+        {"Parameters": {"Env": "prod"}, "Resources": {"Tag": "prod"}},
+        {"Resources": {"Tag": "PROD"}},  # when-gate SKIPs
+    ]
+    _differential(rules, docs)
+
+
+def test_function_let_in_nested_when_block_chained():
+    # when-in-when keeps the root basis; the inner let chains through
+    # the outer let
+    rules = """
+rule r {
+    let base = Parameters.Name
+    when %base exists {
+        when Parameters.Mode == "strict" {
+            let canon = to_lower(%base)
+            Resources.Id == %canon
+        }
+    }
+}
+"""
+    docs = [
+        {"Parameters": {"Name": "AbC", "Mode": "strict"},
+         "Resources": {"Id": "abc"}},
+        {"Parameters": {"Name": "AbC", "Mode": "strict"},
+         "Resources": {"Id": "AbC"}},
+        {"Parameters": {"Name": "AbC", "Mode": "lax"},
+         "Resources": {"Id": "abc"}},
+    ]
+    _differential(rules, docs)
+
+
+def test_inline_call_inside_when_block_clause():
+    rules = """
+rule r {
+    when Parameters.Csv exists {
+        Resources.Joined == join(Parameters.Parts[*], ",")
+    }
+}
+"""
+    docs = [
+        {"Parameters": {"Csv": True, "Parts": ["x", "y"]},
+         "Resources": {"Joined": "x,y"}},
+        {"Parameters": {"Csv": True, "Parts": ["x", "y"]},
+         "Resources": {"Joined": "x;y"}},
+    ]
+    _differential(rules, docs)
+
+
+def test_duplicate_when_let_name_stays_host():
+    # two when blocks binding the same function-let name: ambiguous
+    # under the (rule, name) precompute key -> host fallback
+    rules = """
+rule r {
+    when Parameters.A exists {
+        let v = to_upper(Parameters.A)
+        Resources.X == %v
+    }
+    when Parameters.B exists {
+        let v = to_lower(Parameters.B)
+        Resources.Y == %v
+    }
+}
+"""
+    docs = [
+        {"Parameters": {"A": "a", "B": "B"},
+         "Resources": {"X": "A", "Y": "b"}},
+    ]
+    _differential(rules, docs, expect_host=1)

@@ -1,0 +1,199 @@
+"""Ported legacy-engine `select`/`resolve_query` semantics cases
+(VERDICT r2 item 8): the reference's Guard-2.0 evaluator survives only
+as `resolve_query` behind `PathAwareValue::select`
+(/root/reference/guard/src/rules/path_value.rs:599-891), exercised by
+`evaluate_tests.rs` (test_iam_subselections:937,
+test_rules_with_some_clauses:1101, test_support_for_atleast_one_match
+_clause:1178). This repo deliberately skips the legacy engine (README
+scope note); these ported cases prove the claim that the MODERN query
+walk (core/scopes.py) covers the `select` semantics those tests pin —
+same selections (paths and values), same statuses."""
+
+import pytest
+
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.core.qresult import RESOLVED, Status
+from guard_tpu.core.scopes import RootScope
+from guard_tpu.core.evaluator import eval_rules_file
+from guard_tpu.core.values import from_plain
+
+
+def _select(query: str, doc_plain) -> list:
+    """Resolve a standalone query against a document through the
+    modern walk (the analogue of PathAwareValue::select with a dummy
+    variable resolver)."""
+    rf = parse_rules_file(f"let q = {query}\nrule r {{ %q !empty }}", "s.guard")
+    aq = rf.assignments[0].value
+    scope = RootScope(rf, from_plain(doc_plain))
+    return [
+        r.value for r in scope.query(aq.query) if r.tag == RESOLVED
+    ]
+
+
+def _rule_status(rules: str, doc_plain, name: str) -> str:
+    from guard_tpu.commands.report import rule_statuses_from_root
+
+    rf = parse_rules_file(rules, "s.guard")
+    scope = RootScope(rf, from_plain(doc_plain))
+    eval_rules_file(rf, scope, None)
+    root = scope.reset_recorder().extract()
+    return rule_statuses_from_root(root)[name].value
+
+
+# evaluate_tests.rs:937-1098 (test_iam_subselections)
+IAM_DOC = {
+    "Resources": {
+        "one": {
+            "Type": "AWS::IAM::Role",
+            "Properties": {
+                "Tags": [{"Key": "TestRole", "Value": ""}],
+                "PermissionsBoundary": "aws:arn",
+            },
+        },
+        "two": {
+            "Type": "AWS::IAM::Role",
+            "Properties": {"Tags": [{"Key": "TestRole", "Value": ""}]},
+        },
+        "three": {
+            "Type": "AWS::IAM::Role",
+            "Properties": {"Tags": [], "PermissionsBoundary": "aws:arn"},
+        },
+        "four": {
+            "Type": "AWS::IAM::Role",
+            "Properties": {"Tags": [{"Key": "Prod", "Value": ""}]},
+        },
+    }
+}
+
+
+def test_iam_subselections_single():
+    selected = _select(
+        'Resources.*[ Type == "AWS::IAM::Role" '
+        'Properties.Tags[ Key == "TestRole" ] !empty '
+        "Properties.PermissionsBoundary !exists ]",
+        IAM_DOC,
+    )
+    assert [v.path.s for v in selected] == ["/Resources/two"]
+
+
+def test_iam_subselections_disjunction():
+    selected = _select(
+        'Resources.*[ Type == "AWS::IAM::Role" '
+        'Properties.Tags[ Key == "TestRole" or Key == "Prod" ] !empty '
+        "Properties.PermissionsBoundary !exists ]",
+        IAM_DOC,
+    )
+    assert [v.path.s for v in selected] == [
+        "/Resources/two",
+        "/Resources/four",
+    ]
+
+
+IAM_RULES = """
+let iam_roles = Resources.*[ Type == "AWS::IAM::Role"  ]
+
+rule deny_permissions_boundary_iam_role when %iam_roles !empty {
+    %iam_roles[
+        Properties.Tags[ Key == "TestRole" ] !empty
+        Properties.PermissionsBoundary !exists
+    ] !empty
+}
+"""
+
+
+def test_iam_subselection_rule_pass_fail():
+    assert (
+        _rule_status(IAM_RULES, IAM_DOC, "deny_permissions_boundary_iam_role")
+        == "PASS"
+    )
+    fail_doc = {
+        "Resources": {
+            "one": {
+                "Type": "AWS::IAM::Role",
+                "Properties": {"Tags": [{"Key": "Prod", "Value": ""}]},
+            }
+        }
+    }
+    assert (
+        _rule_status(IAM_RULES, fail_doc, "deny_permissions_boundary_iam_role")
+        == "FAIL"
+    )
+
+
+# evaluate_tests.rs:1101-1176 (test_rules_with_some_clauses)
+def test_some_clause_selection():
+    doc = {
+        "Resources": {
+            "CounterTaskDefExecutionRole5959CB2D": {
+                "Type": "AWS::IAM::Role",
+                "Properties": {
+                    "PermissionsBoundary": {"Fn::Sub": "arn::boundary"},
+                    "Tags": [{"Key": "TestRole", "Value": ""}],
+                },
+            },
+            "BlankRole001": {
+                "Type": "AWS::IAM::Role",
+                "Properties": {"Tags": [{"Key": "FooBar", "Value": ""}]},
+            },
+            "BlankRole002": {
+                "Type": "AWS::IAM::Role",
+                "Properties": {},
+            },
+        }
+    }
+    selected = _select(
+        "some Resources.*[ Type == 'AWS::IAM::Role' ]"
+        ".Properties.Tags[ Key == /[A-Za-z0-9]+Role/ ]",
+        doc,
+    )
+    assert len(selected) == 1
+    assert selected[0].val.values["Key"].val == "TestRole"
+
+
+# evaluate_tests.rs:1178-1253 (test_support_for_atleast_one_match_clause)
+@pytest.mark.parametrize(
+    "doc,some_expected,all_expected",
+    [
+        (
+            {
+                "Tags": [
+                    {"Key": "InPROD", "Value": "ProdApp"},
+                    {"Key": "NoP", "Value": "NoQ"},
+                ]
+            },
+            "PASS",
+            "FAIL",
+        ),
+        ({"Tags": []}, "FAIL", "FAIL"),
+        ({}, "FAIL", "FAIL"),
+    ],
+)
+def test_atleast_one_match_clause(doc, some_expected, all_expected):
+    assert (
+        _rule_status("rule r { some Tags[*].Key == /PROD/ }", doc, "r")
+        == some_expected
+    )
+    assert (
+        _rule_status("rule r { Tags[*].Key == /PROD/ }", doc, "r")
+        == all_expected
+    )
+
+
+def test_atleast_one_match_selection_filter():
+    doc = {
+        "Resources": {
+            "ddbSelected": {
+                "Type": "AWS::DynamoDB::Table",
+                "Properties": {
+                    "Tags": [{"Key": "PROD", "Value": "ProdApp"}]
+                },
+            },
+            "ddbNotSelected": {"Type": "AWS::DynamoDB::Table"},
+        }
+    }
+    selected = _select(
+        "Resources.*[ Type == 'AWS::DynamoDB::Table' "
+        "some Properties.Tags[*].Key == /PROD/ ]",
+        doc,
+    )
+    assert [v.path.s for v in selected] == ["/Resources/ddbSelected"]

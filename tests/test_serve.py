@@ -1,0 +1,78 @@
+"""`guard-tpu serve --stdio`: the persistent validate session the npm
+package drives (ts_lib createSession) — newline-delimited JSON
+requests in, one JSON response line each, startup paid once."""
+
+import json
+
+from guard_tpu.cli import run
+from guard_tpu.utils.io import Reader, Writer
+
+
+def _serve(requests):
+    w = Writer.buffered()
+    rc = run(
+        ["serve", "--stdio"],
+        writer=w,
+        reader=Reader.from_string("\n".join(requests) + "\n"),
+    )
+    lines = [l for l in w.out.getvalue().splitlines() if l.strip()]
+    return rc, [json.loads(l) for l in lines]
+
+
+def test_serve_pass_fail_and_error_codes():
+    rc, resps = _serve([
+        json.dumps({"rules": ["rule ok { a exists }"], "data": ['{"a": 1}']}),
+        json.dumps({"rules": ["rule ok { a exists }"], "data": ['{"b": 1}']}),
+        json.dumps({"rules": ["rule broken {{{"], "data": ['{"a": 1}']}),
+    ])
+    assert rc == 0
+    assert [r["code"] for r in resps] == [0, 19, 5]
+    sarif = json.loads(resps[0]["output"])
+    assert sarif["version"] == "2.1.0"
+    fail_sarif = json.loads(resps[1]["output"])
+    assert any(
+        "ok" in (res.get("ruleId") or "").lower()
+        for run_ in fail_sarif["runs"]
+        for res in run_["results"]
+    )
+
+
+def test_serve_malformed_request_keeps_session_alive():
+    rc, resps = _serve([
+        "this is not json",
+        json.dumps({"rules": ["rule ok { a exists }"], "data": ['{"a": 1}']}),
+    ])
+    assert rc == 0
+    assert resps[0]["code"] == 5
+    assert resps[0]["error"]
+    assert resps[1]["code"] == 0
+
+
+def test_serve_output_formats():
+    rc, resps = _serve([
+        json.dumps({
+            "rules": ["rule ok { a exists }"],
+            "data": ['{"a": 1}'],
+            "output_format": "json",
+        }),
+    ])
+    assert rc == 0
+    reports = json.loads(resps[0]["output"])
+    assert reports[0]["status"] == "PASS"
+
+
+def test_serve_empty_line_ends_session():
+    w = Writer.buffered()
+    rc = run(
+        ["serve", "--stdio"],
+        writer=w,
+        reader=Reader.from_string(
+            "\n"
+            + json.dumps(
+                {"rules": ["rule ok { a exists }"], "data": ['{"a": 1}']}
+            )
+            + "\n"
+        ),
+    )
+    assert rc == 0
+    assert w.out.getvalue().strip() == ""

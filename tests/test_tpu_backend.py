@@ -390,7 +390,11 @@ def test_query_rhs_in_parity():
     assert_parity(rules, docs)
 
 
-def test_query_rhs_in_list_list_flags_unsure():
+def test_query_rhs_in_list_list_decided_on_device():
+    # round 3: list-vs-list IN no longer flags unsure — the kernel
+    # models both containment modes exactly (membership-among-elements
+    # when the rhs's first element is a list, subset otherwise);
+    # differential coverage in tests/test_lowering_round3.py
     rules = "rule r {\n  Resources.x.L IN Resources.x.Allowed\n}\n"
     rf = parse_rules_file(rules, "t.guard")
     docs = [
@@ -401,10 +405,10 @@ def test_query_rhs_in_list_list_flags_unsure():
     unsure = tpu_statuses.last_unsure
     assert compiled.needs_struct_ids
     assert unsure is not None
-    # doc 0 has a list-vs-list containment -> unsure; doc 1 does not
-    assert bool(unsure[0, 0])
+    assert not bool(unsure[0, 0])
     assert not bool(unsure[1, 0])
-    assert STATUS[int(statuses[1, 0])] == cpu_status(rf, docs[1], "r")
+    for di in (0, 1):
+        assert STATUS[int(statuses[di, 0])] == cpu_status(rf, docs[di], "r")
 
 
 # ---------------------------------------------------------------------------

@@ -1,33 +1,48 @@
-"""The npm package ships a runnable CommonJS build (ts_lib/dist/) the
-way the reference ships its generated wasm glue. When node is present
-these tests EXECUTE it end to end against the real engine; without
-node they assert the hand-maintained build stays in sync with the
-TypeScript source."""
+"""The npm package ships a GENERATED CommonJS build (ts_lib/dist/,
+produced by tools/ts_build.py) the way the reference ships generated
+wasm glue. The drift gate regenerates the build from the TypeScript
+source and fails on any difference — the build is never hand-edited.
+When node is present the smoke test EXECUTES the build end to end
+against the real engine, including the persistent `serve --stdio`
+session."""
 
 import pathlib
-import re
 import shutil
 import subprocess
+import sys
 
 import pytest
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
-TS = (REPO / "ts_lib" / "index.ts").read_text()
-JS = (REPO / "ts_lib" / "dist" / "index.js").read_text()
 
 
-def test_dist_build_in_sync_with_ts_source():
-    # the CLI argument contract and exit-code protocol must match
-    for token in [
-        '"validate"', '"--structured"', '"-S", "none"', '"-o", "sarif"',
-        "validationFailure: 19", "maxBuffer: 64 * 1024 * 1024",
-    ]:
-        assert token in TS and token in JS, token
-    # every extension the TS walks, the JS walks
-    for ext in re.findall(r'"\.(\w+)"', TS.split("const DATA_EXTENSIONS")[1].split(";")[0]):
-        assert f'".{ext}"' in JS
-    assert "exports.validate" in JS
-    assert (REPO / "ts_lib" / "dist" / "index.d.ts").exists()
+def test_dist_is_generated_and_current():
+    """`python tools/ts_build.py --check` — committed dist must equal
+    the generated output byte for byte."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "ts_build.py"), "--check"],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_generated_js_has_no_typescript_residue():
+    import re
+
+    js = (REPO / "ts_lib" / "dist" / "index.js").read_text()
+    for pat in (
+        r"\binterface\b",
+        r"^import ",
+        r"\bas\s+[A-Z]",
+        r"as const",
+        r"\?\s*:\s*\w+\s*[,)]",
+        r":\s*Promise<",
+    ):
+        assert not re.search(pat, js, re.M), pat
+    for name in ("validate", "createSession", "EXIT_CODES"):
+        assert f"exports.{name} = {name};" in js
 
 
 @pytest.mark.skipif(shutil.which("node") is None, reason="node unavailable")
@@ -40,3 +55,4 @@ def test_smoke_under_node():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "ts_lib smoke OK" in proc.stdout
+    assert "session smoke OK" in proc.stdout

@@ -1,0 +1,474 @@
+"""Kernel-vs-oracle differential fuzzer (shared generator + runner).
+
+Generates random rule files over random documents and compares every
+(doc, rule) status between the compiled device kernels and the CPU
+oracle. The grammar surface is tagged per construct so coverage is
+checkable: the CI tier (tests/test_kernel_fuzz.py) runs a seeded smoke
+and asserts every tag appears; the nightly tier runs this module with
+a TIME BUDGET (python tools/kernel_fuzz.py --time 420) plus
+corpus-seeded trials (the 250-file vendored corpus evaluated over
+generated documents).
+
+Round-3 shapes are first-class citizens of the grammar: struct
+literals (incl. regex/range members and `!=`), list-vs-list IN,
+`x != %var` inside value scopes, function lets in when blocks, and
+inline calls in nested clauses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import random
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+STATUS = {0: "PASS", 1: "FAIL", 2: "SKIP"}
+
+KEYS = ["Type", "Name", "Size", "Enc", "Tags", "Props", "Env", "Arn", "Vals"]
+TYPES = ["Bucket", "Volume", "Task", "Other"]
+STRS = ["prod", "dev", "a", "arn:aws:s3", "PROD-1", ""]
+NUMS = [0, 1, 7, 443, 16777217, -3]
+
+# every construct the generator can emit; the harness asserts coverage
+ALL_TAGS = frozenset(
+    {
+        "binary", "unary", "filter", "deep-key", "query-rhs", "filter-unary",
+        "keys-filter", "keys-membership", "index", "this-block", "list-walk",
+        "var-set", "var-strings", "count", "fn-upper", "fn-parse-int",
+        "when-gate", "or-join", "some", "interp", "interp-index",
+        "membership-var", "struct-eq", "struct-neq", "struct-regex-member",
+        "struct-range-member", "struct-in-list", "list-in-list",
+        "neq-var-scope", "when-fn-let", "nested-inline-call",
+    }
+)
+
+
+def rand_value(rng, depth=0):
+    r = rng.random()
+    if depth < 2 and r < 0.25:
+        return {
+            rng.choice(KEYS): rand_value(rng, depth + 1)
+            for _ in range(rng.randint(1, 3))
+        }
+    if depth < 2 and r < 0.4:
+        return [rand_value(rng, depth + 1) for _ in range(rng.randint(0, 3))]
+    r = rng.random()
+    if r < 0.35:
+        return rng.choice(STRS)
+    if r < 0.6:
+        return rng.choice(NUMS)
+    if r < 0.7:
+        return rng.random() * 100
+    if r < 0.8:
+        return rng.choice([True, False])
+    if r < 0.9:
+        return None
+    return rng.choice(STRS)
+
+
+def rand_doc(rng):
+    resources = {}
+    for i in range(rng.randint(1, 4)):
+        res = {"Type": rng.choice(TYPES)}
+        for _ in range(rng.randint(1, 4)):
+            res[rng.choice(KEYS)] = rand_value(rng)
+        resources[f"r{i}"] = res
+    doc = {"Resources": resources}
+    if rng.random() < 0.4:
+        doc["Settings"] = {"Allowed": rng.sample(STRS, 2), "Cap": rng.choice(NUMS)}
+    return doc
+
+
+def _lit(rng, tags):
+    r = rng.random()
+    if r < 0.25:
+        return f"'{rng.choice(STRS)}'"
+    if r < 0.45:
+        return str(rng.choice(NUMS))
+    if r < 0.55:
+        return rng.choice(["true", "false", "null", "1.5"])
+    if r < 0.65:
+        return rng.choice(["/prod/", "/^arn:/", "/\\d+/"])
+    if r < 0.72:
+        return rng.choice(["r(0,100)", "r[1,443]"])
+    if r < 0.86:
+        return rng.choice(["['prod', 'dev']", "[0, 1, 443]", "[]"])
+    # struct literals, incl. regex / range members (round 3)
+    r2 = rng.random()
+    if r2 < 0.4:
+        tags.add("struct-eq")
+        return rng.choice(
+            ['{ "Env": "prod" }', '{ "Enc": true, "Size": 7 }']
+        )
+    if r2 < 0.65:
+        tags.add("struct-regex-member")
+        return '{ "Name": /prod/ }'
+    if r2 < 0.85:
+        tags.add("struct-range-member")
+        return '{ "Size": r(0, 500) }'
+    tags.add("struct-in-list")
+    return '[{ "Key": "prod" }, { "Key": /dev/ }]'
+
+
+def _op(rng):
+    return rng.choice(["==", "!=", ">", ">=", "<", "<=", "in", "not in"])
+
+
+def _unary(rng):
+    return rng.choice(
+        ["exists", "!exists", "empty", "!empty", "is_string", "is_list", "is_int"]
+    )
+
+
+def _clause(rng, i, tags):
+    key = rng.choice(KEYS)
+    key2 = rng.choice(KEYS)
+    some = rng.choice(["", "some "])
+    if some:
+        tags.add("some")
+
+    def lit():
+        return _lit(rng, tags)
+
+    def t(tag, s):
+        tags.add(tag)
+        return s
+
+    shapes = [
+        lambda: t("binary", f"{some}Resources.*.{key} {_op(rng)} {lit()}"),
+        lambda: t("unary", f"{some}Resources.*.{key} {_unary(rng)}"),
+        lambda: t(
+            "filter",
+            f"{some}Resources.*[ Type == '{rng.choice(TYPES)}' ].{key} {_op(rng)} {lit()}",
+        ),
+        lambda: t("deep-key", f"{some}Resources.*.{key}.{key2} {_op(rng)} {lit()}"),
+        lambda: t(
+            "query-rhs", f"{some}Resources.*.{key} {_op(rng)} Resources.*.{key2}"
+        ),
+        lambda: t(
+            "list-in-list",
+            f"{some}Resources.*.{key} {rng.choice(['in', 'not in'])} Resources.*.{key2}",
+        ),
+        lambda: t(
+            "filter-unary",
+            f"{some}Resources.*[ {key} {_unary(rng)} ].{key2}[*] {_op(rng)} {lit()}",
+        ),
+        lambda: t("keys-filter", f"Resources[ keys == /r\\d/ ].{key} {_unary(rng)}"),
+        lambda: t(
+            "keys-membership",
+            f"Resources[ keys {rng.choice(['in', 'not in', '!='])} "
+            f"{rng.choice(['/r1/', chr(39) + 'r0' + chr(39)])} ].{key} {_unary(rng)}",
+        ),
+        lambda: t("index", f"{some}Resources.*.{key}[0] {_op(rng)} {lit()}"),
+        lambda: t(
+            "this-block", f"Resources.*.{key} {{ this {_op(rng)} {lit()} }}"
+        ),
+        lambda: t(
+            "list-walk", f"{some}Resources.*.Tags[*].{key} {_op(rng)} {lit()}"
+        ),
+        lambda: t(
+            "struct-neq",
+            f"Resources.*.{key} != "
+            + rng.choice(['{ "Env": "prod" }', '{ "Name": /prod/ }']),
+        ),
+    ]
+    return rng.choice(shapes)()
+
+
+def rand_rules(rng, ti, tags):
+    parts = []
+    nv = rng.randint(0, 2)
+    var_names = []
+    for v in range(nv):
+        kind = rng.random()
+        key = rng.choice(KEYS)
+        if kind < 0.4:
+            tags.add("var-set")
+            parts.append(
+                f"let v{v} = Resources.*[ Type == '{rng.choice(TYPES)}' ]"
+            )
+        elif kind < 0.6:
+            tags.add("var-strings")
+            parts.append(f"let v{v} = some Resources.*.{key}")
+        elif kind < 0.75:
+            tags.add("count")
+            parts.append(f"let v{v} = count(Resources.*.{key})")
+        elif kind < 0.9:
+            tags.add("fn-upper")
+            parts.append(f"let v{v} = to_upper(Resources.*.Name)")
+        else:
+            tags.add("fn-parse-int")
+            parts.append(f"let v{v} = parse_int(Resources.*.Size)")
+        var_names.append((f"v{v}", kind))
+    for ri in range(rng.randint(2, 4)):
+        gate = ""
+        when_body_let = ""
+        if rng.random() < 0.5:
+            tags.add("when-gate")
+            if var_names and rng.random() < 0.5:
+                vn, kind = rng.choice(var_names)
+                if kind < 0.6:
+                    gate = f" when %{vn} !empty"
+                elif kind < 0.75:
+                    gate = f" when %{vn} {rng.choice(['==', '>', '<='])} {rng.choice(NUMS)}"
+                else:
+                    gate = f" when %{vn} !empty"
+            else:
+                gate = " when Resources exists"
+        body = []
+        if rng.random() < 0.2:
+            # function let inside a when block (round 3): the let and
+            # its use live in a nested `when` that keeps the root basis
+            tags.add("when-fn-let")
+            body.append(
+                "when Resources exists {\n"
+                "        let wupper = to_upper(Resources.*.Name)\n"
+                f"        {rng.choice(['some ', ''])}%wupper {_op(rng)} /PROD/\n"
+                "    }"
+            )
+        if rng.random() < 0.15 and var_names:
+            # inline call in a nested clause with root-bound var args
+            vn, kind = rng.choice(var_names)
+            if kind < 0.6:
+                tags.add("nested-inline-call")
+                body.append(
+                    "Resources.* {\n"
+                    f"        {rng.choice(KEYS)} exists or\n"
+                    f"        Name == to_lower(%{vn}.Name)\n"
+                    "    }"
+                )
+        for ci in range(rng.randint(1, 3)):
+            if var_names and rng.random() < 0.4:
+                vn, kind = rng.choice(var_names)
+                if kind < 0.4:  # resource-set var
+                    tags.add("var-set")
+                    body.append(
+                        rng.choice(
+                            [
+                                f"%{vn}.{rng.choice(KEYS)} {_op(rng)} {_lit(rng, tags)}",
+                                f"%{vn}[ {rng.choice(KEYS)} exists ].{rng.choice(KEYS)} {_unary(rng)}",
+                                f"%{vn} {_unary(rng)}",
+                            ]
+                        )
+                    )
+                elif kind < 0.6:  # string-set var
+                    tags.add("var-strings")
+                    choice = rng.random()
+                    if choice < 0.2:
+                        body.append(f"%{vn} {_op(rng)} {rng.choice(NUMS)}")
+                    elif choice < 0.4:
+                        tags.add("interp")
+                        body.append(f"Resources.%{vn} {_unary(rng)}")
+                    elif choice < 0.55:
+                        tags.add("interp-index")
+                        body.append(f"Resources.%{vn}[0] {_unary(rng)}")
+                    elif choice < 0.75:
+                        tags.add("membership-var")
+                        body.append(
+                            f"Resources.*.{rng.choice(KEYS)} IN %{vn}"
+                        )
+                    else:
+                        # negated Eq against a root-bound RHS inside a
+                        # value scope (round 3)
+                        tags.add("neq-var-scope")
+                        body.append(
+                            f"Resources.*[ {rng.choice(KEYS)} != %{vn} ] "
+                            f"{rng.choice(['empty', '!empty'])}"
+                        )
+                elif kind < 0.75:
+                    tags.add("count")
+                    body.append(f"%{vn} {_op(rng)} {rng.choice(NUMS)}")
+                else:
+                    body.append(
+                        f"{rng.choice(['some ', ''])}%{vn} {_op(rng)} {_lit(rng, tags)}"
+                    )
+            else:
+                body.append(_clause(rng, ci, tags))
+        if rng.random() < 0.25:
+            tags.add("or-join")
+            joiner = " or\n    "
+        else:
+            joiner = "\n    "
+        parts.append(
+            f"rule t{ti}_r{ri}{gate} {{\n    " + joiner.join(body) + "\n}"
+        )
+    return "\n\n".join(parts)
+
+
+def oracle_statuses(rf, doc):
+    from guard_tpu.commands.report import rule_statuses_from_root
+    from guard_tpu.core.errors import GuardError
+    from guard_tpu.core.evaluator import eval_rules_file
+    from guard_tpu.core.scopes import RootScope
+
+    scope = RootScope(rf, doc)
+    try:
+        eval_rules_file(rf, scope, None)
+    except GuardError:
+        return None
+    root = scope.reset_recorder().extract()
+    return {n: s.value for n, s in rule_statuses_from_root(root).items()}
+
+
+def run_trial(rng, ti, tags) -> tuple:
+    """One differential trial. Returns (checked, divergences list)."""
+    from guard_tpu.core.errors import GuardError
+    from guard_tpu.core.parser import parse_rules_file
+    from guard_tpu.core.values import from_plain
+    from guard_tpu.ops.encoder import encode_batch
+    from guard_tpu.ops.fnvars import precompute_fn_values
+    from guard_tpu.ops.ir import compile_rules_file
+    from guard_tpu.ops.kernels import BatchEvaluator
+
+    rules_text = rand_rules(rng, ti, tags)
+    try:
+        rf = parse_rules_file(rules_text, "fuzz.guard")
+    except GuardError:
+        return 0, []
+    docs_plain = [rand_doc(rng) for _ in range(6)]
+    docs = [from_plain(d) for d in docs_plain]
+    fn_vars, fn_vals, fn_err = precompute_fn_values(rf, docs)
+    batch, interner = encode_batch(
+        docs, fn_values=fn_vals, fn_var_order=fn_vars
+    )
+    compiled = compile_rules_file(rf, interner)
+    if not compiled.rules:
+        return 0, []
+    evaluator = BatchEvaluator(compiled)
+    statuses = evaluator(batch)
+    unsure = evaluator.last_unsure
+    checked = 0
+    divergences = []
+    for di in range(len(docs)):
+        if di in fn_err:
+            continue  # routed to the oracle (error path) by design
+        oracle = oracle_statuses(rf, docs[di])
+        if oracle is None:
+            if not (unsure is not None and bool(unsure[di].any())):
+                divergences.append(
+                    f"trial={ti} doc={di}: oracle raises but no unsure "
+                    f"flag\n{rules_text}\n{docs_plain[di]}"
+                )
+            continue
+        for ri, crule in enumerate(compiled.rules):
+            if unsure is not None and bool(unsure[di, ri]):
+                continue
+            dev = STATUS[int(statuses[di, ri])]
+            if dev != oracle[crule.name]:
+                divergences.append(
+                    f"trial={ti} doc={di} rule={crule.name}: "
+                    f"device={dev} oracle={oracle[crule.name]}\n"
+                    f"RULES:\n{rules_text}\nDOC: {docs_plain[di]}"
+                )
+            else:
+                checked += 1
+    return checked, divergences
+
+
+def run_corpus_trial(rng, rule_path) -> tuple:
+    """Differential trial seeded with a CORPUS rule file over random
+    documents (surfaces interactions the generator grammar misses)."""
+    from guard_tpu.core.parser import parse_rules_file
+    from guard_tpu.core.values import from_plain
+    from guard_tpu.ops.encoder import encode_batch
+    from guard_tpu.ops.fnvars import precompute_fn_values
+    from guard_tpu.ops.ir import compile_rules_file
+    from guard_tpu.ops.kernels import BatchEvaluator
+
+    rf = parse_rules_file(rule_path.read_text(), rule_path.name)
+    docs_plain = [rand_doc(rng) for _ in range(4)]
+    docs = [from_plain(d) for d in docs_plain]
+    fn_vars, fn_vals, fn_err = precompute_fn_values(rf, docs)
+    batch, interner = encode_batch(
+        docs, fn_values=fn_vals, fn_var_order=fn_vars
+    )
+    compiled = compile_rules_file(rf, interner)
+    if not compiled.rules:
+        return 0, []
+    evaluator = BatchEvaluator(compiled)
+    statuses = evaluator(batch)
+    unsure = evaluator.last_unsure
+    checked = 0
+    divergences = []
+    for di in range(len(docs)):
+        if di in fn_err:
+            continue
+        oracle = oracle_statuses(rf, docs[di])
+        if oracle is None:
+            if not (unsure is not None and bool(unsure[di].any())):
+                divergences.append(
+                    f"corpus={rule_path.name} doc={di}: oracle raises "
+                    f"but no unsure flag\n{docs_plain[di]}"
+                )
+            continue
+        for ri, crule in enumerate(compiled.rules):
+            if unsure is not None and bool(unsure[di, ri]):
+                continue
+            dev = STATUS[int(statuses[di, ri])]
+            if dev != oracle[crule.name]:
+                divergences.append(
+                    f"corpus={rule_path.name} doc={di} rule={crule.name}: "
+                    f"device={dev} oracle={oracle[crule.name]}\n"
+                    f"DOC: {docs_plain[di]}"
+                )
+            else:
+                checked += 1
+    return checked, divergences
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--time", type=float, default=420.0,
+                    help="time budget in seconds")
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--no-corpus", action="store_true",
+                    help="skip corpus-seeded trials")
+    args = ap.parse_args()
+
+    seed = args.seed if args.seed is not None else int(time.time())
+    rng = random.Random(seed)
+    print(f"kernel differential fuzz: budget {args.time}s seed {seed}")
+
+    corpus = sorted((REPO / "corpus" / "rules").glob("*.guard"))
+    tags: set = set()
+    deadline = time.monotonic() + args.time
+    total_checked = 0
+    trials = 0
+    all_divergences = []
+    while time.monotonic() < deadline:
+        if corpus and not args.no_corpus and trials % 5 == 4:
+            checked, div = run_corpus_trial(rng, rng.choice(corpus))
+        else:
+            checked, div = run_trial(rng, trials, tags)
+        total_checked += checked
+        all_divergences.extend(div)
+        trials += 1
+        if all_divergences:
+            break
+
+    missing = ALL_TAGS - tags
+    print(
+        f"trials={trials} checked={total_checked} "
+        f"tags={len(tags)}/{len(ALL_TAGS)} missing={sorted(missing)}"
+    )
+    if all_divergences:
+        print("DIVERGENCES:")
+        for d in all_divergences[:5]:
+            print(d)
+        return 1
+    if trials > 200 and missing:
+        # long runs must exercise the whole tagged grammar
+        print(f"generator never produced: {sorted(missing)}")
+        return 1
+    print("no divergences")
+    return 0
+
+
+if __name__ == "__main__":
+    main_rc = main()
+    sys.exit(main_rc)

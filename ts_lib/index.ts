@@ -1,15 +1,24 @@
 /**
- * guard-tpu npm surface: validate() -> SARIF.
+ * guard-tpu npm surface: validate() -> SARIF, plus a persistent
+ * session that amortizes engine startup.
  *
  * Equivalent of the reference ts-lib (/root/reference/guard/ts-lib/
  * index.ts:156-178): walk rule/data paths, run a structured SARIF
  * validate, and rewrite result locations to real file names. The
- * reference drives a wasm build of its engine; this wrapper drives the
- * installed `guard-tpu` CLI (python) over the same payload contract,
- * so the evaluation semantics are the framework's single engine.
+ * reference links its engine into the calling process as wasm
+ * (lib.rs:318-347); this package drives the installed `guard-tpu`
+ * CLI (python) over the same payload contract — one-shot via
+ * `validate()`, or through `createSession()` which keeps ONE
+ * `guard-tpu serve --stdio` child alive and streams newline-delimited
+ * JSON requests to it, so the Python+JAX startup cost is paid once
+ * per session instead of once per call (the process-boundary
+ * equivalent of the reference's in-process wasm economics).
+ *
+ * dist/index.js is GENERATED from this file by tools/ts_build.py
+ * (`python tools/ts_build.py`); do not edit it by hand.
  */
 
-import { execFile } from "child_process";
+import { execFile, spawn } from "child_process";
 import { promises as fs } from "fs";
 import * as path from "path";
 
@@ -40,6 +49,31 @@ export interface SarifLog {
       }>;
     }>;
   }>;
+}
+
+export interface SessionOptions {
+  /** CLI entry point; defaults to `guard-tpu` on PATH. */
+  cliPath?: string;
+  /** Evaluate on the TPU batch engine. */
+  tpuBackend?: boolean;
+}
+
+export interface SessionResult {
+  /** Exit-code protocol value: 0 pass / 19 fail / 5 error. */
+  code: number;
+  /** Parsed SARIF log (sarif format requests only). */
+  sarif?: SarifLog;
+  /** Raw stdout of the underlying validate. */
+  output: string;
+  /** Stderr of the underlying validate. */
+  error: string;
+}
+
+export interface GuardTpuSession {
+  /** Validate in-memory rule/data strings; resolves per request. */
+  validatePayload(rules: string[], data: string[]): Promise<SessionResult>;
+  /** End the session (closes the child's stdin). */
+  close(): void;
 }
 
 const RULE_EXTENSIONS = new Set([".guard", ".ruleset"]);
@@ -118,6 +152,119 @@ export async function validate(input: ValidateInput): Promise<SarifLog> {
     throw new Error(`guard-tpu validate failed (exit ${code}): ${stderr}`);
   }
   return JSON.parse(stdout) as SarifLog;
+}
+
+/**
+ * Start a persistent validate session: spawns `guard-tpu serve
+ * --stdio` once and streams one JSON request line per
+ * validatePayload() call. Responses arrive in request order
+ * (the server handles one line at a time).
+ */
+export function createSession(options?: SessionOptions): GuardTpuSession {
+  const opts = options ?? {};
+  const cli = opts.cliPath ?? "guard-tpu";
+  const child = spawn(cli, ["serve", "--stdio"], {
+    stdio: ["pipe", "pipe", "pipe"],
+  });
+  const waiters: Array<{ resolve: Function; reject: Function }> = [];
+  let buffer = "";
+  let stderrTail = "";
+  let spawnError: Error | null = null;
+  let closed = false;
+
+  child.on("error", (err) => {
+    spawnError = new Error(`guard-tpu serve failed to start: ${err.message}`);
+    while (waiters.length > 0) {
+      const w = waiters.shift();
+      if (w) w.reject(spawnError);
+    }
+  });
+  // drain stderr (warnings from the Python runtime): an unread pipe
+  // would fill and block the child mid-response, hanging the session;
+  // keep a bounded tail for diagnostics
+  child.stderr.on("data", (chunk) => {
+    stderrTail = (stderrTail + String(chunk)).slice(-8192);
+  });
+  // stdin errors (EPIPE after the child died, write-after-end) must
+  // reject the pending promises, not crash the host process
+  child.stdin.on("error", (err) => {
+    const e = new Error(`guard-tpu serve session broken: ${err.message}`);
+    while (waiters.length > 0) {
+      const w = waiters.shift();
+      if (w) w.reject(e);
+    }
+  });
+  child.stdout.on("data", (chunk) => {
+    buffer += String(chunk);
+    let idx = buffer.indexOf("\n");
+    while (idx >= 0) {
+      const line = buffer.slice(0, idx);
+      buffer = buffer.slice(idx + 1);
+      const w = waiters.shift();
+      if (w) {
+        try {
+          const resp = JSON.parse(line);
+          const result = {
+            code: resp.code,
+            output: resp.output ?? "",
+            error: resp.error ?? "",
+          } as SessionResult;
+          if (resp.code === 0 || resp.code === 19) {
+            try {
+              result.sarif = JSON.parse(resp.output) as SarifLog;
+            } catch (e) {
+              // non-sarif output formats leave sarif unset
+            }
+          }
+          w.resolve(result);
+        } catch (e) {
+          w.reject(new Error(`malformed serve response: ${line}`));
+        }
+      }
+      idx = buffer.indexOf("\n");
+    }
+  });
+  child.on("close", () => {
+    closed = true;
+    while (waiters.length > 0) {
+      const w = waiters.shift();
+      if (w) {
+        w.reject(
+          spawnError ??
+            new Error(
+              `guard-tpu serve session closed${stderrTail ? ": " + stderrTail.trim() : ""}`
+            )
+        );
+      }
+    }
+  });
+
+  function validatePayload(rules: string[], data: string[]): Promise<SessionResult> {
+    return new Promise((resolve, reject) => {
+      if (spawnError) {
+        reject(spawnError);
+        return;
+      }
+      if (closed || child.exitCode !== null) {
+        reject(new Error("guard-tpu serve session is closed"));
+        return;
+      }
+      waiters.push({ resolve: resolve, reject: reject });
+      const req = {
+        rules: rules,
+        data: data,
+        output_format: "sarif",
+        backend: opts.tpuBackend ? "tpu" : "cpu",
+      };
+      child.stdin.write(JSON.stringify(req) + "\n");
+    });
+  }
+
+  function close(): void {
+    child.stdin.end();
+  }
+
+  return { validatePayload: validatePayload, close: close };
 }
 
 /** Exit-code protocol of the wrapped CLI (reference commands/mod.rs:69-73). */

@@ -8,7 +8,7 @@ const assert = require("assert");
 const fs = require("fs");
 const os = require("os");
 const path = require("path");
-const { validate, EXIT_CODES } = require("./dist/index.js");
+const { validate, createSession, EXIT_CODES } = require("./dist/index.js");
 
 const REPO = path.resolve(__dirname, "..");
 
@@ -59,9 +59,27 @@ async function main() {
   }
   assert.ok(rejected, "missing rules path must reject");
 
+  console.log("ts_lib smoke OK");
+
+  // persistent session: one `serve --stdio` child, several payload
+  // validates, startup paid once
+  const session = createSession({ cliPath: cli });
+  const pass = await session.validatePayload(
+    ["rule ok { a exists }"],
+    ['{"a": 1}']
+  );
+  assert.strictEqual(pass.code, EXIT_CODES.success);
+  assert.ok(pass.sarif && pass.sarif.version === "2.1.0");
+  const fail = await session.validatePayload(
+    ["rule ok { a exists }"],
+    ['{"b": 1}']
+  );
+  assert.strictEqual(fail.code, EXIT_CODES.validationFailure);
+  session.close();
+  console.log("session smoke OK");
+
   fs.rmSync(dir, { recursive: true, force: true });
   fs.rmSync(cli, { force: true });
-  console.log("ts_lib smoke OK");
 }
 
 main().catch((e) => {
