@@ -430,6 +430,12 @@ def main() -> int:
                     help="skip corpus-seeded trials")
     args = ap.parse_args()
 
+    # a wedged TPU tunnel hangs device init even under
+    # JAX_PLATFORMS=cpu; mirror the env var programmatically
+    from guard_tpu.ops.backend import _honor_platform_env
+
+    _honor_platform_env()
+
     seed = args.seed if args.seed is not None else int(time.time())
     rng = random.Random(seed)
     print(f"kernel differential fuzz: budget {args.time}s seed {seed}")
