@@ -178,6 +178,45 @@ class StepKeysMatch:
 
 
 @dataclass
+class StepKeyChain:
+    """A maximal run of >= 2 StepKeys with pairwise-DISJOINT key-id
+    sets, folded into ONE device permutation (vs one per step).
+
+    Exactness rests on two facts. (1) Selections are ANTICHAINS (no
+    selected node is an ancestor of another): every traversal step
+    either replaces parents by children or keeps childless scalars, so
+    by induction from {root} the property is preserved. (2) With
+    pairwise-disjoint step keys, a node can prefix-match the chain at
+    at most ONE position j >= 1 (its own key equals k_j for exactly
+    one j). Together these give each node a unique static "anchor"
+    ancestor (chain length up for full matches, j up for the node
+    whose k_{j+1} child is missing), so the only dynamic information
+    the whole run needs is `sel[anchor[m]]` — one permutation by a
+    host-precomputed int32 column, serving both the new selection and
+    the deep UnResolved charges. The basis-level miss (position 0:
+    selected node lacking a k_1 child) anchors at the node itself and
+    is charged inline from `sel` with the first step's has-child
+    column — it would otherwise collide with deeper miss positions in
+    the shared anchor column.
+
+    Wildcard steps (`.*` / `[*]`) do NOT fold: they match every key,
+    which breaks position uniqueness — and a folded trailing wildcard
+    was tried and rejected because moved children carry unconstrained
+    keys, so they can collide with position-1 miss anchors in the one
+    shared anchor column.
+
+    Columns per chain (CompiledRules.chain_tables -> device arrays):
+      chF{i} (D, N) bool  — full prefix match ending here (depth k)
+      chM{i} (D, N) bool  — deep miss at this node (position 1..k-1,
+                            only for steps without drop_unres)
+      chA{i} (D, N) int32 — the anchor ancestor (0 elsewhere)
+    """
+
+    steps: List[StepKey]
+    chain_slot: int = -1
+
+
+@dataclass
 class StepFnVar:
     """Select the precomputed result roots of a function variable
     (ops/fnvars.py): orphan nodes tagged with the reserved negative
@@ -191,6 +230,7 @@ class StepFnVar:
 
 Step = Union[
     StepKey,
+    StepKeyChain,
     StepKeyInterpLit,
     StepKeyInterpVar,
     StepAllValues,
@@ -362,6 +402,10 @@ class CompiledRules:
     # ("i", index) = node has a child at the list index. Deduped across
     # steps (_assign_bit_slots); computed per batch in device_arrays.
     kidc_tables: List[tuple] = field(default_factory=list)
+    # folded StepKeyChain specs (StepKeyChain docstring): per chain a
+    # tuple of (key_ids tuple, drop_unres) per step, resolved per
+    # batch into the chF/chM/chA columns
+    chain_tables: List[tuple] = field(default_factory=list)
     # non-empty when a lowered rule reads a precomputed function
     # variable (StepFnVar): the batch must be encoded with
     # encode_batch(fn_values=precompute_fn_values(rf, docs),
@@ -432,26 +476,90 @@ class CompiledRules:
                 col = table[safe] & (ids >= 0) & (ids < len(table))
             out[f"bits{i}"] = col
         if self.kidc_tables:
-            d, n = batch.node_kind.shape
-            flat = (
-                np.arange(d, dtype=np.int64)[:, None] * n
-                + np.maximum(batch.edge_parent, 0)
-            )
             for i, spec in enumerate(self.kidc_tables):
-                if spec[0] == "k":
-                    match = np.isin(
-                        batch.edge_key_id, np.asarray(spec[1:])
-                    )
-                else:  # ("i", index)
-                    match = batch.edge_index == spec[1]
-                match &= batch.edge_valid
-                col = (
-                    np.bincount(flat[match], minlength=d * n)
-                    .reshape(d, n)
-                    .astype(bool)
-                )
-                out[f"kidc{i}"] = col
+                out[f"kidc{i}"] = _has_child_col(batch, spec)
+        for i, spec in enumerate(self.chain_tables):
+            f, m, a = _chain_columns(batch, spec)
+            out[f"chF{i}"] = f
+            out[f"chM{i}"] = m
+            out[f"chA{i}"] = a
         return out
+
+
+def _has_child_col(batch, spec) -> np.ndarray:
+    """(D, N) bool: node has a child matching `spec` — ("k", *key_ids)
+    = under one of the key ids; ("i", index) = at the list index.
+    Shared by the kidc_tables columns and the chain deep-miss columns
+    so padding/edge_valid handling cannot drift between them."""
+    d, n = batch.node_kind.shape
+    flat = (
+        np.arange(d, dtype=np.int64)[:, None] * n
+        + np.maximum(batch.edge_parent, 0)
+    )
+    if spec[0] == "k":
+        match = np.isin(batch.edge_key_id, np.asarray(spec[1:]))
+    else:  # ("i", index)
+        match = batch.edge_index == spec[1]
+    match &= batch.edge_valid
+    return (
+        np.bincount(flat[match], minlength=d * n)
+        .reshape(d, n)
+        .astype(bool)
+    )
+
+
+def _chain_columns(batch, spec):
+    """Host columns for one folded StepKeyChain (StepKeyChain
+    docstring): walk the static parent structure once per level.
+
+    spec = ((key_ids, drop_unres), ...) per step, length k >= 2.
+    Returns (full (D,N) bool, deep-miss (D,N) bool, anchor (D,N)
+    int32): full marks nodes whose k-deep ancestor key path matches
+    every step; deep-miss marks nodes prefix-matched through position
+    j in [1, k-1] whose k_{j+1} child is missing (accounting steps
+    only); anchor holds the j- (or k-) level ancestor for both."""
+    d, n = batch.node_kind.shape
+    parent = batch.node_parent
+    valid = parent >= 0
+    pclip = np.maximum(parent, 0)
+    key_id = batch.node_key_id
+
+    def has_child(ids) -> np.ndarray:
+        return _has_child_col(batch, ("k",) + tuple(ids))
+
+    k = len(spec)
+    full = np.zeros((d, n), dtype=bool)
+    miss = np.zeros((d, n), dtype=bool)
+    anchor = np.zeros((d, n), dtype=np.int32)
+    # match_j[c]: c's key == k_j and its (j-1)-prefix matches; anc_j[c]
+    # = the ancestor j levels up (the prospective basis node)
+    match_prev = None
+    anc_prev = None
+    for j, (ids, _du) in enumerate(spec):
+        kh = np.isin(key_id, np.asarray(ids))
+        if j == 0:
+            match_j = kh & valid
+            anc_j = np.where(match_j, pclip, 0)
+        else:
+            pm = np.take_along_axis(match_prev, pclip, axis=1)
+            match_j = kh & valid & pm
+            anc_j = np.where(
+                match_j, np.take_along_axis(anc_prev, pclip, axis=1), 0
+            )
+        pos = j + 1  # nodes matched through position `pos`
+        if pos == k:
+            full = match_j
+            anchor = np.where(match_j, anc_j, anchor)
+        else:
+            nxt_ids, nxt_du = spec[pos]
+            if not nxt_du:
+                mj = match_j & ~has_child(nxt_ids)
+                # pairwise-disjoint keys make positions unique: no
+                # overwrite can occur here
+                miss |= mj
+                anchor = np.where(mj, anc_j, anchor)
+        match_prev, anc_prev = match_j, anc_j
+    return full, miss, anchor
 
 
 # ---------------------------------------------------------------------------
@@ -1460,11 +1568,91 @@ def compile_rules_file(rules_file: RulesFile, interner: Interner) -> CompiledRul
         struct_literals=lowering.struct_literals,
         needs_str_rank=needs_rank,
     )
+    _fold_key_chains(out)
     if _assign_bit_slots(out):
         from .fnvars import precomputable_fn_vars
 
         out.fn_vars = precomputable_fn_vars(rules_file)
     return out
+
+
+def _fold_key_chains(compiled: CompiledRules) -> None:
+    """Peephole over every step list: fold maximal runs of >= 2
+    StepKeys whose key-id sets are pairwise disjoint into StepKeyChain
+    nodes (one device permutation per run instead of one per step —
+    see StepKeyChain for the exactness argument)."""
+    seen_chains: dict = {}
+
+    def chain_slot(spec: tuple) -> int:
+        if spec not in seen_chains:
+            seen_chains[spec] = len(compiled.chain_tables)
+            compiled.chain_tables.append(spec)
+        return seen_chains[spec]
+
+    def fold(steps: List[Step]) -> List[Step]:
+        out: List[Step] = []
+        run: List[StepKey] = []
+
+        def flush():
+            if len(run) >= 2:
+                spec = tuple(
+                    (tuple(s.key_ids), s.drop_unres) for s in run
+                )
+                out.append(
+                    StepKeyChain(steps=list(run), chain_slot=chain_slot(spec))
+                )
+            else:
+                out.extend(run)
+            run.clear()
+
+        for s in steps:
+            if isinstance(s, StepKey):
+                ids = set(s.key_ids)
+                overlapping = any(
+                    ids & set(prev.key_ids) for prev in run
+                )
+                if overlapping:
+                    flush()
+                run.append(s)
+            else:
+                flush()
+                if isinstance(s, StepFilter):
+                    s.conjunctions = [
+                        [fold_node(c) for c in disj]
+                        for disj in s.conjunctions
+                    ]
+                elif isinstance(s, StepKeyInterpVar):
+                    s.var_steps = fold(s.var_steps)
+                out.append(s)
+        flush()
+        return out
+
+    def fold_node(n):
+        if isinstance(n, CClause):
+            n.steps = fold(n.steps)
+            if n.rhs_query_steps is not None:
+                n.rhs_query_steps = fold(n.rhs_query_steps)
+        elif isinstance(n, CCountClause):
+            n.steps = fold(n.steps)
+        elif isinstance(n, CBlockClause):
+            n.query_steps = fold(n.query_steps)
+            n.inner = [[fold_node(c) for c in disj] for disj in n.inner]
+        elif isinstance(n, CWhenBlock):
+            if n.conditions is not None:
+                n.conditions = [
+                    [fold_node(c) for c in disj] for disj in n.conditions
+                ]
+            n.inner = [[fold_node(c) for c in disj] for disj in n.inner]
+        return n
+
+    for r in compiled.rules:
+        if r.conditions is not None:
+            r.conditions = [
+                [fold_node(n) for n in disj] for disj in r.conditions
+            ]
+        r.conjunctions = [
+            [fold_node(n) for n in disj] for disj in r.conjunctions
+        ]
 
 
 def _assign_bit_slots(compiled: CompiledRules) -> None:
@@ -1532,6 +1720,14 @@ def _assign_bit_slots(compiled: CompiledRules) -> None:
             elif isinstance(s, StepKey):
                 if not s.drop_unres:
                     s.kc_slot = kidc_slot(("k",) + tuple(s.key_ids))
+            elif isinstance(s, StepKeyChain):
+                # only the FIRST step's has-child column is read (the
+                # inline position-0 miss); deeper misses live in the
+                # chain's static chM column
+                if not s.steps[0].drop_unres:
+                    s.steps[0].kc_slot = kidc_slot(
+                        ("k",) + tuple(s.steps[0].key_ids)
+                    )
             elif isinstance(s, StepIndex):
                 s.kc_slot = kidc_slot(("i", s.index))
 

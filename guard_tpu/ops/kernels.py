@@ -66,6 +66,7 @@ from .ir import (
     StepFnVar,
     StepIndex,
     StepKey,
+    StepKeyChain,
     StepKeyInterpLit,
     StepKeyInterpVar,
     StepKeysMatch,
@@ -119,6 +120,17 @@ class _DocArrays:
         # static per node, so no count-children reduction is paid
         self.kidc = {
             int(k[4:]): v for k, v in arrays.items() if k.startswith("kidc")
+        }
+        # folded key-chain columns (ir.StepKeyChain): full-match flag,
+        # deep-miss flag, anchor-ancestor index per chain slot
+        self.chF = {
+            int(k[3:]): v for k, v in arrays.items() if k.startswith("chF")
+        }
+        self.chM = {
+            int(k[3:]): v for k, v in arrays.items() if k.startswith("chM")
+        }
+        self.chA = {
+            int(k[3:]): v for k, v in arrays.items() if k.startswith("chA")
         }
         self.empty_slot = -1  # set by build_doc_evaluator
         self.n = self.node_kind.shape[0]
@@ -249,7 +261,45 @@ def run_steps(d: _DocArrays, steps: List[Step], sel, rule_statuses=None,
     return sel, acc.finalize(d, scalar)
 
 
+def _select_at(d: _DocArrays, vec: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """(N,) vec, (N,) static per-node indices -> vec[idx] — the one
+    permutation a folded key chain pays (one-hot compare-reduce below
+    GATHER_MIN_NODES, XLA gather above)."""
+    if d.gather_mode:
+        return jnp.take(vec, idx)
+    oh = idx[:, None] == jnp.arange(d.n, dtype=jnp.int32)[None, :]
+    return jnp.sum(jnp.where(oh, vec[None, :], 0), axis=1)
+
+
 def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None):
+    if isinstance(step, StepKeyChain):
+        # k >= 2 key steps in ONE permutation (ir.StepKeyChain): the
+        # anchor column points each full-match / deep-miss node at its
+        # would-be basis ancestor; sel[anchor] both relabels the new
+        # selection and supplies the charge labels for deep misses
+        first = step.steps[0]
+        P = _select_at(d, sel, d.chA[step.chain_slot])
+        new_sel = jnp.where(d.chF[step.chain_slot], P, 0)
+        if not first.drop_unres:
+            # position-0 miss: the basis node itself lacks a k_1 child
+            resolved = (
+                d.kidc[first.kc_slot]
+                if first.kc_slot >= 0
+                else _count_children(
+                    d,
+                    jnp.isin(
+                        d.node_key_id,
+                        jnp.asarray(first.key_ids, dtype=jnp.int32),
+                    ),
+                )
+                > 0
+            )
+            acc.add(sel, (sel > 0) & ~resolved)
+        # deep misses (positions 1..k-1, drop_unres steps pre-excluded
+        # in the static column)
+        acc.add(P, d.chM[step.chain_slot] & (P > 0))
+        return new_sel
+
     if isinstance(step, StepFnVar):
         # precomputed function-result roots (ops/fnvars.py): orphan
         # nodes tagged with the reserved key id. Reached only from the

@@ -179,6 +179,7 @@ def _slice_compiled(compiled: CompiledRules, indices: List[int]) -> CompiledRule
         needs_unsure=compiled.needs_unsure,
         bit_tables=compiled.bit_tables,  # slots stay valid: shared specs
         kidc_tables=compiled.kidc_tables,  # ditto (has-child columns)
+        chain_tables=compiled.chain_tables,  # ditto (folded key chains)
         str_empty_slot=compiled.str_empty_slot,
         struct_literals=compiled.struct_literals,
         needs_str_rank=compiled.needs_str_rank,

@@ -351,3 +351,70 @@ rule r {
          "Resources": {"X": "A", "Y": "b"}},
     ]
     _differential(rules, docs, expect_host=1)
+
+
+# ---------------------------------------------------------------------------
+# folded key chains (ir.StepKeyChain): adversarial nesting
+# ---------------------------------------------------------------------------
+def test_chain_fold_self_similar_paths():
+    # a.b chains over documents with nested a.b.a.b paths: the folded
+    # anchor must pick the dynamically-selected basis only
+    rules = (
+        "rule r { a.b exists }\n"
+        "rule s { a.b.c == 1 }\n"
+        "rule t { some a.b.a exists }\n"
+    )
+    docs = [
+        {"a": {"b": {"c": 1}}},
+        {"a": {"b": {"a": {"b": {"c": 2}}}}},
+        {"a": {"b": {"c": {"a": {"b": 1}}}}},
+        {"a": {"c": 1}},
+        {"b": {"a": {"b": 1}}},
+        {"a": {"b": {"a": 5}}},
+    ]
+    _differential(rules, docs)
+
+
+def test_chain_fold_miss_accounting():
+    # deep misses at every position, mixed with full matches, must
+    # reproduce the oracle's UnResolved counts (they gate some/all)
+    rules = (
+        "rule r { Resources.*.Properties.Enc.Alg == 'kms' }\n"
+        "rule s { some Resources.*.Properties.Enc.Alg == 'kms' }\n"
+    )
+    docs = [
+        {"Resources": {"a": {"Properties": {"Enc": {"Alg": "kms"}}},
+                       "b": {"Properties": {"Enc": {}}}}},
+        {"Resources": {"a": {"Properties": {}},
+                       "b": {"Properties": {"Enc": {"Alg": "aes"}}}}},
+        {"Resources": {"a": {"Other": 1}}},
+        {"Resources": {"a": {"Properties": {"Enc": {"Alg": "kms"}},
+                             "Extra": {"Properties": 1}}}},
+    ]
+    _differential(rules, docs)
+
+
+def test_chain_fold_inside_filters_and_vars():
+    rules = """
+let plans = resource_changes[ change.actions[*] == 'create' ]
+
+rule r when %plans !empty {
+    %plans.change.after.acl != 'public-read'
+    %plans.change.after.tags.env IN ['prod', 'dev']
+}
+"""
+    docs = [
+        {"resource_changes": [
+            {"change": {"actions": ["create"],
+                        "after": {"acl": "private",
+                                  "tags": {"env": "prod"}}}},
+        ]},
+        {"resource_changes": [
+            {"change": {"actions": ["create"],
+                        "after": {"acl": "public-read",
+                                  "tags": {"env": "qa"}}}},
+            {"change": {"actions": ["update"]}},
+        ]},
+        {"resource_changes": []},
+    ]
+    _differential(rules, docs)
