@@ -470,6 +470,49 @@ def measure_corpus():
     return docs_per_sec, rules_total, docs_per_sec / cpu_docs_per_sec
 
 
+def measure_rule_sharded(n_rules: int = 64, n_docs: int = 2048):
+    """Rule-axis parallelism (parallel/rules.py) in a measured number:
+    a 64-rule regex-heavy file through RuleShardedEvaluator. With one
+    device this is the single-group path (partition + slice + dispatch
+    machinery, no concurrency); with more devices the groups evaluate
+    concurrently on disjoint sub-meshes. Steady-state wall timing over
+    repeated __call__ (the dispatch-all-then-collect loop is host-side,
+    so the fori_loop trick does not apply)."""
+    from guard_tpu.core.parser import parse_rules_file
+    from guard_tpu.core.values import from_plain
+    from guard_tpu.ops.encoder import encode_batch
+    from guard_tpu.ops.ir import compile_rules_file
+    from guard_tpu.parallel.rules import RuleShardedEvaluator
+
+    from guard_tpu.core.scopes import RootScope
+    from guard_tpu.core.evaluator import eval_rules_file
+
+    rng = np.random.default_rng(13)
+    docs = [from_plain(make_template(rng, i)) for i in range(n_docs)]
+    rf = parse_rules_file(regex_heavy_rules(n_rules), "rs.guard")
+    batch, interner = encode_batch(docs)
+    compiled = compile_rules_file(rf, interner)
+    assert not compiled.host_rules
+    # the constructor clamps rule_shards to the device/rule counts
+    ev = RuleShardedEvaluator(compiled, rule_shards=4)
+    ev(batch)  # compile
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ev(batch)
+    t1 = time.perf_counter()
+    docs_per_sec = n_docs * reps / (t1 - t0)
+
+    n_cpu = 16
+    t0 = time.perf_counter()
+    for doc in docs[:n_cpu]:
+        scope = RootScope(rf, doc)
+        eval_rules_file(rf, scope, None)
+    t1 = time.perf_counter()
+    cpu_docs_per_sec = n_cpu / (t1 - t0)
+    return docs_per_sec, len(ev.shards), docs_per_sec / cpu_docs_per_sec
+
+
 def measure_fail_heavy(frac_fail: float, statuses_only: bool, n_docs: int = 1024):
     """End-to-end docs/sec through the backend decision flow on a
     workload where `frac_fail` of the documents FAIL: device statuses
@@ -599,6 +642,13 @@ def main() -> None:
     _emit(
         "config5b_corpus_doc_rule_pairs_per_sec", v * rules_total, r
     )
+
+    # config 5c: rule-axis sharding (parallel/rules.py) measured —
+    # single-group path on one device, concurrent groups on more (the
+    # group count is informational stderr, not part of the metric key)
+    v, n_groups, r = measure_rule_sharded()
+    print(f"config5c rule groups: {n_groups}", file=sys.stderr, flush=True)
+    _emit("config5c_rule_sharded_templates_per_sec", v, r)
 
     # config 6: fail-heavy cliff — end-to-end docs/sec including the
     # oracle fail-rerun (rich reports per failing doc) vs the
