@@ -247,6 +247,31 @@ def _probe_tpu_responsive(timeout_s: float = 45.0) -> bool:
         return False
 
 
+def _cpu_oracle_docs_per_sec(rule_files, docs, n_cpu: int, isolate_errors: bool = False) -> float:
+    """Shared CPU-oracle denominator: evaluate each of `rule_files`
+    (a RulesFile or a list of them) over the first n_cpu docs through
+    the pure-Python engine. `isolate_errors` applies validate's
+    per-file error isolation (a raising rule file writes stderr and
+    continues, validate.rs:406-434) — needed when rules meet foreign
+    inputs (the corpus config)."""
+    from guard_tpu.core.errors import GuardError
+    from guard_tpu.core.scopes import RootScope
+    from guard_tpu.core.evaluator import eval_rules_file
+
+    rfs = rule_files if isinstance(rule_files, list) else [rule_files]
+    t0 = time.perf_counter()
+    for doc in docs[:n_cpu]:
+        for rf in rfs:
+            try:
+                scope = RootScope(rf, doc)
+                eval_rules_file(rf, scope, None)
+            except GuardError:
+                if not isolate_errors:
+                    raise
+    t1 = time.perf_counter()
+    return n_cpu / (t1 - t0)
+
+
 def measure(rules_text: str, docs, min_rules: int, n_cpu: int = 256):
     """(tpu_docs_per_sec, vs_cpu) for one workload."""
     import jax
@@ -254,8 +279,6 @@ def measure(rules_text: str, docs, min_rules: int, n_cpu: int = 256):
     from jax import lax
 
     from guard_tpu.core.parser import parse_rules_file
-    from guard_tpu.core.scopes import RootScope
-    from guard_tpu.core.evaluator import eval_rules_file
     from guard_tpu.ops.encoder import encode_batch
     from guard_tpu.ops.ir import compile_rules_file
     from guard_tpu.ops.kernels import build_doc_evaluator
@@ -319,12 +342,7 @@ def measure(rules_text: str, docs, min_rules: int, n_cpu: int = 256):
     per_iter = max((t_k - t_1) / (k_inner - 1), 1e-9)
     tpu_docs_per_sec = n_docs / per_iter
 
-    t0 = time.perf_counter()
-    for doc in docs[:n_cpu]:
-        scope = RootScope(rf, doc)
-        eval_rules_file(rf, scope, None)
-    t1 = time.perf_counter()
-    cpu_docs_per_sec = n_cpu / (t1 - t0)
+    cpu_docs_per_sec = _cpu_oracle_docs_per_sec(rf, docs, n_cpu)
     return tpu_docs_per_sec, tpu_docs_per_sec / cpu_docs_per_sec
 
 
@@ -344,8 +362,6 @@ def measure_corpus():
     from jax import lax
 
     from guard_tpu.core.parser import parse_rules_file
-    from guard_tpu.core.scopes import RootScope
-    from guard_tpu.core.evaluator import eval_rules_file
     from guard_tpu.core.values import from_plain
     from guard_tpu.ops.encoder import Interner, encode_batch
     from guard_tpu.ops.ir import compile_rules_file
@@ -447,26 +463,14 @@ def measure_corpus():
     per_iter = max((t_k - t_1) / (k_inner - 1), 1e-9)
     docs_per_sec = n_docs / per_iter
 
-    # oracle: all corpus rule files over a sample of docs — with the
-    # per-file error isolation the validate loop applies (a rule that
-    # raises on a foreign input writes stderr and continues,
-    # validate.rs:406-434)
-    from guard_tpu.core.errors import GuardError
-
-    n_cpu = 8
+    # oracle: all corpus rule files over a sample of docs, with the
+    # per-file error isolation the validate loop applies
     rfs = [
         parse_rules_file(p.read_text(), p.name) for p in rule_files
     ]
-    t0 = time.perf_counter()
-    for doc in docs[:n_cpu]:
-        for rf in rfs:
-            try:
-                scope = RootScope(rf, doc)
-                eval_rules_file(rf, scope, None)
-            except GuardError:
-                pass
-    t1 = time.perf_counter()
-    cpu_docs_per_sec = n_cpu / (t1 - t0)
+    cpu_docs_per_sec = _cpu_oracle_docs_per_sec(
+        rfs, docs, n_cpu=8, isolate_errors=True
+    )
     return docs_per_sec, rules_total, docs_per_sec / cpu_docs_per_sec
 
 
@@ -484,8 +488,6 @@ def measure_rule_sharded(n_rules: int = 64, n_docs: int = 2048):
     from guard_tpu.ops.ir import compile_rules_file
     from guard_tpu.parallel.rules import RuleShardedEvaluator
 
-    from guard_tpu.core.scopes import RootScope
-    from guard_tpu.core.evaluator import eval_rules_file
 
     rng = np.random.default_rng(13)
     docs = [from_plain(make_template(rng, i)) for i in range(n_docs)]
@@ -503,13 +505,7 @@ def measure_rule_sharded(n_rules: int = 64, n_docs: int = 2048):
     t1 = time.perf_counter()
     docs_per_sec = n_docs * reps / (t1 - t0)
 
-    n_cpu = 16
-    t0 = time.perf_counter()
-    for doc in docs[:n_cpu]:
-        scope = RootScope(rf, doc)
-        eval_rules_file(rf, scope, None)
-    t1 = time.perf_counter()
-    cpu_docs_per_sec = n_cpu / (t1 - t0)
+    cpu_docs_per_sec = _cpu_oracle_docs_per_sec(rf, docs, n_cpu=16)
     return docs_per_sec, len(ev.shards), docs_per_sec / cpu_docs_per_sec
 
 

@@ -1,0 +1,370 @@
+"""Sixth ported-semantics batch from the reference's eval_tests.rs:
+the realistic policy-document cases — map-keys filters over IAM
+condition blocks (test_map_keys_function:2294,
+test_iam_statement_clauses:3146 with SAMPLE:3120), API-gateway rules
+in both block styles (test_api_gateway:3273,
+test_api_gateway_cleaner_model:3336), security-group egress filters
+(testing_sg_rules_pro_serve:3507), and empty-list access
+(ensure_all_list_value_access_on_empty_fails:2350). Statuses are
+pinned where the reference asserts them; print-only reference cases
+pin the oracle outcome derived from the rule semantics. Every case
+also runs the device differential where the rules lower."""
+
+import pytest
+
+from test_lowering_round2 import _differential, _oracle
+
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.core.values import from_plain
+
+
+def _statuses(rules_text, doc_plain):
+    rf = parse_rules_file(rules_text, "p6.guard")
+    return _oracle(rf, from_plain(doc_plain))
+
+
+API_GW_DOC = {
+    "Resources": {
+        "apigatewayapi": {
+            "Type": "AWS::ApiGateway::RestApi",
+            "Properties": {
+                "Policy": {
+                    "Version": "2012-10-17",
+                    "Statement": [
+                        {
+                            "Sid": "PrincipalPutObjectIfIpAddress",
+                            "Effect": "Allow",
+                            "Action": "s3:PutObject",
+                            "Resource": "arn:aws:s3:::my-service-bucket/*",
+                            "Condition": {
+                                "Bool": {"aws:ViaAWSService": "false"},
+                                "StringEquals": {"aws:SourceVpc": "vpc-12243sc"},
+                            },
+                        },
+                        {
+                            "Sid": "ServicePutObject",
+                            "Effect": "Allow",
+                            "Action": "s3:PutObject",
+                            "Resource": "arn:aws:s3:::my-service-bucket/*",
+                            "Condition": {"Bool": {"aws:ViaAWSService": "true"}},
+                        },
+                    ],
+                },
+                "EndpointConfiguration": ["PRIVATE"],
+            },
+        }
+    }
+}
+
+
+# eval_tests.rs:2294 (test_map_keys_function)
+MAP_KEYS_RULES = """
+let api_gw = Resources[ Type == 'AWS::ApiGateway::RestApi' ]
+rule check_rest_api_is_private_and_has_access {
+    %api_gw {
+      Properties.EndpointConfiguration == ["PRIVATE"]
+      some Properties.Policy.Statement[*].Condition[ keys == /aws:[sS]ource(Vpc|VPC|Vpce|VPCE)/ ] !empty
+    }
+}
+"""
+
+
+def test_map_keys_function():
+    fail_doc = {
+        "Resources": {
+            "apiGw": {
+                "Type": "AWS::ApiGateway::RestApi",
+                "Properties": {
+                    "EndpointConfiguration": ["PRIVATE"],
+                    "Policy": {
+                        "Statement": [
+                            {
+                                "Action": "Allow",
+                                "Resource": ["*", "aws:"],
+                                "Condition": {"aws:IsSecure": True},
+                            }
+                        ]
+                    },
+                },
+            }
+        }
+    }
+    assert (
+        _statuses(MAP_KEYS_RULES, fail_doc)[
+            "check_rest_api_is_private_and_has_access"
+        ]
+        == "FAIL"
+    )
+    pass_doc = {
+        "Resources": {
+            "apiGw": {
+                "Type": "AWS::ApiGateway::RestApi",
+                "Properties": {
+                    "EndpointConfiguration": ["PRIVATE"],
+                    "Policy": {
+                        "Statement": [
+                            {
+                                "Action": "Allow",
+                                "Resource": ["*", "aws:"],
+                                "Condition": {
+                                    "aws:IsSecure": True,
+                                    "aws:sourceVpc": ["vpc-1234"],
+                                },
+                            }
+                        ]
+                    },
+                },
+            }
+        }
+    }
+    assert (
+        _statuses(MAP_KEYS_RULES, pass_doc)[
+            "check_rest_api_is_private_and_has_access"
+        ]
+        == "PASS"
+    )
+    _differential(MAP_KEYS_RULES, [fail_doc, pass_doc])
+
+
+# eval_tests.rs:2350 (ensure_all_list_value_access_on_empty_fails)
+@pytest.mark.parametrize(
+    "clause",
+    [
+        "Tags[*].Key == /Name/",
+        "some Tags[*].Key == /Name/",
+        "Tags[*] { Key == /Name/ }",
+        "some Tags[*] { Key == /Name/ }",
+    ],
+)
+def test_all_list_value_access_on_empty_fails(clause):
+    doc = {"Tags": []}
+    rules = f"rule r {{ {clause} }}"
+    assert _statuses(rules, doc)["r"] == "FAIL"
+    _differential(rules, [doc])
+
+
+# eval_tests.rs:3146 (test_iam_statement_clauses; SAMPLE at :3120)
+IAM_SAMPLE = {
+    "Statement": [
+        {
+            "Sid": "PrincipalPutObjectIfIpAddress",
+            "Effect": "Allow",
+            "Action": "s3:PutObject",
+            "Resource": "arn:aws:s3:::my-service-bucket/*",
+            "Condition": {
+                "Bool": {"aws:ViaAWSService": "false"},
+                "StringEquals": {"aws:SourceVpc": "vpc-12243sc"},
+            },
+        },
+        {
+            "Sid": "ServicePutObject",
+            "Effect": "Allow",
+            "Action": "s3:PutObject",
+            "Resource": "arn:aws:s3:::my-service-bucket/*",
+            "Condition": {"Bool": {"aws:ViaAWSService": "true"}},
+        },
+    ]
+}
+
+NO_CONDITION = {
+    "Statement": [
+        {
+            "Sid": "PrincipalPutObjectIfIpAddress",
+            "Effect": "Allow",
+            "Action": "s3:PutObject",
+        }
+    ]
+}
+
+ARRAY_CONDITION = {
+    "Statement": [
+        {
+            "Sid": "PrincipalPutObjectIfIpAddress",
+            "Effect": "Allow",
+            "Action": "s3:PutObject",
+            "Condition": {"array": [1, 3, 4]},
+        }
+    ]
+}
+
+MIXED_CONDITION = {
+    "Statement": [
+        {
+            "Sid": "PrincipalPutObjectIfIpAddress",
+            "Effect": "Allow",
+            "Action": "s3:PutObject",
+            "Condition": {
+                "array": [1, 3, 4],
+                "StringEquals": {"aws:SourceVpc": "vpc-12243sc"},
+            },
+        }
+    ]
+}
+
+# the ViaAWSService-only variant (reference SAMPLE): no source-vpc key
+VIA_ONLY = {
+    "Statement": [
+        {
+            "Sid": "PrincipalPutObjectIfIpAddress",
+            "Effect": "Allow",
+            "Action": "s3:PutObject",
+            "Resource": "arn:aws:s3:::my-service-bucket/*",
+            "Condition": {"Bool": {"aws:ViaAWSService": "false"}},
+        },
+        {
+            "Sid": "ServicePutObject",
+            "Effect": "Allow",
+            "Action": "s3:PutObject",
+            "Resource": "arn:aws:s3:::my-service-bucket/*",
+            "Condition": {"Bool": {"aws:ViaAWSService": "true"}},
+        },
+    ]
+}
+
+CLAUSE_A = (
+    "Statement[ Condition exists ].Condition.*[ this is_struct ]"
+    "[ keys == /aws:[sS]ource(Vpc|VPC|Vpce|VPCE)/ ] not empty"
+)
+CLAUSE_B = (
+    "Statement[ Condition exists\n"
+    "           Condition.*[ keys == /aws:[sS]ource(Vpc|VPC|Vpce|VPCE)/ ]"
+    " !empty ] not empty"
+)
+CLAUSE_C = (
+    "some Statement[*].Condition.*[ this is_struct ]"
+    "[ keys == /aws:[sS]ource(Vpc|VPC|Vpce|VPCE)/ ] not empty"
+)
+
+
+@pytest.mark.parametrize(
+    "clause,doc,expected",
+    [
+        (CLAUSE_A, IAM_SAMPLE, "PASS"),
+        (CLAUSE_B, IAM_SAMPLE, "PASS"),
+        (CLAUSE_C, IAM_SAMPLE, "PASS"),
+        (CLAUSE_C, NO_CONDITION, "FAIL"),
+        (CLAUSE_C, ARRAY_CONDITION, "FAIL"),
+        (CLAUSE_C, MIXED_CONDITION, "PASS"),
+        (CLAUSE_B, VIA_ONLY, "FAIL"),
+    ],
+)
+def test_iam_statement_clauses(clause, doc, expected):
+    rules = f"rule r {{ {clause} }}"
+    assert _statuses(rules, doc)["r"] == expected
+    _differential(rules, [doc])
+
+
+# eval_tests.rs:3273 (test_api_gateway)
+def test_api_gateway():
+    rules = """
+rule check_rest_api_private {
+  AWS::ApiGateway::RestApi {
+    Properties.EndpointConfiguration == ["PRIVATE"]
+    Properties.Policy.Statement[ Condition.*[ keys == /aws:[sS]ource(Vpc|VPC|Vpce|VPCE)/ ] !empty ] !empty
+  }
+}
+"""
+    assert _statuses(rules, API_GW_DOC)["check_rest_api_private"] == "PASS"
+    _differential(rules, [API_GW_DOC])
+
+
+# eval_tests.rs:3336 (test_api_gateway_cleaner_model)
+def test_api_gateway_cleaner_model():
+    rules = """
+rule check_rest_api_private {
+  AWS::ApiGateway::RestApi {
+    Properties {
+        EndpointConfiguration == ["PRIVATE"]
+        some Policy.Statement[*] {
+            Condition.*[ keys == /aws:[sS]ource(Vpc|VPC|Vpce|VPCE)/ ] not empty
+        }
+    }
+  }
+}
+"""
+    assert _statuses(rules, API_GW_DOC)["check_rest_api_private"] == "PASS"
+    _differential(rules, [API_GW_DOC])
+    fail_doc = {
+        "Resources": {
+            "apigatewayapi": {
+                "Type": "AWS::ApiGateway::RestApi",
+                "Properties": {
+                    "Policy": {
+                        "Version": "2012-10-17",
+                        "Statement": [
+                            {
+                                "Sid": "PrincipalPutObjectIfIpAddress",
+                                "Effect": "Allow",
+                                "Action": "s3:PutObject",
+                                "Resource": "arn:aws:s3:::my-service-bucket/*",
+                                # duplicate-key YAML collapses to the
+                                # LAST Bool entry, like the reference's
+                                # JSON parse
+                                "Condition": {
+                                    "Bool": {"aws:SecureTransport": "true"}
+                                },
+                            },
+                            {
+                                "Sid": "ServicePutObject",
+                                "Effect": "Allow",
+                                "Action": "s3:PutObject",
+                                "Resource": "arn:aws:s3:::my-service-bucket/*",
+                                "Condition": {
+                                    "Bool": {"aws:ViaAWSService": "true"}
+                                },
+                            },
+                        ],
+                    },
+                    "EndpointConfiguration": ["PRIVATE"],
+                },
+            }
+        }
+    }
+    assert _statuses(rules, fail_doc)["check_rest_api_private"] == "FAIL"
+
+
+# eval_tests.rs:3507 (testing_sg_rules_pro_serve — print-only in the
+# reference; statuses pinned from the rule semantics: an egress rule
+# open to the world FAILs, a scoped or absent egress list PASSes
+# because the filter resolves empty / the query UnResolves to SKIP)
+SG_RULES = """
+let sgs = Resources.*[ Type == "AWS::EC2::SecurityGroup" ]
+
+rule deny_egress when %sgs not empty {
+    %sgs.Properties.SecurityGroupEgress[ CidrIp   == "0.0.0.0/0" or
+                                         CidrIpv6 == "::/0" ] empty
+}
+"""
+
+
+def _sg_doc(egress):
+    props = {
+        "GroupDescription": "foo/Counter/Service/SecurityGroup",
+        "VpcId": {"Ref": "Vpc8378EB38"},
+    }
+    if egress is not None:
+        props["SecurityGroupEgress"] = egress
+    return {
+        "Resources": {
+            "CounterServiceSecurityGroupF41A3908": {
+                "Type": "AWS::EC2::SecurityGroup",
+                "Properties": props,
+                "Metadata": {"aws:cdk:path": "foo/.../Resource"},
+            }
+        }
+    }
+
+
+@pytest.mark.parametrize(
+    "egress,expected",
+    [
+        ([{"CidrIp": "0.0.0.0/0", "Description": "d", "IpProtocol": "-1"}], "FAIL"),
+        ([{"CidrIpv6": "::/0", "Description": "d", "IpProtocol": "-1"}], "FAIL"),
+        ([{"CidrIp": "10.0.0.0/16", "Description": "", "IpProtocol": "-1"}], "PASS"),
+        (None, "PASS"),
+    ],
+)
+def test_sg_egress_rules(egress, expected):
+    doc = _sg_doc(egress)
+    assert _statuses(SG_RULES, doc)["deny_egress"] == expected
+    _differential(SG_RULES, [doc])
