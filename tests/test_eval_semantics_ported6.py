@@ -368,3 +368,45 @@ def test_sg_egress_rules(egress, expected):
     doc = _sg_doc(egress)
     assert _statuses(SG_RULES, doc)["deny_egress"] == expected
     _differential(SG_RULES, [doc])
+
+
+# eval_tests.rs:1044 (test_guard_10_compatibility_and_diff): Guard-2.0
+# ALL-by-default semantics vs explicit `some`
+def test_guard_10_compatibility_and_diff():
+    doc1 = {"Statement": [{"Principal": ["*", "s3:*"]}]}
+    all_rule = "rule r { Statement.*.Principal == '*' }"
+    some_rule = "rule r { some Statement.*.Principal == '*' }"
+    assert _statuses(all_rule, doc1)["r"] == "FAIL"
+    assert _statuses(some_rule, doc1)["r"] == "PASS"
+    doc2 = {
+        "Statement": [
+            {"Principal": "aws"},
+            {"Principal": ["*", "s3:*"]},
+        ]
+    }
+    assert _statuses(some_rule, doc2)["r"] == "PASS"
+    _differential(all_rule, [doc1, doc2])
+    _differential(some_rule, [doc1, doc2])
+
+
+# eval_tests.rs:1785 (test_multiple_valued_clause_reporting): the rule
+# status pins; the per-record reporting assertions are covered by the
+# verbose-tree / --print-json functional pins (tests/test_functional_pin.py)
+def test_multiple_valued_clause_status():
+    doc = {
+        "Resources": {
+            "second": {"Properties": {"Name": "FAILEDMatch"}},
+            "first": {"Properties": {"Name": "MatchNAME"}},
+            "matches": {"Properties": {"Name": "MatchNAME"}},
+            "failed": {"Properties": {"Name": "FAILEDMatch"}},
+        }
+    }
+    direct = "rule name_check { Resources.*.Properties.Name == /NAME/ }"
+    assert _statuses(direct, doc)["name_check"] == "FAIL"
+    via_var = (
+        "let resources = Resources.*\n"
+        "rule name_check { %resources.Properties.Name == /NAME/ }"
+    )
+    assert _statuses(via_var, doc)["name_check"] == "FAIL"
+    _differential(direct, [doc])
+    _differential(via_var, [doc])
