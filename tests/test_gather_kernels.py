@@ -184,3 +184,23 @@ def test_backend_evaluates_16k_doc_without_host_fallback(monkeypatch):
     )
     assert host_docs == set()
     assert STATUS[int(statuses[0, 0])] == "PASS"
+
+
+def test_top_bucket_33k_nodes_on_device():
+    # a ~33k-node document exercises the 65536 top bucket end to end
+    rules = 'rule big { Resources.* { Type exists } }\n' \
+            'rule enc { some Resources.*.Properties.Size >= 0 }'
+    rf = parse_rules_file(rules, "big2.guard")
+    n_res = 4700  # ~7 nodes per resource -> ~33k nodes
+    doc = from_plain(_mk_doc(n_res, with_enc=False))
+    batch, interner = encode_batch([doc])
+    assert batch.n_nodes > 16384
+    compiled = compile_rules_file(rf, interner)
+    assert not compiled.host_rules and not compiled.needs_pairwise
+    groups, oversize = split_batch_by_size(batch, NODE_BUCKETS_EXTENDED)
+    assert len(oversize) == 0 and len(groups) == 1
+    sub, _ = groups[0]
+    statuses = BatchEvaluator(compiled)(sub)
+    oracle = _oracle(rf, doc)
+    for ri, crule in enumerate(compiled.rules):
+        assert STATUS[int(statuses[0, ri])] == oracle[crule.name]
