@@ -252,12 +252,27 @@ class _UnresAcc:
 
 
 def run_steps(d: _DocArrays, steps: List[Step], sel, rule_statuses=None,
-              scalar: bool = False):
+              scalar: bool = False, sel_is_root: Optional[bool] = None):
     """Walk a query: returns (leaf selection, unresolved counts) —
-    counts are (N+1,) per origin, or a scalar in single-origin mode."""
+    counts are (N+1,) per origin, or a scalar in single-origin mode.
+
+    `sel_is_root`: the incoming selection is exactly `_sel_root` (label
+    1 on node 0) — the FIRST step's parent-select is then the static
+    elementwise `node_parent == 0` instead of a permutation.
+
+    CONTRACT: `scalar=True` means single-origin ROOT-BASIS evaluation
+    (it always has — the scalar aggregations in _agg/_UnresAcc assume
+    one origin, which only the rule-root selection provides), so it
+    defaults sel_is_root. A future scalar-mode caller evaluating from
+    a NON-root single-origin selection must pass sel_is_root=False
+    explicitly or the first step miscompiles."""
+    if sel_is_root is None:
+        sel_is_root = scalar
     acc = _UnresAcc(d)
     for step in steps:
-        sel = run_step(d, step, sel, acc, rule_statuses)
+        sel = run_step(d, step, sel, acc, rule_statuses,
+                       sel_is_root=sel_is_root)
+        sel_is_root = False
     return sel, acc.finalize(d, scalar)
 
 
@@ -271,14 +286,20 @@ def _select_at(d: _DocArrays, vec: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray
     return jnp.sum(jnp.where(oh, vec[None, :], 0), axis=1)
 
 
-def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None):
+def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None,
+             sel_is_root: bool = False):
     if isinstance(step, StepKeyChain):
         # k >= 2 key steps in ONE permutation (ir.StepKeyChain): the
         # anchor column points each full-match / deep-miss node at its
         # would-be basis ancestor; sel[anchor] both relabels the new
-        # selection and supplies the charge labels for deep misses
+        # selection and supplies the charge labels for deep misses.
+        # From the root basis the permutation degenerates to the
+        # static `anchor == 0` (the columns gate every read of P)
         first = step.steps[0]
-        P = _select_at(d, sel, d.chA[step.chain_slot])
+        if sel_is_root:
+            P = (d.chA[step.chain_slot] == 0).astype(jnp.int32)
+        else:
+            P = _select_at(d, sel, d.chA[step.chain_slot])
         new_sel = jnp.where(d.chF[step.chain_slot], P, 0)
         if not first.drop_unres:
             # position-0 miss: the basis node itself lacks a k_1 child
@@ -308,7 +329,12 @@ def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None)
         hit = d.node_key_id == step.key_id
         return jnp.where(hit, jnp.int32(1), jnp.int32(0))
 
-    psel = _parent_select(d, sel)  # label of each node's parent
+    if sel_is_root:
+        # sel is exactly `_sel_root` (label 1 on node 0): each node's
+        # parent label is the static root-child indicator
+        psel = (d.node_parent == 0).astype(jnp.int32)
+    else:
+        psel = _parent_select(d, sel)  # label of each node's parent
     if isinstance(step, StepKey):
         kh = jnp.zeros(d.n, bool)
         for kid in step.key_ids:
@@ -784,7 +810,8 @@ def _flatten_one_level(d: _DocArrays, sel_v: jnp.ndarray) -> jnp.ndarray:
     return jnp.maximum(child, keep)
 
 
-def _eval_query_rhs_ordering(d: _DocArrays, c: CClause, sel, rule_statuses) -> jnp.ndarray:
+def _eval_query_rhs_ordering(d: _DocArrays, c: CClause, sel, rule_statuses,
+                             sel_is_root: bool = False) -> jnp.ndarray:
     """Ordering ops (< <= > >=) against a query RHS: CommonOperator's
     cartesian pair comparison over flattened value sets
     (operators.rs:146-176 + evaluator._common_operation), with
@@ -792,14 +819,18 @@ def _eval_query_rhs_ordering(d: _DocArrays, c: CClause, sel, rule_statuses) -> j
     by the exact (hi, lo) keys, STRING by the host-precomputed rank
     column, NULLs all equal. The `not` inversion flips comparable
     pairs; NotComparable pairs stay FAIL."""
-    lhs_sel, lhs_unres = run_steps(d, c.steps, sel, rule_statuses)
+    lhs_sel, lhs_unres = run_steps(
+        d, c.steps, sel, rule_statuses, sel_is_root=sel_is_root
+    )
     if c.rhs_query_from_root:
         rhs_sel, rhs_unres_s = run_steps(
             d, c.rhs_query_steps, _sel_root(d), rule_statuses, scalar=True
         )
         rhs_unres = jnp.full((d.n + 1,), rhs_unres_s, jnp.int32)
     else:
-        rhs_sel, rhs_unres = run_steps(d, c.rhs_query_steps, sel, rule_statuses)
+        rhs_sel, rhs_unres = run_steps(
+            d, c.rhs_query_steps, sel, rule_statuses, sel_is_root=sel_is_root
+        )
     ones = jnp.ones(d.n, bool)
     n_lhs = _segment_count(d, lhs_sel, ones)
     if c.rhs_query_from_root:
@@ -866,13 +897,16 @@ def _eval_query_rhs_ordering(d: _DocArrays, c: CClause, sel, rule_statuses) -> j
     return jnp.where(skip, jnp.int8(SKIP), st)
 
 
-def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses) -> jnp.ndarray:
+def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses,
+                           sel_is_root: bool = False) -> jnp.ndarray:
     """LHS query vs RHS query, per origin (operators.rs:552-594 Eq
     `query_in` set-difference; :434-451 In containment; the `not`
     inversion reverse-diffs, operators.rs:637-646 via evaluator
     `operator_compare`). Membership tests are canonical struct-id
     equality (= loose_eq, encoder.DocBatch.struct_ids)."""
-    lhs_sel, lhs_unres = run_steps(d, c.steps, sel, rule_statuses)
+    lhs_sel, lhs_unres = run_steps(
+        d, c.steps, sel, rule_statuses, sel_is_root=sel_is_root
+    )
     if c.rhs_query_from_root:
         # root-bound RHS variable: one shared result set for every
         # origin (resolved against the binding scope)
@@ -882,7 +916,9 @@ def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses) -> jnp
         )
         rhs_unres = jnp.full((d.n + 1,), rhs_unres_s, jnp.int32)
     else:
-        rhs_sel, rhs_unres = run_steps(d, c.rhs_query_steps, sel, rule_statuses)
+        rhs_sel, rhs_unres = run_steps(
+            d, c.rhs_query_steps, sel, rule_statuses, sel_is_root=sel_is_root
+        )
     ones = jnp.ones(d.n, bool)
     n_lhs = _segment_count(d, lhs_sel, ones)
     if c.rhs_query_from_root:
@@ -1074,9 +1110,13 @@ def eval_clause(d: _DocArrays, c: CClause, sel, rule_statuses=None,
         return jnp.full((d.n + 1,), st, dtype=jnp.int8)
     if c.rhs_query_steps is not None:
         if c.op in (CmpOperator.Gt, CmpOperator.Ge, CmpOperator.Lt, CmpOperator.Le):
-            st = _eval_query_rhs_ordering(d, c, sel, rule_statuses)
+            st = _eval_query_rhs_ordering(
+                d, c, sel, rule_statuses, sel_is_root=scalar
+            )
         else:
-            st = _eval_query_rhs_clause(d, c, sel, rule_statuses)
+            st = _eval_query_rhs_clause(
+                d, c, sel, rule_statuses, sel_is_root=scalar
+            )
         return st[1] if scalar else st
     sel_leaf, unres = run_steps(d, c.steps, sel, rule_statuses, scalar=scalar)
     n_res = _agg(d, sel_leaf, jnp.ones(d.n, bool), scalar)
