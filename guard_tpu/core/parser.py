@@ -87,7 +87,12 @@ class Fatal(Exception):
 _VAR_NAME_RE = re.compile(r"[A-Za-z][A-Za-z0-9_]*")
 _KEY_CHARS_RE = re.compile(r"[A-Za-z0-9_-]+")
 _INT_RE = re.compile(r"[0-9]+")
+# the GATE mirrors parser.rs:230-243: fraction, or exponent WITH a
+# sign; on success the reference re-parses with nom's `double`, whose
+# grammar also takes an UNSIGNED exponent — so `1.5e3` is a float but
+# `2e3` is not (gate fails: no fraction, no signed exponent)
 _FLOAT_BODY_RE = re.compile(r"[0-9]+(\.[0-9]+)?([eE][+-][0-9]+)?")
+_FLOAT_DOUBLE_RE = re.compile(r"[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?")
 
 
 class Parser:
@@ -244,12 +249,15 @@ class Parser:
         return int(self.regex(_INT_RE))
 
     def parse_float_scalar(self) -> float:
-        """parser.rs:230-243 — requires fraction or exponent."""
+        """parser.rs:230-243 — the gate requires a fraction or a
+        SIGNED exponent, then nom `double` consumes the maximal float
+        (incl. an unsigned exponent after a fraction: `1.5e3`)."""
         m = _FLOAT_BODY_RE.match(self.text, self.pos)
         if not m or (m.group(1) is None and m.group(2) is None):
             raise Backtrack(self.pos, "not a float")
-        self.pos = m.end()
-        return float(m.group(0))
+        m2 = _FLOAT_DOUBLE_RE.match(self.text, self.pos)
+        self.pos = m2.end()
+        return float(m2.group(0))
 
     def parse_regex_literal(self) -> str:
         """parser.rs:245-286 — /.../ with \\/ escapes; validated."""

@@ -168,3 +168,23 @@ def test_invalid_rule_rejected():
 )
 def test_reference_examples_parse(path):
     assert parse_rules_file(path.read_text(), path.name) is not None
+
+
+def test_float_exponent_grammar_matches_reference():
+    """parser.rs:230-243: the float gate needs a fraction or a SIGNED
+    exponent, but nom `double` then consumes unsigned exponents too —
+    `1.5e3` parses as 1500.0 while `2e3` is not a float (and `e3`
+    residue makes the clause unparseable)."""
+    from guard_tpu.core.errors import GuardError
+
+    rf = parse_rules_file("rule r { x == 1.5e3 }", "f.guard")
+    cw = rf.guard_rules[0].block.conjunctions[0][0].access_clause.compare_with
+    assert cw.val == 1500.0
+    rf = parse_rules_file("rule r { x == 2e+3 }", "f.guard")
+    cw = rf.guard_rules[0].block.conjunctions[0][0].access_clause.compare_with
+    assert cw.val == 2000.0
+    rf = parse_rules_file("rule r { x == 1.5E-2 }", "f.guard")
+    cw = rf.guard_rules[0].block.conjunctions[0][0].access_clause.compare_with
+    assert cw.val == 0.015
+    with pytest.raises(GuardError):
+        parse_rules_file("rule r { x == 2e3 }", "f.guard")
