@@ -104,6 +104,9 @@ class StepKeyInterpLit:
     hits concatenate, each miss is its own UnResolved entry."""
 
     key_ids: List[int]  # one interned id per literal string (-99 absent)
+    # per-key has-child column slots (parallel to key_ids): the
+    # per-(map, key) miss check is static per node
+    kc_slots: List[int] = field(default_factory=list)
 
 
 @dataclass
@@ -1728,6 +1731,10 @@ def _assign_bit_slots(compiled: CompiledRules) -> None:
                     s.steps[0].kc_slot = kidc_slot(
                         ("k",) + tuple(s.steps[0].key_ids)
                     )
+            elif isinstance(s, StepKeyInterpLit):
+                s.kc_slots = [
+                    kidc_slot(("k", kid)) for kid in s.key_ids
+                ]
             elif isinstance(s, StepIndex):
                 s.kc_slot = kidc_slot(("i", s.index))
 

@@ -355,14 +355,18 @@ def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None,
         # `.%var` with literal strings: each string is an EXACT key
         # lookup (no converter retry); one UnResolved per missing
         # (map, key) pair; non-map candidates UnResolve first
-        # (scopes._retrieve_key:533-632)
+        # (scopes._retrieve_key:533-632). The per-key has-child checks
+        # are static per node (kidc columns)
         is_map_sel = (sel > 0) & (d.node_kind == MAP)
         acc.add(sel, (sel > 0) & (d.node_kind != MAP))
         kh_any = jnp.zeros(d.n, bool)
-        for kid in step.key_ids:
-            kh = d.node_key_id == kid
-            kh_any = kh_any | kh
-            has = _count_children(d, kh) > 0
+        for i, kid in enumerate(step.key_ids):
+            kh_any = kh_any | (d.node_key_id == kid)
+            has = (
+                d.kidc[step.kc_slots[i]]
+                if i < len(step.kc_slots)
+                else _count_children(d, d.node_key_id == kid) > 0
+            )
             acc.add(sel, is_map_sel & ~has)
         # a key id implies a map parent, so psel needs no extra guard
         return jnp.where(kh_any, psel, 0)
