@@ -1,0 +1,83 @@
+"""Grammar acceptance/rejection batch ported from the reference's
+parser tests (/root/reference/guard/src/rules/parser_tests.rs) at the
+rules-file level: the clause/value/range/list combinator cases wrapped
+in minimal rules, asserting parse success or failure exactly as the
+reference's combinators do (test_parse_float:138, test_broken_lists
+:291, test_range_type_failures:516, test_clause_failures:1891,
+test_keys_keyword:1320, test_parse_value_with_comments:533)."""
+
+import pytest
+
+from guard_tpu.core.errors import GuardError
+from guard_tpu.core.parser import parse_rules_file
+
+
+ACCEPT = [
+    # floats (test_parse_float) — fraction or signed exponent gate,
+    # maximal consume after
+    "rule r { x == 10.0 }",
+    "rule r { x == 10.2 }",
+    "rule r { x == 1.5e3 }",
+    "rule r { x == 2e+10 }",
+    "rule r { x == 1.25E-2 }",
+    # lists incl. nesting and empties (test_lists_success)
+    "rule r { x == [] }",
+    "rule r { x in [1, 2, 3] }",
+    "rule r { x in [[1, 2], [3]] }",
+    "rule r { x in ['a', \"b\"] }",
+    "rule r { x in [1,\n # comment\n 2] }",
+    # maps (test_map_success): keys bare/quoted, nesting
+    'rule r { x == { key: 1, value: "there" } }',
+    "rule r { x == { 'quoted': [1, 2], inner: { a: true } } }",
+    "rule r { x == {} }",
+    # ranges (test_range_type_success)
+    "rule r { x in r(10, 20) }",
+    "rule r { x in r[10, 20] }",
+    "rule r { x in r(10, 20] }",
+    "rule r { x in r[10.2, 50.5) }",
+    # comments everywhere (test_parse_value_with_comments,
+    # test_white_space_with_comments)
+    "# lead\nrule r { # inner\n x == 1234 # trail\n }\n# end",
+    # keys keyword (test_keys_keyword)
+    "rule r { x[ keys == /aws/ ] !empty }",
+    "rule r { x[ keys in ['a', 'b'] ] !empty }",
+    "rule r { x[ keys != 'c' ] !empty }",
+    # custom messages (clause suffix)
+    "rule r { x == 10 << must be ten >> }",
+    "rule r { x exists\n<<\nmult不line\n>> }",
+    # dotted access variants (test_dotted_access)
+    "rule r { a.b.c.d exists }",
+    "rule r { a.'b c'.\"d.e\" exists }",
+    "rule r { a.*.b[*].c[0] exists }",
+    "rule r { %var.a.b exists\n}\nrule s { x exists }",
+]
+
+REJECT = [
+    # broken lists (test_broken_lists)
+    "rule r { x in [ }",
+    # paren range without the r prefix (test_range_type_failures)
+    "rule r { x in (10, 20) }",
+    # missing access / missing RHS (test_clause_failures)
+    "rule r { > 10 }",
+    "rule r { x == << message >> }",
+    "rule r { x > << message >> }",
+    "rule r { x != << message >> }",
+    # empty rule block
+    "rule r { }",
+    # unterminated string / regex
+    "rule r { x == 'abc }",
+    "rule r { x == /abc }",
+    # bare exponent is not a float and leaves residue
+    "rule r { x == 2e3 }",
+]
+
+
+@pytest.mark.parametrize("text", ACCEPT)
+def test_grammar_accepts(text):
+    parse_rules_file(text, "a.guard")
+
+
+@pytest.mark.parametrize("text", REJECT)
+def test_grammar_rejects(text):
+    with pytest.raises(GuardError):
+        parse_rules_file(text, "r.guard")
