@@ -41,8 +41,31 @@ import numpy as np
 # from fused one-hot masked reductions (O(N^2) lanes, fastest for small
 # docs where the compare fuses into the consuming reduction) to XLA
 # gather / segment-sum (O(N) work, the only formulation whose cost
-# scales linearly with document size). Overridable for bake-off probes.
+# scales linearly with document size). Overridable for bake-off probes
+# (tools/tune_gather.py).
 GATHER_MIN_NODES = int(os.environ.get("GUARD_TPU_GATHER_MIN_NODES", "4096"))
+
+# on CPU backends real gathers are cheap and the one-hot's N^2 lanes
+# are not (tools/tune_gather.py measured gather 6-33x faster even at
+# the 64-node bucket), so CPU runs use gather at EVERY bucket — the
+# threshold above only governs accelerator backends
+GATHER_ALWAYS_ON_CPU = (
+    os.environ.get("GUARD_TPU_GATHER_ON_CPU", "1") != "0"
+)
+
+
+def _use_gather(n: int, platform: Optional[str] = None) -> bool:
+    """Trace-time formulation choice for an n-node bucket. `platform`
+    is the backend the evaluator will actually run on (mesh evaluators
+    pass their mesh's device platform — the process default can differ
+    under explicit placement); falls back to jax.default_backend()."""
+    if n >= GATHER_MIN_NODES:
+        return True
+    if not GATHER_ALWAYS_ON_CPU:
+        return False
+    if platform is None:
+        platform = jax.default_backend()
+    return platform == "cpu"
 
 from ..core.values import BOOL, FLOAT, INT, LIST, MAP, NULL, STRING
 from ..core.values import LOWER_INCLUSIVE, UPPER_INCLUSIVE
@@ -85,7 +108,8 @@ class _DocArrays:
     static parent column and sorted segment-sums — the only
     formulation whose cost stays proportional to document size, used
     for the big buckets where the one-hot's quadratic lane count
-    collapses MFU). Chosen per node bucket by BatchEvaluator."""
+    collapses MFU, and for EVERY bucket on CPU backends). Chosen per
+    node bucket and platform by _use_gather."""
 
     def __init__(self, arrays: Dict[str, jnp.ndarray], gather_mode: bool = False):
         self.gather_mode = gather_mode
@@ -1397,21 +1421,24 @@ def eval_rule(d: _DocArrays, rule: CRule, rule_statuses) -> Tuple[jnp.ndarray, j
     return status, unsure
 
 
-def build_doc_evaluator(compiled: CompiledRules, with_unsure: bool = False):
+def build_doc_evaluator(compiled: CompiledRules, with_unsure: bool = False,
+                        platform: Optional[str] = None):
     """Returns fn(per-doc arrays dict) -> (num_rules,) int8 statuses,
     or (statuses, unsure (num_rules,) bool) when with_unsure. The
     arrays dict is CompiledRules.device_arrays(batch) sliced per doc.
 
-    The traversal-primitive formulation is picked at TRACE time from
-    the node-bucket shape: one-hot masked reductions below
-    GATHER_MIN_NODES, O(N) gather/segment-sum at and above it (the
+    The traversal-primitive formulation is picked at TRACE time by
+    _use_gather: one-hot masked reductions below GATHER_MIN_NODES on
+    accelerators, O(N) gather/segment-sum at and above it (the
     one-hot's N^2 lane count is quadratic in bucket size while the
-    walk only ever touches N parent edges)."""
+    walk only ever touches N parent edges) — and gather at EVERY
+    bucket on CPU backends (GATHER_ALWAYS_ON_CPU). `platform` is the
+    target backend when known (mesh evaluators)."""
     empty_slot = compiled.str_empty_slot
 
     def evaluate(arrays: Dict[str, jnp.ndarray]):
         n = arrays["node_kind"].shape[-1]
-        d = _DocArrays(arrays, gather_mode=n >= GATHER_MIN_NODES)
+        d = _DocArrays(arrays, gather_mode=_use_gather(n, platform))
         d.empty_slot = empty_slot
         d.rule_unsure = []
         statuses: List[jnp.ndarray] = []

@@ -86,7 +86,14 @@ class ShardedBatchEvaluator:
         self.compiled = compiled
         self.mesh = mesh if mesh is not None else default_mesh()
         self._with_unsure = compiled.needs_unsure
-        doc_eval = build_doc_evaluator(compiled, with_unsure=self._with_unsure)
+        # the mesh's platform, not the process default, decides the
+        # primitive formulation (an explicit CPU mesh on a TPU host
+        # must still get the CPU gather override)
+        doc_eval = build_doc_evaluator(
+            compiled,
+            with_unsure=self._with_unsure,
+            platform=self.mesh.devices.flat[0].platform,
+        )
         # every input array is doc-major: one sharding as a pytree
         # prefix covers the whole arrays dict. The doc axis shards
         # over EVERY mesh axis, so the same evaluator runs on a flat

@@ -9,6 +9,13 @@ import os
 # at device discovery. Tests that exercise ambient-platform handling
 # (test_multichip_dryrun) build their own env explicitly.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# keep the TPU-like formulation split under test: without this, the
+# CPU-only suite would trace ONLY the gather kernels (the CPU override
+# forces gather at every bucket) and the one-hot branches production
+# TPU uses below GATHER_MIN_NODES would lose nearly all coverage.
+# test_gather_kernels still compares both formulations explicitly.
+os.environ["GUARD_TPU_GATHER_ON_CPU"] = "0"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

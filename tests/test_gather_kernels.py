@@ -80,6 +80,9 @@ def _oracle(rf, doc):
 
 def _eval_with_threshold(compiled, batch, threshold, monkeypatch):
     monkeypatch.setattr(kernels, "GATHER_MIN_NODES", threshold)
+    # the CPU override would otherwise force gather at every bucket,
+    # defeating the one-hot side of the comparison
+    monkeypatch.setattr(kernels, "GATHER_ALWAYS_ON_CPU", False)
     ev = BatchEvaluator(compiled)
     return ev(batch)
 

@@ -1,0 +1,183 @@
+"""Bake off the two traversal formulations and suggest GATHER_MIN_NODES.
+
+Measures the REAL compiled evaluator (BatchEvaluator over bench-style
+rules) per node bucket under both primitive formulations — fused
+one-hot masked reductions vs O(N) gather/segment-sum
+(kernels.GATHER_MIN_NODES) — using the same robust timing the bench
+uses (K evaluations inside one compiled fori_loop with an opaque data
+dependency, minus the 1-iteration dispatch floor; the remote-TPU
+tunnel acks dispatches before execution, so naive per-dispatch timing
+is meaningless).
+
+Run on a healthy device:  python tools/tune_gather.py
+CPU sanity run:           JAX_PLATFORMS=cpu python tools/tune_gather.py --buckets 64,256
+
+Prints docs/sec per (bucket, formulation) and the crossover — set
+kernels.GATHER_MIN_NODES (env GUARD_TPU_GATHER_MIN_NODES) to the
+smallest bucket where gather wins.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+import time
+
+import numpy as np
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+RULES = """
+let creates = resource_changes[ change.actions[*] == 'create' ]
+
+rule no_destroys when resource_changes exists {
+    resource_changes[*].change.actions[*] != 'delete'
+}
+
+rule buckets_private when %creates !empty {
+    resource_changes[ type == 'aws_s3_bucket' ].change.after.acl != 'public-read'
+}
+
+rule deep_walk {
+    some resource_changes[*].change.after.tags.env in ['prod', 'dev'] or
+    resource_changes empty
+}
+"""
+
+
+def make_doc(rng, n_nodes_target: int) -> dict:
+    """Terraform-plan-shaped doc sized to roughly n_nodes_target."""
+    changes = []
+    nodes = 2
+    while nodes < n_nodes_target - 16:
+        after = {
+            "acl": str(rng.choice(["private", "public-read"])),
+            "tags": {"env": str(rng.choice(["prod", "qa"]))},
+        }
+        node = after
+        for k in range(int(rng.integers(2, 6))):
+            node[f"n{k}"] = {"leaf": int(rng.integers(0, 99))}
+            node = node[f"n{k}"]
+        changes.append(
+            {
+                "type": str(rng.choice(["aws_s3_bucket", "aws_vpc"])),
+                "change": {"actions": ["create"], "after": after},
+            }
+        )
+        nodes += 14 + 2 * 4
+    return {"resource_changes": changes}
+
+
+def measure_bucket(n_nodes: int, n_docs: int, formulation: str) -> float:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    import guard_tpu.ops.kernels as kernels
+    from guard_tpu.core.parser import parse_rules_file
+    from guard_tpu.core.values import from_plain
+    from guard_tpu.ops.encoder import encode_batch
+    from guard_tpu.ops.ir import compile_rules_file
+    from guard_tpu.ops.kernels import build_doc_evaluator
+
+    kernels.GATHER_MIN_NODES = 1 if formulation == "gather" else (1 << 30)
+    kernels.GATHER_ALWAYS_ON_CPU = False  # measure BOTH forms anywhere
+
+    rng = np.random.default_rng(5)
+    docs = [from_plain(make_doc(rng, n_nodes)) for _ in range(n_docs)]
+    rf = parse_rules_file(RULES, "tune.guard")
+    batch, interner = encode_batch(docs, pad_nodes=n_nodes)
+    compiled = compile_rules_file(rf, interner)
+    assert not compiled.host_rules
+    doc_eval = build_doc_evaluator(compiled)
+    arrays = {
+        k: jax.device_put(jnp.asarray(v))
+        for k, v in compiled.device_arrays(batch).items()
+    }
+
+    def make_loop(iters: int):
+        @jax.jit
+        def loop(arrs):
+            def body(_, acc):
+                dep = jnp.minimum(acc % 2, 0).astype(jnp.int32)
+                a2 = dict(arrs)
+                a2["node_kind"] = arrs["node_kind"] + dep
+                st = jax.vmap(doc_eval)(a2)
+                return acc + jnp.sum(st.astype(jnp.int32))
+
+            return lax.fori_loop(0, iters, body, jnp.int32(0))
+
+        return loop
+
+    def med(fn, reps=3):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            int(fn(arrays))
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2]
+
+    fn1 = make_loop(1)
+    int(fn1(arrays))
+    t1 = med(fn1)
+    k = 9
+    while True:
+        fnk = make_loop(k)
+        int(fnk(arrays))
+        tk = med(fnk)
+        if tk >= 2.5 * t1 or k >= 1025:
+            break
+        k = (k - 1) * 4 + 1
+    per_iter = max((tk - t1) / (k - 1), 1e-9)
+    return n_docs / per_iter
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--buckets", default="256,1024,4096,8192,16384",
+        help="comma-separated node buckets to measure",
+    )
+    ap.add_argument("--docs", type=int, default=512,
+                    help="docs per batch at the smallest bucket "
+                         "(scaled down as buckets grow)")
+    args = ap.parse_args()
+
+    from guard_tpu.ops.backend import _honor_platform_env
+
+    _honor_platform_env()
+    import jax
+
+    print(f"devices: {jax.devices()}")
+    buckets = [int(b) for b in args.buckets.split(",")]
+    crossover = None
+    for b in buckets:
+        n_docs = max(16, args.docs * buckets[0] // b)
+        results = {}
+        for form in ("onehot", "gather"):
+            try:
+                results[form] = measure_bucket(b, n_docs, form)
+            except Exception as e:  # keep measuring other points
+                print(f"bucket {b} {form}: FAILED {e}")
+                results[form] = float("nan")
+        oh, ga = results["onehot"], results["gather"]
+        win = "gather" if ga > oh else "onehot"
+        if win == "gather" and crossover is None:
+            crossover = b
+        print(
+            f"bucket {b:6d} docs {n_docs:5d}: onehot {oh:12.1f} docs/s   "
+            f"gather {ga:12.1f} docs/s   -> {win}"
+        )
+    if crossover is not None:
+        print(f"\nsuggested GATHER_MIN_NODES = {crossover}")
+    else:
+        print("\ngather never won on the measured buckets; keep the "
+              "one-hot default and re-measure with bigger buckets")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
