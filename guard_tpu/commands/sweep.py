@@ -171,28 +171,36 @@ class Sweep:
 
         data_files: List[DataFile] = []
         for p in chunk:
+            # path_value loads LAZILY (_pv): on the tpu backend the
+            # native encoder works from raw content and the Python
+            # document build is only needed for oracle fallbacks and
+            # function-let precompute — profiling showed the eager
+            # build was ~40% of end-to-end sweep wall time on
+            # all-lowered JSON corpora
             try:
                 content = p.read_text()
                 data_files.append(
-                    DataFile(
-                        name=p.name,
-                        content=content,
-                        path_value=load_document(content, p.name),
-                    )
+                    DataFile(name=p.name, content=content, path_value=None)
                 )
-            except (GuardError, OSError) as e:
+            except OSError as e:
                 writer.writeln_err(f"skipping {p}: {e}")
                 errors += 1
 
         per_doc: List[Dict[str, Status]] = [dict() for _ in data_files]
+        err_box = [0]
         if self.backend == "tpu":
-            errors += self._eval_tpu(data_files, rule_files, per_doc, writer)
+            errors += self._eval_tpu(
+                data_files, rule_files, per_doc, writer, err_box
+            )
         else:
             errors += self._eval_oracle(
-                data_files, rule_files, None, per_doc, writer
+                data_files, rule_files, None, per_doc, writer, err_box
             )
+        errors += err_box[0]
 
         for df, statuses in zip(data_files, per_doc):
+            if getattr(df, "_pv_failed", False):
+                continue  # unparseable doc: error counted, not tallied
             doc_status = Status.SKIP
             for st in statuses.values():
                 doc_status = doc_status.and_(st)
@@ -211,7 +219,33 @@ class Sweep:
             "errors": errors,
         }
 
-    def _eval_tpu(self, data_files, rule_files, per_doc, writer) -> int:
+    @staticmethod
+    def _pv(df, writer, err_box):
+        """Lazy document build: the native encoder works from raw
+        content, so the Python PV is only materialized for oracle
+        fallbacks / function precompute. A parse failure marks the
+        doc (excluded from tallies) and counts one error."""
+        if df.path_value is None and not getattr(df, "_pv_failed", False):
+            try:
+                df.path_value = load_document(df.content, df.name)
+            except GuardError as e:
+                df._pv_failed = True
+                writer.writeln_err(f"skipping {df.name}: {e}")
+                err_box[0] += 1
+        return df.path_value
+
+    def _padded_pvs(self, data_files, writer, err_box):
+        """Python documents for every file, unparseable ones replaced
+        by a null stand-in (marked _pv_failed: their statuses are
+        excluded from tallies)."""
+        from ..core.values import PV, Path as VPath
+
+        pvs = [self._pv(df, writer, err_box) for df in data_files]
+        return [
+            pv if pv is not None else PV.null(VPath.root()) for pv in pvs
+        ]
+
+    def _eval_tpu(self, data_files, rule_files, per_doc, writer, err_box) -> int:
         from ..ops.encoder import encode_batch
         from ..ops.ir import FAIL, PASS, SKIP, compile_rules_file
         from ..ops.native_encoder import encode_json_batch_native, native_available
@@ -224,16 +258,30 @@ class Sweep:
         if native_available() and all(
             df.content.lstrip()[:1] in ("{", "[") for df in data_files
         ):
-            try:
-                batch, interner, err = encode_json_batch_native(
-                    [df.content for df in data_files]
-                )
-                if err is not None:
+            # an invalid doc must not push the whole chunk off the
+            # native encoder: mark it, substitute a null stand-in,
+            # and retry with the rest
+            contents = [df.content for df in data_files]
+            for _ in range(len(data_files) + 1):
+                try:
+                    batch, interner, err = encode_json_batch_native(contents)
+                except RuntimeError:
                     batch = interner = None
-            except RuntimeError:
+                    break
+                if err is None:
+                    break
+                bad = data_files[err]
+                if not getattr(bad, "_pv_failed", False):
+                    bad._pv_failed = True
+                    writer.writeln_err(f"skipping {bad.name}: invalid JSON")
+                    err_box[0] += 1
+                contents[err] = "null"
                 batch = interner = None
         if batch is None:
-            batch, interner = encode_batch([df.path_value for df in data_files])
+            # Python fallback (non-JSON corpora or no native lib)
+            batch, interner = encode_batch(
+                self._padded_pvs(data_files, writer, err_box)
+            )
 
         errors = 0
         for rf in rule_files:
@@ -242,12 +290,12 @@ class Sweep:
             rf_batch = batch
             if precomputable_fn_vars(rf.rules):
                 # precomputed function lets: re-encode with per-doc
-                # results before compile (ops/fnvars.py)
-                fn_vars, fn_vals, fn_err = precompute_fn_values(
-                    rf.rules, [df.path_value for df in data_files]
-                )
+                # results before compile (ops/fnvars.py) — this path
+                # genuinely needs the Python documents
+                pvs = self._padded_pvs(data_files, writer, err_box)
+                fn_vars, fn_vals, fn_err = precompute_fn_values(rf.rules, pvs)
                 rf_batch, _ = encode_batch(
-                    [df.path_value for df in data_files],
+                    pvs,
                     interner,
                     fn_values=fn_vals,
                     fn_var_order=fn_vars,
@@ -283,7 +331,8 @@ class Sweep:
             # double-evaluation / double-counted errors)
             if host_docs:
                 errors += self._eval_oracle(
-                    data_files, [rf], {"only_docs": host_docs}, per_doc, writer
+                    data_files, [rf], {"only_docs": host_docs}, per_doc,
+                    writer, err_box,
                 )
             # host fallback: unlowerable rules run on the oracle for
             # every other doc; unsure-flagged docs re-run all rules
@@ -301,6 +350,7 @@ class Sweep:
                         },
                         per_doc,
                         writer,
+                        err_box,
                     )
             if unsure is not None:
                 oracle_docs = {
@@ -308,11 +358,13 @@ class Sweep:
                 }
                 if oracle_docs:
                     errors += self._eval_oracle(
-                        data_files, [rf], {"only_docs": oracle_docs}, per_doc, writer
+                        data_files, [rf], {"only_docs": oracle_docs},
+                        per_doc, writer, err_box,
                     )
         return errors
 
-    def _eval_oracle(self, data_files, rule_files, restrict, per_doc, writer) -> int:
+    def _eval_oracle(self, data_files, rule_files, restrict, per_doc, writer,
+                     err_box) -> int:
         from .report import rule_statuses_from_root
 
         only_docs = restrict.get("only_docs") if restrict else None
@@ -322,8 +374,11 @@ class Sweep:
             for di, df in enumerate(data_files):
                 if only_docs is not None and di not in only_docs:
                     continue
+                pv = self._pv(df, writer, err_box)
+                if pv is None:
+                    continue
                 try:
-                    scope = RootScope(rf.rules, df.path_value)
+                    scope = RootScope(rf.rules, pv)
                     eval_rules_file(rf.rules, scope, df.name)
                 except GuardError as e:
                     writer.writeln_err(f"{df.name} vs {rf.name}: {e}")

@@ -183,3 +183,27 @@ rule named_prod when %n >= 1 { some %upper == /PROD/ }
         results[backend] = (code, summary["counts"], summary["failed"])
     assert results["cpu"] == results["tpu"]
     assert results["cpu"][1] == {"pass": 2, "fail": 1, "skip": 0}
+
+
+def test_sweep_invalid_json_doc_stays_native_and_counts_error(tmp_path):
+    """One truncated JSON doc must not stall the chunk: it is skipped
+    with one error while the remaining documents still evaluate (on
+    the native encoder when available)."""
+    rules = tmp_path / "r.guard"
+    rules.write_text("rule ok { Resources exists }\n")
+    data = tmp_path / "data"
+    data.mkdir()
+    for i in range(5):
+        (data / f"t{i}.json").write_text('{"Resources": {"a": 1}}')
+    (data / "bad.json").write_text('{"Resources": {')  # truncated
+    w = Writer.buffered()
+    rc = run(
+        ["sweep", "-r", str(rules), "-d", str(data),
+         "-M", str(tmp_path / "m.jsonl"), "-c", "16"],
+        writer=w, reader=Reader(),
+    )
+    summary = json.loads(w.out.getvalue().strip().splitlines()[-1])
+    assert summary["errors"] == 1
+    assert summary["counts"]["pass"] == 5
+    assert summary["counts"]["fail"] == 0
+    assert rc == 5  # error exit dominates
