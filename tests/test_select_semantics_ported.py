@@ -197,3 +197,40 @@ def test_atleast_one_match_selection_filter():
         doc,
     )
     assert [v.path.s for v in selected] == ["/Resources/ddbSelected"]
+
+
+# eval_context_tests.rs:409 (test_with_converter): lowercase query
+# parts resolve against capitalized document keys via the case
+# converters, and the non-matching resource UnResolves at its
+# deepest reached value
+def test_query_with_case_converters():
+    from guard_tpu.core.qresult import UNRESOLVED
+
+    doc = {
+        "Resources": {
+            "s3": {
+                "Type": "AWS::S3::Bucket",
+                "Properties": {"Tags": [{"Key": 1, "Value": 1}]},
+            },
+            "ec2": {
+                "Type": "AWS::EC2::Instance",
+                "Properties": {"ImageId": "ami-123456789012", "Tags": []},
+            },
+        }
+    }
+    rf = parse_rules_file(
+        "let q = resources.*.properties.tags[*].value\nrule r { %q !empty }",
+        "c.guard",
+    )
+    aq = rf.assignments[0].value
+    scope = RootScope(rf, from_plain(doc))
+    results = scope.query(aq.query)
+    assert len(results) == 2
+    resolved = [r for r in results if r.tag == RESOLVED]
+    unresolved = [r for r in results if r.tag == UNRESOLVED]
+    assert len(resolved) == 1 and len(unresolved) == 1
+    assert resolved[0].value.path.s == "/Resources/s3/Properties/Tags/0/Value"
+    assert (
+        unresolved[0].unresolved.traversed_to.path.s
+        == "/Resources/ec2/Properties/Tags"
+    )
