@@ -15,6 +15,7 @@ returns a list of Optional[PV]; `None` entries are dropped by the caller
 
 from __future__ import annotations
 
+import re
 import datetime
 import json
 import time
@@ -158,7 +159,17 @@ def fn_regex_replace(args: List[List[QueryResult]]) -> List[Optional[PV]]:
     )
     if extract.kind != STRING or replace.kind != STRING:
         raise ParseError("regex_replace function requires string arguments")
-    rx = compiled_regex(extract.val)
+    try:
+        rx = compiled_regex(extract.val)
+    except re.error as e:
+        # the reference surfaces an invalid runtime pattern as a clean
+        # evaluation error (strings.rs Regex::try_from(...)?), never a
+        # crash — string arguments are not parse-time validated the
+        # way regex literals are
+        raise ParseError(
+            f"regex_replace: invalid regular expression "
+            f"{extract.val!r}: {e}"
+        )
     out: List[Optional[PV]] = []
     for q in base:
         v = _resolved_pv(q)

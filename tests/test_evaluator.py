@@ -242,3 +242,38 @@ def test_reference_example_expectations(guard, input_doc, rule_name, expected):
     rf = parse_rules_file(guard.read_text(), guard.name)
     scope = RootScope(rf, from_plain(input_doc))
     assert scope.rule_status(rule_name).value == expected
+
+
+def test_regex_replace_invalid_runtime_pattern_is_clean_error():
+    """An invalid regex STRING argument (not parse-time validated like
+    regex literals) must surface as a clean evaluation error, matching
+    the reference's Regex::try_from error path (strings.rs:68) — found
+    by the coverage-guided parser fuzzer as an uncaught re.error."""
+    from guard_tpu.api import run_checks
+    from guard_tpu.core.errors import GuardError
+
+    rules = (
+        'let arn = Resources.*.Arn\n'
+        'rule r { %arn == regex_replace(%arn, "[", "x") }'
+    )
+    with pytest.raises(GuardError):
+        run_checks('{"Resources": {"a": {"Arn": "arn:aws:x"}}}', rules)
+
+
+def test_regex_replace_invalid_pattern_routes_doc_to_oracle():
+    """Same invalid-pattern path through the TPU backend's function
+    precompute: the raising doc lands in the error set (routed to the
+    oracle, which reproduces the error), never a crash."""
+    from guard_tpu.core.parser import parse_rules_file
+    from guard_tpu.core.values import from_plain
+    from guard_tpu.ops.fnvars import precompute_fn_values
+
+    rules = (
+        "let arn = Resources.*.Arn\n"
+        'let fixed = regex_replace(%arn, "[", "x")\n'
+        "rule r { %fixed exists }"
+    )
+    rf = parse_rules_file(rules, "t.guard")
+    docs = [from_plain({"Resources": {"a": {"Arn": "arn:aws:x"}}})]
+    _keys, _vals, errors = precompute_fn_values(rf, docs)
+    assert errors == {0}
