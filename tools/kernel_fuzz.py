@@ -73,11 +73,26 @@ def rand_value(rng, depth=0):
 
 def rand_doc(rng):
     resources = {}
-    for i in range(rng.randint(1, 4)):
+    # occasionally WIDE documents (cross the 64/128 node-bucket
+    # boundaries, so mixed-size batches split across bucket groups)
+    n_res = rng.randint(1, 4) if rng.random() < 0.85 else rng.randint(8, 24)
+    for i in range(n_res):
         res = {"Type": rng.choice(TYPES)}
         for _ in range(rng.randint(1, 4)):
             res[rng.choice(KEYS)] = rand_value(rng)
         resources[f"r{i}"] = res
+    # occasionally a DEEP chain (long parent paths stress the chain
+    # anchor columns and UnResolved accounting at depth)
+    if rng.random() < 0.15:
+        node = {}
+        resources["deep"] = {"Type": rng.choice(TYPES), "Props": node}
+        for k in range(rng.randint(5, 12)):
+            nxt = {} if rng.random() < 0.8 else [rand_value(rng)]
+            node[rng.choice(KEYS)] = nxt
+            if isinstance(nxt, dict):
+                node = nxt
+            else:
+                break
     doc = {"Resources": resources}
     if rng.random() < 0.4:
         doc["Settings"] = {"Allowed": rng.sample(STRS, 2), "Cap": rng.choice(NUMS)}
