@@ -11,13 +11,21 @@ via host-precomputed bit tables), and block/when/CNF structure becomes
 tri-state combinator nodes.
 
 Lowering is *exact or refused*: any construct whose semantics the kernel
-cannot reproduce bit-for-bit (function calls, query-to-query compares,
-map literals, variable captures) raises `Unlowerable`, and the backend
-falls back to the CPU oracle for that rule. Parameterized rule calls
-(eval.rs:1504-1618) lower by inline expansion: argument queries are
-pre-lowered in the caller's scope, literals bind like `let` literals,
-and the callee body becomes an anonymous gated block. Coverage is wide
-enough for the dominant registry rule shapes.
+cannot reproduce bit-for-bit raises `Unlowerable`, and the backend falls
+back to the CPU oracle for that rule. The *semantic categories* that
+stay host-side are enumerated in `HOST_ONLY_CONSTRUCTS` below (kept
+honest by `tests/test_ir_refusals.py`); beyond those, individual raise
+sites in this file refuse structural edge shapes (chained filters,
+numeric literals with no exact device encoding, count bounds beyond
+i32, malformed parameterized calls, ...) — grep `Unlowerable(` for the
+full set. Function calls, query-to-query compares, map/struct literals
+and root-bound variable captures all lower as of rounds 2-3 (see
+docs/KNOWN_ISSUES.md "TPU backend coverage").
+Parameterized rule calls (eval.rs:1504-1618) lower by inline expansion:
+argument queries are pre-lowered in the caller's scope, literals bind
+like `let` literals, and the callee body becomes an anonymous gated
+block. Coverage spans all 21 reference guard-examples rules and the
+full vendored registry corpus.
 """
 
 from __future__ import annotations
@@ -73,6 +81,42 @@ PASS, FAIL, SKIP = 0, 1, 2
 
 class Unlowerable(Exception):
     """Raised when a rule uses semantics outside the kernel's coverage."""
+
+
+#: The documented host-only *semantic categories* (the same list
+#: docs/KNOWN_ISSUES.md publishes to users). Not an enumeration of
+#: every `Unlowerable` raise site — structural edge shapes also refuse;
+#: see the module docstring. `tests/test_ir_refusals.py` holds one
+#: canonical example per key and asserts it actually falls back to the
+#: host, and asserts the formerly-documented refusals still lower, so
+#: the categories listed here track the implementation in both
+#: directions for the shapes they name.
+HOST_ONLY_CONSTRUCTS = {
+    "now_builtin": (
+        "now() is nondeterministic: precomputing at encode time could "
+        "straddle a second boundary vs the oracle rerun"
+    ),
+    "parse_char_builtin": (
+        "parse_char produces CHAR nodes, which documents otherwise "
+        "never contain"
+    ),
+    "per_origin_inline_call": (
+        "inline function call in a value scope whose query argument "
+        "resolves per candidate origin"
+    ),
+    "fn_let_multi_when_block": (
+        "a (rule, name) function `let` bound in more than one when "
+        "block has an ambiguous precompute key"
+    ),
+    "cross_scope_value_var": (
+        "a variable bound in a non-root value scope used in another "
+        "scope re-resolves per origin"
+    ),
+    "variable_capture": (
+        "variable capture inside a query projection or filter binds "
+        "per traversal step"
+    ),
+}
 
 
 class CrossScopeRootVar(Unlowerable):
