@@ -1,0 +1,278 @@
+"""Wire serialization of the Guard AST and documents for the native
+C++ oracle (native/oracle.cpp).
+
+The native statuses oracle is a from-scratch C++ port of the evaluation
+core (evaluator.py / scopes.py / functions.py / values.py — themselves
+ports of the reference's `eval.rs` / `eval_context.rs`). Python remains
+the single owner of both grammars: the DSL parser and the YAML/JSON
+loaders run here, and this module flattens their outputs — the
+`RulesFile` AST and located `PV` document trees — into a compact JSON
+the C++ side deserializes 1:1. That keeps the native engine free of any
+parser beyond one small JSON reader, and guarantees both engines
+evaluate the exact same trees.
+
+Everything is JSON-serializable losslessly except integers outside
+i64 — documents containing them raise `Unserializable`, and callers
+fall back to the Python oracle (the same contract as the device
+encoder's `num_exotic` flag).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import List, Optional
+
+from .exprs import (
+    AccessQuery,
+    Block,
+    BlockGuardClause,
+    FileLocation,
+    FunctionExpr,
+    GuardAccessClause,
+    GuardNamedRuleClause,
+    LetExpr,
+    ParameterizedNamedRuleClause,
+    QAllIndices,
+    QAllValues,
+    QFilter,
+    QIndex,
+    QKey,
+    QMapKeyFilter,
+    QThis,
+    Rule,
+    RulesFile,
+    TypeBlock,
+    WhenBlockClause,
+)
+from .values import (
+    BOOL,
+    CHAR,
+    FLOAT,
+    INT,
+    LIST,
+    MAP,
+    NULL,
+    RANGE_CHAR,
+    RANGE_FLOAT,
+    RANGE_INT,
+    REGEX,
+    STRING,
+    PV,
+)
+
+I64_MIN = -(2**63)
+I64_MAX = 2**63 - 1
+
+
+class Unserializable(Exception):
+    """The value cannot be represented losslessly on the wire."""
+
+
+def _loc(loc: FileLocation) -> dict:
+    return {"line": loc.line, "col": loc.column, "file": loc.file_name}
+
+
+def _num(v):
+    if isinstance(v, int) and not (I64_MIN <= v <= I64_MAX):
+        raise Unserializable(f"integer {v} outside i64")
+    if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
+        # JSON has no NaN/Inf; documents never contain them (loaders
+        # produce finite floats), ranges neither (parser rejects)
+        raise Unserializable(f"non-finite float {v}")
+    return v
+
+
+def pv_to_wire(pv: PV) -> dict:
+    """Serialize a PV (with its path + location) to the wire dict."""
+    k = pv.kind
+    out: dict = {"k": k}
+    p = pv.path
+    if p.s or p.loc.line or p.loc.col:
+        out["p"] = [p.s, p.loc.line, p.loc.col]
+    if k == NULL:
+        pass
+    elif k in (STRING, REGEX, CHAR):
+        out["s"] = pv.val
+    elif k == BOOL:
+        out["b"] = bool(pv.val)
+    elif k == INT:
+        out["i"] = _num(pv.val)
+    elif k == FLOAT:
+        out["f"] = _num(float(pv.val))
+    elif k == LIST:
+        out["items"] = [pv_to_wire(e) for e in pv.val]
+    elif k == MAP:
+        mv = pv.val
+        out["entries"] = [
+            [pv_to_wire(key_node), pv_to_wire(mv.values[key_node.val])]
+            for key_node in mv.keys
+        ]
+    elif k in (RANGE_INT, RANGE_FLOAT, RANGE_CHAR):
+        r = pv.val
+        lo = r.lower if k == RANGE_CHAR else _num(r.lower)
+        hi = r.upper if k == RANGE_CHAR else _num(r.upper)
+        out["lo"] = lo
+        out["hi"] = hi
+        out["inc"] = r.inclusive
+    else:
+        raise Unserializable(f"unknown PV kind {k}")
+    return out
+
+
+def _let_value(lv) -> dict:
+    if isinstance(lv, PV):
+        return {"l": "pv", "pv": pv_to_wire(lv)}
+    if isinstance(lv, AccessQuery):
+        return {"l": "q", "q": _query(lv)}
+    if isinstance(lv, FunctionExpr):
+        return {
+            "l": "fn",
+            "name": lv.name,
+            "params": [_let_value(p) for p in lv.parameters],
+            "loc": _loc(lv.location),
+        }
+    raise Unserializable(f"unknown let value {lv!r}")
+
+
+def _part(part) -> dict:
+    if isinstance(part, QThis):
+        return {"p": "this"}
+    if isinstance(part, QKey):
+        return {"p": "key", "name": part.name}
+    if isinstance(part, QAllValues):
+        return {"p": "all_values", "name": part.name}
+    if isinstance(part, QAllIndices):
+        return {"p": "all_indices", "name": part.name}
+    if isinstance(part, QIndex):
+        return {"p": "index", "i": part.index}
+    if isinstance(part, QFilter):
+        return {"p": "filter", "name": part.name, "conj": _conj(part.conjunctions)}
+    if isinstance(part, QMapKeyFilter):
+        c = part.clause
+        return {
+            "p": "keys",
+            "name": part.name,
+            "cmp": c.comparator.value,
+            "inv": c.comparator_inverse,
+            "cw": _let_value(c.compare_with),
+        }
+    raise Unserializable(f"unknown query part {part!r}")
+
+
+def _query(q: AccessQuery) -> dict:
+    return {"parts": [_part(p) for p in q.query], "match_all": q.match_all}
+
+
+def _assignments(assignments: List[LetExpr]) -> list:
+    return [{"var": a.var, "value": _let_value(a.value)} for a in assignments]
+
+
+def _clause(c) -> dict:
+    if isinstance(c, GuardAccessClause):
+        ac = c.access_clause
+        return {
+            "t": "access",
+            "query": _query(ac.query),
+            "cmp": ac.comparator.value,
+            "inv": ac.comparator_inverse,
+            "neg": c.negation,
+            "cw": None if ac.compare_with is None else _let_value(ac.compare_with),
+            "msg": ac.custom_message,
+            "loc": _loc(ac.location),
+        }
+    if isinstance(c, GuardNamedRuleClause):
+        return {
+            "t": "named",
+            "rule": c.dependent_rule,
+            "neg": c.negation,
+            "msg": c.custom_message,
+            "loc": _loc(c.location),
+        }
+    if isinstance(c, BlockGuardClause):
+        return {
+            "t": "block",
+            "query": _query(c.query),
+            "assignments": _assignments(c.block.assignments),
+            "conj": _conj(c.block.conjunctions),
+            "not_empty": c.not_empty,
+            "loc": _loc(c.location),
+        }
+    if isinstance(c, WhenBlockClause):
+        return {
+            "t": "when",
+            "conditions": _conj(c.conditions),
+            "assignments": _assignments(c.block.assignments),
+            "conj": _conj(c.block.conjunctions),
+        }
+    if isinstance(c, ParameterizedNamedRuleClause):
+        return {
+            "t": "call",
+            "params": [_let_value(p) for p in c.parameters],
+            "named": _clause(c.named_rule),
+        }
+    if isinstance(c, TypeBlock):
+        return {
+            "t": "type_block",
+            "type_name": c.type_name,
+            "query": [_part(p) for p in c.query],
+            "conditions": None if c.conditions is None else _conj(c.conditions),
+            "assignments": _assignments(c.block.assignments),
+            "conj": _conj(c.block.conjunctions),
+        }
+    raise Unserializable(f"unknown clause {type(c).__name__}")
+
+
+def _conj(conjunctions) -> list:
+    return [[_clause(c) for c in disj] for disj in conjunctions]
+
+
+def _rule(rule: Rule) -> dict:
+    return {
+        "name": rule.rule_name,
+        "conditions": None if rule.conditions is None else _conj(rule.conditions),
+        "assignments": _assignments(rule.block.assignments),
+        "conj": _conj(rule.block.conjunctions),
+    }
+
+
+def rules_file_to_wire(rf: RulesFile) -> dict:
+    return {
+        "assignments": _assignments(rf.assignments),
+        "rules": [_rule(r) for r in rf.guard_rules],
+        "param_rules": [
+            {"params": pr.parameter_names, "rule": _rule(pr.rule)}
+            for pr in rf.parameterized_rules
+        ],
+    }
+
+
+def rules_file_to_json(rf: RulesFile) -> str:
+    return json.dumps(rules_file_to_wire(rf), ensure_ascii=False)
+
+
+def _pv_to_compact(pv: PV):
+    k = pv.kind
+    if k == NULL:
+        return (0,)
+    if k in (STRING, REGEX, CHAR):
+        return (k, pv.val)
+    if k == BOOL:
+        return (3, bool(pv.val))
+    if k == INT:
+        return (4, _num(pv.val))
+    if k == FLOAT:
+        return (5, _num(float(pv.val)))
+    if k == LIST:
+        return (7, [_pv_to_compact(e) for e in pv.val])
+    if k == MAP:
+        mv = pv.val
+        return (8, [[kn.val, _pv_to_compact(mv.values[kn.val])] for kn in mv.keys])
+    raise Unserializable(f"kind {k} cannot appear in a document")
+
+
+def doc_to_compact(doc: PV) -> str:
+    """Status-mode document wire: positional [kind, payload] arrays, no
+    paths/locations (statuses never read them) — about 3x leaner than
+    the rich wire and parsed by a dedicated direct scanner in C++."""
+    return json.dumps(_pv_to_compact(doc), ensure_ascii=False)

@@ -1,0 +1,56 @@
+"""Shared loader for the native shared libraries (native/*.so).
+
+Both ctypes binding modules (native_encoder.py, native_oracle.py)
+resolve the same `GUARD_TPU_NATIVE_DIR` root, cache one CDLL per
+library, and drive the same build-script contract; this is the single
+copy of that plumbing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+from typing import Dict, Optional
+
+NATIVE_DIR = Path(
+    os.environ.get(
+        "GUARD_TPU_NATIVE_DIR",
+        Path(__file__).resolve().parent.parent.parent / "native",
+    )
+)
+
+_libs: Dict[str, ctypes.CDLL] = {}
+
+
+def so_path(so_name: str) -> Path:
+    return NATIVE_DIR / so_name
+
+
+def load_lib(so_name: str) -> Optional[ctypes.CDLL]:
+    """CDLL for `so_name`, cached; None when not built."""
+    if so_name in _libs:
+        return _libs[so_name]
+    path = so_path(so_name)
+    if not path.exists():
+        return None
+    lib = ctypes.CDLL(str(path))
+    _libs[so_name] = lib
+    return lib
+
+
+def build(so_name: str, build_script: str, force: bool = False) -> bool:
+    """Compile `so_name` via its build script; True when present."""
+    path = so_path(so_name)
+    if path.exists() and not force:
+        return True
+    try:
+        subprocess.run(
+            ["sh", str(NATIVE_DIR / build_script)],
+            check=True,
+            capture_output=True,
+        )
+    except (subprocess.CalledProcessError, OSError):
+        return False
+    return path.exists()

@@ -10,23 +10,15 @@ the shared library hasn't been built (`native/build.sh`).
 from __future__ import annotations
 
 import ctypes
-import subprocess
-from pathlib import Path
 from typing import List, Optional, Tuple
 
 import numpy as np
 
 from .encoder import DocBatch, Interner
+from ._native_lib import build, load_lib
 
-import os
-
-_NATIVE_DIR = Path(
-    os.environ.get(
-        "GUARD_TPU_NATIVE_DIR",
-        Path(__file__).resolve().parent.parent.parent / "native",
-    )
-)
-_SO_PATH = _NATIVE_DIR / "libguard_encoder.so"
+_SO_NAME = "libguard_encoder.so"
+_BUILD_SCRIPT = "build.sh"
 
 
 class _EncodedBatchStruct(ctypes.Structure):
@@ -53,16 +45,16 @@ class _EncodedBatchStruct(ctypes.Structure):
     ]
 
 
-_lib = None
+_configured = None
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib
-    if _lib is not None:
-        return _lib
-    if not _SO_PATH.exists():
+    global _configured
+    if _configured is not None:
+        return _configured
+    lib = load_lib(_SO_NAME)
+    if lib is None:
         return None
-    lib = ctypes.CDLL(str(_SO_PATH))
     lib.guard_encode_json_batch.restype = ctypes.POINTER(_EncodedBatchStruct)
     lib.guard_encode_json_batch.argtypes = [
         ctypes.POINTER(ctypes.c_char_p),
@@ -70,23 +62,13 @@ def _load() -> Optional[ctypes.CDLL]:
     ]
     lib.guard_batch_free.argtypes = [ctypes.POINTER(_EncodedBatchStruct)]
     lib.guard_batch_free.restype = None
-    _lib = lib
+    _configured = lib
     return lib
 
 
 def build_native(force: bool = False) -> bool:
     """Compile the shared library via native/build.sh."""
-    if _SO_PATH.exists() and not force:
-        return True
-    try:
-        subprocess.run(
-            ["sh", str(_NATIVE_DIR / "build.sh")],
-            check=True,
-            capture_output=True,
-        )
-    except (subprocess.CalledProcessError, OSError):
-        return False
-    return _SO_PATH.exists()
+    return build(_SO_NAME, _BUILD_SCRIPT, force)
 
 
 def native_available() -> bool:

@@ -1,0 +1,163 @@
+"""ctypes bindings for the native C++ statuses oracle (native/oracle.cpp).
+
+`NativeOracle` compiles a parsed `RulesFile` once (Python serializes the
+AST, C++ deserializes) and then evaluates per-document rule statuses at
+compiled-engine speed — the economics of the reference's Rust evaluator
+(`/root/reference/guard/src/rules/eval.rs:1915`) that the pure-Python
+oracle cannot match. Two outcomes per document:
+
+  * a status list (0 PASS / 1 FAIL / 2 SKIP per guard rule, file order)
+    guaranteed to match the Python oracle bit-for-bit (differential
+    suite: tests/test_native_oracle.py), or
+  * `NativeUnsupported` / `NativeEvalError` — the engine declined
+    (construct outside its certain-parity subset) or hit the same
+    evaluation error Python would raise; callers fall back to the
+    Python oracle either way.
+
+Falls back transparently when the shared library hasn't been built
+(`native/build_oracle.sh`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional
+
+from ..core.ast_serde import Unserializable, doc_to_compact, rules_file_to_json
+from ..core.exprs import RulesFile
+from ..core.values import PV
+from ._native_lib import build, load_lib
+
+_SO_NAME = "libguard_oracle.so"
+_BUILD_SCRIPT = "build_oracle.sh"
+
+_configured = None
+
+
+class NativeUnsupported(Exception):
+    """The native engine declined (fall back to the Python oracle)."""
+
+
+class NativeEvalError(Exception):
+    """The native engine hit the evaluation error Python would raise."""
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _configured
+    if _configured is not None:
+        return _configured
+    lib = load_lib(_SO_NAME)
+    if lib is None:
+        return None
+    lib.guard_oracle_compile.restype = ctypes.c_void_p
+    lib.guard_oracle_compile.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_char_p),
+    ]
+    for fn_name in ("guard_oracle_eval", "guard_oracle_eval_raw"):
+        fn = getattr(lib, fn_name)
+        fn.restype = ctypes.c_int32
+        fn.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
+    lib.guard_oracle_free.argtypes = [ctypes.c_void_p]
+    lib.guard_oracle_free.restype = None
+    lib.guard_oracle_free_str.argtypes = [ctypes.c_char_p]
+    lib.guard_oracle_free_str.restype = None
+    _configured = lib
+    return lib
+
+
+def build_native(force: bool = False) -> bool:
+    """Compile the shared library via native/build_oracle.sh."""
+    return build(_SO_NAME, _BUILD_SCRIPT, force)
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _consume_err(lib, err: ctypes.c_char_p) -> str:
+    msg = err.value.decode("utf-8", "replace") if err.value else "unknown"
+    lib.guard_oracle_free_str(err)
+    return msg
+
+
+class NativeOracle:
+    """One compiled rule file; evaluates per-doc statuses natively.
+
+    NOT thread-safe: the engine's regex cache and pcre2 match data are
+    per-handle and unsynchronized — use one NativeOracle per thread.
+    """
+
+    def __init__(self, rules_file: RulesFile):
+        lib = _load()
+        if lib is None:
+            raise NativeUnsupported(
+                "native oracle not built; run native/build_oracle.sh"
+            )
+        self._lib = lib
+        self.n_rules = len(rules_file.guard_rules)
+        try:
+            ast_json = rules_file_to_json(rules_file).encode("utf-8")
+        except (Unserializable, RecursionError) as e:
+            raise NativeUnsupported(str(e))
+        err = ctypes.c_char_p()
+        self._handle = lib.guard_oracle_compile(ast_json, ctypes.byref(err))
+        if not self._handle:
+            msg = _consume_err(lib, err)
+            raise NativeUnsupported(msg)
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.guard_oracle_free(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover - interpreter teardown order
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def eval_doc(self, doc: PV) -> List[int]:
+        """Per-rule statuses for one loaded document (0/1/2 =
+        PASS/FAIL/SKIP in guard-rule file order)."""
+        try:
+            wire = doc_to_compact(doc).encode("utf-8")
+        except (Unserializable, RecursionError) as e:
+            raise NativeUnsupported(str(e))
+        return self.eval_wire(wire)
+
+    def eval_raw_json(self, content: str) -> List[int]:
+        """Per-rule statuses straight from raw JSON document text — no
+        Python-side load or serialization (the sweep / fail-rerun JSON
+        fast path; typing matches the location-aware loader's)."""
+        return self.eval_wire(content.encode("utf-8"), raw=True)
+
+    def eval_wire(self, wire: bytes, raw: bool = False) -> List[int]:
+        if not self._handle:
+            raise NativeUnsupported("oracle handle closed")
+        err = ctypes.c_char_p()
+        buf = (ctypes.c_int32 * max(self.n_rules, 1))()
+        entry = self._lib.guard_oracle_eval_raw if raw else self._lib.guard_oracle_eval
+        n = entry(self._handle, wire, buf, len(buf), ctypes.byref(err))
+        if n < 0:
+            msg = _consume_err(self._lib, err)
+            if msg.startswith("unsupported:"):
+                raise NativeUnsupported(msg)
+            raise NativeEvalError(msg[len("error: "):] if msg.startswith("error: ") else msg)
+        return [int(buf[i]) for i in range(n)]
+
+
+def overall_status(statuses: List[int]) -> int:
+    """eval_rules_file aggregation (evaluator.py:1533-1564): FAIL if any
+    rule failed, else PASS if any passed, else SKIP."""
+    if any(s == 1 for s in statuses):
+        return 1
+    if any(s == 0 for s in statuses):
+        return 0
+    return 2

@@ -1,0 +1,5 @@
+#!/bin/sh
+# Build the native statuses oracle (native/oracle.cpp -> libguard_oracle.so)
+set -e
+cd "$(dirname "$0")"
+g++ -O2 -fPIC -shared -std=c++17 -o libguard_oracle.so oracle.cpp -ldl

@@ -1,0 +1,3809 @@
+// guard-tpu native statuses oracle.
+//
+// A from-scratch C++ port of the evaluation core — the compiled-engine
+// role the reference fills with Rust (/root/reference/guard/src/rules/
+// eval.rs:1915, eval_context.rs:337-924, eval/operators.rs). The Python
+// modules it mirrors function-for-function are guard_tpu/core/
+// {evaluator,scopes,functions,values}.py; every section below cites the
+// Python (and transitively the reference) lines it ports.
+//
+// Scope: STATUS evaluation only — the full query walk, tri-state
+// UnResolved lattice, CNF/when/named/parameterized semantics, operators
+// and builtins, but no record tree and no reporters. Python parses the
+// DSL and the documents; this engine consumes their serialized forms
+// (guard_tpu/core/ast_serde.py) so both engines evaluate the exact same
+// trees.
+//
+// Safety contract: for any construct whose Python parity is not
+// bit-certain (regex features outside a conservative common subset,
+// non-ASCII case conversion, YAML-flavored json_parse inputs, ...)
+// the engine throws Unsupported and the caller falls back to the
+// Python oracle. The engine either agrees with Python or declines —
+// never silently diverges. tests/test_native_oracle.py holds the
+// corpus-wide differential suite backing that claim.
+//
+// C ABI (driven from guard_tpu/ops/native_oracle.py via ctypes):
+//   guard_oracle_compile(ast_json, err*)          -> handle | NULL
+//   guard_oracle_eval(handle, doc_json, out, cap, err*) -> n_rules | -1
+//   guard_oracle_free(handle)
+//   guard_oracle_free_str(str)
+//
+// Build: native/build_oracle.sh -> libguard_oracle.so
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <dlfcn.h>
+
+#include <deque>
+#include <map>
+#include <memory>
+#include <regex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Exceptions (guard_tpu/core/errors.py). NotComparable is caught at
+// specific sites (_match_value, _each_lhs_compare, loose_eq); both it
+// and GuardErr abort the doc eval when they escape. Unsupported aborts
+// with the "decline, fall back to Python" contract.
+// ---------------------------------------------------------------------------
+struct GuardErr {
+  std::string msg;
+  explicit GuardErr(std::string m) : msg(std::move(m)) {}
+};
+struct NotComparable {
+  std::string msg;
+  explicit NotComparable(std::string m) : msg(std::move(m)) {}
+};
+struct Unsupported {
+  std::string msg;
+  explicit Unsupported(std::string m) : msg(std::move(m)) {}
+};
+
+// ---------------------------------------------------------------------------
+// Minimal JSON reader for the wire formats (ast_serde.py). Ordered
+// objects; ints are i64 (the serializer guards the range).
+// ---------------------------------------------------------------------------
+enum JType { JNULL, JBOOL, JINT, JFLOAT, JSTR, JARR, JOBJ };
+
+struct JValue {
+  int t = JNULL;
+  bool b = false;
+  long long i = 0;
+  double f = 0;
+  std::string s;
+  std::vector<JValue> arr;
+  std::vector<std::pair<std::string, JValue>> obj;
+
+  const JValue* get(const char* key) const {
+    for (const auto& kv : obj)
+      if (kv.first == key) return &kv.second;
+    return nullptr;
+  }
+  const JValue& at(const char* key) const {
+    const JValue* v = get(key);
+    if (!v) throw GuardErr(std::string("wire: missing key ") + key);
+    return *v;
+  }
+  bool is_null() const { return t == JNULL; }
+  const std::string& str() const {
+    if (t != JSTR) throw GuardErr("wire: expected string");
+    return s;
+  }
+  long long as_int() const {
+    if (t == JINT) return i;
+    throw GuardErr("wire: expected int");
+  }
+  bool as_bool() const {
+    if (t != JBOOL) throw GuardErr("wire: expected bool");
+    return b;
+  }
+};
+
+struct JParser {
+  const char* p;
+  const char* end;
+  int depth = 0;
+  // strict: reject leading zeros / require JSON number grammar AND
+  // decline raw control chars inside strings (pyyaml line-folds them;
+  // silently keeping them would diverge). Used by the embedded
+  // json_parse re-parser and the raw-document path.
+  bool strict = false;
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++;
+  }
+
+  [[noreturn]] void fail(const char* why) { throw GuardErr(std::string("json: ") + why); }
+
+  std::string pstring() {
+    if (p >= end || *p != '"') fail("expected string");
+    p++;
+    std::string s;
+    while (p < end && *p != '"') {
+      char c = *p++;
+      if (c == '\\' && p < end) {
+        char e = *p++;
+        switch (e) {
+          case 'n': s.push_back('\n'); break;
+          case 't': s.push_back('\t'); break;
+          case 'r': s.push_back('\r'); break;
+          case 'b': s.push_back('\b'); break;
+          case 'f': s.push_back('\f'); break;
+          case '/': s.push_back('/'); break;
+          case '\\': s.push_back('\\'); break;
+          case '"': s.push_back('"'); break;
+          case 'u': {
+            if (end - p < 4) fail("bad \\u");
+            unsigned code = 0;
+            for (int k = 0; k < 4; k++) {
+              char h = *p++;
+              code <<= 4;
+              if (h >= '0' && h <= '9') code |= h - '0';
+              else if (h >= 'a' && h <= 'f') code |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
+              else fail("bad \\u");
+            }
+            // surrogate pair
+            if (code >= 0xD800 && code <= 0xDBFF && end - p >= 6 && p[0] == '\\' &&
+                p[1] == 'u') {
+              unsigned lo = 0;
+              bool ok = true;
+              for (int k = 0; k < 4; k++) {
+                char h = p[2 + k];
+                lo <<= 4;
+                if (h >= '0' && h <= '9') lo |= h - '0';
+                else if (h >= 'a' && h <= 'f') lo |= h - 'a' + 10;
+                else if (h >= 'A' && h <= 'F') lo |= h - 'A' + 10;
+                else { ok = false; break; }
+              }
+              if (ok && lo >= 0xDC00 && lo <= 0xDFFF) {
+                p += 6;
+                code = 0x10000 + ((code - 0xD800) << 10) + (lo - 0xDC00);
+              }
+            }
+            // UTF-8 encode
+            if (code < 0x80) {
+              s.push_back(static_cast<char>(code));
+            } else if (code < 0x800) {
+              s.push_back(static_cast<char>(0xC0 | (code >> 6)));
+              s.push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            } else if (code < 0x10000) {
+              s.push_back(static_cast<char>(0xE0 | (code >> 12)));
+              s.push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+              s.push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            } else {
+              s.push_back(static_cast<char>(0xF0 | (code >> 18)));
+              s.push_back(static_cast<char>(0x80 | ((code >> 12) & 0x3F)));
+              s.push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+              s.push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            }
+            break;
+          }
+          default: fail("bad escape");
+        }
+      } else {
+        if (strict && static_cast<unsigned char>(c) < 0x20)
+          throw Unsupported("raw control char in string");
+        s.push_back(c);
+      }
+    }
+    if (p >= end) fail("unterminated string");
+    p++;
+    return s;
+  }
+
+  JValue value() {
+    if (++depth > 800) throw Unsupported("json nesting too deep");
+    ws();
+    if (p >= end) fail("eof");
+    JValue v;
+    char c = *p;
+    if (c == '{') {
+      p++;
+      v.t = JOBJ;
+      ws();
+      if (p < end && *p == '}') { p++; depth--; return v; }
+      while (true) {
+        ws();
+        std::string key = pstring();
+        ws();
+        if (p >= end || *p != ':') fail("expected :");
+        p++;
+        JValue item = value();
+        // duplicate keys: keep first position, last value (python dict)
+        bool dup = false;
+        for (auto& kv : v.obj)
+          if (kv.first == key) { kv.second = std::move(item); dup = true; break; }
+        if (!dup) v.obj.emplace_back(std::move(key), std::move(item));
+        ws();
+        if (p < end && *p == ',') { p++; continue; }
+        if (p < end && *p == '}') { p++; break; }
+        fail("expected , or }");
+      }
+    } else if (c == '[') {
+      p++;
+      v.t = JARR;
+      ws();
+      if (p < end && *p == ']') { p++; depth--; return v; }
+      while (true) {
+        v.arr.push_back(value());
+        ws();
+        if (p < end && *p == ',') { p++; continue; }
+        if (p < end && *p == ']') { p++; break; }
+        fail("expected , or ]");
+      }
+    } else if (c == '"') {
+      v.t = JSTR;
+      v.s = pstring();
+    } else if (c == 't' && end - p >= 4 && strncmp(p, "true", 4) == 0) {
+      p += 4; v.t = JBOOL; v.b = true;
+    } else if (c == 'f' && end - p >= 5 && strncmp(p, "false", 5) == 0) {
+      p += 5; v.t = JBOOL; v.b = false;
+    } else if (c == 'n' && end - p >= 4 && strncmp(p, "null", 4) == 0) {
+      p += 4; v.t = JNULL;
+    } else {
+      // number
+      const char* start = p;
+      if (p < end && *p == '-') p++;
+      if (strict) {
+        if (p >= end || *p < '0' || *p > '9') fail("bad number");
+        if (*p == '0' && p + 1 < end && p[1] >= '0' && p[1] <= '9')
+          fail("leading zero");
+      }
+      bool is_float = false;
+      while (p < end && ((*p >= '0' && *p <= '9') || *p == '.' || *p == 'e' ||
+                         *p == 'E' || *p == '+' || *p == '-')) {
+        if (*p == '.' || *p == 'e' || *p == 'E') is_float = true;
+        p++;
+      }
+      if (p == start) fail("bad number");
+      std::string num(start, p - start);
+      if (is_float) {
+        char* endp = nullptr;
+        v.t = JFLOAT;
+        v.f = strtod(num.c_str(), &endp);
+        if (endp != num.c_str() + num.size()) fail("bad float");
+      } else {
+        errno = 0;
+        char* endp = nullptr;
+        v.t = JINT;
+        v.i = strtoll(num.c_str(), &endp, 10);
+        if (endp != num.c_str() + num.size()) fail("bad int");
+        if (errno == ERANGE) throw Unsupported("integer outside i64");
+      }
+    }
+    depth--;
+    return v;
+  }
+
+  JValue parse() {
+    JValue v = value();
+    ws();
+    if (p != end) fail("trailing data");
+    return v;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Value model (guard_tpu/core/values.py PV; path_value.rs:172-185).
+// Kinds share the Python module's stable small ints.
+// ---------------------------------------------------------------------------
+enum Kind {
+  K_NULL = 0, K_STRING = 1, K_REGEX = 2, K_BOOL = 3, K_INT = 4,
+  K_FLOAT = 5, K_CHAR = 6, K_LIST = 7, K_MAP = 8,
+  K_RANGE_INT = 9, K_RANGE_FLOAT = 10, K_RANGE_CHAR = 11,
+};
+
+const int LOWER_INCLUSIVE = 0x01;  // values.rs:239
+const int UPPER_INCLUSIVE = 0x02;  // values.rs:240
+
+struct PVal {
+  int kind = K_NULL;
+  std::string path;
+  int line = 0, col = 0;
+  std::string s;    // STRING / REGEX / CHAR; RANGE_CHAR bounds in rs_lo/rs_hi
+  bool b = false;   // BOOL
+  long long i = 0;  // INT; RANGE_INT bounds in ri_lo/ri_hi
+  double f = 0;     // FLOAT; RANGE_FLOAT bounds in rf_lo/rf_hi
+  std::vector<PVal*> list;
+  // MAP: insertion-ordered (key node, value) pairs; key lookup scans a
+  // side index built lazily only for big maps
+  std::vector<std::pair<PVal*, PVal*>> entries;
+  long long ri_lo = 0, ri_hi = 0;
+  double rf_lo = 0, rf_hi = 0;
+  std::string rs_lo, rs_hi;
+  int inc = 0;
+
+  bool is_scalar() const { return kind != K_LIST && kind != K_MAP; }
+  bool is_null() const { return kind == K_NULL; }
+  bool map_empty() const { return entries.empty(); }
+
+  PVal* map_get(const std::string& key) const {
+    for (const auto& e : entries)
+      if (e.first->s == key) return e.second;
+    return nullptr;
+  }
+
+  const char* type_info() const {
+    switch (kind) {
+      case K_NULL: return "null";
+      case K_STRING: return "String";
+      case K_REGEX: return "Regex";
+      case K_BOOL: return "bool";
+      case K_INT: return "int";
+      case K_FLOAT: return "float";
+      case K_CHAR: return "char";
+      case K_LIST: return "array";
+      case K_MAP: return "map";
+      case K_RANGE_INT: return "range(int, int)";
+      case K_RANGE_FLOAT: return "range(float, float)";
+      default: return "range(char, char)";
+    }
+  }
+};
+
+// Arena: PVals live as long as the evaluation that created them.
+struct Arena {
+  std::deque<PVal> pool;
+  PVal* nv() {
+    pool.emplace_back();
+    return &pool.back();
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Regex: conservative common-subset classifier + std::regex (ECMAScript)
+// execution. Python `re` (values.py compiled_regex) is the semantics
+// being reproduced; any feature whose behavior could differ between the
+// engines throws Unsupported so the caller falls back to Python.
+// ---------------------------------------------------------------------------
+// --- PCRE2 via dlopen (no headers in this image; the 8-bit C ABI is
+// stable). Preferred engine: Perl-family semantics match Python's `re`
+// across the classified subset — including `$` matching before a final
+// newline — and the JIT makes it the fast path for the hot loop the
+// reference profile calls out (regex dominates registry rules).
+// Falls back to std::regex (ECMAScript) with a stricter classifier
+// when the library is absent.
+typedef struct pcre2_real_code_8 pcre2_code_8;
+typedef struct pcre2_real_match_data_8 pcre2_match_data_8;
+
+struct Pcre2Api {
+  pcre2_code_8* (*compile)(const uint8_t*, size_t, uint32_t, int*, size_t*, void*);
+  pcre2_match_data_8* (*match_data_create_from_pattern)(const pcre2_code_8*, void*);
+  int (*match)(const pcre2_code_8*, const uint8_t*, size_t, size_t, uint32_t,
+               pcre2_match_data_8*, void*);
+  size_t* (*get_ovector_pointer)(pcre2_match_data_8*);
+  uint32_t (*get_ovector_count)(pcre2_match_data_8*);
+  int (*jit_compile)(pcre2_code_8*, uint32_t);
+  void (*code_free)(pcre2_code_8*);
+  void (*match_data_free)(pcre2_match_data_8*);
+  bool ok = false;
+};
+
+const uint32_t PCRE2_CASELESS_F = 0x00000008u;
+const uint32_t PCRE2_JIT_COMPLETE_F = 0x00000001u;
+const size_t PCRE2_ZERO_TERMINATED_C = ~static_cast<size_t>(0);
+const int PCRE2_ERROR_NOMATCH_C = -1;
+
+Pcre2Api& pcre2_api();
+
+struct CompiledRx {
+  // one of the two engines is populated
+  pcre2_code_8* pc = nullptr;
+  pcre2_match_data_8* md = nullptr;
+  std::regex re;
+  bool use_std = false;
+  bool dollar = false;     // std::regex only: guard \n tails on $ / \Z
+  bool usable = false;
+  int ngroups = 0;
+
+  ~CompiledRx() {
+    if (pc) {
+      pcre2_api().code_free(pc);
+      if (md) pcre2_api().match_data_free(md);
+    }
+  }
+};
+
+bool ascii_only(const std::string& s) {
+  for (unsigned char c : s)
+    if (c >= 0x80) return false;
+  return true;
+}
+
+// Translate a Python-re pattern into the shared subset, or throw.
+// Returns the (possibly rewritten) pattern; sets icase/dollar flags.
+std::string classify_pattern(const std::string& pat, bool* icase, bool* dollar) {
+  if (!ascii_only(pat)) throw Unsupported("non-ascii regex pattern");
+  std::string out;
+  *icase = false;
+  *dollar = false;
+  size_t n = pat.size();
+  bool in_class = false;
+  for (size_t i = 0; i < n; i++) {
+    char c = pat[i];
+    if (c == '\\') {
+      if (i + 1 >= n) throw Unsupported("trailing backslash");
+      char e = pat[i + 1];
+      if (in_class) {
+        // class escapes: \d \w \s etc. and punctuation are shared
+        if (e == 'N' || e == 'p' || e == 'P' || e == 'u' || e == 'x') {
+          // \u/\x inside classes: allow only ASCII-valued
+          if (e == 'u' || e == 'x') {
+            int hex = (e == 'u') ? 4 : 2;
+            unsigned v = 0;
+            if (i + 2 + hex > n) throw Unsupported("bad hex escape");
+            for (int k = 0; k < hex; k++) {
+              char h = pat[i + 2 + k];
+              v <<= 4;
+              if (h >= '0' && h <= '9') v |= h - '0';
+              else if (h >= 'a' && h <= 'f') v |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') v |= h - 'A' + 10;
+              else throw Unsupported("bad hex escape");
+            }
+            if (v >= 0x80) throw Unsupported("non-ascii escape");
+          } else {
+            throw Unsupported("unsupported class escape");
+          }
+        }
+        out.push_back(c);
+        out.push_back(e);
+        i++;
+        continue;
+      }
+      if (e == 'A') { out.push_back('^'); i++; continue; }
+      if (e == 'Z') { out.push_back('$'); *dollar = true; i++; continue; }
+      if (e == 'z' || e == 'G' || e == 'N' || e == 'p' || e == 'P')
+        throw Unsupported("unsupported escape");
+      if (e == 'u' || e == 'x') {
+        int hex = (e == 'u') ? 4 : 2;
+        unsigned v = 0;
+        if (i + 2 + hex > n) throw Unsupported("bad hex escape");
+        for (int k = 0; k < hex; k++) {
+          char h = pat[i + 2 + k];
+          v <<= 4;
+          if (h >= '0' && h <= '9') v |= h - '0';
+          else if (h >= 'a' && h <= 'f') v |= h - 'a' + 10;
+          else if (h >= 'A' && h <= 'F') v |= h - 'A' + 10;
+          else throw Unsupported("bad hex escape");
+        }
+        if (v >= 0x80) throw Unsupported("non-ascii escape");
+      }
+      out.push_back(c);
+      out.push_back(e);
+      i++;
+      continue;
+    }
+    if (in_class) {
+      if (c == ']') in_class = false;
+      else if (c == '[' && i + 1 < n &&
+               (pat[i + 1] == ':' || pat[i + 1] == '.' || pat[i + 1] == '='))
+        throw Unsupported("posix class syntax");
+      out.push_back(c);
+      continue;
+    }
+    switch (c) {
+      case '[': {
+        in_class = true;
+        out.push_back(c);
+        size_t j = i + 1;
+        if (j < n && pat[j] == '^') { out.push_back('^'); j++; i++; }
+        if (j < n && pat[j] == ']')
+          throw Unsupported("leading ] in class");  // py: literal; es: empty class
+        break;
+      }
+      case '(': {
+        if (i + 1 < n && pat[i + 1] == '?') {
+          size_t j = i + 2;
+          if (j < n && (pat[j] == ':' || pat[j] == '=' || pat[j] == '!')) {
+            out += "(?";
+            out.push_back(pat[j]);
+            i = j;
+            break;
+          }
+          // global flag group (?i) — values.py hoists these globally
+          size_t k = j;
+          while (k < n && pat[k] >= 'a' && pat[k] <= 'z') k++;
+          if (k > j && k < n && pat[k] == ')') {
+            for (size_t m = j; m < k; m++) {
+              if (pat[m] == 'i') *icase = true;
+              else throw Unsupported("unsupported inline flag");
+            }
+            i = k;  // drop the group entirely
+            break;
+          }
+          throw Unsupported("unsupported group syntax");
+        }
+        out.push_back(c);
+        break;
+      }
+      case '$':
+        *dollar = true;
+        out.push_back(c);
+        break;
+      case '{': {
+        // python: '{' is literal unless it forms {m}/{m,}/{m,n}
+        size_t j = i + 1;
+        while (j < n && pat[j] >= '0' && pat[j] <= '9') j++;
+        bool valid = j > i + 1;
+        if (valid && j < n && pat[j] == ',') {
+          j++;
+          while (j < n && pat[j] >= '0' && pat[j] <= '9') j++;
+        }
+        if (!(valid && j < n && pat[j] == '}'))
+          throw Unsupported("literal brace");
+        out.push_back(c);
+        break;
+      }
+      case '*':
+      case '+':
+      case '?': {
+        if (i + 1 < n && pat[i + 1] == '+')
+          throw Unsupported("possessive quantifier");
+        out.push_back(c);
+        break;
+      }
+      default:
+        out.push_back(c);
+    }
+  }
+  if (in_class) throw Unsupported("unterminated class");
+  // '}' after a counted repetition followed by '+' (possessive)
+  for (size_t i = 1; i < out.size(); i++)
+    if (out[i] == '+' && out[i - 1] == '}') throw Unsupported("possessive quantifier");
+  return out;
+}
+
+Pcre2Api& pcre2_api() {
+  static Pcre2Api api = [] {
+    Pcre2Api a;
+    void* h = dlopen("libpcre2-8.so.0", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) h = dlopen("libpcre2-8.so", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) return a;
+    auto sym = [&](const char* n) { return dlsym(h, n); };
+    a.compile = reinterpret_cast<decltype(a.compile)>(sym("pcre2_compile_8"));
+    a.match_data_create_from_pattern =
+        reinterpret_cast<decltype(a.match_data_create_from_pattern)>(
+            sym("pcre2_match_data_create_from_pattern_8"));
+    a.match = reinterpret_cast<decltype(a.match)>(sym("pcre2_match_8"));
+    a.get_ovector_pointer = reinterpret_cast<decltype(a.get_ovector_pointer)>(
+        sym("pcre2_get_ovector_pointer_8"));
+    a.get_ovector_count = reinterpret_cast<decltype(a.get_ovector_count)>(
+        sym("pcre2_get_ovector_count_8"));
+    a.jit_compile = reinterpret_cast<decltype(a.jit_compile)>(sym("pcre2_jit_compile_8"));
+    a.code_free = reinterpret_cast<decltype(a.code_free)>(sym("pcre2_code_free_8"));
+    a.match_data_free =
+        reinterpret_cast<decltype(a.match_data_free)>(sym("pcre2_match_data_free_8"));
+    a.ok = a.compile && a.match_data_create_from_pattern && a.match &&
+           a.get_ovector_pointer && a.code_free && a.match_data_free;
+    return a;
+  }();
+  return api;
+}
+
+// PCRE2-mode classifier: Perl-family semantics equal Python's for a
+// wider subset than ECMAScript. Still rejected (behavior differs or is
+// uncertain vs python `re`): POSIX classes (python treats the syntax
+// literally), \G, \p/\P/\N unicode escapes, (?P name syntax kept out
+// until fuzz-backed, inline flags other than global (?i) (values.py
+// hoists those globally), non-ascii patterns. \Z translates to \z
+// (python \Z is end-of-string only; pcre2 \Z allows a trailing \n).
+std::string classify_pattern_pcre2(const std::string& pat, bool* icase) {
+  if (!ascii_only(pat)) throw Unsupported("non-ascii regex pattern");
+  std::string out;
+  *icase = false;
+  size_t n = pat.size();
+  bool in_class = false;
+  for (size_t i = 0; i < n; i++) {
+    char c = pat[i];
+    if (c == '\\') {
+      if (i + 1 >= n) throw Unsupported("trailing backslash");
+      char e = pat[i + 1];
+      if (e == 'Z' && !in_class) { out += "\\z"; i++; continue; }
+      if (e == 'G' || e == 'N' || e == 'p' || e == 'P')
+        throw Unsupported("unsupported escape");
+      if (e == 'u' || e == 'x') {
+        int hex = (e == 'u') ? 4 : 2;
+        unsigned v = 0;
+        if (i + 2 + hex > n) throw Unsupported("bad hex escape");
+        for (int k = 0; k < hex; k++) {
+          char h = pat[i + 2 + k];
+          v <<= 4;
+          if (h >= '0' && h <= '9') v |= h - '0';
+          else if (h >= 'a' && h <= 'f') v |= h - 'a' + 10;
+          else if (h >= 'A' && h <= 'F') v |= h - 'A' + 10;
+          else throw Unsupported("bad hex escape");
+        }
+        if (v >= 0x80) throw Unsupported("non-ascii escape");
+        if (e == 'u') {
+          // pcre2 \uXXXX needs ALT_BSUX; rewrite to \x{XX}
+          char buf[16];
+          snprintf(buf, sizeof buf, "\\x{%02x}", v);
+          out += buf;
+          i += 5;
+          continue;
+        }
+      }
+      out.push_back(c);
+      out.push_back(e);
+      i++;
+      continue;
+    }
+    if (in_class) {
+      if (c == ']') in_class = false;
+      else if (c == '[' && i + 1 < n &&
+               (pat[i + 1] == ':' || pat[i + 1] == '.' || pat[i + 1] == '='))
+        throw Unsupported("posix class syntax");
+      out.push_back(c);
+      continue;
+    }
+    if (c == '[') {
+      in_class = true;
+      out.push_back(c);
+      size_t j = i + 1;
+      if (j < n && pat[j] == '^') { out.push_back('^'); j++; i++; }
+      if (j < n && pat[j] == ']') {
+        // leading ] is literal in BOTH python and pcre2... except pcre2
+        // needs it escaped to be safe across versions
+        out += "\\]";
+        i++;
+      }
+      continue;
+    }
+    if (c == '(' && i + 1 < n && pat[i + 1] == '?') {
+      size_t j = i + 2;
+      if (j < n && (pat[j] == ':' || pat[j] == '=' || pat[j] == '!')) {
+        out += "(?";
+        out.push_back(pat[j]);
+        i = j;
+        continue;
+      }
+      // lookbehind stays out: python `re` requires fixed-width bodies
+      // and errors otherwise; pcre2 accepts per-alternative widths, so
+      // admitting it would evaluate where python raises
+      size_t k = j;
+      while (k < n && pat[k] >= 'a' && pat[k] <= 'z') k++;
+      if (k > j && k < n && pat[k] == ')') {
+        for (size_t m = j; m < k; m++) {
+          if (pat[m] == 'i') *icase = true;
+          else throw Unsupported("unsupported inline flag");
+        }
+        i = k;
+        continue;
+      }
+      throw Unsupported("unsupported group syntax");
+    }
+    out.push_back(c);
+  }
+  if (in_class) throw Unsupported("unterminated class");
+  return out;
+}
+
+struct Match {
+  // group spans as byte offsets; (-1,-1) = unmatched group
+  std::vector<std::pair<long long, long long>> groups;
+};
+
+struct RxCache {
+  std::unordered_map<std::string, std::shared_ptr<CompiledRx>> cache;
+
+  std::shared_ptr<CompiledRx> get(const std::string& pattern) {
+    auto it = cache.find(pattern);
+    if (it != cache.end()) {
+      if (!it->second->usable) throw Unsupported("regex outside subset");
+      return it->second;
+    }
+    auto rx = std::make_shared<CompiledRx>();
+    try {
+      bool icase = false;
+      if (pcre2_api().ok) {
+        std::string translated = classify_pattern_pcre2(pattern, &icase);
+        int errcode = 0;
+        size_t erroff = 0;
+        uint32_t opts = icase ? PCRE2_CASELESS_F : 0;
+        rx->pc = pcre2_api().compile(
+            reinterpret_cast<const uint8_t*>(translated.c_str()), translated.size(),
+            opts, &errcode, &erroff, nullptr);
+        if (!rx->pc) throw Unsupported("regex rejected by pcre2");
+        if (pcre2_api().jit_compile) pcre2_api().jit_compile(rx->pc, PCRE2_JIT_COMPLETE_F);
+        rx->md = pcre2_api().match_data_create_from_pattern(rx->pc, nullptr);
+        if (!rx->md) throw Unsupported("pcre2 match data alloc failed");
+        rx->use_std = false;
+      } else {
+        std::string translated = classify_pattern(pattern, &icase, &rx->dollar);
+        auto flags = std::regex::ECMAScript;
+        if (icase) flags |= std::regex::icase;
+        rx->re = std::regex(translated, flags);
+        rx->use_std = true;
+      }
+      rx->usable = true;
+    } catch (const std::regex_error&) {
+      cache.emplace(pattern, rx);
+      throw Unsupported("regex rejected by std::regex");
+    } catch (const Unsupported&) {
+      cache.emplace(pattern, rx);
+      throw;
+    }
+    cache.emplace(pattern, rx);
+    return rx;
+  }
+
+  // One match at-or-after `start`; fills group spans. Python re.search.
+  static bool find_at(CompiledRx* rx, const std::string& subject, size_t start,
+                      Match* m) {
+    if (!rx->use_std) {
+      int rc = pcre2_api().match(rx->pc,
+                                 reinterpret_cast<const uint8_t*>(subject.data()),
+                                 subject.size(), start, 0, rx->md, nullptr);
+      if (rc == PCRE2_ERROR_NOMATCH_C) return false;
+      if (rc < 0) throw Unsupported("pcre2 match error");
+      size_t* ov = pcre2_api().get_ovector_pointer(rx->md);
+      uint32_t pairs = pcre2_api().get_ovector_count
+                           ? pcre2_api().get_ovector_count(rx->md)
+                           : static_cast<uint32_t>(rc);
+      if (m) {
+        m->groups.clear();
+        for (uint32_t g = 0; g < pairs; g++) {
+          size_t a = ov[2 * g], b = ov[2 * g + 1];
+          if (a == PCRE2_ZERO_TERMINATED_C)
+            m->groups.emplace_back(-1, -1);
+          else
+            m->groups.emplace_back(static_cast<long long>(a), static_cast<long long>(b));
+        }
+      }
+      return true;
+    }
+    std::smatch sm;
+    std::regex_constants::match_flag_type fl = std::regex_constants::match_default;
+    if (start > 0) fl |= std::regex_constants::match_prev_avail;
+    if (!std::regex_search(subject.begin() + static_cast<long>(start), subject.end(),
+                           sm, rx->re, fl))
+      return false;
+    if (m) {
+      m->groups.clear();
+      for (size_t g = 0; g < sm.size(); g++) {
+        if (!sm[g].matched) {
+          m->groups.emplace_back(-1, -1);
+        } else {
+          long long a = sm.position(g) + static_cast<long long>(start);
+          m->groups.emplace_back(a, a + sm.length(g));
+        }
+      }
+    }
+    return true;
+  }
+
+  // Unanchored match, like fancy_regex / re.search (values.py:350-352)
+  bool matches(const std::string& pattern, const std::string& subject) {
+    auto rx = get(pattern);
+    if (!ascii_only(subject)) throw Unsupported("non-ascii regex subject");
+    if (rx->use_std && rx->dollar && !subject.empty() && subject.back() == '\n')
+      throw Unsupported("$ with trailing newline");  // python $ matches pre-\n
+    return find_at(rx.get(), subject, 0, nullptr);
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Comparisons (values.py:361-446; path_value.rs:1047-1196)
+// ---------------------------------------------------------------------------
+bool kind_ordered(int k) {
+  return k == K_NULL || k == K_INT || k == K_STRING || k == K_FLOAT || k == K_CHAR;
+}
+
+int compare_values(const PVal& a, const PVal& b) {
+  if (a.kind == b.kind && kind_ordered(a.kind)) {
+    switch (a.kind) {
+      case K_NULL: return 0;
+      case K_INT: return a.i < b.i ? -1 : (a.i > b.i ? 1 : 0);
+      case K_FLOAT: return a.f < b.f ? -1 : (a.f > b.f ? 1 : 0);
+      default:  // STRING / CHAR: utf-8 byte order == code-point order
+        return a.s < b.s ? -1 : (a.s > b.s ? 1 : 0);
+    }
+  }
+  throw NotComparable(std::string("PathAwareValues are not comparable ") +
+                      a.type_info() + ", " + b.type_info());
+}
+
+bool range_contains_int(const PVal& r, long long v) {
+  bool lo = (r.inc & LOWER_INCLUSIVE) ? r.ri_lo <= v : r.ri_lo < v;
+  bool hi = (r.inc & UPPER_INCLUSIVE) ? r.ri_hi >= v : r.ri_hi > v;
+  return lo && hi;
+}
+bool range_contains_float(const PVal& r, double v) {
+  bool lo = (r.inc & LOWER_INCLUSIVE) ? r.rf_lo <= v : r.rf_lo < v;
+  bool hi = (r.inc & UPPER_INCLUSIVE) ? r.rf_hi >= v : r.rf_hi > v;
+  return lo && hi;
+}
+bool range_contains_char(const PVal& r, const std::string& v) {
+  bool lo = (r.inc & LOWER_INCLUSIVE) ? r.rs_lo <= v : r.rs_lo < v;
+  bool hi = (r.inc & UPPER_INCLUSIVE) ? r.rs_hi >= v : r.rs_hi > v;
+  return lo && hi;
+}
+
+bool loose_eq(const PVal& a, const PVal& b, RxCache& rx);
+
+bool compare_eq(const PVal& a, const PVal& b, RxCache& rx) {
+  int fk = a.kind, sk = b.kind;
+  if (fk == K_STRING && sk == K_REGEX) return rx.matches(b.s, a.s);
+  if (fk == K_REGEX && sk == K_STRING) return rx.matches(a.s, b.s);
+  if (fk == K_STRING && sk == K_STRING) return a.s == b.s;
+  if (fk == K_MAP && sk == K_MAP) {
+    if (a.entries.size() != b.entries.size()) return false;
+    for (const auto& e : a.entries) {
+      PVal* v2 = b.map_get(e.first->s);
+      if (!v2 || !compare_eq(*e.second, *v2, rx)) return false;
+    }
+    return true;
+  }
+  if (fk == K_LIST && sk == K_LIST) {
+    if (a.list.size() != b.list.size()) return false;
+    for (size_t k = 0; k < a.list.size(); k++)
+      if (!compare_eq(*a.list[k], *b.list[k], rx)) return false;
+    return true;
+  }
+  if (fk == K_BOOL && sk == K_BOOL) return a.b == b.b;
+  if (fk == K_REGEX && sk == K_REGEX) return a.s == b.s;
+  if (fk == K_INT && sk == K_RANGE_INT) return range_contains_int(b, a.i);
+  if (fk == K_FLOAT && sk == K_RANGE_FLOAT) return range_contains_float(b, a.f);
+  if (fk == K_CHAR && sk == K_RANGE_CHAR) return range_contains_char(b, a.s);
+  return compare_values(a, b) == 0;
+}
+
+// MapValue PartialEq — values only, loose (values.py:174-183)
+bool map_loose_eq(const PVal& a, const PVal& b, RxCache& rx) {
+  if (a.entries.size() != b.entries.size()) return false;
+  for (const auto& e : a.entries) {
+    PVal* v2 = b.map_get(e.first->s);
+    if (!v2 || !loose_eq(*e.second, *v2, rx)) return false;
+  }
+  return true;
+}
+
+bool loose_eq(const PVal& a, const PVal& b, RxCache& rx) {
+  int fk = a.kind, sk = b.kind;
+  if (fk == K_MAP && sk == K_MAP) return map_loose_eq(a, b, rx);
+  if (fk == K_LIST && sk == K_LIST) {
+    if (a.list.size() != b.list.size()) return false;
+    for (size_t k = 0; k < a.list.size(); k++)
+      if (!loose_eq(*a.list[k], *b.list[k], rx)) return false;
+    return true;
+  }
+  // values.py:423-429 — regex compile errors -> False; our compile
+  // failures are Unsupported (propagate: fall back rather than guess)
+  try {
+    return compare_eq(a, b, rx);
+  } catch (const NotComparable&) {
+    return false;
+  }
+}
+
+bool compare_lt(const PVal& a, const PVal& b) { return compare_values(a, b) < 0; }
+bool compare_le(const PVal& a, const PVal& b) { return compare_values(a, b) <= 0; }
+bool compare_gt(const PVal& a, const PVal& b) { return compare_values(a, b) > 0; }
+bool compare_ge(const PVal& a, const PVal& b) { return compare_values(a, b) >= 0; }
+
+}  // namespace
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// AST (guard_tpu/core/exprs.py; wire format ast_serde.py)
+// ---------------------------------------------------------------------------
+enum Cmp {
+  C_EQ, C_IN, C_GT, C_LT, C_LE, C_GE,
+  C_EXISTS, C_EMPTY, C_IS_STRING, C_IS_LIST, C_IS_MAP, C_IS_BOOL,
+  C_IS_INT, C_IS_FLOAT, C_IS_NULL,
+};
+
+bool cmp_is_unary(int c) { return c >= C_EXISTS; }
+
+int cmp_from_str(const std::string& s) {
+  if (s == "Eq") return C_EQ;
+  if (s == "In") return C_IN;
+  if (s == "Gt") return C_GT;
+  if (s == "Lt") return C_LT;
+  if (s == "Le") return C_LE;
+  if (s == "Ge") return C_GE;
+  if (s == "Exists") return C_EXISTS;
+  if (s == "Empty") return C_EMPTY;
+  if (s == "IsString") return C_IS_STRING;
+  if (s == "IsList") return C_IS_LIST;
+  if (s == "IsMap") return C_IS_MAP;
+  if (s == "IsBool") return C_IS_BOOL;
+  if (s == "IsInt") return C_IS_INT;
+  if (s == "IsFloat") return C_IS_FLOAT;
+  if (s == "IsNull") return C_IS_NULL;
+  throw GuardErr("wire: unknown comparator " + s);
+}
+
+struct Clause;
+struct LetValue;
+using Conj = std::vector<std::vector<Clause*>>;
+
+enum PartType { P_THIS, P_KEY, P_ALL_VALUES, P_ALL_INDICES, P_INDEX, P_FILTER, P_KEYS };
+
+struct Part {
+  int type = P_THIS;
+  std::string name;      // key name (incl. leading %) or capture name
+  bool has_name = false; // capture present (all_values/all_indices/filter/keys)
+  long long index = 0;
+  Conj conj;             // filter clauses
+  int cmp = C_EQ;        // keys filter
+  bool inv = false;
+  LetValue* cw = nullptr;
+};
+
+struct Query {
+  std::vector<Part*> parts;
+  bool match_all = true;
+};
+
+struct FnExpr {
+  std::string name;
+  std::vector<LetValue*> params;
+};
+
+enum LvTag { LV_PV, LV_QUERY, LV_FN };
+
+struct LetValue {
+  int tag = LV_PV;
+  PVal* pv = nullptr;
+  Query* q = nullptr;
+  FnExpr* fn = nullptr;
+};
+
+struct Assign {
+  std::string var;
+  LetValue* value;
+};
+
+enum ClauseType { CL_ACCESS, CL_NAMED, CL_BLOCK, CL_WHEN, CL_CALL, CL_TYPE_BLOCK };
+
+struct Clause {
+  int t = CL_ACCESS;
+  // access
+  Query* query = nullptr;
+  int cmp = C_EQ;
+  bool inv = false;
+  bool neg = false;
+  LetValue* cw = nullptr;
+  // named / call
+  std::string rule;
+  std::vector<LetValue*> params;
+  Clause* named = nullptr;
+  // block / when / type_block bodies
+  std::vector<Assign> assigns;
+  Conj conj;
+  bool not_empty = false;
+  Conj conditions;
+  bool has_conditions = false;
+  std::string type_name;
+  std::vector<Part*> tb_query;
+};
+
+struct RuleC {
+  std::string name;
+  bool has_conditions = false;
+  Conj conditions;
+  std::vector<Assign> assigns;
+  Conj conj;
+};
+
+struct ParamRuleC {
+  std::vector<std::string> params;
+  RuleC* rule;
+};
+
+struct Engine {
+  Arena ast_arena;  // AST literal PVals
+  std::deque<Query> q_pool;
+  std::deque<Part> part_pool;
+  std::deque<Clause> clause_pool;
+  std::deque<LetValue> lv_pool;
+  std::deque<FnExpr> fn_pool;
+  std::deque<RuleC> rule_pool;
+  std::vector<Assign> assignments;
+  std::vector<RuleC*> rules;
+  std::vector<ParamRuleC> param_rules;
+  RxCache rx;
+
+  Query* nq() { q_pool.emplace_back(); return &q_pool.back(); }
+  Part* npart() { part_pool.emplace_back(); return &part_pool.back(); }
+  Clause* ncl() { clause_pool.emplace_back(); return &clause_pool.back(); }
+  LetValue* nlv() { lv_pool.emplace_back(); return &lv_pool.back(); }
+  FnExpr* nfn() { fn_pool.emplace_back(); return &fn_pool.back(); }
+  RuleC* nrule() { rule_pool.emplace_back(); return &rule_pool.back(); }
+};
+
+bool part_is_variable(const Part* p) {
+  return p->type == P_KEY && !p->name.empty() && p->name[0] == '%';
+}
+std::string part_variable(const Part* p) { return p->name.substr(1); }
+
+// ---------------------------------------------------------------------------
+// Wire deserialization (ast_serde.py formats)
+// ---------------------------------------------------------------------------
+PVal* pv_from_wire(const JValue& j, Arena& arena) {
+  PVal* v = arena.nv();
+  v->kind = static_cast<int>(j.at("k").as_int());
+  if (const JValue* p = j.get("p")) {
+    v->path = p->arr.at(0).str();
+    v->line = static_cast<int>(p->arr.at(1).as_int());
+    v->col = static_cast<int>(p->arr.at(2).as_int());
+  }
+  switch (v->kind) {
+    case K_NULL: break;
+    case K_STRING: case K_REGEX: case K_CHAR:
+      v->s = j.at("s").str();
+      break;
+    case K_BOOL: v->b = j.at("b").as_bool(); break;
+    case K_INT: v->i = j.at("i").as_int(); break;
+    case K_FLOAT: {
+      const JValue& f = j.at("f");
+      v->f = (f.t == JFLOAT) ? f.f : static_cast<double>(f.as_int());
+      break;
+    }
+    case K_LIST:
+      for (const JValue& e : j.at("items").arr)
+        v->list.push_back(pv_from_wire(e, arena));
+      break;
+    case K_MAP:
+      for (const JValue& e : j.at("entries").arr) {
+        PVal* key = pv_from_wire(e.arr.at(0), arena);
+        PVal* val = pv_from_wire(e.arr.at(1), arena);
+        v->entries.emplace_back(key, val);
+      }
+      break;
+    case K_RANGE_INT:
+      v->ri_lo = j.at("lo").as_int();
+      v->ri_hi = j.at("hi").as_int();
+      v->inc = static_cast<int>(j.at("inc").as_int());
+      break;
+    case K_RANGE_FLOAT: {
+      const JValue& lo = j.at("lo");
+      const JValue& hi = j.at("hi");
+      v->rf_lo = (lo.t == JFLOAT) ? lo.f : static_cast<double>(lo.as_int());
+      v->rf_hi = (hi.t == JFLOAT) ? hi.f : static_cast<double>(hi.as_int());
+      v->inc = static_cast<int>(j.at("inc").as_int());
+      break;
+    }
+    case K_RANGE_CHAR:
+      v->rs_lo = j.at("lo").str();
+      v->rs_hi = j.at("hi").str();
+      v->inc = static_cast<int>(j.at("inc").as_int());
+      break;
+    default:
+      throw GuardErr("wire: unknown pv kind");
+  }
+  return v;
+}
+
+// ---------------------------------------------------------------------------
+// Direct document parsers (no JValue intermediate — the per-doc hot
+// path). Two formats:
+//   * compact wire (ast_serde.doc_to_compact): [kind, payload...] nested
+//     arrays, no paths (statuses need none);
+//   * raw JSON (the sweep / fail-rerun JSON fast path): standard JSON
+//     with the location-aware loader's scalar typing (loader.py:79-97 —
+//     JSON quoted strings stay strings, numbers int-unless-dotted).
+// ---------------------------------------------------------------------------
+struct DocParser {
+  const char* p;
+  const char* end;
+  int depth = 0;
+  Arena* arena;
+
+  [[noreturn]] void fail(const char* why) { throw GuardErr(std::string("doc: ") + why); }
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++;
+  }
+
+  void expect(char c) {
+    ws();
+    if (p >= end || *p != c) fail("unexpected token");
+    p++;
+  }
+
+  std::string pstring() {
+    JParser jp{p, end};
+    jp.strict = true;  // decline raw control chars (loader line-folds)
+    std::string s = jp.pstring();
+    p = jp.p;
+    return s;
+  }
+
+  long long pint() {
+    ws();
+    const char* start = p;
+    if (p < end && *p == '-') p++;
+    while (p < end && *p >= '0' && *p <= '9') p++;
+    if (p == start) fail("bad int");
+    errno = 0;
+    long long v = strtoll(std::string(start, p - start).c_str(), nullptr, 10);
+    if (errno == ERANGE) throw Unsupported("integer outside i64");
+    return v;
+  }
+
+  double pnum(bool* was_float) {
+    ws();
+    const char* start = p;
+    if (p < end && *p == '-') p++;
+    bool is_float = false;
+    while (p < end && ((*p >= '0' && *p <= '9') || *p == '.' || *p == 'e' ||
+                       *p == 'E' || *p == '+' || *p == '-')) {
+      if (*p == '.' || *p == 'e' || *p == 'E') is_float = true;
+      p++;
+    }
+    if (p == start) fail("bad number");
+    std::string num(start, p - start);
+    *was_float = is_float;
+    if (is_float) {
+      char* endp = nullptr;
+      double v = strtod(num.c_str(), &endp);
+      if (endp != num.c_str() + num.size()) fail("bad float");
+      return v;
+    }
+    errno = 0;
+    char* endp = nullptr;
+    long long v = strtoll(num.c_str(), &endp, 10);
+    if (endp != num.c_str() + num.size()) fail("bad int");
+    if (errno == ERANGE) throw Unsupported("integer outside i64");
+    return static_cast<double>(v);  // caller re-reads via pint path below
+  }
+
+  // compact wire: [kind, ...]
+  PVal* compact() {
+    if (++depth > 400) throw Unsupported("doc nesting too deep");
+    expect('[');
+    long long kind = pint();
+    PVal* v = arena->nv();
+    v->kind = static_cast<int>(kind);
+    switch (kind) {
+      case K_NULL:
+        break;
+      case K_STRING: case K_REGEX: case K_CHAR:
+        expect(',');
+        ws();
+        v->s = pstring();
+        break;
+      case K_BOOL: {
+        expect(',');
+        ws();
+        if (end - p >= 4 && strncmp(p, "true", 4) == 0) { v->b = true; p += 4; }
+        else if (end - p >= 5 && strncmp(p, "false", 5) == 0) { v->b = false; p += 5; }
+        else fail("bad bool");
+        break;
+      }
+      case K_INT:
+        expect(',');
+        v->i = pint();
+        break;
+      case K_FLOAT: {
+        expect(',');
+        bool wf = false;
+        v->f = pnum(&wf);
+        break;
+      }
+      case K_LIST: {
+        expect(',');
+        expect('[');
+        ws();
+        if (p < end && *p == ']') { p++; break; }
+        while (true) {
+          v->list.push_back(compact());
+          ws();
+          if (p < end && *p == ',') { p++; continue; }
+          expect(']');
+          break;
+        }
+        break;
+      }
+      case K_MAP: {
+        expect(',');
+        expect('[');
+        ws();
+        if (p < end && *p == ']') { p++; break; }
+        while (true) {
+          expect('[');
+          ws();
+          std::string key = pstring();
+          expect(',');
+          PVal* child = compact();
+          expect(']');
+          PVal* key_node = arena->nv();
+          key_node->kind = K_STRING;
+          key_node->s = std::move(key);
+          v->entries.emplace_back(key_node, child);
+          ws();
+          if (p < end && *p == ',') { p++; continue; }
+          expect(']');
+          break;
+        }
+        break;
+      }
+      default:
+        throw Unsupported("doc compact kind");
+    }
+    expect(']');
+    depth--;
+    return v;
+  }
+
+  // raw JSON with loader scalar typing
+  PVal* raw() {
+    if (++depth > 400) throw Unsupported("doc nesting too deep");
+    ws();
+    if (p >= end) fail("eof");
+    PVal* v;
+    char c = *p;
+    if (c == '{') {
+      p++;
+      v = arena->nv();
+      v->kind = K_MAP;
+      ws();
+      if (p < end && *p == '}') { p++; depth--; return v; }
+      while (true) {
+        ws();
+        std::string key = pstring();
+        expect(':');
+        PVal* child = raw();
+        // duplicate keys: first position, last value (python dict)
+        bool dup = false;
+        for (auto& e : v->entries)
+          if (e.first->s == key) { e.second = child; dup = true; break; }
+        if (!dup) {
+          PVal* key_node = arena->nv();
+          key_node->kind = K_STRING;
+          key_node->s = std::move(key);
+          v->entries.emplace_back(key_node, child);
+        }
+        ws();
+        if (p < end && *p == ',') { p++; continue; }
+        if (p < end && *p == '}') { p++; break; }
+        fail("expected , or }");
+      }
+    } else if (c == '[') {
+      p++;
+      v = arena->nv();
+      v->kind = K_LIST;
+      ws();
+      if (p < end && *p == ']') { p++; depth--; return v; }
+      while (true) {
+        v->list.push_back(raw());
+        ws();
+        if (p < end && *p == ',') { p++; continue; }
+        if (p < end && *p == ']') { p++; break; }
+        fail("expected , or ]");
+      }
+    } else if (c == '"') {
+      v = arena->nv();
+      v->kind = K_STRING;
+      v->s = pstring();
+    } else if (c == 't' && end - p >= 4 && strncmp(p, "true", 4) == 0) {
+      p += 4;
+      v = arena->nv();
+      v->kind = K_BOOL;
+      v->b = true;
+    } else if (c == 'f' && end - p >= 5 && strncmp(p, "false", 5) == 0) {
+      p += 5;
+      v = arena->nv();
+      v->kind = K_BOOL;
+      v->b = false;
+    } else if (c == 'n' && end - p >= 4 && strncmp(p, "null", 4) == 0) {
+      p += 4;
+      v = arena->nv();
+      v->kind = K_NULL;
+    } else {
+      ws();
+      const char* start = p;
+      if (p < end && *p == '-') p++;
+      bool is_float = false;
+      while (p < end && ((*p >= '0' && *p <= '9') || *p == '.' || *p == 'e' ||
+                         *p == 'E' || *p == '+' || *p == '-')) {
+        if (*p == '.' || *p == 'e' || *p == 'E') is_float = true;
+        p++;
+      }
+      if (p == start) fail("bad number");
+      std::string num(start, p - start);
+      v = arena->nv();
+      if (is_float) {
+        char* endp = nullptr;
+        v->kind = K_FLOAT;
+        v->f = strtod(num.c_str(), &endp);
+        if (endp != num.c_str() + num.size()) fail("bad float");
+      } else {
+        errno = 0;
+        char* endp = nullptr;
+        v->kind = K_INT;
+        v->i = strtoll(num.c_str(), &endp, 10);
+        if (endp != num.c_str() + num.size()) fail("bad int");
+        if (errno == ERANGE) throw Unsupported("integer outside i64");
+      }
+    }
+    depth--;
+    return v;
+  }
+};
+
+Conj conj_from_wire(const JValue& j, Engine& eng);
+LetValue* lv_from_wire(const JValue& j, Engine& eng);
+
+Query* query_from_wire(const JValue& j, Engine& eng) {
+  Query* q = eng.nq();
+  q->match_all = j.at("match_all").as_bool();
+  for (const JValue& pj : j.at("parts").arr) {
+    Part* p = eng.npart();
+    const std::string& t = pj.at("p").str();
+    if (t == "this") {
+      p->type = P_THIS;
+    } else if (t == "key") {
+      p->type = P_KEY;
+      p->name = pj.at("name").str();
+    } else if (t == "all_values" || t == "all_indices") {
+      p->type = (t == "all_values") ? P_ALL_VALUES : P_ALL_INDICES;
+      const JValue& nm = pj.at("name");
+      if (!nm.is_null()) { p->has_name = true; p->name = nm.str(); }
+    } else if (t == "index") {
+      p->type = P_INDEX;
+      p->index = pj.at("i").as_int();
+    } else if (t == "filter") {
+      p->type = P_FILTER;
+      const JValue& nm = pj.at("name");
+      if (!nm.is_null()) { p->has_name = true; p->name = nm.str(); }
+      p->conj = conj_from_wire(pj.at("conj"), eng);
+    } else if (t == "keys") {
+      p->type = P_KEYS;
+      const JValue& nm = pj.at("name");
+      if (!nm.is_null()) { p->has_name = true; p->name = nm.str(); }
+      p->cmp = cmp_from_str(pj.at("cmp").str());
+      p->inv = pj.at("inv").as_bool();
+      p->cw = lv_from_wire(pj.at("cw"), eng);
+    } else {
+      throw GuardErr("wire: unknown part " + t);
+    }
+    q->parts.push_back(p);
+  }
+  return q;
+}
+
+LetValue* lv_from_wire(const JValue& j, Engine& eng) {
+  LetValue* lv = eng.nlv();
+  const std::string& l = j.at("l").str();
+  if (l == "pv") {
+    lv->tag = LV_PV;
+    lv->pv = pv_from_wire(j.at("pv"), eng.ast_arena);
+  } else if (l == "q") {
+    lv->tag = LV_QUERY;
+    lv->q = query_from_wire(j.at("q"), eng);
+  } else if (l == "fn") {
+    lv->tag = LV_FN;
+    FnExpr* fn = eng.nfn();
+    fn->name = j.at("name").str();
+    for (const JValue& pj : j.at("params").arr)
+      fn->params.push_back(lv_from_wire(pj, eng));
+    lv->fn = fn;
+  } else {
+    throw GuardErr("wire: unknown let value " + l);
+  }
+  return lv;
+}
+
+std::vector<Assign> assigns_from_wire(const JValue& j, Engine& eng) {
+  std::vector<Assign> out;
+  for (const JValue& aj : j.arr)
+    out.push_back(Assign{aj.at("var").str(), lv_from_wire(aj.at("value"), eng)});
+  return out;
+}
+
+Clause* clause_from_wire(const JValue& j, Engine& eng) {
+  Clause* c = eng.ncl();
+  const std::string& t = j.at("t").str();
+  if (t == "access") {
+    c->t = CL_ACCESS;
+    c->query = query_from_wire(j.at("query"), eng);
+    c->cmp = cmp_from_str(j.at("cmp").str());
+    c->inv = j.at("inv").as_bool();
+    c->neg = j.at("neg").as_bool();
+    const JValue& cw = j.at("cw");
+    if (!cw.is_null()) c->cw = lv_from_wire(cw, eng);
+  } else if (t == "named") {
+    c->t = CL_NAMED;
+    c->rule = j.at("rule").str();
+    c->neg = j.at("neg").as_bool();
+  } else if (t == "block") {
+    c->t = CL_BLOCK;
+    c->query = query_from_wire(j.at("query"), eng);
+    c->assigns = assigns_from_wire(j.at("assignments"), eng);
+    c->conj = conj_from_wire(j.at("conj"), eng);
+    c->not_empty = j.at("not_empty").as_bool();
+  } else if (t == "when") {
+    c->t = CL_WHEN;
+    c->conditions = conj_from_wire(j.at("conditions"), eng);
+    c->has_conditions = true;
+    c->assigns = assigns_from_wire(j.at("assignments"), eng);
+    c->conj = conj_from_wire(j.at("conj"), eng);
+  } else if (t == "call") {
+    c->t = CL_CALL;
+    for (const JValue& pj : j.at("params").arr)
+      c->params.push_back(lv_from_wire(pj, eng));
+    c->named = clause_from_wire(j.at("named"), eng);
+  } else if (t == "type_block") {
+    c->t = CL_TYPE_BLOCK;
+    c->type_name = j.at("type_name").str();
+    for (const JValue& pj : j.at("query").arr) {
+      JValue wrapper;
+      wrapper.t = JOBJ;
+      wrapper.obj.emplace_back("parts", JValue());
+      wrapper.obj[0].second.t = JARR;
+      wrapper.obj[0].second.arr.push_back(pj);
+      wrapper.obj.emplace_back("match_all", JValue());
+      wrapper.obj[1].second.t = JBOOL;
+      wrapper.obj[1].second.b = true;
+      Query* q1 = query_from_wire(wrapper, eng);
+      c->tb_query.push_back(q1->parts.at(0));
+    }
+    const JValue& conds = j.at("conditions");
+    if (!conds.is_null()) {
+      c->has_conditions = true;
+      c->conditions = conj_from_wire(conds, eng);
+    }
+    c->assigns = assigns_from_wire(j.at("assignments"), eng);
+    c->conj = conj_from_wire(j.at("conj"), eng);
+  } else {
+    throw GuardErr("wire: unknown clause " + t);
+  }
+  return c;
+}
+
+Conj conj_from_wire(const JValue& j, Engine& eng) {
+  Conj out;
+  for (const JValue& dj : j.arr) {
+    std::vector<Clause*> disj;
+    for (const JValue& cj : dj.arr) disj.push_back(clause_from_wire(cj, eng));
+    out.push_back(std::move(disj));
+  }
+  return out;
+}
+
+RuleC* rule_from_wire(const JValue& j, Engine& eng) {
+  RuleC* r = eng.nrule();
+  r->name = j.at("name").str();
+  const JValue& conds = j.at("conditions");
+  if (!conds.is_null()) {
+    r->has_conditions = true;
+    r->conditions = conj_from_wire(conds, eng);
+  }
+  r->assigns = assigns_from_wire(j.at("assignments"), eng);
+  r->conj = conj_from_wire(j.at("conj"), eng);
+  return r;
+}
+
+void engine_from_wire(const JValue& j, Engine& eng) {
+  eng.assignments = assigns_from_wire(j.at("assignments"), eng);
+  for (const JValue& rj : j.at("rules").arr) eng.rules.push_back(rule_from_wire(rj, eng));
+  for (const JValue& pj : j.at("param_rules").arr) {
+    ParamRuleC pr;
+    for (const JValue& nj : pj.at("params").arr) pr.params.push_back(nj.str());
+    pr.rule = rule_from_wire(pj.at("rule"), eng);
+    eng.param_rules.push_back(std::move(pr));
+  }
+}
+
+}  // namespace
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Query results + status lattice (guard_tpu/core/qresult.py; mod.rs:88-185)
+// ---------------------------------------------------------------------------
+enum St { ST_PASS = 0, ST_FAIL = 1, ST_SKIP = 2 };
+enum QTag { T_LITERAL = 0, T_RESOLVED = 1, T_UNRESOLVED = 2 };
+
+struct QR {
+  int tag = T_RESOLVED;
+  PVal* value = nullptr;        // LITERAL / RESOLVED
+  PVal* traversed_to = nullptr; // UNRESOLVED
+  static QR literal(PVal* v) { QR q; q.tag = T_LITERAL; q.value = v; return q; }
+  static QR resolved(PVal* v) { QR q; q.tag = T_RESOLVED; q.value = v; return q; }
+  static QR unresolved(PVal* at) {
+    QR q; q.tag = T_UNRESOLVED; q.traversed_to = at; return q;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Key-case converters (scopes.py:51-98; eval_context.rs:315-326).
+// ASCII-exact port of _words(): [A-Za-z0-9]+ tokens split into camel
+// humps by [A-Z]+(?![a-z]) | [A-Z][a-z0-9]* | [a-z0-9]+.
+// ---------------------------------------------------------------------------
+inline bool is_upper(char c) { return c >= 'A' && c <= 'Z'; }
+inline bool is_lower(char c) { return c >= 'a' && c <= 'z'; }
+inline bool is_digit_c(char c) { return c >= '0' && c <= '9'; }
+inline bool is_alnum_c(char c) { return is_upper(c) || is_lower(c) || is_digit_c(c); }
+inline char to_lower_c(char c) { return is_upper(c) ? c + 32 : c; }
+inline char to_upper_c(char c) { return is_lower(c) ? c - 32 : c; }
+
+std::vector<std::string> split_words(const std::string& s) {
+  std::vector<std::string> out;
+  size_t n = s.size();
+  size_t i = 0;
+  while (i < n) {
+    if (!is_alnum_c(s[i])) { i++; continue; }
+    size_t tok_end = i;
+    while (tok_end < n && is_alnum_c(s[tok_end])) tok_end++;
+    // hump-split the token [i, tok_end)
+    size_t j = i;
+    while (j < tok_end) {
+      if (is_upper(s[j])) {
+        size_t k = j;
+        while (k < tok_end && is_upper(s[k])) k++;
+        if (k < tok_end && is_lower(s[k])) {
+          if (k - j > 1) {
+            out.emplace_back(s, j, k - 1 - j);  // [A-Z]+ minus last, (?![a-z])
+            j = k - 1;
+            continue;
+          }
+          // single upper followed by lower: [A-Z][a-z0-9]*
+          size_t m = j + 1;
+          while (m < tok_end && (is_lower(s[m]) || is_digit_c(s[m]))) m++;
+          out.emplace_back(s, j, m - j);
+          j = m;
+          continue;
+        }
+        out.emplace_back(s, j, k - j);
+        j = k;
+      } else {
+        size_t m = j;
+        while (m < tok_end && (is_lower(s[m]) || is_digit_c(s[m]))) m++;
+        out.emplace_back(s, j, m - j);
+        j = m;
+      }
+    }
+    i = tok_end;
+  }
+  return out;
+}
+
+std::string word_lower(const std::string& w) {
+  std::string out = w;
+  for (char& c : out) c = to_lower_c(c);
+  return out;
+}
+
+// python str.capitalize(): first upper, rest lower
+std::string word_capitalize(const std::string& w) {
+  std::string out = w;
+  for (char& c : out) c = to_lower_c(c);
+  if (!out.empty()) out[0] = to_upper_c(out[0]);
+  return out;
+}
+
+std::string conv_camel(const std::string& s) {
+  auto w = split_words(s);
+  if (w.empty()) return s;
+  std::string out = word_lower(w[0]);
+  for (size_t k = 1; k < w.size(); k++) out += word_capitalize(w[k]);
+  return out;
+}
+std::string conv_pascal(const std::string& s) {
+  std::string out;
+  for (const auto& w : split_words(s)) out += word_capitalize(w);
+  return out;
+}
+std::string conv_join(const std::string& s, char sep, bool cap) {
+  std::string out;
+  bool first = true;
+  for (const auto& w : split_words(s)) {
+    if (!first) out.push_back(sep);
+    out += cap ? word_capitalize(w) : word_lower(w);
+    first = false;
+  }
+  return out;
+}
+std::string conv_kebab(const std::string& s) { return conv_join(s, '-', false); }
+std::string conv_snake(const std::string& s) { return conv_join(s, '_', false); }
+std::string conv_title(const std::string& s) { return conv_join(s, ' ', true); }
+std::string conv_train(const std::string& s) { return conv_join(s, '-', true); }
+
+using ConvFn = std::string (*)(const std::string&);
+// order matches scopes.py CONVERTERS (camel, class=pascal, kebab,
+// pascal, snake, title, train)
+const ConvFn CONVERTERS[] = {conv_camel, conv_pascal, conv_kebab, conv_pascal,
+                             conv_snake, conv_title, conv_train};
+
+// ---------------------------------------------------------------------------
+// Scopes (scopes.py:137-337; eval_context.rs:47-87, 1062-1177)
+// ---------------------------------------------------------------------------
+struct ScopeData {
+  PVal* root = nullptr;
+  std::unordered_map<std::string, PVal*> literals;
+  std::unordered_map<std::string, Query*> variable_queries;
+  std::unordered_map<std::string, FnExpr*> function_expressions;
+  std::unordered_map<std::string, std::vector<QR>> resolved_variables;
+
+  void load(const std::vector<Assign>& assigns, PVal* r) {
+    root = r;
+    for (const Assign& a : assigns) {
+      switch (a.value->tag) {
+        case LV_PV: literals[a.var] = a.value->pv; break;
+        case LV_QUERY: variable_queries[a.var] = a.value->q; break;
+        default: function_expressions[a.var] = a.value->fn;
+      }
+    }
+  }
+};
+
+struct EvalState;
+
+struct Resolver {
+  virtual ~Resolver() = default;
+  virtual std::vector<QR> query(const std::vector<Part*>& parts) = 0;
+  virtual PVal* root() = 0;
+  virtual ParamRuleC* find_param_rule(const std::string& name) = 0;
+  virtual int rule_status(const std::string& name) = 0;
+  virtual std::vector<QR> resolve_variable(const std::string& name) = 0;
+  virtual void add_capture(const std::string& name, PVal* key) = 0;
+  virtual EvalState* state() = 0;
+};
+
+std::vector<QR> query_retrieval(int qi, const std::vector<Part*>& parts, PVal* current,
+                                Resolver* resolver, ConvFn converter);
+std::vector<QR> resolve_function(const std::string& name,
+                                 const std::vector<LetValue*>& params, Resolver* r);
+int eval_rule(RuleC* rule, Resolver* resolver);
+
+struct EvalState {
+  Engine* eng;
+  Arena arena;  // doc nodes + function-produced values
+  int depth = 0;
+};
+
+struct DepthGuard {
+  EvalState* st;
+  explicit DepthGuard(EvalState* s) : st(s) {
+    if (++st->depth > 400) throw Unsupported("recursion too deep");
+  }
+  ~DepthGuard() { st->depth--; }
+};
+
+// _resolve_variable_in (scopes.py:241-260)
+std::vector<QR> resolve_variable_in(Resolver* ctx, ScopeData& scope,
+                                    const std::string& name) {
+  auto lit = scope.literals.find(name);
+  if (lit != scope.literals.end()) return {QR::literal(lit->second)};
+  auto res = scope.resolved_variables.find(name);
+  if (res != scope.resolved_variables.end()) return res->second;
+  auto fn = scope.function_expressions.find(name);
+  if (fn != scope.function_expressions.end()) {
+    std::vector<QR> result = resolve_function(fn->second->name, fn->second->params, ctx);
+    scope.resolved_variables[name] = result;
+    return result;
+  }
+  auto q = scope.variable_queries.find(name);
+  if (q == scope.variable_queries.end())
+    throw GuardErr("Could not resolve variable by name " + name + " across scopes");
+  std::vector<QR> result =
+      query_retrieval(0, q->second->parts, ctx->root(), ctx, nullptr);
+  if (!q->second->match_all) {
+    std::vector<QR> kept;
+    for (const QR& r : result)
+      if (r.tag == T_RESOLVED) kept.push_back(r);
+    result = std::move(kept);
+  }
+  scope.resolved_variables[name] = result;
+  return result;
+}
+
+struct RootScope : Resolver {
+  ScopeData scope;
+  std::unordered_map<std::string, std::vector<RuleC*>> rules;
+  std::unordered_map<std::string, ParamRuleC*> parameterized;
+  std::unordered_map<std::string, int> rules_status;
+  EvalState* st;
+
+  RootScope(Engine* eng, PVal* doc, EvalState* state) : st(state) {
+    scope.load(eng->assignments, doc);
+    for (RuleC* r : eng->rules) rules[r->name].push_back(r);
+    for (ParamRuleC& pr : eng->param_rules) parameterized[pr.rule->name] = &pr;
+  }
+
+  std::vector<QR> query(const std::vector<Part*>& parts) override {
+    return query_retrieval(0, parts, root(), this, nullptr);
+  }
+  PVal* root() override { return scope.root; }
+  ParamRuleC* find_param_rule(const std::string& name) override {
+    auto it = parameterized.find(name);
+    if (it == parameterized.end())
+      throw GuardErr("Parameterized Rule with name " + name + " was not found");
+    return it->second;
+  }
+  // eval_context.rs:1087-1115 — first non-SKIP among same-named, cached
+  int rule_status(const std::string& name) override {
+    auto cached = rules_status.find(name);
+    if (cached != rules_status.end()) return cached->second;
+    auto it = rules.find(name);
+    if (it == rules.end())
+      throw GuardErr("Rule " + name + " by that name does not exist");
+    int status = ST_SKIP;
+    for (RuleC* r : it->second) {
+      int s = eval_rule(r, this);
+      if (s != ST_SKIP) { status = s; break; }
+    }
+    rules_status[name] = status;
+    return status;
+  }
+  std::vector<QR> resolve_variable(const std::string& name) override {
+    return resolve_variable_in(this, scope, name);
+  }
+  void add_capture(const std::string& name, PVal* key) override {
+    scope.resolved_variables[name].push_back(QR::resolved(key));
+  }
+  EvalState* state() override { return st; }
+};
+
+struct BlockScope : Resolver {
+  ScopeData scope;
+  Resolver* parent;
+
+  BlockScope(const std::vector<Assign>& assigns, PVal* root_v, Resolver* p) : parent(p) {
+    scope.load(assigns, root_v);
+  }
+
+  std::vector<QR> query(const std::vector<Part*>& parts) override {
+    return query_retrieval(0, parts, root(), this, nullptr);
+  }
+  PVal* root() override { return scope.root; }
+  ParamRuleC* find_param_rule(const std::string& name) override {
+    return parent->find_param_rule(name);
+  }
+  int rule_status(const std::string& name) override { return parent->rule_status(name); }
+  std::vector<QR> resolve_variable(const std::string& name) override {
+    if (scope.literals.count(name) || scope.resolved_variables.count(name) ||
+        scope.function_expressions.count(name) || scope.variable_queries.count(name))
+      return resolve_variable_in(this, scope, name);
+    return parent->resolve_variable(name);
+  }
+  void add_capture(const std::string& name, PVal* key) override {
+    scope.resolved_variables[name].push_back(QR::resolved(key));
+  }
+  EvalState* state() override { return parent->state(); }
+};
+
+struct ValueScope : Resolver {
+  PVal* root_value;
+  Resolver* parent;
+
+  ValueScope(PVal* r, Resolver* p) : root_value(r), parent(p) {}
+
+  // scopes.py:320-322 — queries resolve against the PARENT context
+  std::vector<QR> query(const std::vector<Part*>& parts) override {
+    return query_retrieval(0, parts, root(), parent, nullptr);
+  }
+  PVal* root() override { return root_value; }
+  ParamRuleC* find_param_rule(const std::string& name) override {
+    return parent->find_param_rule(name);
+  }
+  int rule_status(const std::string& name) override { return parent->rule_status(name); }
+  std::vector<QR> resolve_variable(const std::string& name) override {
+    return parent->resolve_variable(name);
+  }
+  void add_capture(const std::string& name, PVal* key) override {
+    parent->add_capture(name, key);
+  }
+  EvalState* state() override { return parent->state(); }
+};
+
+}  // namespace
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Query retrieval — the recursive tree-walk
+// (scopes.py:361-837; eval_context.rs:337-924)
+// ---------------------------------------------------------------------------
+int eval_conjunction_clauses(const Conj& conjunctions, Resolver* resolver,
+                             int (*eval_fn)(Clause*, Resolver*));
+int eval_guard_clause(Clause* c, Resolver* resolver);
+std::vector<std::pair<QR, int>> real_binary_operation(const std::vector<QR>& lhs,
+                                                      const std::vector<QR>& rhs,
+                                                      int op, bool negated,
+                                                      Resolver* ctx);
+
+// integer-looking key: fullmatch [+-]?[0-9]+ (scopes.py:511-513)
+bool int_key(const std::string& s, long long* out) {
+  size_t i = 0, n = s.size();
+  if (n == 0) return false;
+  if (s[0] == '+' || s[0] == '-') i = 1;
+  if (i >= n) return false;
+  for (size_t k = i; k < n; k++)
+    if (!is_digit_c(s[k])) return false;
+  errno = 0;
+  long long v = strtoll(s.c_str(), nullptr, 10);
+  if (errno == ERANGE) v = (s[0] == '-') ? INT64_MIN : INT64_MAX;  // saturate
+  *out = v;
+  return true;
+}
+
+// _retrieve_index (scopes.py:450-460; eval_context.rs:119-140)
+QR retrieve_index(PVal* parent, long long index) {
+  long long check = index >= 0 ? index : -index;
+  if (check < static_cast<long long>(parent->list.size()))
+    return QR::resolved(parent->list[static_cast<size_t>(check)]);
+  return QR::unresolved(parent);
+}
+
+// _accumulate over a list (scopes.py:463-481)
+std::vector<QR> accumulate(PVal* parent, int qi, const std::vector<Part*>& parts,
+                           const std::vector<PVal*>& elements, Resolver* resolver,
+                           ConvFn converter) {
+  if (elements.empty()) return {QR::unresolved(parent)};
+  std::vector<QR> acc;
+  for (PVal* each : elements) {
+    auto sub = query_retrieval(qi + 1, parts, each, resolver, converter);
+    acc.insert(acc.end(), sub.begin(), sub.end());
+  }
+  return acc;
+}
+
+// _accumulate_map (scopes.py:484-505): each value visited under a
+// ValueScope rooted at that value; visit(key, value, scope)
+template <typename Visit>
+std::vector<QR> accumulate_map(PVal* parent, int qi, const std::vector<Part*>& parts,
+                               Resolver* resolver, ConvFn converter, Visit visit) {
+  if (parent->map_empty()) return {QR::unresolved(parent)};
+  std::vector<QR> acc;
+  for (const auto& e : parent->entries) {
+    ValueScope vs(e.second, resolver);
+    auto sub = visit(qi + 1, parts, e.first, e.second, &vs, converter);
+    acc.insert(acc.end(), sub.begin(), sub.end());
+  }
+  return acc;
+}
+
+// check_and_delegate (scopes.py:768-786; eval_context.rs:268-313)
+std::vector<QR> filter_check_delegate(const Conj& conjunctions, const Part* part,
+                                      int qi, const std::vector<Part*>& parts,
+                                      PVal* key, PVal* value, Resolver* ctx,
+                                      ConvFn converter) {
+  int status = eval_conjunction_clauses(conjunctions, ctx, eval_guard_clause);
+  if (part->has_name && status == ST_PASS) ctx->add_capture(part->name, key);
+  if (status == ST_PASS) return query_retrieval(qi, parts, value, ctx, converter);
+  return {};
+}
+
+std::vector<QR> retrieve_key(const Part* part, int qi, const std::vector<Part*>& parts,
+                             PVal* current, Resolver* resolver, ConvFn converter);
+
+std::vector<QR> retrieve_filter(const Part* part, int qi,
+                                const std::vector<Part*>& parts, PVal* current,
+                                Resolver* resolver, ConvFn converter) {
+  // scopes.py:702-765 (eval_context.rs:723-828)
+  const Conj& conjunctions = part->conj;
+  if (current->kind == K_MAP) {
+    const Part* prev = qi > 0 ? parts[qi - 1] : nullptr;
+    if (prev && (prev->type == P_ALL_VALUES || prev->type == P_ALL_INDICES)) {
+      return filter_check_delegate(conjunctions, part, qi + 1, parts, current,
+                                   current, resolver, converter);
+    }
+    if (!prev || prev->type == P_KEY) {
+      if (current->map_empty()) return {};
+      return accumulate_map(
+          current, qi, parts, resolver, converter,
+          [&](int index, const std::vector<Part*>& q, PVal* key, PVal* value,
+              Resolver* ctx, ConvFn conv) {
+            return filter_check_delegate(conjunctions, part, index, q, key, value,
+                                         ctx, conv);
+          });
+    }
+    throw GuardErr("Filter after unexpected query part");
+  }
+  if (current->kind == K_LIST) {
+    std::vector<QR> selected;
+    for (PVal* each : current->list) {
+      ValueScope vs(each, resolver);
+      int status = eval_conjunction_clauses(conjunctions, &vs, eval_guard_clause);
+      if (status == ST_PASS) {
+        auto sub = query_retrieval(qi + 1, parts, each, resolver, converter);
+        selected.insert(selected.end(), sub.begin(), sub.end());
+      }
+    }
+    return selected;
+  }
+  const Part* prev = qi > 0 ? parts[qi - 1] : nullptr;
+  if (prev && prev->type == P_ALL_INDICES) {
+    ValueScope vs(current, resolver);
+    int status = eval_conjunction_clauses(conjunctions, &vs, eval_guard_clause);
+    if (status == ST_PASS)
+      return query_retrieval(qi + 1, parts, current, resolver, converter);
+    return {};
+  }
+  return {QR::unresolved(current)};
+}
+
+std::vector<QR> retrieve_map_key_filter(const Part* part, int qi,
+                                        const std::vector<Part*>& parts, PVal* current,
+                                        Resolver* resolver, ConvFn converter);
+
+std::vector<QR> query_retrieval(int qi, const std::vector<Part*>& parts, PVal* current,
+                                Resolver* resolver, ConvFn converter) {
+  DepthGuard guard(resolver->state());
+  if (qi >= static_cast<int>(parts.size())) return {QR::resolved(current)};
+  const Part* part = parts[qi];
+
+  // %variable head (scopes.py:390-408; eval_context.rs:348-385)
+  if (qi == 0 && part_is_variable(part)) {
+    std::vector<QR> retrieved = resolver->resolve_variable(part_variable(part));
+    std::vector<QR> resolved;
+    for (const QR& each : retrieved) {
+      if (each.tag == T_UNRESOLVED) { resolved.push_back(each); continue; }
+      PVal* value = each.value;
+      int index = qi + 1;
+      if (index < static_cast<int>(parts.size()) &&
+          parts[index]->type == P_ALL_INDICES)
+        index = qi + 2;
+      if (index < static_cast<int>(parts.size())) {
+        ValueScope vs(value, resolver);
+        auto sub = query_retrieval(index, parts, value, &vs, converter);
+        resolved.insert(resolved.end(), sub.begin(), sub.end());
+      } else {
+        resolved.push_back(each);
+      }
+    }
+    return resolved;
+  }
+
+  switch (part->type) {
+    case P_THIS:
+      return query_retrieval(qi + 1, parts, current, resolver, converter);
+    case P_KEY:
+      return retrieve_key(part, qi, parts, current, resolver, converter);
+    case P_INDEX: {
+      if (current->kind == K_LIST) {
+        QR qr = retrieve_index(current, part->index);
+        if (qr.tag == T_RESOLVED)
+          return query_retrieval(qi + 1, parts, qr.value, resolver, converter);
+        return {qr};
+      }
+      return {QR::unresolved(current)};
+    }
+    case P_ALL_INDICES: {
+      // scopes.py:663-681 (eval_context.rs:609-665)
+      if (current->kind == K_LIST)
+        return accumulate(current, qi, parts, current->list, resolver, converter);
+      if (current->kind == K_MAP) {
+        if (!part->has_name)
+          return query_retrieval(qi + 1, parts, current, resolver, converter);
+        return accumulate_map(
+            current, qi, parts, resolver, converter,
+            [&](int index, const std::vector<Part*>& q, PVal* key, PVal* value,
+                Resolver* ctx, ConvFn conv) {
+              ctx->add_capture(part->name, key);
+              return query_retrieval(index, q, value, ctx, conv);
+            });
+      }
+      // single value accepted where a list is expected
+      return query_retrieval(qi + 1, parts, current, resolver, converter);
+    }
+    case P_ALL_VALUES: {
+      // scopes.py:684-699 (eval_context.rs:667-721)
+      if (current->kind == K_LIST)
+        return accumulate(current, qi, parts, current->list, resolver, converter);
+      if (current->kind == K_MAP) {
+        bool report = part->has_name;
+        return accumulate_map(
+            current, qi, parts, resolver, converter,
+            [&](int index, const std::vector<Part*>& q, PVal* key, PVal* value,
+                Resolver* ctx, ConvFn conv) {
+              if (report) ctx->add_capture(part->name, key);
+              return query_retrieval(index, q, value, ctx, conv);
+            });
+      }
+      return query_retrieval(qi + 1, parts, current, resolver, converter);
+    }
+    case P_FILTER:
+      return retrieve_filter(part, qi, parts, current, resolver, converter);
+    case P_KEYS:
+      return retrieve_map_key_filter(part, qi, parts, current, resolver, converter);
+    default:
+      throw GuardErr("Unknown query part");
+  }
+}
+
+std::vector<QR> retrieve_key(const Part* part, int qi, const std::vector<Part*>& parts,
+                             PVal* current, Resolver* resolver, ConvFn converter) {
+  const std::string& key = part->name;
+  long long idx;
+  if (int_key(key, &idx)) {
+    // scopes.py:508-531 (eval_context.rs:392-417)
+    if (current->kind == K_LIST) {
+      QR qr = retrieve_index(current, idx);
+      if (qr.tag == T_RESOLVED)
+        return query_retrieval(qi + 1, parts, qr.value, resolver, converter);
+      return {qr};
+    }
+    return {QR::unresolved(current)};
+  }
+
+  if (current->kind != K_MAP) return {QR::unresolved(current)};
+
+  if (part_is_variable(part)) {
+    // variable interpolation as a key (scopes.py:545-632;
+    // eval_context.rs:421-526)
+    std::string var = part_variable(part);
+    std::vector<QR> keys = resolver->resolve_variable(var);
+    if (static_cast<int>(parts.size()) > qi + 1) {
+      const Part* nxt = parts[qi + 1];
+      if (nxt->type == P_INDEX) {
+        long long check = nxt->index >= 0 ? nxt->index : -nxt->index;
+        if (check < static_cast<long long>(keys.size()))
+          keys = {keys[static_cast<size_t>(check)]};
+        else
+          return {QR::unresolved(current)};
+      } else if (nxt->type != P_ALL_INDICES && nxt->type != P_KEY) {
+        throw GuardErr("This type of query variable interpolation is not supported");
+      }
+    }
+    std::vector<QR> acc;
+    for (const QR& each_key : keys) {
+      if (each_key.tag == T_UNRESOLVED) {
+        acc.push_back(QR::unresolved(current));
+        continue;
+      }
+      PVal* kv = each_key.value;
+      if (kv->kind == K_STRING) {
+        PVal* nxt_val = current->map_get(kv->s);
+        if (nxt_val) {
+          auto sub = query_retrieval(qi + 1, parts, nxt_val, resolver, converter);
+          acc.insert(acc.end(), sub.begin(), sub.end());
+        } else {
+          acc.push_back(QR::unresolved(current));
+        }
+      } else if (kv->kind == K_LIST) {
+        for (PVal* inner : kv->list) {
+          if (inner->kind == K_STRING) {
+            PVal* nxt_val = current->map_get(inner->s);
+            if (nxt_val) {
+              auto sub = query_retrieval(qi + 1, parts, nxt_val, resolver, converter);
+              acc.insert(acc.end(), sub.begin(), sub.end());
+            } else {
+              acc.push_back(QR::unresolved(current));
+            }
+          } else {
+            throw NotComparable(
+                "Variable projections inside Query is returning a non-string "
+                "value for key " + std::string(inner->type_info()));
+          }
+        }
+      } else {
+        throw NotComparable(
+            "Variable projections inside Query is returning a non-string value "
+            "for key " + std::string(kv->type_info()));
+      }
+    }
+    return acc;
+  }
+
+  // plain key (scopes.py:634-660; eval_context.rs:527-576)
+  PVal* val = current->map_get(key);
+  if (val) return query_retrieval(qi + 1, parts, val, resolver, converter);
+  if (converter != nullptr) {
+    PVal* conv_val = current->map_get(converter(key));
+    if (conv_val) return query_retrieval(qi + 1, parts, conv_val, resolver, converter);
+  } else {
+    for (ConvFn each : CONVERTERS) {
+      PVal* candidate = current->map_get(each(key));
+      if (candidate)
+        return query_retrieval(qi + 1, parts, candidate, resolver, each);
+    }
+  }
+  return {QR::unresolved(current)};
+}
+
+std::vector<QR> retrieve_map_key_filter(const Part* part, int qi,
+                                        const std::vector<Part*>& parts, PVal* current,
+                                        Resolver* resolver, ConvFn converter) {
+  // scopes.py:789-837 (eval_context.rs:830-922)
+  if (current->kind != K_MAP) return {QR::unresolved(current)};
+  std::vector<QR> rhs;
+  switch (part->cw->tag) {
+    case LV_QUERY:
+      rhs = query_retrieval(0, part->cw->q->parts, current, resolver, converter);
+      break;
+    case LV_PV:
+      rhs = {QR::literal(part->cw->pv)};
+      break;
+    default:
+      rhs = resolve_function(part->cw->fn->name, part->cw->fn->params, resolver);
+  }
+  std::vector<QR> lhs;
+  for (const auto& e : current->entries) lhs.push_back(QR::resolved(e.first));
+  auto results = real_binary_operation(lhs, rhs, part->cmp, part->inv, resolver);
+  std::vector<QR> selected;
+  for (const auto& rs : results) {
+    const QR& qr = rs.first;
+    if (qr.tag == T_RESOLVED && rs.second == ST_PASS) {
+      if (qr.value->kind == K_STRING) {
+        PVal* v = current->map_get(qr.value->s);
+        if (!v) throw GuardErr("map key filter: key vanished");
+        selected.push_back(QR::resolved(v));
+      }
+    } else if (qr.tag == T_UNRESOLVED) {
+      selected.push_back(qr);
+    }
+  }
+  std::vector<QR> extended;
+  for (const QR& each : selected) {
+    if (each.tag == T_UNRESOLVED) {
+      extended.push_back(each);
+    } else {
+      auto sub = query_retrieval(qi + 1, parts, each.value, resolver, converter);
+      extended.insert(extended.end(), sub.begin(), sub.end());
+    }
+  }
+  return extended;
+}
+
+}  // namespace
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Built-in functions (guard_tpu/core/functions.py; eval_context.rs:1181-1268,
+// rules/functions/). Unsupported-on-uncertainty applies throughout.
+// ---------------------------------------------------------------------------
+PVal* resolved_pv(const QR& q) { return q.tag != T_UNRESOLVED ? q.value : nullptr; }
+
+PVal* first_resolved(const std::vector<QR>& args, const char* err) {
+  if (!args.empty()) {
+    PVal* v = resolved_pv(args[0]);
+    if (v) return v;
+  }
+  throw GuardErr(err);
+}
+
+PVal* copy_at_path(EvalState* st, const PVal& src) {
+  PVal* v = st->arena.nv();
+  v->path = src.path;
+  v->line = src.line;
+  v->col = src.col;
+  return v;
+}
+
+// from_plain over a parsed JSON value, base path inherited
+// (functions.py fn_json_parse -> values.py from_plain)
+PVal* pv_from_json(EvalState* st, const JValue& j, const std::string& base,
+                   int line, int col) {
+  PVal* v = st->arena.nv();
+  v->path = base;
+  v->line = line;
+  v->col = col;
+  switch (j.t) {
+    case JNULL: v->kind = K_NULL; break;
+    case JBOOL: v->kind = K_BOOL; v->b = j.b; break;
+    case JINT: v->kind = K_INT; v->i = j.i; break;
+    case JFLOAT: v->kind = K_FLOAT; v->f = j.f; break;
+    case JSTR: v->kind = K_STRING; v->s = j.s; break;
+    case JARR: {
+      v->kind = K_LIST;
+      int idx = 0;
+      for (const JValue& e : j.arr) {
+        v->list.push_back(
+            pv_from_json(st, e, base + "/" + std::to_string(idx), line, col));
+        idx++;
+      }
+      break;
+    }
+    default: {
+      v->kind = K_MAP;
+      for (const auto& kv : j.obj) {
+        std::string kp = base + "/" + kv.first;
+        PVal* key = st->arena.nv();
+        key->kind = K_STRING;
+        key->s = kv.first;
+        key->path = kp;
+        key->line = line;
+        key->col = col;
+        v->entries.emplace_back(key, pv_from_json(st, kv.second, kp, line, col));
+      }
+    }
+  }
+  return v;
+}
+
+std::vector<PVal*> fn_count(EvalState* st, const std::vector<QR>& args) {
+  // collections.rs:6-23
+  long long n = 0;
+  for (const QR& q : args)
+    if (q.tag != T_UNRESOLVED) n++;
+  PVal* out;
+  if (args.empty()) {
+    out = st->arena.nv();
+  } else {
+    const QR& first = args[0];
+    const PVal& src = first.tag != T_UNRESOLVED ? *first.value : *first.traversed_to;
+    out = copy_at_path(st, src);
+  }
+  out->kind = K_INT;
+  out->i = n;
+  return {out};
+}
+
+std::vector<PVal*> fn_json_parse(EvalState* st, const std::vector<QR>& args) {
+  // functions.py:96-109 — python uses yaml.safe_load; only strict-JSON
+  // inputs are typing-identical, everything else declines. Numbers with
+  // exponents type differently under pyyaml 1.1 -> Unsupported (checked
+  // by scanning the raw text).
+  std::vector<PVal*> out;
+  for (const QR& q : args) {
+    PVal* v = resolved_pv(q);
+    if (v && v->kind == K_STRING) {
+      for (char c : v->s)
+        if (c == 'e' || c == 'E') throw Unsupported("json_parse exponent typing");
+      if (!ascii_only(v->s)) throw Unsupported("json_parse non-ascii");
+      JParser p{v->s.c_str(), v->s.c_str() + v->s.size()};
+      p.strict = true;
+      JValue j;
+      try {
+        j = p.parse();
+      } catch (const GuardErr&) {
+        // python would YAML-parse this; decline rather than guess
+        throw Unsupported("json_parse input is not strict JSON");
+      }
+      out.push_back(pv_from_json(st, j, v->path, v->line, v->col));
+    } else {
+      out.push_back(nullptr);
+    }
+  }
+  return out;
+}
+
+// Rust Display float formatting via shortest-round-trip like repr()
+// (functions.py:350-355)
+std::string format_float(double f) {
+  if (f < 1e16 && f > -1e16 && f == static_cast<long long>(f))
+    return std::to_string(static_cast<long long>(f));
+  char buf[64];
+  for (int prec = 1; prec <= 17; prec++) {
+    snprintf(buf, sizeof buf, "%.*g", prec, f);
+    if (strtod(buf, nullptr) == f) break;
+  }
+  std::string s(buf);
+  // python repr: "1e+16" style matches %g; strip '+0' exponent padding
+  size_t e = s.find('e');
+  if (e != std::string::npos) {
+    size_t d = e + 1;
+    if (d < s.size() && (s[d] == '+' || s[d] == '-')) d++;
+    while (d + 1 < s.size() && s[d] == '0') s.erase(d, 1);
+  }
+  return s;
+}
+
+std::vector<PVal*> map_strings(EvalState* st, const std::vector<QR>& args,
+                               std::string (*f)(const std::string&)) {
+  std::vector<PVal*> out;
+  for (const QR& q : args) {
+    PVal* v = resolved_pv(q);
+    if (v && v->kind == K_STRING) {
+      PVal* r = copy_at_path(st, *v);
+      r->kind = K_STRING;
+      r->s = f(v->s);
+      out.push_back(r);
+    } else {
+      out.push_back(nullptr);
+    }
+  }
+  return out;
+}
+
+std::string str_upper(const std::string& s) {
+  if (!ascii_only(s)) throw Unsupported("non-ascii to_upper");
+  std::string out = s;
+  for (char& c : out) c = to_upper_c(c);
+  return out;
+}
+std::string str_lower(const std::string& s) {
+  if (!ascii_only(s)) throw Unsupported("non-ascii to_lower");
+  std::string out = s;
+  for (char& c : out) c = to_lower_c(c);
+  return out;
+}
+
+std::string url_decode_py(const std::string& s) {
+  // urllib.parse.unquote: %XX as utf-8; invalid sequences literal;
+  // '+' NOT decoded. Non-ascii decode results decline.
+  std::string out;
+  size_t n = s.size();
+  for (size_t i = 0; i < n; i++) {
+    if (s[i] == '%' && i + 2 < n) {
+      auto hex = [](char c) -> int {
+        if (c >= '0' && c <= '9') return c - '0';
+        if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+        if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+        return -1;
+      };
+      int h = hex(s[i + 1]), l = hex(s[i + 2]);
+      if (h >= 0 && l >= 0) {
+        int byte = (h << 4) | l;
+        if (byte >= 0x80) throw Unsupported("url_decode non-ascii byte");
+        out.push_back(static_cast<char>(byte));
+        i += 2;
+        continue;
+      }
+    }
+    out.push_back(s[i]);
+  }
+  return out;
+}
+
+std::vector<PVal*> fn_join(EvalState* st, const std::vector<QR>& collection,
+                           const std::vector<QR>& delim_q) {
+  PVal* delim = first_resolved(
+      delim_q, "join function requires the second argument to be either a char or string");
+  if (delim->kind != K_STRING && delim->kind != K_CHAR)
+    throw GuardErr(
+        "join function requires the second argument to be either a char or string");
+  std::string joined;
+  bool first = true;
+  for (const QR& q : collection) {
+    if (q.tag == T_UNRESOLVED) throw GuardErr("Joining unresolved values is not allowed");
+    if (q.value->kind != K_STRING) throw GuardErr("Joining non string values");
+    if (!first) joined += delim->s;
+    joined += q.value->s;
+    first = false;
+  }
+  PVal* out = collection.empty() ? st->arena.nv()
+                                 : copy_at_path(st, *collection[0].value);
+  out->kind = K_STRING;
+  out->s = joined;
+  return {out};
+}
+
+// _rust_expand: $1 / ${name} capture references (functions.py:112-148)
+std::string rust_expand(const std::string& tmpl, const Match& m,
+                        const std::string& subject) {
+  std::string out;
+  size_t i = 0, n = tmpl.size();
+  auto group_of = [&](const std::string& name) -> std::string {
+    bool digits = !name.empty();
+    for (char c : name)
+      if (!is_digit_c(c)) { digits = false; break; }
+    if (!digits) return "";  // named groups don't exist in the subset
+    long long g = strtoll(name.c_str(), nullptr, 10);
+    if (g < 0 || g >= static_cast<long long>(m.groups.size())) return "";
+    auto span = m.groups[static_cast<size_t>(g)];
+    if (span.first < 0) return "";
+    return subject.substr(static_cast<size_t>(span.first),
+                          static_cast<size_t>(span.second - span.first));
+  };
+  while (i < n) {
+    char c = tmpl[i];
+    if (c == '$' && i + 1 < n) {
+      char nxt = tmpl[i + 1];
+      if (nxt == '$') { out.push_back('$'); i += 2; continue; }
+      if (nxt == '{') {
+        size_t e = tmpl.find('}', i + 2);
+        if (e != std::string::npos && e > 0) {
+          out += group_of(tmpl.substr(i + 2, e - i - 2));
+          i = e + 1;
+          continue;
+        }
+      }
+      size_t j = i + 1;
+      while (j < n && (is_alnum_c(tmpl[j]) || tmpl[j] == '_')) j++;
+      if (j > i + 1) {
+        out += group_of(tmpl.substr(i + 1, j - i - 1));
+        i = j;
+        continue;
+      }
+    }
+    out.push_back(c);
+    i++;
+  }
+  return out;
+}
+
+std::vector<PVal*> fn_regex_replace(EvalState* st, const std::vector<QR>& base,
+                                    const std::vector<QR>& extract_q,
+                                    const std::vector<QR>& replace_q) {
+  PVal* extract = first_resolved(
+      extract_q, "regex_replace function requires the second argument to be a string");
+  PVal* replace = first_resolved(
+      replace_q, "regex_replace function requires the third argument to be a string");
+  if (extract->kind != K_STRING || replace->kind != K_STRING)
+    throw GuardErr("regex_replace function requires string arguments");
+  auto rx = st->eng->rx.get(extract->s);  // Unsupported propagates (fallback)
+  std::vector<PVal*> out;
+  for (const QR& q : base) {
+    PVal* v = resolved_pv(q);
+    if (v && v->kind == K_STRING) {
+      if (!ascii_only(v->s)) throw Unsupported("regex_replace non-ascii subject");
+      if (rx->use_std && rx->dollar && !v->s.empty() && v->s.back() == '\n')
+        throw Unsupported("$ with trailing newline");
+      // finditer semantics: advance past each match; zero-width
+      // matches advance by one (CPython scanner behavior)
+      std::string pieces;
+      size_t pos = 0;
+      Match m;
+      while (pos <= v->s.size() && RxCache::find_at(rx.get(), v->s, pos, &m)) {
+        pieces += rust_expand(replace->s, m, v->s);
+        size_t endp = static_cast<size_t>(m.groups[0].second);
+        pos = endp > static_cast<size_t>(m.groups[0].first) ? endp
+              : static_cast<size_t>(m.groups[0].first) + 1;
+      }
+      PVal* r = copy_at_path(st, *v);
+      r->kind = K_STRING;
+      r->s = pieces;
+      out.push_back(r);
+    } else {
+      out.push_back(nullptr);
+    }
+  }
+  return out;
+}
+
+std::vector<PVal*> fn_substring(EvalState* st, const std::vector<QR>& base,
+                                const std::vector<QR>& from_q,
+                                const std::vector<QR>& to_q) {
+  auto as_index = [](const std::vector<QR>& ql, const char* which) -> long long {
+    std::string err = std::string("substring function requires the ") + which +
+                      " argument to be a number";
+    PVal* v = first_resolved(ql, err.c_str());
+    if (v->kind == K_INT) return v->i;
+    if (v->kind == K_FLOAT) {
+      if (!(v->f > -9.2233720368547758e18 && v->f < 9.2233720368547758e18))
+        throw Unsupported("substring index outside i64");
+      return static_cast<long long>(v->f);
+    }
+    throw GuardErr(err);
+  };
+  long long start = as_index(from_q, "second");
+  long long endi = as_index(to_q, "third");
+  std::vector<PVal*> out;
+  for (const QR& q : base) {
+    PVal* v = resolved_pv(q);
+    if (v && v->kind == K_STRING) {
+      if (!ascii_only(v->s)) throw Unsupported("substring non-ascii");  // py len/slice
+      long long len = static_cast<long long>(v->s.size());
+      if (!v->s.empty() && start < endi && start <= len && endi <= len &&
+          start >= 0) {
+        PVal* r = copy_at_path(st, *v);
+        r->kind = K_STRING;
+        r->s = v->s.substr(static_cast<size_t>(start),
+                           static_cast<size_t>(endi - start));
+        out.push_back(r);
+        continue;
+      }
+      if (start < 0 || endi < 0) throw Unsupported("negative substring index");
+      out.push_back(nullptr);
+    } else {
+      out.push_back(nullptr);
+    }
+  }
+  return out;
+}
+
+std::string strip_ascii(const std::string& s) {
+  size_t a = 0, b = s.size();
+  auto is_ws = [](char c) {
+    return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' || c == '\v';
+  };
+  while (a < b && is_ws(s[a])) a++;
+  while (b > a && is_ws(s[b - 1])) b--;
+  return s.substr(a, b - a);
+}
+
+long long parse_int_py(const std::string& raw) {
+  if (!ascii_only(raw)) throw Unsupported("non-ascii int literal");
+  std::string s = strip_ascii(raw);
+  if (s.find('_') != std::string::npos) throw Unsupported("underscore int literal");
+  size_t i = 0;
+  if (i < s.size() && (s[i] == '+' || s[i] == '-')) i++;
+  if (i >= s.size()) throw GuardErr("Cannot parse int from " + raw);
+  for (size_t k = i; k < s.size(); k++)
+    if (!is_digit_c(s[k])) throw GuardErr("Cannot parse int from " + raw);
+  errno = 0;
+  long long v = strtoll(s.c_str(), nullptr, 10);
+  if (errno == ERANGE) throw Unsupported("int literal outside i64");
+  return v;
+}
+
+double parse_float_py(const std::string& raw) {
+  if (!ascii_only(raw)) throw Unsupported("non-ascii float literal");
+  std::string s = strip_ascii(raw);
+  if (s.find('_') != std::string::npos) throw Unsupported("underscore float literal");
+  if (s.empty()) throw GuardErr("Cannot parse float from " + raw);
+  char* endp = nullptr;
+  double v = strtod(s.c_str(), &endp);
+  if (endp != s.c_str() + s.size()) throw GuardErr("Cannot parse float from " + raw);
+  return v;
+}
+
+// RFC3339-ish parse matching datetime.fromisoformat usage in
+// functions.py:384-400 (the 'Z' -> '+00:00' substitution included).
+// Anything outside the strict common grammar declines.
+long long parse_epoch_py(const std::string& raw) {
+  if (!ascii_only(raw)) throw Unsupported("non-ascii timestamp");
+  std::string s = raw;
+  // functions.py replaces ALL 'Z' (str.replace)
+  std::string repl;
+  for (char c : s) {
+    if (c == 'Z') repl += "+00:00";
+    else repl.push_back(c);
+  }
+  s = repl;
+  // Structural deviations from this strict grammar DECLINE
+  // (datetime.fromisoformat accepts more — hour-only times, basic
+  // format, week dates — and python evaluates those fine); only
+  // values the grammar parses but the calendar rejects raise the
+  // error python raises (fromisoformat ValueError -> IncompatibleError).
+  auto digits = [&](size_t pos, int count) -> long long {
+    if (pos + count > s.size()) throw Unsupported("parse_epoch grammar");
+    long long v = 0;
+    for (int k = 0; k < count; k++) {
+      char c = s[pos + k];
+      if (!is_digit_c(c)) throw Unsupported("parse_epoch grammar");
+      v = v * 10 + (c - '0');
+    }
+    return v;
+  };
+  long long year = digits(0, 4);
+  if (s.size() < 10 || s[4] != '-' || s[7] != '-')
+    throw Unsupported("parse_epoch grammar");
+  long long month = digits(5, 2), day = digits(8, 2);
+  if (month < 1 || month > 12)
+    throw GuardErr("Cannot parse epoch from " + raw);
+  bool leap = (year % 4 == 0 && year % 100 != 0) || year % 400 == 0;
+  static const int mdays[] = {31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31};
+  long long dim = mdays[month - 1] + ((month == 2 && leap) ? 1 : 0);
+  if (day < 1 || day > dim)
+    throw GuardErr("Cannot parse epoch from " + raw);
+  long long hh = 0, mm = 0, ss = 0;
+  long long off = 0;
+  size_t i = 10;
+  if (i < s.size()) {
+    if (s[i] != 'T' && s[i] != ' ') throw Unsupported("parse_epoch grammar");
+    i++;
+    hh = digits(i, 2);
+    if (i + 2 >= s.size() || s[i + 2] != ':') throw Unsupported("parse_epoch grammar");
+    mm = digits(i + 3, 2);
+    i += 5;
+    if (i < s.size() && s[i] == ':') {
+      ss = digits(i + 1, 2);
+      i += 3;
+    }
+    if (i < s.size() && s[i] == '.') {
+      // fractional seconds truncate through int(timestamp()); decline
+      // to avoid pre-epoch truncation-direction mismatches
+      throw Unsupported("fractional seconds in parse_epoch");
+    }
+    if (i < s.size()) {
+      char sign = s[i];
+      if (sign != '+' && sign != '-') throw Unsupported("parse_epoch grammar");
+      long long oh = digits(i + 1, 2);
+      if (i + 3 >= s.size() || s[i + 3] != ':') throw Unsupported("parse_epoch grammar");
+      long long om = digits(i + 4, 2);
+      i += 6;
+      if (i != s.size()) throw Unsupported("parse_epoch grammar");
+      if (oh > 23 || om > 59) throw GuardErr("Cannot parse epoch from " + raw);
+      off = (oh * 3600 + om * 60) * (sign == '-' ? -1 : 1);
+    }
+    if (hh > 23 || mm > 59 || ss > 59)
+      throw GuardErr("Cannot parse epoch from " + raw);
+  }
+  // days-from-civil (Howard Hinnant), valid over the full year range
+  long long y = year;
+  long long m = month;
+  y -= m <= 2;
+  long long era = (y >= 0 ? y : y - 399) / 400;
+  long long yoe = y - era * 400;
+  long long doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + day - 1;
+  long long doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+  long long days = era * 146097 + doe - 719468;
+  return days * 86400 + hh * 3600 + mm * 60 + ss - off;
+}
+
+std::vector<PVal*> call_function(EvalState* st, const std::string& name,
+                                 const std::vector<std::vector<QR>>& args) {
+  // functions.py:429-437 dispatch
+  if (name == "now") {
+    PVal* out = st->arena.nv();
+    out->kind = K_INT;
+    out->i = static_cast<long long>(time(nullptr));
+    return {out};
+  }
+  if (name == "join") return fn_join(st, args.at(0), args.at(1));
+  if (name == "regex_replace")
+    return fn_regex_replace(st, args.at(0), args.at(1), args.at(2));
+  if (name == "substring") return fn_substring(st, args.at(0), args.at(1), args.at(2));
+
+  const std::vector<QR>& a0 = args.at(0);
+  if (name == "count") return fn_count(st, a0);
+  if (name == "json_parse") return fn_json_parse(st, a0);
+  if (name == "to_upper") return map_strings(st, a0, str_upper);
+  if (name == "to_lower") return map_strings(st, a0, str_lower);
+  if (name == "url_decode") return map_strings(st, a0, url_decode_py);
+
+  std::vector<PVal*> out;
+  for (const QR& q : a0) {
+    PVal* v = resolved_pv(q);
+    if (!v) { out.push_back(nullptr); continue; }
+    if (name == "parse_int") {
+      if (v->kind == K_INT) { out.push_back(v); continue; }
+      if (v->kind == K_FLOAT) {
+        if (!(v->f > -9.2233720368547758e18 && v->f < 9.2233720368547758e18))
+          throw Unsupported("parse_int float outside i64");  // python is exact
+        PVal* r = copy_at_path(st, *v);
+        r->kind = K_INT;
+        r->i = static_cast<long long>(v->f);  // python int() truncates
+        out.push_back(r);
+        continue;
+      }
+      if (v->kind == K_STRING || v->kind == K_CHAR) {
+        PVal* r = copy_at_path(st, *v);
+        r->kind = K_INT;
+        r->i = parse_int_py(v->s);
+        out.push_back(r);
+        continue;
+      }
+      out.push_back(nullptr);
+    } else if (name == "parse_float") {
+      if (v->kind == K_FLOAT) { out.push_back(v); continue; }
+      if (v->kind == K_INT) {
+        PVal* r = copy_at_path(st, *v);
+        r->kind = K_FLOAT;
+        r->f = static_cast<double>(v->i);
+        out.push_back(r);
+        continue;
+      }
+      if (v->kind == K_STRING || v->kind == K_CHAR) {
+        PVal* r = copy_at_path(st, *v);
+        r->kind = K_FLOAT;
+        r->f = parse_float_py(v->s);
+        out.push_back(r);
+        continue;
+      }
+      out.push_back(nullptr);
+    } else if (name == "parse_boolean") {
+      if (v->kind == K_BOOL) { out.push_back(v); continue; }
+      if (v->kind == K_STRING) {
+        std::string low = v->s;
+        if (!ascii_only(low)) throw Unsupported("non-ascii boolean literal");
+        for (char& c : low) c = to_lower_c(c);
+        if (low == "true" || low == "false") {
+          PVal* r = copy_at_path(st, *v);
+          r->kind = K_BOOL;
+          r->b = (low == "true");
+          out.push_back(r);
+          continue;
+        }
+        throw GuardErr("Cannot parse boolean from " + v->s);
+      }
+      out.push_back(nullptr);
+    } else if (name == "parse_string") {
+      if (v->kind == K_STRING) { out.push_back(v); continue; }
+      PVal* r = copy_at_path(st, *v);
+      r->kind = K_STRING;
+      if (v->kind == K_BOOL) r->s = v->b ? "true" : "false";
+      else if (v->kind == K_INT) r->s = std::to_string(v->i);
+      else if (v->kind == K_CHAR) r->s = v->s;
+      else if (v->kind == K_FLOAT) r->s = format_float(v->f);
+      else { out.push_back(nullptr); continue; }
+      out.push_back(r);
+    } else if (name == "parse_char") {
+      if (v->kind == K_CHAR) { out.push_back(v); continue; }
+      if (v->kind == K_INT) {
+        if (v->i >= 0 && v->i <= 9) {
+          PVal* r = copy_at_path(st, *v);
+          r->kind = K_CHAR;
+          r->s = std::to_string(v->i);
+          out.push_back(r);
+          continue;
+        }
+        throw GuardErr("Cannot parse char from int");
+      }
+      if (v->kind == K_STRING) {
+        if (!ascii_only(v->s)) throw Unsupported("non-ascii char");  // py len==1
+        if (v->s.size() == 1) {
+          PVal* r = copy_at_path(st, *v);
+          r->kind = K_CHAR;
+          r->s = v->s;
+          out.push_back(r);
+          continue;
+        }
+        throw GuardErr("Cannot parse char from string");
+      }
+      out.push_back(nullptr);
+    } else if (name == "parse_epoch") {
+      if (v->kind == K_STRING) {
+        PVal* r = copy_at_path(st, *v);
+        r->kind = K_INT;
+        r->i = parse_epoch_py(v->s);
+        out.push_back(r);
+      } else {
+        out.push_back(nullptr);
+      }
+    } else {
+      throw GuardErr("No function with the name '" + name + "' exists.");
+    }
+  }
+  return out;
+}
+
+// resolve_function (scopes.py:343-355; eval_context.rs:2437-2472)
+std::vector<QR> resolve_function(const std::string& name,
+                                 const std::vector<LetValue*>& params, Resolver* r) {
+  std::vector<std::vector<QR>> args;
+  for (LetValue* param : params) {
+    switch (param->tag) {
+      case LV_PV: args.push_back({QR::literal(param->pv)}); break;
+      case LV_QUERY: args.push_back(r->query(param->q->parts)); break;
+      default:
+        args.push_back(resolve_function(param->fn->name, param->fn->params, r));
+    }
+  }
+  std::vector<PVal*> results = call_function(r->state(), name, args);
+  std::vector<QR> out;
+  for (PVal* v : results)
+    if (v) out.push_back(QR::resolved(v));
+  return out;
+}
+
+}  // namespace
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Operators (evaluator.py:264-551; operators.rs:100-787).
+// ValueEvalResult as a tagged struct.
+// ---------------------------------------------------------------------------
+enum VTag { V_LHS_UR, V_RHS_UR, V_NOT_COMP, V_SUCCESS, V_FAIL };
+enum CKind { CK_VALUE, CK_VALUE_IN, CK_LIST_IN, CK_QUERY_IN };
+
+struct VER {
+  int tag;
+  int ckind = CK_VALUE;
+  PVal* lhs = nullptr;
+  PVal* rhs = nullptr;
+  QR ur;  // the unresolved side for V_LHS_UR / V_RHS_UR
+  std::vector<PVal*> diff, lhs_list, rhs_list;
+};
+
+using CmpFn = bool (*)(const PVal&, const PVal&, RxCache&);
+
+bool cmp_eq_fn(const PVal& a, const PVal& b, RxCache& rx) { return compare_eq(a, b, rx); }
+bool cmp_lt_fn(const PVal& a, const PVal& b, RxCache&) { return compare_lt(a, b); }
+bool cmp_le_fn(const PVal& a, const PVal& b, RxCache&) { return compare_le(a, b); }
+bool cmp_gt_fn(const PVal& a, const PVal& b, RxCache&) { return compare_gt(a, b); }
+bool cmp_ge_fn(const PVal& a, const PVal& b, RxCache&) { return compare_ge(a, b); }
+
+// _selected / flattened (evaluator.py:273-283; operators.rs:116-144)
+template <typename OnUr>
+std::vector<PVal*> selected(const std::vector<QR>& qrs, OnUr on_ur, bool flatten) {
+  std::vector<PVal*> out;
+  for (const QR& each : qrs) {
+    if (each.tag == T_UNRESOLVED) {
+      on_ur(each);
+    } else if (flatten && each.value->kind == K_LIST) {
+      for (PVal* e : each.value->list) out.push_back(e);
+    } else {
+      out.push_back(each.value);
+    }
+  }
+  return out;
+}
+
+// _match_value (evaluator.py:286-292)
+VER match_value(PVal* lhs, PVal* rhs, CmpFn cmp, RxCache& rx) {
+  VER v;
+  v.lhs = lhs;
+  v.rhs = rhs;
+  v.ckind = CK_VALUE;
+  try {
+    v.tag = cmp(*lhs, *rhs, rx) ? V_SUCCESS : V_FAIL;
+  } catch (const NotComparable&) {
+    v.tag = V_NOT_COMP;
+  }
+  return v;
+}
+
+// _is_literal (evaluator.py:295-299)
+PVal* is_literal(const std::vector<QR>& qrs) {
+  if (qrs.size() == 1 && qrs[0].tag == T_LITERAL) return qrs[0].value;
+  return nullptr;
+}
+
+// _string_in (evaluator.py:302-312)
+VER string_in(PVal* lhs, PVal* rhs) {
+  VER v;
+  v.lhs = lhs;
+  v.rhs = rhs;
+  v.ckind = CK_VALUE;
+  if (lhs->kind == K_STRING && rhs->kind == K_STRING)
+    v.tag = rhs->s.find(lhs->s) != std::string::npos ? V_SUCCESS : V_FAIL;
+  else
+    v.tag = V_NOT_COMP;
+  return v;
+}
+
+// _contained_in (evaluator.py:315-338; operators.rs:256-321)
+VER contained_in(PVal* lhs, PVal* rhs, RxCache& rx) {
+  if (lhs->kind == K_LIST) {
+    if (rhs->kind == K_LIST) {
+      VER v;
+      v.lhs = lhs;
+      v.rhs = rhs;
+      v.ckind = CK_LIST_IN;
+      if (!rhs->list.empty() && rhs->list[0]->kind == K_LIST) {
+        // list-of-lists membership
+        bool found = false;
+        for (PVal* e : rhs->list)
+          if (loose_eq(*lhs, *e, rx)) { found = true; break; }
+        v.tag = found ? V_SUCCESS : V_FAIL;
+        if (!found) v.diff.push_back(lhs);
+        return v;
+      }
+      for (PVal* e : lhs->list) {
+        bool found = false;
+        for (PVal* r : rhs->list)
+          if (loose_eq(*e, *r, rx)) { found = true; break; }
+        if (!found) v.diff.push_back(e);
+      }
+      v.tag = v.diff.empty() ? V_SUCCESS : V_FAIL;
+      return v;
+    }
+    VER v;
+    v.tag = V_NOT_COMP;
+    v.lhs = lhs;
+    v.rhs = rhs;
+    return v;
+  }
+  if (rhs->kind == K_LIST) {
+    VER v;
+    v.lhs = lhs;
+    v.rhs = rhs;
+    v.ckind = CK_VALUE_IN;
+    bool found = false;
+    for (PVal* e : rhs->list)
+      if (loose_eq(*lhs, *e, rx)) { found = true; break; }
+    v.tag = found ? V_SUCCESS : V_FAIL;
+    return v;
+  }
+  return match_value(lhs, rhs, cmp_eq_fn, rx);
+}
+
+// _eq_operation (evaluator.py:341-401; operators.rs:453-598)
+std::vector<VER> eq_operation(const std::vector<QR>& lhs_results,
+                              const std::vector<QR>& rhs_results, RxCache& rx) {
+  std::vector<VER> results;
+  PVal* l_lit = is_literal(lhs_results);
+  PVal* r_lit = is_literal(rhs_results);
+
+  if (l_lit && r_lit) {
+    results.push_back(match_value(l_lit, r_lit, cmp_eq_fn, rx));
+    return results;
+  }
+
+  if (l_lit) {
+    auto rhs = selected(rhs_results,
+                        [&](const QR& ur) {
+                          VER v;
+                          v.tag = V_RHS_UR;
+                          v.ur = ur;
+                          v.lhs = l_lit;
+                          results.push_back(v);
+                        },
+                        false);
+    if (l_lit->kind == K_LIST) {
+      for (PVal* each : rhs) results.push_back(match_value(l_lit, each, cmp_eq_fn, rx));
+    } else {
+      for (PVal* each_r : rhs) {
+        if (each_r->kind == K_LIST) {
+          for (PVal* inner : each_r->list)
+            results.push_back(match_value(l_lit, inner, cmp_eq_fn, rx));
+        } else {
+          results.push_back(match_value(l_lit, each_r, cmp_eq_fn, rx));
+        }
+      }
+    }
+    return results;
+  }
+
+  if (r_lit) {
+    auto lhs_flat = selected(lhs_results,
+                             [&](const QR& ur) {
+                               VER v;
+                               v.tag = V_LHS_UR;
+                               v.ur = ur;
+                               results.push_back(v);
+                             },
+                             false);
+    if (r_lit->kind == K_LIST) {
+      for (PVal* each : lhs_flat) {
+        if (each->is_scalar() && r_lit->list.size() == 1)
+          results.push_back(match_value(each, r_lit->list[0], cmp_eq_fn, rx));
+        else
+          results.push_back(match_value(each, r_lit, cmp_eq_fn, rx));
+      }
+    } else {
+      for (PVal* each : lhs_flat) {
+        if (each->kind == K_LIST) {
+          for (PVal* inner : each->list)
+            results.push_back(match_value(inner, r_lit, cmp_eq_fn, rx));
+        } else {
+          results.push_back(match_value(each, r_lit, cmp_eq_fn, rx));
+        }
+      }
+    }
+    return results;
+  }
+
+  // query vs query: set-difference semantics (operators.rs:552-594)
+  std::vector<PVal*> lhs_sel = selected(lhs_results,
+                                        [&](const QR& ur) {
+                                          VER v;
+                                          v.tag = V_LHS_UR;
+                                          v.ur = ur;
+                                          results.push_back(v);
+                                        },
+                                        false);
+  std::vector<PVal*> rhs_sel = selected(rhs_results,
+                                        [&](const QR& ur) {
+                                          for (PVal* l : lhs_sel) {
+                                            VER v;
+                                            v.tag = V_RHS_UR;
+                                            v.ur = ur;
+                                            v.lhs = l;
+                                            results.push_back(v);
+                                          }
+                                        },
+                                        false);
+  std::vector<PVal*> diff;
+  if (lhs_sel.size() > rhs_sel.size()) {
+    for (PVal* e : lhs_sel) {
+      bool found = false;
+      for (PVal* r : rhs_sel)
+        if (loose_eq(*e, *r, rx)) { found = true; break; }
+      if (!found) diff.push_back(e);
+    }
+  } else {
+    for (PVal* e : rhs_sel) {
+      bool found = false;
+      for (PVal* l : lhs_sel)
+        if (loose_eq(*e, *l, rx)) { found = true; break; }
+      if (!found) diff.push_back(e);
+    }
+  }
+  VER v;
+  v.tag = diff.empty() ? V_SUCCESS : V_FAIL;
+  v.ckind = CK_QUERY_IN;
+  v.diff = std::move(diff);
+  v.lhs_list = std::move(lhs_sel);
+  v.rhs_list = std::move(rhs_sel);
+  results.push_back(std::move(v));
+  return results;
+}
+
+// _in_operation (evaluator.py:404-460; operators.rs:323-451)
+std::vector<VER> in_operation(const std::vector<QR>& lhs_results,
+                              const std::vector<QR>& rhs_results, RxCache& rx) {
+  std::vector<VER> results;
+  PVal* l_lit = is_literal(lhs_results);
+  PVal* r_lit = is_literal(rhs_results);
+
+  if (l_lit && r_lit) {
+    VER first = string_in(l_lit, r_lit);
+    if (first.tag == V_SUCCESS)
+      results.push_back(first);
+    else
+      results.push_back(contained_in(l_lit, r_lit, rx));
+    return results;
+  }
+
+  if (l_lit) {
+    auto rhs = selected(rhs_results,
+                        [&](const QR& ur) {
+                          VER v;
+                          v.tag = V_RHS_UR;
+                          v.ur = ur;
+                          v.lhs = l_lit;
+                          results.push_back(v);
+                        },
+                        false);
+    bool any_list = false;
+    for (PVal* e : rhs)
+      if (e->kind == K_LIST) { any_list = true; break; }
+    if (any_list) {
+      for (PVal* r : rhs) results.push_back(contained_in(l_lit, r, rx));
+    } else if (l_lit->kind == K_LIST) {
+      std::vector<PVal*> diff;
+      for (PVal* e : l_lit->list) {
+        bool found = false;
+        for (PVal* r : rhs)
+          if (loose_eq(*e, *r, rx)) { found = true; break; }
+        if (!found) diff.push_back(e);
+      }
+      VER v;
+      v.tag = diff.empty() ? V_SUCCESS : V_FAIL;
+      v.ckind = CK_QUERY_IN;
+      v.diff = std::move(diff);
+      v.lhs_list = {l_lit};
+      v.rhs_list = rhs;
+      results.push_back(std::move(v));
+    } else {
+      for (PVal* r : rhs) results.push_back(contained_in(l_lit, r, rx));
+    }
+    return results;
+  }
+
+  if (r_lit) {
+    auto lhs_sel = selected(lhs_results,
+                            [&](const QR& ur) {
+                              VER v;
+                              v.tag = V_LHS_UR;
+                              v.ur = ur;
+                              results.push_back(v);
+                            },
+                            false);
+    for (PVal* l : lhs_sel) {
+      if (r_lit->kind == K_STRING) {
+        if (l->kind == K_LIST) {
+          for (PVal* inner : l->list) results.push_back(string_in(inner, r_lit));
+        } else {
+          results.push_back(string_in(l, r_lit));
+        }
+      } else {
+        results.push_back(contained_in(l, r_lit, rx));
+      }
+    }
+    return results;
+  }
+
+  auto lhs_sel = selected(lhs_results,
+                          [&](const QR& ur) {
+                            VER v;
+                            v.tag = V_LHS_UR;
+                            v.ur = ur;
+                            results.push_back(v);
+                          },
+                          false);
+  auto rhs_sel = selected(rhs_results,
+                          [&](const QR& ur) {
+                            for (PVal* l : lhs_sel) {
+                              VER v;
+                              v.tag = V_RHS_UR;
+                              v.ur = ur;
+                              v.lhs = l;
+                              results.push_back(v);
+                            }
+                          },
+                          false);
+  std::vector<PVal*> diff;
+  for (PVal* l : lhs_sel) {
+    bool found = false;
+    for (PVal* r : rhs_sel)
+      if (contained_in(l, r, rx).tag == V_SUCCESS) { found = true; break; }
+    if (!found) diff.push_back(l);
+  }
+  VER v;
+  v.tag = diff.empty() ? V_SUCCESS : V_FAIL;
+  v.ckind = CK_QUERY_IN;
+  v.diff = std::move(diff);
+  v.lhs_list = std::move(lhs_sel);
+  v.rhs_list = std::move(rhs_sel);
+  results.push_back(std::move(v));
+  return results;
+}
+
+// _common_operation (evaluator.py:463-479; operators.rs:146-176)
+std::vector<VER> common_operation(const std::vector<QR>& lhs_results,
+                                  const std::vector<QR>& rhs_results, CmpFn cmp,
+                                  RxCache& rx) {
+  std::vector<VER> results;
+  auto lhs_flat = selected(lhs_results,
+                           [&](const QR& ur) {
+                             VER v;
+                             v.tag = V_LHS_UR;
+                             v.ur = ur;
+                             results.push_back(v);
+                           },
+                           true);
+  auto rhs_flat = selected(rhs_results,
+                           [&](const QR& ur) {
+                             for (PVal* l : lhs_flat) {
+                               VER v;
+                               v.tag = V_RHS_UR;
+                               v.ur = ur;
+                               v.lhs = l;
+                               results.push_back(v);
+                             }
+                           },
+                           true);
+  for (PVal* l : lhs_flat)
+    for (PVal* r : rhs_flat) results.push_back(match_value(l, r, cmp, rx));
+  return results;
+}
+
+// _reverse_diff (evaluator.py:490-492)
+std::vector<PVal*> reverse_diff(const std::vector<PVal*>& diff,
+                                const std::vector<PVal*>& other, RxCache& rx) {
+  std::vector<PVal*> out;
+  for (PVal* e : other) {
+    bool found = false;
+    for (PVal* d : diff)
+      if (loose_eq(*e, *d, rx)) { found = true; break; }
+    if (!found) out.push_back(e);
+  }
+  return out;
+}
+
+// operator_compare (evaluator.py:495-551; operators.rs:600-787).
+// Returns false in *skip when evaluated; true means EvalResult::Skip.
+std::vector<VER> operator_compare(int op, bool negated, const std::vector<QR>& lhs,
+                                  const std::vector<QR>& rhs, RxCache& rx,
+                                  bool* skip) {
+  *skip = false;
+  if (lhs.empty() || rhs.empty()) {
+    *skip = true;
+    return {};
+  }
+  std::vector<VER> results;
+  switch (op) {
+    case C_EQ: results = eq_operation(lhs, rhs, rx); break;
+    case C_IN: results = in_operation(lhs, rhs, rx); break;
+    case C_LT: results = common_operation(lhs, rhs, cmp_lt_fn, rx); break;
+    case C_GT: results = common_operation(lhs, rhs, cmp_gt_fn, rx); break;
+    case C_LE: results = common_operation(lhs, rhs, cmp_le_fn, rx); break;
+    case C_GE: results = common_operation(lhs, rhs, cmp_ge_fn, rx); break;
+    default: throw GuardErr("Operation NOT PERMITTED");
+  }
+  if (!negated) return results;
+
+  std::vector<VER> inverted;
+  for (VER& e : results) {
+    if (e.tag == V_FAIL) {
+      if (e.ckind == CK_QUERY_IN) {
+        std::vector<PVal*> rdiff;
+        if (rhs.size() >= lhs.size() && op == C_EQ)
+          rdiff = reverse_diff(e.diff, e.rhs_list, rx);
+        else
+          rdiff = reverse_diff(e.diff, e.lhs_list, rx);
+        VER v;
+        v.tag = rdiff.empty() ? V_SUCCESS : V_FAIL;
+        v.ckind = CK_QUERY_IN;
+        v.diff = std::move(rdiff);
+        v.lhs_list = e.lhs_list;
+        v.rhs_list = e.rhs_list;
+        inverted.push_back(std::move(v));
+      } else if (e.ckind == CK_LIST_IN) {
+        std::vector<PVal*> rdiff;
+        for (PVal* e2 : e.lhs->list) {
+          bool found = false;
+          for (PVal* d : e.diff)
+            if (loose_eq(*e2, *d, rx)) { found = true; break; }
+          if (!found) rdiff.push_back(e2);
+        }
+        VER v = e;
+        v.tag = rdiff.empty() ? V_SUCCESS : V_FAIL;
+        v.diff = std::move(rdiff);
+        inverted.push_back(std::move(v));
+      } else {
+        VER v = e;
+        v.tag = V_SUCCESS;
+        inverted.push_back(std::move(v));
+      }
+    } else if (e.tag == V_SUCCESS) {
+      if (e.ckind == CK_QUERY_IN) {
+        VER v = e;
+        v.tag = V_FAIL;
+        v.diff = e.lhs_list;
+        inverted.push_back(std::move(v));
+      } else if (e.ckind == CK_LIST_IN) {
+        VER v = e;
+        v.tag = V_FAIL;
+        v.diff = e.lhs->list;
+        inverted.push_back(std::move(v));
+      } else {
+        VER v = e;
+        v.tag = V_FAIL;
+        inverted.push_back(std::move(v));
+      }
+    } else {
+      inverted.push_back(e);
+    }
+  }
+  return inverted;
+}
+
+}  // namespace
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Unary / binary operations (evaluator.py:123-261, 557-698;
+// eval.rs:174-405, 765-974) — status collection without the record tree.
+// ---------------------------------------------------------------------------
+struct OpResult {
+  bool empty = false;     // EmptyQueryResult
+  int empty_status = ST_SKIP;
+  std::vector<std::pair<QR, int>> statuses;
+};
+
+OpResult unary_operation(const std::vector<Part*>& lhs_query, int op, bool op_not,
+                         bool inverse, Resolver* ctx) {
+  std::vector<QR> lhs = ctx->query(lhs_query);
+  OpResult out;
+
+  const Part* last = lhs_query.back();
+  bool empty_on_expr = last->type == P_FILTER || last->type == P_KEYS ||
+                       (part_is_variable(last) && lhs_query.size() == 1);
+
+  if (empty_on_expr && op == C_EMPTY) {
+    // evaluator.py:142-198 (eval.rs:198-298)
+    if (!lhs.empty()) {
+      for (const QR& each : lhs) {
+        int status;
+        QR qr = each;
+        if (each.tag != T_UNRESOLVED) {
+          bool ok = op_not ? !each.value->is_null() : each.value->is_null();
+          qr = QR::resolved(each.value);
+          status = ok ? ST_PASS : ST_FAIL;
+        } else {
+          status = op_not ? ST_FAIL : ST_PASS;
+        }
+        if (inverse) status = (status == ST_FAIL) ? ST_PASS : ST_FAIL;
+        out.statuses.emplace_back(qr, status);
+      }
+      return out;
+    }
+    bool result = !op_not;
+    if (inverse) result = !result;
+    out.empty = true;
+    out.empty_status = result ? ST_PASS : ST_FAIL;
+    return out;
+  }
+
+  if (lhs.empty()) {
+    out.empty = true;
+    out.empty_status = ST_SKIP;
+    return out;
+  }
+
+  for (const QR& each : lhs) {
+    bool r;
+    switch (op) {
+      case C_EXISTS: r = each.tag != T_UNRESOLVED; break;
+      case C_EMPTY: {
+        // evaluator.py:76-91
+        if (each.tag == T_UNRESOLVED) { r = true; break; }
+        PVal* v = each.value;
+        if (v->kind == K_LIST) r = v->list.empty();
+        else if (v->kind == K_MAP) r = v->map_empty();
+        else if (v->kind == K_STRING) r = v->s.empty();
+        else if (v->kind == K_BOOL) r = false;
+        else
+          throw GuardErr(std::string("Attempting EMPTY operation on type ") +
+                         v->type_info() + " that does not support it");
+        break;
+      }
+      case C_IS_STRING: r = each.tag != T_UNRESOLVED && each.value->kind == K_STRING; break;
+      case C_IS_LIST: r = each.tag != T_UNRESOLVED && each.value->kind == K_LIST; break;
+      case C_IS_MAP: r = each.tag != T_UNRESOLVED && each.value->kind == K_MAP; break;
+      case C_IS_INT: r = each.tag != T_UNRESOLVED && each.value->kind == K_INT; break;
+      case C_IS_FLOAT: r = each.tag != T_UNRESOLVED && each.value->kind == K_FLOAT; break;
+      case C_IS_BOOL: r = each.tag != T_UNRESOLVED && each.value->kind == K_BOOL; break;
+      case C_IS_NULL: r = each.tag != T_UNRESOLVED && each.value->kind == K_NULL; break;
+      default: throw GuardErr("bad unary op");
+    }
+    if (op_not) r = !r;
+    if (inverse) r = !r;
+    out.statuses.emplace_back(each, r ? ST_PASS : ST_FAIL);
+  }
+  return out;
+}
+
+OpResult binary_operation(const std::vector<Part*>& lhs_query,
+                          const std::vector<QR>& rhs, int op, bool negated,
+                          Resolver* ctx) {
+  std::vector<QR> lhs = ctx->query(lhs_query);
+  bool skip = false;
+  std::vector<VER> results =
+      operator_compare(op, negated, lhs, rhs, ctx->state()->eng->rx, &skip);
+  OpResult out;
+  if (skip) {
+    out.empty = true;
+    out.empty_status = ST_SKIP;
+    return out;
+  }
+  for (const VER& e : results) {
+    switch (e.tag) {
+      case V_LHS_UR:
+        out.statuses.emplace_back(e.ur, ST_FAIL);
+        break;
+      case V_RHS_UR:
+        out.statuses.emplace_back(QR::resolved(e.lhs), ST_FAIL);
+        break;
+      case V_NOT_COMP:
+        out.statuses.emplace_back(QR::resolved(e.lhs), ST_FAIL);
+        break;
+      case V_SUCCESS:
+        if (e.ckind == CK_QUERY_IN) {
+          for (PVal* l : e.lhs_list) out.statuses.emplace_back(QR::resolved(l), ST_PASS);
+        } else if (e.ckind == CK_LIST_IN) {
+          out.statuses.emplace_back(QR::resolved(e.lhs), ST_PASS);
+        } else {
+          out.statuses.emplace_back(QR::resolved(e.lhs), ST_PASS);
+        }
+        break;
+      default:  // V_FAIL
+        if (e.ckind == CK_QUERY_IN) {
+          for (PVal* l : e.diff) out.statuses.emplace_back(QR::resolved(l), ST_FAIL);
+        } else {
+          out.statuses.emplace_back(QR::resolved(e.lhs), ST_FAIL);
+        }
+    }
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// real_binary_operation + helpers (evaluator.py:705-920; eval.rs:434-753)
+// ---------------------------------------------------------------------------
+struct LCmp {
+  int tag;  // 0 comparable, 1 not_comparable, 2 rhs_unresolved
+  bool outcome = false;
+  PVal* lhs = nullptr;
+  PVal* rhs = nullptr;
+  QR rhs_q;
+};
+
+std::vector<LCmp> each_lhs_compare(
+    const std::function<bool(const PVal&, const PVal&)>& cmp_fn, PVal* lhs,
+    const std::vector<QR>& rhs) {
+  std::vector<LCmp> statuses;
+  for (const QR& each_rhs : rhs) {
+    if (each_rhs.tag == T_UNRESOLVED) {
+      LCmp c;
+      c.tag = 2;
+      c.rhs_q = each_rhs;
+      c.lhs = lhs;
+      statuses.push_back(c);
+      continue;
+    }
+    PVal* rv = each_rhs.value;
+    try {
+      LCmp c;
+      c.tag = 0;
+      c.outcome = cmp_fn(*lhs, *rv);
+      c.lhs = lhs;
+      c.rhs = rv;
+      statuses.push_back(c);
+    } catch (const NotComparable& reason) {
+      if (lhs->kind == K_LIST) {
+        for (PVal* inner : lhs->list) {
+          try {
+            LCmp c;
+            c.tag = 0;
+            c.outcome = cmp_fn(*inner, *rv);
+            c.lhs = inner;
+            c.rhs = rv;
+            statuses.push_back(c);
+          } catch (const NotComparable&) {
+            LCmp c;
+            c.tag = 1;
+            c.lhs = inner;
+            c.rhs = rv;
+            statuses.push_back(c);
+          }
+        }
+        continue;
+      }
+      if (lhs->is_scalar() && each_rhs.tag == T_LITERAL && rv->kind == K_LIST &&
+          rv->list.size() == 1) {
+        PVal* inner_rhs = rv->list[0];
+        try {
+          LCmp c;
+          c.tag = 0;
+          c.outcome = cmp_fn(*lhs, *inner_rhs);
+          c.lhs = lhs;
+          c.rhs = inner_rhs;
+          statuses.push_back(c);
+        } catch (const NotComparable&) {
+          LCmp c;
+          c.tag = 1;
+          c.lhs = lhs;
+          c.rhs = inner_rhs;
+          statuses.push_back(c);
+        }
+        continue;
+      }
+      LCmp c;
+      c.tag = 1;
+      c.lhs = lhs;
+      c.rhs = rv;
+      statuses.push_back(c);
+    }
+  }
+  return statuses;
+}
+
+std::vector<std::pair<QR, int>> real_binary_operation(const std::vector<QR>& lhs,
+                                                      const std::vector<QR>& rhs,
+                                                      int op, bool negated,
+                                                      Resolver* ctx) {
+  std::vector<std::pair<QR, int>> statuses;
+  RxCache& rx = ctx->state()->eng->rx;
+  if (op == C_EQ && rhs.size() > 1) op = C_IN;  // eval.rs:986-990
+
+  for (const QR& each : lhs) {
+    if (each.tag == T_UNRESOLVED) {
+      statuses.emplace_back(each, ST_FAIL);
+      continue;
+    }
+    PVal* l = each.value;
+    std::function<bool(const PVal&, const PVal&)> cmp_fn;
+    if (op == C_IN) {
+      // _in_cmp (evaluator.py:705-718; eval.rs:560-583)
+      bool not_in = negated;
+      cmp_fn = [&rx, not_in](const PVal& a, const PVal& b) {
+        if (a.kind == K_STRING && b.kind == K_STRING) {
+          bool r = b.s.find(a.s) != std::string::npos;
+          return not_in ? !r : r;
+        }
+        if (b.kind == K_LIST) {
+          bool found = false;
+          for (PVal* e : b.list)
+            if (compare_eq(a, *e, rx)) { found = true; break; }
+          return not_in ? !found : found;
+        }
+        bool r = compare_eq(a, b, rx);
+        return not_in ? !r : r;
+      };
+    } else {
+      CmpFn base;
+      switch (op) {
+        case C_EQ: base = cmp_eq_fn; break;
+        case C_GE: base = cmp_ge_fn; break;
+        case C_GT: base = cmp_gt_fn; break;
+        case C_LT: base = cmp_lt_fn; break;
+        case C_LE: base = cmp_le_fn; break;
+        default: throw GuardErr("Operation NOT PERMITTED");
+      }
+      bool inv = negated;
+      cmp_fn = [&rx, base, inv](const PVal& a, const PVal& b) {
+        bool v = base(a, b, rx);
+        return inv ? !v : v;
+      };
+    }
+    std::vector<LCmp> r = each_lhs_compare(cmp_fn, l, rhs);
+
+    if (op == C_IN) {
+      // _report_at_least_one (evaluator.py:870-920): group by lhs
+      // IDENTITY, PASS iff any comparable outcome true
+      std::vector<std::pair<PVal*, bool>> by_lhs;
+      for (const LCmp& c : r) {
+        PVal* key = c.lhs;
+        bool hit = (c.tag == 0 && c.outcome);
+        bool found = false;
+        for (auto& entry : by_lhs)
+          if (entry.first == key) {
+            entry.second = entry.second || hit;
+            found = true;
+            break;
+          }
+        if (!found) by_lhs.emplace_back(key, hit);
+      }
+      for (const auto& entry : by_lhs)
+        statuses.emplace_back(QR::resolved(entry.first),
+                              entry.second ? ST_PASS : ST_FAIL);
+    } else {
+      // _report_all_values (evaluator.py:825-867)
+      for (const LCmp& c : r) {
+        bool ok = (c.tag == 0 && c.outcome);
+        statuses.emplace_back(QR::resolved(c.lhs), ok ? ST_PASS : ST_FAIL);
+      }
+    }
+  }
+  return statuses;
+}
+
+// ---------------------------------------------------------------------------
+// Clause / block / rule evaluation (evaluator.py:926-1634;
+// eval.rs:1078-2065) — statuses only.
+// ---------------------------------------------------------------------------
+int eval_when_clause(Clause* c, Resolver* resolver);
+int eval_rule_clause(Clause* c, Resolver* resolver);
+
+int eval_guard_access_clause(Clause* gac, Resolver* resolver) {
+  bool all_match = gac->query->match_all;
+  OpResult statuses;
+  if (cmp_is_unary(gac->cmp)) {
+    statuses = unary_operation(gac->query->parts, gac->cmp, gac->inv, gac->neg, resolver);
+  } else {
+    if (!gac->cw)
+      throw NotComparable("GuardAccessClause did not have a RHS for compare operation");
+    std::vector<QR> rhs;
+    switch (gac->cw->tag) {
+      case LV_PV: rhs = {QR::literal(gac->cw->pv)}; break;
+      case LV_QUERY: rhs = resolver->query(gac->cw->q->parts); break;
+      default:
+        rhs = resolve_function(gac->cw->fn->name, gac->cw->fn->params, resolver);
+    }
+    statuses = binary_operation(gac->query->parts, rhs, gac->cmp,
+                                gac->inv != false ? gac->inv : false, resolver);
+    // note: negation (`not <clause>`) applies through operator_compare's
+    // `negated` only for unary ops in the reference; binary clauses fold
+    // `!`/`not` into comparator_inverse at parse time and `negation`
+    // stays false — mirrored from evaluator.py:932-975 where binary ops
+    // receive cmp=(op, inverse) and unary ops receive `inverse=negation`
+  }
+  if (statuses.empty) return statuses.empty_status;
+  int fails = 0, passes = 0;
+  for (const auto& vs : statuses.statuses) {
+    if (vs.second == ST_FAIL) fails++;
+    else if (vs.second == ST_PASS) passes++;
+  }
+  if (all_match) return fails > 0 ? ST_FAIL : ST_PASS;
+  return passes > 0 ? ST_PASS : ST_FAIL;
+}
+
+int eval_guard_named_clause(Clause* gnc, Resolver* resolver) {
+  // evaluator.py:1017-1061 (eval.rs:1227-1289)
+  int status = resolver->rule_status(gnc->rule);
+  if (status == ST_PASS) return gnc->neg ? ST_FAIL : ST_PASS;
+  return gnc->neg ? ST_PASS : ST_FAIL;
+}
+
+int eval_general_block_clause(const std::vector<Assign>& assigns, const Conj& conj,
+                              Resolver* resolver, int (*eval_fn)(Clause*, Resolver*)) {
+  BlockScope scope(assigns, resolver->root(), resolver);
+  return eval_conjunction_clauses(conj, &scope, eval_fn);
+}
+
+int eval_guard_block_clause(Clause* bc, Resolver* resolver) {
+  // evaluator.py:1075-1164 (eval.rs:1303-1426)
+  bool match_all = bc->query->match_all;
+  std::vector<QR> block_values = resolver->query(bc->query->parts);
+  if (block_values.empty()) return bc->not_empty ? ST_FAIL : ST_SKIP;
+  int fails = 0, passes = 0;
+  for (const QR& each : block_values) {
+    if (each.tag == T_UNRESOLVED) { fails++; continue; }
+    ValueScope vs(each.value, resolver);
+    int status = eval_general_block_clause(bc->assigns, bc->conj, &vs, eval_guard_clause);
+    if (status == ST_PASS) passes++;
+    else if (status == ST_FAIL) fails++;
+  }
+  if (match_all)
+    return fails > 0 ? ST_FAIL : (passes > 0 ? ST_PASS : ST_SKIP);
+  return passes > 0 ? ST_PASS : (fails > 0 ? ST_FAIL : ST_SKIP);
+}
+
+int eval_when_condition_block(const Conj& conditions, const std::vector<Assign>& assigns,
+                              const Conj& conj, Resolver* resolver) {
+  // evaluator.py:1167-1221 (eval.rs:1428-1502)
+  int status = eval_conjunction_clauses(conditions, resolver, eval_when_clause);
+  if (status != ST_PASS) return ST_SKIP;
+  return eval_general_block_clause(assigns, conj, resolver, eval_guard_clause);
+}
+
+// _ResolvedParameterContext (evaluator.py:1224-1269; eval.rs:1504-1572)
+struct ResolvedParameterContext : Resolver {
+  std::unordered_map<std::string, std::vector<QR>> resolved;
+  Resolver* parent;
+
+  explicit ResolvedParameterContext(Resolver* p) : parent(p) {}
+
+  std::vector<QR> query(const std::vector<Part*>& parts) override {
+    return parent->query(parts);
+  }
+  PVal* root() override { return parent->root(); }
+  ParamRuleC* find_param_rule(const std::string& name) override {
+    return parent->find_param_rule(name);
+  }
+  int rule_status(const std::string& name) override { return parent->rule_status(name); }
+  std::vector<QR> resolve_variable(const std::string& name) override {
+    auto it = resolved.find(name);
+    if (it != resolved.end()) return it->second;
+    return parent->resolve_variable(name);
+  }
+  void add_capture(const std::string& name, PVal* key) override {
+    parent->add_capture(name, key);
+  }
+  EvalState* state() override { return parent->state(); }
+};
+
+int eval_parameterized_rule_call(Clause* call, Resolver* resolver) {
+  // evaluator.py:1272-1293 (eval.rs:1574-1618)
+  ParamRuleC* pr = resolver->find_param_rule(call->named->rule);
+  if (pr->params.size() != call->params.size())
+    throw GuardErr("Arity mismatch for called parameter rule " + call->named->rule);
+  ResolvedParameterContext ctx(resolver);
+  for (size_t idx = 0; idx < call->params.size(); idx++) {
+    LetValue* each = call->params[idx];
+    const std::string& name = pr->params[idx];
+    switch (each->tag) {
+      case LV_PV: ctx.resolved[name] = {QR::resolved(each->pv)}; break;
+      case LV_QUERY: ctx.resolved[name] = resolver->query(each->q->parts); break;
+      default:
+        ctx.resolved[name] = resolve_function(each->fn->name, each->fn->params, resolver);
+    }
+  }
+  return eval_rule(pr->rule, &ctx);
+}
+
+int eval_guard_clause(Clause* c, Resolver* resolver) {
+  // evaluator.py:1296-1310 (eval.rs:1620-1636)
+  switch (c->t) {
+    case CL_ACCESS: return eval_guard_access_clause(c, resolver);
+    case CL_NAMED: return eval_guard_named_clause(c, resolver);
+    case CL_BLOCK: return eval_guard_block_clause(c, resolver);
+    case CL_WHEN:
+      return eval_when_condition_block(c->conditions, c->assigns, c->conj, resolver);
+    case CL_CALL: return eval_parameterized_rule_call(c, resolver);
+    default: throw GuardErr("Unknown guard clause");
+  }
+}
+
+int eval_when_clause(Clause* c, Resolver* resolver) {
+  // evaluator.py:1313-1321 (eval.rs:1638-1647)
+  switch (c->t) {
+    case CL_ACCESS: return eval_guard_access_clause(c, resolver);
+    case CL_NAMED: return eval_guard_named_clause(c, resolver);
+    case CL_CALL: return eval_parameterized_rule_call(c, resolver);
+    default: throw GuardErr("Unknown when clause");
+  }
+}
+
+int eval_type_block_clause(Clause* tb, Resolver* resolver) {
+  // evaluator.py:1324-1461 (eval.rs:1649-1822)
+  if (tb->has_conditions) {
+    int status = eval_conjunction_clauses(tb->conditions, resolver, eval_when_clause);
+    if (status != ST_PASS) return ST_SKIP;
+  }
+  std::vector<QR> values = resolver->query(tb->tb_query);
+  if (values.empty()) return ST_SKIP;
+  int fails = 0, passes = 0;
+  for (const QR& each : values) {
+    if (each.tag == T_UNRESOLVED)
+      throw GuardErr("Unable to resolve type block query: " + tb->type_name);
+    ValueScope vs(each.value, resolver);
+    int status = eval_general_block_clause(tb->assigns, tb->conj, &vs, eval_guard_clause);
+    if (status == ST_PASS) passes++;
+    else if (status == ST_FAIL) fails++;
+  }
+  return fails > 0 ? ST_FAIL : (passes > 0 ? ST_PASS : ST_SKIP);
+}
+
+int eval_rule_clause(Clause* c, Resolver* resolver) {
+  // evaluator.py:1464-1472 (eval.rs:1824-1835)
+  if (c->t == CL_TYPE_BLOCK) return eval_type_block_clause(c, resolver);
+  if (c->t == CL_WHEN)
+    return eval_when_condition_block(c->conditions, c->assigns, c->conj, resolver);
+  return eval_guard_clause(c, resolver);
+}
+
+int eval_rule(RuleC* rule, Resolver* resolver) {
+  // evaluator.py:1475-1530 (eval.rs:1837-1906)
+  if (rule->has_conditions) {
+    int status = eval_conjunction_clauses(rule->conditions, resolver, eval_when_clause);
+    if (status != ST_PASS) return ST_SKIP;
+  }
+  BlockScope scope(rule->assigns, resolver->root(), resolver);
+  return eval_conjunction_clauses(rule->conj, &scope, eval_rule_clause);
+}
+
+int eval_conjunction_clauses(const Conj& conjunctions, Resolver* resolver,
+                             int (*eval_fn)(Clause*, Resolver*)) {
+  // evaluator.py:1567-1634 (eval.rs:1971-2065)
+  int num_passes = 0, num_fails = 0;
+  for (const auto& conjunction : conjunctions) {
+    int disjunction_fails = 0;
+    bool passed = false;
+    for (Clause* disjunction : conjunction) {
+      int status = eval_fn(disjunction, resolver);
+      if (status == ST_PASS) {
+        num_passes++;
+        passed = true;
+        break;
+      }
+      if (status == ST_FAIL) disjunction_fails++;
+    }
+    if (passed) continue;
+    if (disjunction_fails > 0) num_fails++;
+  }
+  if (num_fails > 0) return ST_FAIL;
+  if (num_passes > 0) return ST_PASS;
+  return ST_SKIP;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+extern "C" {
+
+struct OracleHandle {
+  Engine eng;
+};
+
+static char* dup_msg(const std::string& s) {
+  char* out = static_cast<char*>(malloc(s.size() + 1));
+  memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+void* guard_oracle_compile(const char* ast_json, char** err_out) {
+  if (err_out) *err_out = nullptr;
+  auto* h = new OracleHandle();
+  try {
+    JParser p{ast_json, ast_json + strlen(ast_json)};
+    JValue j = p.parse();
+    engine_from_wire(j, h->eng);
+    return h;
+  } catch (const GuardErr& e) {
+    if (err_out) *err_out = dup_msg("error: " + e.msg);
+  } catch (const Unsupported& e) {
+    if (err_out) *err_out = dup_msg("unsupported: " + e.msg);
+  } catch (const std::exception& e) {
+    if (err_out) *err_out = dup_msg(std::string("error: ") + e.what());
+  }
+  delete h;
+  return nullptr;
+}
+
+// Evaluate one document. Writes one status (0 PASS / 1 FAIL / 2 SKIP)
+// per guard rule in file order; returns the rule count, or -1 with
+// *err_out set ("unsupported: ..." means fall back to the Python
+// oracle; "error: ..." mirrors a Python-side GuardError).
+static int32_t eval_doc_modes(void* handle, const char* doc_text, bool raw,
+                              int32_t* statuses_out, int32_t cap, char** err_out) {
+  if (err_out) *err_out = nullptr;
+  auto* h = static_cast<OracleHandle*>(handle);
+  try {
+    EvalState st;
+    st.eng = &h->eng;
+    DocParser dp{doc_text, doc_text + strlen(doc_text), 0, &st.arena};
+    PVal* doc = raw ? dp.raw() : dp.compact();
+    dp.ws();
+    if (dp.p != dp.end) throw GuardErr("doc: trailing data");
+    RootScope scope(&h->eng, doc, &st);
+    int32_t n = static_cast<int32_t>(h->eng.rules.size());
+    if (n > cap) throw GuardErr("status buffer too small");
+    for (int32_t i = 0; i < n; i++)
+      statuses_out[i] = eval_rule(h->eng.rules[static_cast<size_t>(i)], &scope);
+    return n;
+  } catch (const Unsupported& e) {
+    if (err_out) *err_out = dup_msg("unsupported: " + e.msg);
+  } catch (const GuardErr& e) {
+    if (err_out) *err_out = dup_msg("error: " + e.msg);
+  } catch (const NotComparable& e) {
+    if (err_out) *err_out = dup_msg("error: " + e.msg);
+  } catch (const std::exception& e) {
+    if (err_out) *err_out = dup_msg(std::string("error: ") + e.what());
+  }
+  return -1;
+}
+
+// compact-wire documents (ast_serde.doc_to_compact)
+int32_t guard_oracle_eval(void* handle, const char* doc_json, int32_t* statuses_out,
+                          int32_t cap, char** err_out) {
+  return eval_doc_modes(handle, doc_json, false, statuses_out, cap, err_out);
+}
+
+// raw JSON documents (data-file content, loader scalar typing)
+int32_t guard_oracle_eval_raw(void* handle, const char* doc_json,
+                              int32_t* statuses_out, int32_t cap, char** err_out) {
+  return eval_doc_modes(handle, doc_json, true, statuses_out, cap, err_out);
+}
+
+void guard_oracle_free(void* handle) { delete static_cast<OracleHandle*>(handle); }
+
+void guard_oracle_free_str(char* s) { free(s); }
+
+}  // extern "C"
