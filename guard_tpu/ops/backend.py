@@ -173,6 +173,40 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
             "%s: %d/%d rules lowered to device kernels (%d host-fallback)",
             rule_file.name, n_dev, n_dev + n_host, n_host,
         )
+
+        # native statuses oracle (native/oracle.cpp): the compiled-
+        # engine prefilter. When rich reports aren't required it
+        # answers host-rule/unsure/oversized-doc statuses at native
+        # speed, and pre-filters which failing docs actually need the
+        # rich Python rerun — the Python oracle runs only for those.
+        rich_mode = validate.structured or validate.verbose or validate.print_json
+        native = None
+        if not rich_mode:
+            from .native_oracle import (
+                NativeEvalError,
+                NativeOracle,
+                NativeUnsupported,
+                overall_status,
+            )
+
+            try:
+                native = NativeOracle(rule_file.rules)
+            except NativeUnsupported:
+                native = None
+        guard_rule_names = [r.rule_name for r in rule_file.rules.guard_rules]
+
+        def _merge_native(raw_statuses):
+            """Same-name merge as the report layer (non-SKIP beats
+            SKIP, FAIL dominates)."""
+            merged = {}
+            for name, s in zip(guard_rule_names, raw_statuses):
+                st = _STATUS[s]
+                prev = merged.get(name)
+                if prev is None or (prev == Status.SKIP and st != Status.SKIP):
+                    merged[name] = st
+                elif st == Status.FAIL:
+                    merged[name] = Status.FAIL
+            return merged
         statuses = None
         unsure = None
         if compiled.rules:
@@ -222,7 +256,32 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                     )
                 )
             )
-            doc_infos.append((rule_statuses, unsure_rules, doc_status))
+            native_statuses = None
+            if need_oracle and native is not None:
+                try:
+                    raw_ok = (
+                        not validate.input_params
+                        and data_file.content.lstrip()[:1] in ("{", "[")
+                    )
+                    raw = (
+                        native.eval_raw_json(data_file.content)
+                        if raw_ok
+                        else native.eval_doc(data_file.path_value)
+                    )
+                    native_statuses = (
+                        _merge_native(raw),
+                        _STATUS[overall_status(raw)],
+                    )
+                    if statuses_only or native_statuses[1] != Status.FAIL:
+                        # statuses suffice: no Python rerun for this doc
+                        need_oracle = False
+                except (NativeUnsupported, NativeEvalError):
+                    # declined, or the evaluation error Python raises —
+                    # the Python path reproduces either faithfully
+                    native_statuses = None
+            doc_infos.append(
+                (rule_statuses, unsure_rules, doc_status, native_statuses)
+            )
             if need_oracle:
                 oracle_dis.append(di)
 
@@ -261,8 +320,21 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
         # where available and the inline oracle otherwise
         oracle_set = set(oracle_dis)
         for di, data_file in enumerate(data_files):
-            rule_statuses, unsure_rules, doc_status = doc_infos[di]
+            (rule_statuses, unsure_rules, doc_status, native_statuses) = doc_infos[di]
             need_oracle = di in oracle_set
+            if native_statuses is not None and not need_oracle:
+                merged, n_doc_status = native_statuses
+                # device/native parity net (kernel-flagged unsure rules
+                # excepted — the oracle's answer is authoritative there)
+                for rn, st in rule_statuses.items():
+                    nst = merged.get(rn)
+                    if nst is not None and nst != st and rn not in unsure_rules:
+                        raise GuardError(
+                            f"TPU/native status divergence for rule {rn} on "
+                            f"{data_file.name}: tpu={st.value} native={nst.value}"
+                        )
+                rule_statuses = merged
+                doc_status = n_doc_status
             report = {
                 "name": data_file.name,
                 "metadata": {},
@@ -351,6 +423,9 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                     doc_status, rule_statuses, report, validate.show_summary,
                     validate.output_format,
                 )
+
+        if native is not None:
+            native.close()
 
     if validate.structured:
         if validate.output_format in ("json", "yaml"):

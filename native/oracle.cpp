@@ -656,6 +656,34 @@ std::string classify_pattern_pcre2(const std::string& pat, bool* icase) {
       }
       continue;
     }
+    if (c == '{') {
+      // python quantifier forms: {m} {m,} {m,n} and {,n} (== {0,n});
+      // pcre2 < 10.43 treats {,n} as LITERAL text, so rewrite it, and
+      // decline non-quantifier braces (literal-brace semantics are a
+      // version-dependent minefield)
+      size_t j = i + 1;
+      size_t m_start = j;
+      while (j < n && pat[j] >= '0' && pat[j] <= '9') j++;
+      bool has_m = j > m_start;
+      bool has_comma = j < n && pat[j] == ',';
+      size_t n_start = has_comma ? j + 1 : j;
+      size_t k = n_start;
+      while (k < n && pat[k] >= '0' && pat[k] <= '9') k++;
+      bool has_n = k > n_start;
+      size_t close = has_comma ? k : j;
+      if (close < n && pat[close] == '}' && (has_m || (has_comma && has_n))) {
+        out += "{";
+        out += has_m ? pat.substr(m_start, j - m_start) : std::string("0");
+        if (has_comma) {
+          out += ",";
+          if (has_n) out += pat.substr(n_start, k - n_start);
+        }
+        out += "}";
+        i = close;
+        continue;
+      }
+      throw Unsupported("literal brace");
+    }
     if (c == '(' && i + 1 < n && pat[i + 1] == '?') {
       size_t j = i + 2;
       if (j < n && (pat[j] == ':' || pat[j] == '=' || pat[j] == '!')) {
@@ -965,6 +993,11 @@ struct Assign {
 
 enum ClauseType { CL_ACCESS, CL_NAMED, CL_BLOCK, CL_WHEN, CL_CALL, CL_TYPE_BLOCK };
 
+struct Loc {
+  long long line = 0, col = 0;
+  std::string file;
+};
+
 struct Clause {
   int t = CL_ACCESS;
   // access
@@ -985,6 +1018,11 @@ struct Clause {
   bool has_conditions = false;
   std::string type_name;
   std::vector<Part*> tb_query;
+  // records: custom message + source location (exprs.py AccessClause /
+  // GuardNamedRuleClause / BlockGuardClause fields)
+  bool has_msg = false;
+  std::string msg;
+  Loc loc;
 };
 
 struct RuleC {
@@ -1402,6 +1440,17 @@ std::vector<Assign> assigns_from_wire(const JValue& j, Engine& eng) {
   return out;
 }
 
+void read_msg_loc(const JValue& j, Clause* c) {
+  if (const JValue* m = j.get("msg")) {
+    if (!m->is_null()) { c->has_msg = true; c->msg = m->str(); }
+  }
+  if (const JValue* l = j.get("loc")) {
+    c->loc.line = l->at("line").as_int();
+    c->loc.col = l->at("col").as_int();
+    c->loc.file = l->at("file").str();
+  }
+}
+
 Clause* clause_from_wire(const JValue& j, Engine& eng) {
   Clause* c = eng.ncl();
   const std::string& t = j.at("t").str();
@@ -1413,16 +1462,19 @@ Clause* clause_from_wire(const JValue& j, Engine& eng) {
     c->neg = j.at("neg").as_bool();
     const JValue& cw = j.at("cw");
     if (!cw.is_null()) c->cw = lv_from_wire(cw, eng);
+    read_msg_loc(j, c);
   } else if (t == "named") {
     c->t = CL_NAMED;
     c->rule = j.at("rule").str();
     c->neg = j.at("neg").as_bool();
+    read_msg_loc(j, c);
   } else if (t == "block") {
     c->t = CL_BLOCK;
     c->query = query_from_wire(j.at("query"), eng);
     c->assigns = assigns_from_wire(j.at("assignments"), eng);
     c->conj = conj_from_wire(j.at("conj"), eng);
     c->not_empty = j.at("not_empty").as_bool();
+    read_msg_loc(j, c);
   } else if (t == "when") {
     c->t = CL_WHEN;
     c->conditions = conj_from_wire(j.at("conditions"), eng);
@@ -1499,6 +1551,192 @@ void engine_from_wire(const JValue& j, Engine& eng) {
 }  // namespace
 
 namespace {
+
+// ---------------------------------------------------------------------------
+// Display / debug renderings (exprs.py display fns, values.py
+// value_only_display / rust_debug_pv / _rust_num, Path.disp) — records
+// embed these strings and reporters pin them byte-for-byte.
+// ---------------------------------------------------------------------------
+const char* CMP_DISPLAY[] = {
+    "EQUALS", "IN", "GREATER THAN", "LESS THAN", "LESS THAN EQUALS",
+    "GREATER THAN EQUALS", "EXISTS", "EMPTY", "IS STRING", "IS LIST",
+    "IS MAP", "IS BOOL", "IS INT", "IS FLOAT", "IS NULL",
+};
+
+const char* CMP_NAME[] = {
+    "Eq", "In", "Gt", "Lt", "Le", "Ge", "Exists", "Empty", "IsString",
+    "IsList", "IsMap", "IsBool", "IsInt", "IsFloat", "IsNull",
+};
+
+std::string format_float(double f);
+
+std::string rust_num_f(double v) {
+  // values.py _rust_num float path
+  if (v != v) return "NaN";
+  if (v == 1.0 / 0.0) return "inf";
+  if (v == -1.0 / 0.0) return "-inf";
+  return format_float(v);
+}
+
+std::string rust_num_i(long long v) { return std::to_string(v); }
+
+std::string path_disp(const PVal& pv) {
+  // Path.disp (values.py:103-106): "{path}[L:{l},C:{c}]"
+  return pv.path + "[L:" + std::to_string(pv.line) + ",C:" +
+         std::to_string(pv.col) + "]";
+}
+
+std::string loc_str(const Loc& l) {
+  // FileLocation __str__ (exprs.py:87-88)
+  return "Location[file:" + l.file + ", line:" + std::to_string(l.line) +
+         ", column:" + std::to_string(l.col) + "]";
+}
+
+std::string value_only_display(const PVal& pv) {
+  // values.py:517-547 (display.rs:42-99)
+  switch (pv.kind) {
+    case K_NULL: return "\"NULL\"";
+    case K_STRING: return "\"" + pv.s + "\"";
+    case K_REGEX: return "\"/" + pv.s + "/\"";
+    case K_CHAR: return "'" + pv.s + "'";
+    case K_BOOL: return pv.b ? "true" : "false";
+    case K_INT: return rust_num_i(pv.i);
+    case K_FLOAT: return rust_num_f(pv.f);
+    case K_LIST: {
+      std::string out = "[";
+      bool first = true;
+      for (PVal* e : pv.list) {
+        if (!first) out += ",";
+        out += value_only_display(*e);
+        first = false;
+      }
+      return out + "]";
+    }
+    case K_MAP: {
+      std::string out = "{";
+      bool first = true;
+      for (const auto& e : pv.entries) {
+        if (!first) out += ",";
+        out += "\"" + e.first->s + "\":" + value_only_display(*e.second);
+        first = false;
+      }
+      return out + "}";
+    }
+    default: {
+      std::string lo = (pv.inc & LOWER_INCLUSIVE) ? "[" : "(";
+      std::string hi = (pv.inc & UPPER_INCLUSIVE) ? "]" : ")";
+      std::string a, b;
+      if (pv.kind == K_RANGE_INT) { a = rust_num_i(pv.ri_lo); b = rust_num_i(pv.ri_hi); }
+      else if (pv.kind == K_RANGE_FLOAT) { a = rust_num_f(pv.rf_lo); b = rust_num_f(pv.rf_hi); }
+      else { a = pv.rs_lo; b = pv.rs_hi; }
+      return lo + a + "," + b + hi;
+    }
+  }
+}
+
+std::string rust_debug_pv(const PVal& pv) {
+  // values.py:550-585 — Rust derive(Debug) rendering
+  std::string path = "Path(\"" + pv.path + "\", Location { line: " +
+                     std::to_string(pv.line) + ", col: " + std::to_string(pv.col) +
+                     " })";
+  switch (pv.kind) {
+    case K_STRING: return "String((" + path + ", \"" + pv.s + "\"))";
+    case K_REGEX: return "Regex((" + path + ", \"" + pv.s + "\"))";
+    case K_CHAR: return "Char((" + path + ", '" + pv.s + "'))";
+    case K_BOOL: return "Bool((" + path + ", " + (pv.b ? "true" : "false") + "))";
+    case K_INT: return "Int((" + path + ", " + std::to_string(pv.i) + "))";
+    case K_FLOAT: {
+      double f = pv.f;
+      if (f != f || f == 1.0 / 0.0 || f == -1.0 / 0.0)
+        return "Float((" + path + ", " + rust_num_f(f) + "))";
+      if (f < 1e16 && f > -1e16 && f == static_cast<long long>(f))
+        return "Float((" + path + ", " + rust_num_f(f) + ".0))";
+      // python embeds str(pv.val) == repr for non-integral floats
+      return "Float((" + path + ", " + format_float(f) + "))";
+    }
+    case K_NULL: return "Null(" + path + ")";
+    case K_LIST: {
+      std::string inner;
+      bool first = true;
+      for (PVal* e : pv.list) {
+        if (!first) inner += ", ";
+        inner += rust_debug_pv(*e);
+        first = false;
+      }
+      return "List((" + path + ", [" + inner + "]))";
+    }
+    case K_MAP: {
+      std::string entries;
+      bool first = true;
+      for (const auto& e : pv.entries) {
+        if (!first) entries += ", ";
+        entries += "\"" + e.first->s + "\": " + rust_debug_pv(*e.second);
+        first = false;
+      }
+      return "Map((" + path + ", MapValue { values: {" + entries + "} }))";
+    }
+    default: return "PV(range)";
+  }
+}
+
+std::string display_part(const Part* p) {
+  switch (p->type) {
+    case P_THIS: return "_";
+    case P_KEY: return p->name;
+    case P_ALL_VALUES: return "*";
+    case P_ALL_INDICES: return "[*]";
+    case P_INDEX: return std::to_string(p->index);
+    case P_FILTER:
+      return (p->has_name ? p->name : std::string()) + " (filter-clauses)";
+    default:
+      return (p->has_name ? p->name : std::string()) + " (map-key-filter-clauses)";
+  }
+}
+
+std::string display_query(const std::vector<Part*>& parts, size_t from = 0) {
+  // exprs.py display_query: ".".join then ".[" -> "["
+  std::string joined;
+  for (size_t i = from; i < parts.size(); i++) {
+    if (i > from) joined += ".";
+    joined += display_part(parts[i]);
+  }
+  std::string out;
+  for (size_t i = 0; i < joined.size(); i++) {
+    if (joined[i] == '.' && i + 1 < joined.size() && joined[i + 1] == '[') continue;
+    out.push_back(joined[i]);
+  }
+  return out;
+}
+
+std::string display_let_value(const LetValue* lv);
+
+std::string display_fn(const FnExpr* fn) {
+  std::string out = fn->name + "(";
+  bool first = true;
+  for (LetValue* p : fn->params) {
+    if (!first) out += ", ";
+    out += display_let_value(p);
+    first = false;
+  }
+  return out + ")";
+}
+
+std::string display_let_value(const LetValue* lv) {
+  switch (lv->tag) {
+    case LV_PV: return value_only_display(*lv->pv);
+    case LV_QUERY: return display_query(lv->q->parts);
+    default: return display_fn(lv->fn);
+  }
+}
+
+std::string display_access_clause(const Clause* gac) {
+  // exprs.py GuardAccessClause.display (byte-pinned double spaces)
+  std::string lead = gac->neg ? "not" : "";
+  std::string cmp_not = gac->inv ? "not " : "";
+  std::string rhs = gac->cw ? display_let_value(gac->cw) : "";
+  return lead + " " + display_query(gac->query->parts) + " " + cmp_not +
+         CMP_DISPLAY[gac->cmp] + "  " + rhs;
+}
 
 // ---------------------------------------------------------------------------
 // Query results + status lattice (guard_tpu/core/qresult.py; mod.rs:88-185)

@@ -1,0 +1,137 @@
+"""The native statuses oracle as the backend prefilter (VERDICT r3
+item 2 integration): host-fallback rules and passing documents must be
+settled by the C++ engine with ZERO Python-oracle reruns, and failing
+documents must reach the Python oracle only when rich reports are
+actually wanted."""
+
+import json
+
+import pytest
+
+import guard_tpu.ops.backend as backend_mod
+from guard_tpu.cli import run
+from guard_tpu.ops.native_oracle import build_native, native_available
+from guard_tpu.utils.io import Reader, Writer
+
+# one lowerable rule + one host-only rule (per-origin inline call keeps
+# `upper` on the CPU oracle — ir.HOST_ONLY_CONSTRUCTS)
+RULES = """\
+rule sse when Resources exists {
+    Resources.*.Properties.Enc == true
+}
+rule upper when Resources exists {
+    Resources.* { Name == to_lower(Name) }
+}
+"""
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _built():
+    assert build_native(), "native oracle failed to build"
+    assert native_available()
+
+
+def _mk_corpus(tmp_path, n, fail_every):
+    rules = tmp_path / "r.guard"
+    rules.write_text(RULES)
+    data = tmp_path / "data"
+    data.mkdir()
+    n_fail = 0
+    for i in range(n):
+        fail = fail_every and (i % fail_every == 0)
+        n_fail += bool(fail)
+        (data / f"t{i:03d}.json").write_text(json.dumps({
+            "Resources": {
+                "b": {
+                    "Name": "ok",
+                    "Properties": {"Enc": not fail},
+                }
+            }
+        }))
+    return rules, data, n_fail
+
+
+def _run_counting(monkeypatch, args):
+    calls = {"n": 0}
+    real = backend_mod.eval_rules_file
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(backend_mod, "eval_rules_file", counting)
+    w = Writer.buffered()
+    rc = run(args, writer=w, reader=Reader())
+    return rc, calls["n"], w.out.getvalue()
+
+
+def test_host_rules_all_pass_needs_zero_python(tmp_path, monkeypatch):
+    rules, data, _ = _mk_corpus(tmp_path, 8, fail_every=0)
+    rc, n_python, out = _run_counting(monkeypatch, [
+        "validate", "-r", str(rules), "-d", str(data), "--backend", "tpu",
+    ])
+    assert rc == 0, out
+    # the host-only rule used to force a Python rerun for EVERY doc;
+    # the native engine settles all of them
+    assert n_python == 0
+
+
+def test_host_rule_failure_detected_natively(tmp_path, monkeypatch):
+    # the FAILING rule is the host-only one: its status must come from
+    # the native engine (device kernels never see it), zero Python
+    rules = tmp_path / "r.guard"
+    rules.write_text(RULES)
+    data = tmp_path / "data"
+    data.mkdir()
+    (data / "t.json").write_text(json.dumps({
+        "Resources": {"b": {"Name": "UPPER", "Properties": {"Enc": True}}}
+    }))
+    rc, n_python, out = _run_counting(monkeypatch, [
+        "validate", "-r", str(rules), "-d", str(data), "--backend", "tpu",
+        "--statuses-only",
+    ])
+    assert rc == 19, out
+    assert n_python == 0
+
+
+def test_statuses_only_needs_zero_python_even_failing(tmp_path, monkeypatch):
+    rules, data, n_fail = _mk_corpus(tmp_path, 8, fail_every=2)
+    assert n_fail > 0
+    rc, n_python, out = _run_counting(monkeypatch, [
+        "validate", "-r", str(rules), "-d", str(data), "--backend", "tpu",
+        "--statuses-only",
+    ])
+    assert rc == 19, out
+    assert n_python == 0
+
+
+def test_failing_docs_get_python_only_for_reports(tmp_path, monkeypatch):
+    rules, data, n_fail = _mk_corpus(tmp_path, 8, fail_every=2)
+    rc, n_python, out = _run_counting(monkeypatch, [
+        "validate", "-r", str(rules), "-d", str(data), "--backend", "tpu",
+    ])
+    assert rc == 19, out
+    # rich reports: exactly the failing docs hit the Python oracle
+    assert n_python == n_fail
+
+
+def test_output_identical_with_and_without_native(tmp_path, monkeypatch):
+    rules, data, _ = _mk_corpus(tmp_path, 6, fail_every=3)
+    args = ["validate", "-r", str(rules), "-d", str(data), "--backend", "tpu"]
+
+    w1 = Writer.buffered()
+    rc1 = run(args, writer=w1, reader=Reader())
+
+    # disable the native path: statuses must come out identical
+    from guard_tpu.ops.native_oracle import NativeUnsupported
+
+    def refuse(rf):
+        raise NativeUnsupported("disabled for differential")
+
+    import guard_tpu.ops.native_oracle as no_mod
+
+    monkeypatch.setattr(no_mod, "NativeOracle", refuse)
+    w2 = Writer.buffered()
+    rc2 = run(args, writer=w2, reader=Reader())
+    assert rc1 == rc2
+    assert w1.out.getvalue() == w2.out.getvalue()
