@@ -251,28 +251,232 @@ def rules_file_to_json(rf: RulesFile) -> str:
     return json.dumps(rules_file_to_wire(rf), ensure_ascii=False)
 
 
-def _pv_to_compact(pv: PV):
+def doc_to_json(doc: PV) -> str:
+    """Records-mode document wire: full paths + source locations (the
+    record tree embeds them in reasons and report locations)."""
+    return json.dumps(pv_to_wire(doc), ensure_ascii=False)
+
+
+def pv_from_wire(d: dict) -> PV:
+    """Inverse of pv_to_wire — rebuilds PVs emitted by the native
+    engine's record tree."""
+    from .values import Location, MapValue, Path, Range
+
+    p = d.get("p")
+    path = Path(p[0], Location(p[1], p[2])) if p else Path.root()
+    k = d["k"]
+    if k == NULL:
+        return PV(path, k, None)
+    if k in (STRING, REGEX, CHAR):
+        return PV(path, k, d["s"])
+    if k == BOOL:
+        return PV(path, k, d["b"])
+    if k == INT:
+        return PV(path, k, d["i"])
+    if k == FLOAT:
+        return PV(path, k, float(d["f"]))
+    if k == LIST:
+        return PV(path, k, [pv_from_wire(e) for e in d["items"]])
+    if k == MAP:
+        mv = MapValue()
+        for key_d, val_d in d["entries"]:
+            key_pv = pv_from_wire(key_d)
+            mv.keys.append(key_pv)
+            mv.values[key_pv.val] = pv_from_wire(val_d)
+        return PV(path, k, mv)
+    if k in (RANGE_INT, RANGE_FLOAT, RANGE_CHAR):
+        return PV(path, k, Range(d["lo"], d["hi"], d["inc"]))
+    raise Unserializable(f"unknown wire kind {k}")
+
+
+def records_from_wire(text: str):
+    """Rebuild the EventRecord tree emitted by the native engine's
+    records mode (native/oracle.cpp rec_json) so commands/report.py
+    consumes it exactly as it consumes the Python evaluator's tree."""
+    from .exprs import CmpOperator
+    from .qresult import QueryResult, Status, UnResolved
+    from .records import (
+        BlockCheck,
+        ClauseCheck,
+        ComparisonClauseCheck,
+        EventRecord,
+        InComparisonCheck,
+        MissingValueCheck,
+        NamedStatus,
+        RecordType,
+        TypeBlockCheck,
+        UnaryValueCheck,
+        ValueCheck,
+    )
+
+    STATUS = {0: Status.PASS, 1: Status.FAIL, 2: Status.SKIP}
+
+    def qr(d):
+        t = d["t"]
+        if t == "ur":
+            return QueryResult.unresolved_(
+                UnResolved(pv_from_wire(d["to"]), d["rem"], d["reason"])
+            )
+        pv = pv_from_wire(d["pv"])
+        return QueryResult.literal(pv) if t == "lit" else QueryResult.resolved(pv)
+
+    def cmp_of(p):
+        return (CmpOperator(p["cmp"][0]), p["cmp"][1])
+
+    def clause_check(p):
+        cc = p["cc"]
+        if cc == ClauseCheck.SUCCESS:
+            return ClauseCheck.success()
+        if cc == ClauseCheck.NO_VALUE_FOR_EMPTY:
+            return ClauseCheck.no_value_for_empty(p["custom"])
+        if cc == ClauseCheck.COMPARISON:
+            return ClauseCheck.comparison(
+                ComparisonClauseCheck(
+                    comparison=cmp_of(p),
+                    from_=qr(p["from"]),
+                    to=None if p["to"] is None else qr(p["to"]),
+                    status=STATUS[p["status"]],
+                    message=p["msg"],
+                    custom_message=p["custom"],
+                )
+            )
+        if cc == ClauseCheck.IN_COMPARISON:
+            return ClauseCheck.in_comparison(
+                InComparisonCheck(
+                    comparison=cmp_of(p),
+                    from_=qr(p["from"]),
+                    to=[qr(e) for e in p["to_list"]],
+                    status=STATUS[p["status"]],
+                    message=p["msg"],
+                    custom_message=p["custom"],
+                )
+            )
+        if cc == ClauseCheck.UNARY:
+            return ClauseCheck.unary(
+                UnaryValueCheck(
+                    value=ValueCheck(
+                        from_=qr(p["from"]),
+                        status=STATUS[p["status"]],
+                        message=p["msg"],
+                        custom_message=p["custom"],
+                    ),
+                    comparison=cmp_of(p),
+                )
+            )
+        if cc == ClauseCheck.DEPENDENT_RULE:
+            return ClauseCheck.dependent_rule(
+                MissingValueCheck(
+                    rule=p["rule"],
+                    status=STATUS[p["status"]],
+                    message=p["msg"],
+                    custom_message=p["custom"],
+                )
+            )
+        if cc == ClauseCheck.MISSING_BLOCK_VALUE:
+            return ClauseCheck.missing_block_value(
+                ValueCheck(
+                    from_=qr(p["from"]),
+                    status=STATUS[p["status"]],
+                    message=p["msg"],
+                    custom_message=p["custom"],
+                )
+            )
+        raise Unserializable(f"unknown clause check {cc}")
+
+    def record(d) -> EventRecord:
+        ev = EventRecord(context=d["c"])
+        k = d["k"]
+        if k is not None:
+            p = d.get("p", {})
+            if k in (RecordType.FILE_CHECK, RecordType.RULE_CHECK):
+                payload = NamedStatus(
+                    name=p["name"], status=STATUS[p["status"]], message=p["msg"]
+                )
+            elif k in (
+                RecordType.RULE_CONDITION,
+                RecordType.TYPE_CONDITION,
+                RecordType.TYPE_BLOCK,
+                RecordType.FILTER,
+                RecordType.WHEN_CONDITION,
+            ):
+                payload = STATUS[p["status"]]
+            elif k == RecordType.TYPE_CHECK:
+                payload = TypeBlockCheck(
+                    type_name=p["type_name"],
+                    block=BlockCheck(
+                        at_least_one_matches=p["alo"],
+                        status=STATUS[p["status"]],
+                        message=p["msg"],
+                    ),
+                )
+            elif k in (
+                RecordType.WHEN_CHECK,
+                RecordType.DISJUNCTION,
+                RecordType.BLOCK_GUARD_CHECK,
+                RecordType.GUARD_CLAUSE_BLOCK_CHECK,
+            ):
+                payload = BlockCheck(
+                    at_least_one_matches=p["alo"],
+                    status=STATUS[p["status"]],
+                    message=p["msg"],
+                )
+            elif k == RecordType.CLAUSE_VALUE_CHECK:
+                payload = clause_check(p)
+            else:
+                raise Unserializable(f"unknown record kind {k}")
+            ev.container = RecordType(k, payload)
+        ev.children = [record(ch) for ch in d["ch"]]
+        return ev
+
+    return record(json.loads(text))
+
+
+def _pv_to_compact(pv: PV, locs: bool):
     k = pv.kind
     if k == NULL:
-        return (0,)
-    if k in (STRING, REGEX, CHAR):
-        return (k, pv.val)
-    if k == BOOL:
-        return (3, bool(pv.val))
-    if k == INT:
-        return (4, _num(pv.val))
-    if k == FLOAT:
-        return (5, _num(float(pv.val)))
-    if k == LIST:
-        return (7, [_pv_to_compact(e) for e in pv.val])
-    if k == MAP:
+        head = (0,)
+    elif k in (STRING, REGEX, CHAR):
+        head = (k, pv.val)
+    elif k == BOOL:
+        head = (3, bool(pv.val))
+    elif k == INT:
+        head = (4, _num(pv.val))
+    elif k == FLOAT:
+        head = (5, _num(float(pv.val)))
+    elif k == LIST:
+        head = (7, [_pv_to_compact(e, locs) for e in pv.val])
+    elif k == MAP:
         mv = pv.val
-        return (8, [[kn.val, _pv_to_compact(mv.values[kn.val])] for kn in mv.keys])
-    raise Unserializable(f"kind {k} cannot appear in a document")
+        if locs:
+            head = (
+                8,
+                [
+                    [
+                        kn.val,
+                        kn.path.loc.line,
+                        kn.path.loc.col,
+                        _pv_to_compact(mv.values[kn.val], locs),
+                    ]
+                    for kn in mv.keys
+                ],
+            )
+        else:
+            head = (
+                8,
+                [[kn.val, _pv_to_compact(mv.values[kn.val], locs)] for kn in mv.keys],
+            )
+    else:
+        raise Unserializable(f"kind {k} cannot appear in a document")
+    if locs:
+        loc = pv.path.loc
+        return head + (loc.line, loc.col)
+    return head
 
 
-def doc_to_compact(doc: PV) -> str:
-    """Status-mode document wire: positional [kind, payload] arrays, no
-    paths/locations (statuses never read them) — about 3x leaner than
-    the rich wire and parsed by a dedicated direct scanner in C++."""
-    return json.dumps(_pv_to_compact(doc), ensure_ascii=False)
+def doc_to_compact(doc: PV, locs: bool = False) -> str:
+    """Document wire: positional [kind, payload] arrays, about 3x
+    leaner than the rich wire and parsed by a dedicated direct scanner
+    in C++. Statuses mode omits paths/locations entirely (C++ derives
+    paths); records mode (`locs=True`) appends per-node and per-key
+    line/col trailers so report locations match the loader's."""
+    return json.dumps(_pv_to_compact(doc, locs), ensure_ascii=False)

@@ -41,6 +41,15 @@ _WORKER_RULES: dict = {}
 _POOL_MIN_JOBS = 48
 
 
+def _looks_json(content: str) -> bool:
+    """First non-space byte sniff without copying the document."""
+    for ch in content[:256]:
+        if ch in " \t\r\n":
+            continue
+        return ch in "{["
+    return False
+
+
 def _oracle_pool_init(rule_texts) -> None:
     import os
 
@@ -217,6 +226,7 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
         statuses_only = getattr(validate, "statuses_only", False)
         doc_infos = []
         oracle_dis = []
+        native_declines = 0
         for di, data_file in enumerate(data_files):
             rule_statuses = {}
             unsure_rules = set()
@@ -256,18 +266,37 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                     )
                 )
             )
+            # native statuses can settle the doc only when statuses are
+            # what's missing (host rules / unsure / oversized docs, or
+            # statuses-only mode); a device-decided FAIL needing a rich
+            # report goes straight to the pass-B report path instead of
+            # paying a redundant statuses evaluation
+            needs_statuses = (
+                bool(compiled.host_rules)
+                or bool(unsure_rules)
+                or di in host_docs
+            )
             native_statuses = None
-            if need_oracle and native is not None:
-                try:
-                    raw_ok = (
-                        not validate.input_params
-                        and data_file.content.lstrip()[:1] in ("{", "[")
-                    )
-                    raw = (
-                        native.eval_raw_json(data_file.content)
-                        if raw_ok
-                        else native.eval_doc(data_file.path_value)
-                    )
+            if need_oracle and native is not None and (
+                needs_statuses or statuses_only
+            ):
+                raw = None
+                raw_ok = not validate.input_params and _looks_json(
+                    data_file.content
+                )
+                if raw_ok:
+                    try:
+                        raw = native.eval_raw_json(data_file.content)
+                    except (NativeUnsupported, NativeEvalError):
+                        # e.g. flow-style YAML that sniffs as JSON, or a
+                        # decline — the loaded-PV wire is authoritative
+                        raw = None
+                if raw is None:
+                    try:
+                        raw = native.eval_doc(data_file.path_value)
+                    except (NativeUnsupported, NativeEvalError):
+                        raw = None
+                if raw is not None:
                     native_statuses = (
                         _merge_native(raw),
                         _STATUS[overall_status(raw)],
@@ -275,10 +304,8 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                     if statuses_only or native_statuses[1] != Status.FAIL:
                         # statuses suffice: no Python rerun for this doc
                         need_oracle = False
-                except (NativeUnsupported, NativeEvalError):
-                    # declined, or the evaluation error Python raises —
-                    # the Python path reproduces either faithfully
-                    native_statuses = None
+                else:
+                    native_declines += 1
             doc_infos.append(
                 (rule_statuses, unsure_rules, doc_status, native_statuses)
             )
@@ -292,7 +319,8 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
         # --input-params docs keep the inline path.
         pooled_results = {}
         if (
-            len(oracle_dis) >= _POOL_MIN_JOBS
+            (native is None or native_declines >= _POOL_MIN_JOBS)
+            and len(oracle_dis) >= _POOL_MIN_JOBS
             and not validate.input_params
         ):
             import os
@@ -361,6 +389,50 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                     n for n, s in rule_statuses.items() if s == Status.PASS
                 ),
             }
+            if (
+                need_oracle
+                and native is not None
+                and not rich_mode
+                and di not in pooled_results
+            ):
+                # rich reports from the native engine, byte-identical to
+                # simplified_report_from_root over the Python evaluator's
+                # tree (tests/test_native_oracle.py corpus differential)
+                native_result = None
+                raw_ok = not validate.input_params and _looks_json(
+                    data_file.content
+                )
+                if raw_ok:
+                    try:
+                        native_result = native.eval_report_raw(
+                            data_file.content, data_file.name
+                        )
+                    except (NativeUnsupported, NativeEvalError):
+                        # possibly flow-style YAML sniffing as JSON —
+                        # retry from the loaded tree before giving up
+                        native_result = None
+                if native_result is None:
+                    try:
+                        native_result = native.eval_report(
+                            data_file.path_value, data_file.name
+                        )
+                    except (NativeUnsupported, NativeEvalError):
+                        # declined or errored: the Python path below
+                        # reproduces a genuine evaluation error
+                        native_result = None
+                if native_result is not None:
+                    report, oracle_rule_statuses, oracle_status = native_result
+                    for rn, st in rule_statuses.items():
+                        ost = oracle_rule_statuses.get(rn)
+                        if ost is not None and ost != st and rn not in unsure_rules:
+                            raise GuardError(
+                                f"TPU/native status divergence for rule {rn} on "
+                                f"{data_file.name}: tpu={st.value} "
+                                f"native={ost.value}"
+                            )
+                    rule_statuses = oracle_rule_statuses
+                    doc_status = oracle_status
+                    need_oracle = False
             if need_oracle:
                 if di in pooled_results:
                     (_key, st_val, p_report, p_statuses, err) = pooled_results[di]

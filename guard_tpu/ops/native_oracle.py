@@ -23,7 +23,13 @@ from __future__ import annotations
 import ctypes
 from typing import List, Optional
 
-from ..core.ast_serde import Unserializable, doc_to_compact, rules_file_to_json
+from ..core.ast_serde import (
+    Unserializable,
+    doc_to_compact,
+    doc_to_json,
+    records_from_wire,
+    rules_file_to_json,
+)
 from ..core.exprs import RulesFile
 from ..core.values import PV
 from ._native_lib import build, load_lib
@@ -64,9 +70,22 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int32,
             ctypes.POINTER(ctypes.c_char_p),
         ]
+    for fn_name in (
+        "guard_oracle_eval_records",
+        "guard_oracle_eval_report",
+        "guard_oracle_eval_report_raw",
+    ):
+        fn = getattr(lib, fn_name)
+        fn.restype = ctypes.c_void_p  # char* we free
+        fn.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
     lib.guard_oracle_free.argtypes = [ctypes.c_void_p]
     lib.guard_oracle_free.restype = None
-    lib.guard_oracle_free_str.argtypes = [ctypes.c_char_p]
+    lib.guard_oracle_free_str.argtypes = [ctypes.c_void_p]
     lib.guard_oracle_free_str.restype = None
     _configured = lib
     return lib
@@ -131,6 +150,87 @@ class NativeOracle:
         except (Unserializable, RecursionError) as e:
             raise NativeUnsupported(str(e))
         return self.eval_wire(wire)
+
+    def eval_records(self, doc: PV, data_file_name: str):
+        """Full evaluation record tree (EventRecord) for one document —
+        the rich-report path. The returned tree is byte-equivalent to
+        the Python evaluator's (differential suite pins the serde
+        encoding), so simplified_report_from_root / rule_statuses_from_root
+        consume it unchanged."""
+        if not self._handle:
+            raise NativeUnsupported("oracle handle closed")
+        try:
+            wire = doc_to_json(doc).encode("utf-8")
+        except (Unserializable, RecursionError) as e:
+            raise NativeUnsupported(str(e))
+        err = ctypes.c_char_p()
+        ptr = self._lib.guard_oracle_eval_records(
+            self._handle, wire, data_file_name.encode("utf-8"), ctypes.byref(err)
+        )
+        if not ptr:
+            msg = _consume_err(self._lib, err)
+            if msg.startswith("unsupported:"):
+                raise NativeUnsupported(msg)
+            raise NativeEvalError(
+                msg[len("error: "):] if msg.startswith("error: ") else msg
+            )
+        try:
+            text = ctypes.string_at(ptr).decode("utf-8")
+        finally:
+            self._lib.guard_oracle_free_str(ptr)
+        return records_from_wire(text)
+
+    def eval_report(self, doc: PV, data_file_name: str):
+        """(report_dict, {rule: Status}, overall Status) for one
+        document — the simplified report built natively from failing
+        records only (the fail-rerun fast path). Byte-equal to
+        simplified_report_from_root over the Python evaluator's tree
+        (differential suite)."""
+        if not self._handle:
+            raise NativeUnsupported("oracle handle closed")
+        try:
+            wire = doc_to_compact(doc, locs=True).encode("utf-8")
+        except (Unserializable, RecursionError) as e:
+            raise NativeUnsupported(str(e))
+        return self._report_call(
+            self._lib.guard_oracle_eval_report, wire, data_file_name
+        )
+
+    def eval_report_raw(self, content: str, data_file_name: str):
+        """eval_report straight from raw JSON text — no Python-side
+        load or serialization; source marks match the loader's."""
+        if not self._handle:
+            raise NativeUnsupported("oracle handle closed")
+        return self._report_call(
+            self._lib.guard_oracle_eval_report_raw,
+            content.encode("utf-8"),
+            data_file_name,
+        )
+
+    def _report_call(self, entry, wire: bytes, data_file_name: str):
+        import json as _json
+
+        from ..core.qresult import Status
+
+        err = ctypes.c_char_p()
+        ptr = entry(
+            self._handle, wire, data_file_name.encode("utf-8"), ctypes.byref(err)
+        )
+        if not ptr:
+            msg = _consume_err(self._lib, err)
+            if msg.startswith("unsupported:"):
+                raise NativeUnsupported(msg)
+            raise NativeEvalError(
+                msg[len("error: "):] if msg.startswith("error: ") else msg
+            )
+        try:
+            text = ctypes.string_at(ptr).decode("utf-8")
+        finally:
+            self._lib.guard_oracle_free_str(ptr)
+        env = _json.loads(text)
+        st_map = {0: Status.PASS, 1: Status.FAIL, 2: Status.SKIP}
+        statuses = {k: st_map[v] for k, v in env["statuses"].items()}
+        return env["report"], statuses, st_map[env["overall"]]
 
     def eval_raw_json(self, content: str) -> List[int]:
         """Per-rule statuses straight from raw JSON document text — no

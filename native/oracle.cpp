@@ -31,6 +31,7 @@
 // Build: native/build_oracle.sh -> libguard_oracle.so
 
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -1196,13 +1197,19 @@ struct DocParser {
     return static_cast<double>(v);  // caller re-reads via pint path below
   }
 
-  // compact wire: [kind, ...]
-  PVal* compact() {
+  // compact wire: [kind, payload?, line?, col?]; map entries
+  // [key(, kline, kcol)?, node]. Paths derive from the parent exactly
+  // like the loader builds them (Path.extend over keys / indices).
+  PVal* compact() { return compact_at("", 0, 0); }
+
+  PVal* compact_at(const std::string& path, long long line0, long long col0) {
     if (++depth > 400) throw Unsupported("doc nesting too deep");
     expect('[');
     long long kind = pint();
     PVal* v = arena->nv();
     v->kind = static_cast<int>(kind);
+    v->path = path;
+    long long line = line0, col = col0;
     switch (kind) {
       case K_NULL:
         break;
@@ -1234,8 +1241,11 @@ struct DocParser {
         expect('[');
         ws();
         if (p < end && *p == ']') { p++; break; }
+        int idx = 0;
         while (true) {
-          v->list.push_back(compact());
+          v->list.push_back(
+              compact_at(path + "/" + std::to_string(idx), line0, col0));
+          idx++;
           ws();
           if (p < end && *p == ',') { p++; continue; }
           expect(']');
@@ -1252,12 +1262,26 @@ struct DocParser {
           expect('[');
           ws();
           std::string key = pstring();
+          std::string child_path = path + "/" + key;
+          long long kline = line0, kcol = col0;
           expect(',');
-          PVal* child = compact();
+          ws();
+          if (p < end && *p != '[') {
+            // key location trailer: [key, kline, kcol, node]
+            kline = pint();
+            expect(',');
+            kcol = pint();
+            expect(',');
+            ws();
+          }
+          PVal* child = compact_at(child_path, kline, kcol);
           expect(']');
           PVal* key_node = arena->nv();
           key_node->kind = K_STRING;
           key_node->s = std::move(key);
+          key_node->path = child_path;
+          key_node->line = static_cast<int>(kline);
+          key_node->col = static_cast<int>(kcol);
           v->entries.emplace_back(key_node, child);
           ws();
           if (p < end && *p == ',') { p++; continue; }
@@ -1269,30 +1293,77 @@ struct DocParser {
       default:
         throw Unsupported("doc compact kind");
     }
+    // optional node location trailer
+    ws();
+    if (p < end && *p == ',') {
+      p++;
+      line = pint();
+      expect(',');
+      col = pint();
+    }
+    v->line = static_cast<int>(line);
+    v->col = static_cast<int>(col);
     expect(']');
     depth--;
     return v;
   }
 
-  // raw JSON with loader scalar typing
-  PVal* raw() {
+  // pyyaml-mark tracking for raw parses: 0-based line; col = offset
+  // from the last newline (ascii-guarded by the caller — pyyaml counts
+  // characters, we count bytes)
+  const char* buf_start = nullptr;
+  const char* line_start = nullptr;
+  long long line_no = 0;
+  bool track_locs = false;
+
+  void ws_locs() {
+    while (p < end) {
+      char c = *p;
+      if (c == '\n') {
+        line_no++;
+        p++;
+        line_start = p;
+      } else if (c == ' ' || c == '\t' || c == '\r') {
+        p++;
+      } else {
+        break;
+      }
+    }
+  }
+
+  // raw JSON with loader scalar typing; paths derived, marks tracked
+  PVal* raw() { return raw_at(""); }
+
+  PVal* raw_at(const std::string& path) {
     if (++depth > 400) throw Unsupported("doc nesting too deep");
-    ws();
+    if (track_locs) ws_locs();
+    else ws();
     if (p >= end) fail("eof");
     PVal* v;
+    long long vline = line_no, vcol = track_locs ? (p - line_start) : 0;
     char c = *p;
+    auto mark_ws = [&]() { if (track_locs) ws_locs(); else ws(); };
     if (c == '{') {
       p++;
       v = arena->nv();
       v->kind = K_MAP;
-      ws();
+      v->path = path;
+      v->line = static_cast<int>(vline);
+      v->col = static_cast<int>(vcol);
+      mark_ws();
       if (p < end && *p == '}') { p++; depth--; return v; }
       while (true) {
-        ws();
+        mark_ws();
+        long long kline = line_no,
+                  kcol = track_locs ? (p - line_start) : 0;
         std::string key = pstring();
-        expect(':');
-        PVal* child = raw();
-        // duplicate keys: first position, last value (python dict)
+        mark_ws();
+        if (p >= end || *p != ':') fail("expected :");
+        p++;
+        std::string child_path = path + "/" + key;
+        PVal* child = raw_at(child_path);
+        // duplicate keys: first position, last value (python dict;
+        // loader.py:175-179 keeps the first key node)
         bool dup = false;
         for (auto& e : v->entries)
           if (e.first->s == key) { e.second = child; dup = true; break; }
@@ -1300,9 +1371,12 @@ struct DocParser {
           PVal* key_node = arena->nv();
           key_node->kind = K_STRING;
           key_node->s = std::move(key);
+          key_node->path = child_path;
+          key_node->line = static_cast<int>(kline);
+          key_node->col = static_cast<int>(kcol);
           v->entries.emplace_back(key_node, child);
         }
-        ws();
+        mark_ws();
         if (p < end && *p == ',') { p++; continue; }
         if (p < end && *p == '}') { p++; break; }
         fail("expected , or }");
@@ -1311,11 +1385,16 @@ struct DocParser {
       p++;
       v = arena->nv();
       v->kind = K_LIST;
-      ws();
+      v->path = path;
+      v->line = static_cast<int>(vline);
+      v->col = static_cast<int>(vcol);
+      mark_ws();
       if (p < end && *p == ']') { p++; depth--; return v; }
+      int idx = 0;
       while (true) {
-        v->list.push_back(raw());
-        ws();
+        v->list.push_back(raw_at(path + "/" + std::to_string(idx)));
+        idx++;
+        mark_ws();
         if (p < end && *p == ',') { p++; continue; }
         if (p < end && *p == ']') { p++; break; }
         fail("expected , or ]");
@@ -1365,6 +1444,9 @@ struct DocParser {
         if (errno == ERANGE) throw Unsupported("integer outside i64");
       }
     }
+    v->path = path;
+    v->line = static_cast<int>(vline);
+    v->col = static_cast<int>(vcol);
     depth--;
     return v;
   }
@@ -1649,7 +1731,7 @@ std::string rust_debug_pv(const PVal& pv) {
       double f = pv.f;
       if (f != f || f == 1.0 / 0.0 || f == -1.0 / 0.0)
         return "Float((" + path + ", " + rust_num_f(f) + "))";
-      if (f < 1e16 && f > -1e16 && f == static_cast<long long>(f))
+      if (f == std::floor(f))  // python: fv == int(fv), any magnitude
         return "Float((" + path + ", " + rust_num_f(f) + ".0))";
       // python embeds str(pv.val) == repr for non-integral floats
       return "Float((" + path + ", " + format_float(f) + "))";
@@ -1748,12 +1830,133 @@ struct QR {
   int tag = T_RESOLVED;
   PVal* value = nullptr;        // LITERAL / RESOLVED
   PVal* traversed_to = nullptr; // UNRESOLVED
+  // UnResolved{remaining_query, reason} (qresult.py:37-51) — built only
+  // in records mode; statuses never read them
+  std::string ur_remaining;
+  std::string ur_reason;
+  bool ur_has_reason = false;
   static QR literal(PVal* v) { QR q; q.tag = T_LITERAL; q.value = v; return q; }
   static QR resolved(PVal* v) { QR q; q.tag = T_RESOLVED; q.value = v; return q; }
   static QR unresolved(PVal* at) {
     QR q; q.tag = T_UNRESOLVED; q.traversed_to = at; return q;
   }
 };
+
+// ---------------------------------------------------------------------------
+// Record tree (records.py EventRecord/RecordType/ClauseCheck;
+// eval_context.rs:999-1060, mod.rs:196-355) — populated only in
+// records mode; the JSON emitted crosses back to Python where
+// commands/report.py consumes the rebuilt EventRecord tree unchanged.
+// ---------------------------------------------------------------------------
+enum RT {
+  RT_FILE_CHECK, RT_RULE_CHECK, RT_RULE_CONDITION, RT_TYPE_CHECK,
+  RT_TYPE_CONDITION, RT_TYPE_BLOCK, RT_FILTER, RT_WHEN_CHECK,
+  RT_WHEN_CONDITION, RT_DISJUNCTION, RT_BLOCK_GUARD_CHECK,
+  RT_GUARD_CLAUSE_BLOCK_CHECK, RT_CLAUSE_VALUE_CHECK,
+};
+
+const char* RT_NAMES[] = {
+    "FileCheck", "RuleCheck", "RuleCondition", "TypeCheck", "TypeCondition",
+    "TypeBlock", "Filter", "WhenCheck", "WhenCondition", "Disjunction",
+    "BlockGuardCheck", "GuardClauseBlockCheck", "ClauseValueCheck",
+};
+
+enum CC {
+  CC_NONE = -1, CC_SUCCESS, CC_COMPARISON, CC_IN_COMPARISON, CC_UNARY,
+  CC_NO_VALUE_EMPTY, CC_DEPENDENT_RULE, CC_MISSING_BLOCK_VALUE,
+};
+
+const char* CC_NAMES[] = {
+    "Success", "Comparison", "InComparison", "Unary",
+    "NoValueForEmptyCheck", "DependentRule", "MissingBlockValue",
+};
+
+struct RecPayload {
+  int status = -1;             // ST_* (bare-status records + embedded status)
+  std::string name;            // NamedStatus.name / TypeBlockCheck.type_name /
+                               // MissingValueCheck.rule
+  bool has_message = false;
+  std::string message;
+  bool has_custom = false;
+  std::string custom;
+  bool at_least_one = false;   // BlockCheck.at_least_one_matches
+  int cc = CC_NONE;            // ClauseCheck variant
+  int cmp_op = -1;
+  bool cmp_neg = false;
+  bool has_from = false;
+  QR from;
+  bool has_to = false;
+  QR to;
+  bool has_to_list = false;
+  std::vector<QR> to_list;     // InComparison.to
+};
+
+struct Rec {
+  std::string context;
+  bool has_container = false;
+  int rt = RT_FILE_CHECK;
+  RecPayload p;
+  std::vector<Rec*> children;
+};
+
+struct Tracker {
+  std::deque<Rec> pool;
+  std::vector<Rec*> stack;
+  Rec* final_rec = nullptr;
+  bool enabled = false;
+  // report mode: Success leaf records are invisible to the simplified
+  // report (report.py _clause_value_report returns [] for them) — skip
+  // their start/end entirely. Records mode keeps full fidelity.
+  bool skip_success = false;
+
+  void start(std::string ctx) {
+    pool.emplace_back();
+    Rec* r = &pool.back();
+    r->context = std::move(ctx);
+    stack.push_back(r);
+  }
+  void drop() {
+    if (stack.empty()) throw GuardErr("record drop without start");
+    stack.pop_back();
+  }
+  void end(int rt, RecPayload p) {
+    if (stack.empty()) throw GuardErr("record end without start");
+    Rec* r = stack.back();
+    stack.pop_back();
+    r->has_container = true;
+    r->rt = rt;
+    r->p = std::move(p);
+    if (!stack.empty()) stack.back()->children.push_back(r);
+    else final_rec = r;
+  }
+};
+
+RecPayload pay_status(int status) {
+  RecPayload p;
+  p.status = status;
+  return p;
+}
+
+RecPayload pay_named(const std::string& name, int status) {
+  RecPayload p;
+  p.name = name;
+  p.status = status;
+  return p;
+}
+
+RecPayload pay_block(int status, bool at_least_one) {
+  RecPayload p;
+  p.status = status;
+  p.at_least_one = at_least_one;
+  return p;
+}
+
+RecPayload pay_block_msg(int status, bool at_least_one, std::string msg) {
+  RecPayload p = pay_block(status, at_least_one);
+  p.has_message = true;
+  p.message = std::move(msg);
+  return p;
+}
 
 // ---------------------------------------------------------------------------
 // Key-case converters (scopes.py:51-98; eval_context.rs:315-326).
@@ -1888,7 +2091,16 @@ struct Resolver {
   virtual std::vector<QR> resolve_variable(const std::string& name) = 0;
   virtual void add_capture(const std::string& name, PVal* key) = 0;
   virtual EvalState* state() = 0;
+  // RecordTracer routing (scopes forward to their parent; the
+  // parameterized-call context rewrites RuleCheck messages en route,
+  // eval.rs:1504-1572)
+  virtual void rec_start(std::string ctx) = 0;
+  virtual void rec_end(int rt, RecPayload p) = 0;
+  virtual void rec_drop() = 0;  // discard the open record (skipped leaf)
 };
+
+// records mode on? (gates reason/context string construction)
+bool recording(Resolver* r);
 
 std::vector<QR> query_retrieval(int qi, const std::vector<Part*>& parts, PVal* current,
                                 Resolver* resolver, ConvFn converter);
@@ -1900,7 +2112,14 @@ struct EvalState {
   Engine* eng;
   Arena arena;  // doc nodes + function-produced values
   int depth = 0;
+  Tracker trk;
 };
+
+bool recording(Resolver* r) { return r->state()->trk.enabled; }
+bool rec_success(Resolver* r) {
+  Tracker& t = r->state()->trk;
+  return t.enabled && !t.skip_success;
+}
 
 struct DepthGuard {
   EvalState* st;
@@ -1983,6 +2202,15 @@ struct RootScope : Resolver {
     scope.resolved_variables[name].push_back(QR::resolved(key));
   }
   EvalState* state() override { return st; }
+  void rec_start(std::string ctx) override {
+    if (st->trk.enabled) st->trk.start(std::move(ctx));
+  }
+  void rec_end(int rt, RecPayload p) override {
+    if (st->trk.enabled) st->trk.end(rt, std::move(p));
+  }
+  void rec_drop() override {
+    if (st->trk.enabled) st->trk.drop();
+  }
 };
 
 struct BlockScope : Resolver {
@@ -2011,6 +2239,9 @@ struct BlockScope : Resolver {
     scope.resolved_variables[name].push_back(QR::resolved(key));
   }
   EvalState* state() override { return parent->state(); }
+  void rec_start(std::string ctx) override { parent->rec_start(std::move(ctx)); }
+  void rec_end(int rt, RecPayload p) override { parent->rec_end(rt, std::move(p)); }
+  void rec_drop() override { parent->rec_drop(); }
 };
 
 struct ValueScope : Resolver {
@@ -2035,6 +2266,9 @@ struct ValueScope : Resolver {
     parent->add_capture(name, key);
   }
   EvalState* state() override { return parent->state(); }
+  void rec_start(std::string ctx) override { parent->rec_start(std::move(ctx)); }
+  void rec_end(int rt, RecPayload p) override { parent->rec_end(rt, std::move(p)); }
+  void rec_drop() override { parent->rec_drop(); }
 };
 
 }  // namespace
@@ -2045,13 +2279,18 @@ namespace {
 // Query retrieval — the recursive tree-walk
 // (scopes.py:361-837; eval_context.rs:337-924)
 // ---------------------------------------------------------------------------
+const char* CTX_GUARD_DISJ = "cfn_guard::rules::exprs::GuardClause#disjunction";
+const char* CTX_WHEN_DISJ = "cfn_guard::rules::exprs::WhenGuardClause#disjunction";
+const char* CTX_RULE_DISJ = "cfn_guard::rules::exprs::RuleClause#disjunction";
+
 int eval_conjunction_clauses(const Conj& conjunctions, Resolver* resolver,
-                             int (*eval_fn)(Clause*, Resolver*));
+                             int (*eval_fn)(Clause*, Resolver*),
+                             const char* context = CTX_GUARD_DISJ);
 int eval_guard_clause(Clause* c, Resolver* resolver);
-std::vector<std::pair<QR, int>> real_binary_operation(const std::vector<QR>& lhs,
-                                                      const std::vector<QR>& rhs,
-                                                      int op, bool negated,
-                                                      Resolver* ctx);
+std::vector<std::pair<QR, int>> real_binary_operation(
+    const std::vector<QR>& lhs, const std::vector<QR>& rhs, int op, bool negated,
+    const std::string& context, bool has_custom, const std::string& custom,
+    Resolver* ctx);
 
 // integer-looking key: fullmatch [+-]?[0-9]+ (scopes.py:511-513)
 bool int_key(const std::string& s, long long* out) {
@@ -2068,19 +2307,39 @@ bool int_key(const std::string& s, long long* out) {
   return true;
 }
 
-// _retrieve_index (scopes.py:450-460; eval_context.rs:119-140)
-QR retrieve_index(PVal* parent, long long index) {
+QR make_ur(PVal* at, std::string remaining, std::string reason) {
+  QR q = QR::unresolved(at);
+  q.ur_remaining = std::move(remaining);
+  q.ur_reason = std::move(reason);
+  q.ur_has_reason = true;
+  return q;
+}
+
+// _retrieve_index (scopes.py:450-460; eval_context.rs:119-140).
+// `rec` gates the reason-string build (records mode only).
+QR retrieve_index(PVal* parent, long long index, const std::vector<Part*>& parts,
+                  bool rec) {
   long long check = index >= 0 ? index : -index;
   if (check < static_cast<long long>(parent->list.size()))
     return QR::resolved(parent->list[static_cast<size_t>(check)]);
-  return QR::unresolved(parent);
+  if (!rec) return QR::unresolved(parent);
+  std::string q = display_query(parts);
+  return make_ur(parent, q,
+                 "Array Index out of bounds for path = " + path_disp(*parent) +
+                     " on index = " + std::to_string(index) +
+                     " inside Array, remaining query = " + q);
 }
 
 // _accumulate over a list (scopes.py:463-481)
 std::vector<QR> accumulate(PVal* parent, int qi, const std::vector<Part*>& parts,
                            const std::vector<PVal*>& elements, Resolver* resolver,
                            ConvFn converter) {
-  if (elements.empty()) return {QR::unresolved(parent)};
+  if (elements.empty()) {
+    if (!recording(resolver)) return {QR::unresolved(parent)};
+    return {make_ur(parent, display_query(parts, qi),
+                    "No more entries for value at path = " + path_disp(*parent) +
+                        " on type = " + parent->type_info() + " ")};
+  }
   std::vector<QR> acc;
   for (PVal* each : elements) {
     auto sub = query_retrieval(qi + 1, parts, each, resolver, converter);
@@ -2094,7 +2353,12 @@ std::vector<QR> accumulate(PVal* parent, int qi, const std::vector<Part*>& parts
 template <typename Visit>
 std::vector<QR> accumulate_map(PVal* parent, int qi, const std::vector<Part*>& parts,
                                Resolver* resolver, ConvFn converter, Visit visit) {
-  if (parent->map_empty()) return {QR::unresolved(parent)};
+  if (parent->map_empty()) {
+    if (!recording(resolver)) return {QR::unresolved(parent)};
+    return {make_ur(parent, display_query(parts, qi),
+                    "No more entries for value at path = " + path_disp(*parent) +
+                        " on type = " + parent->type_info() + " ")};
+  }
   std::vector<QR> acc;
   for (const auto& e : parent->entries) {
     ValueScope vs(e.second, resolver);
@@ -2109,7 +2373,17 @@ std::vector<QR> filter_check_delegate(const Conj& conjunctions, const Part* part
                                       int qi, const std::vector<Part*>& parts,
                                       PVal* key, PVal* value, Resolver* ctx,
                                       ConvFn converter) {
-  int status = eval_conjunction_clauses(conjunctions, ctx, eval_guard_clause);
+  bool rec = recording(ctx);
+  if (rec)
+    ctx->rec_start("Filter/Map#" + std::to_string(conjunctions.size()));
+  int status;
+  try {
+    status = eval_conjunction_clauses(conjunctions, ctx, eval_guard_clause);
+  } catch (...) {
+    if (rec) ctx->rec_end(RT_FILTER, pay_status(ST_FAIL));
+    throw;
+  }
+  if (rec) ctx->rec_end(RT_FILTER, pay_status(status));
   if (part->has_name && status == ST_PASS) ctx->add_capture(part->name, key);
   if (status == ST_PASS) return query_retrieval(qi, parts, value, ctx, converter);
   return {};
@@ -2142,10 +2416,20 @@ std::vector<QR> retrieve_filter(const Part* part, int qi,
     throw GuardErr("Filter after unexpected query part");
   }
   if (current->kind == K_LIST) {
+    bool rec = recording(resolver);
     std::vector<QR> selected;
     for (PVal* each : current->list) {
+      if (rec)
+        resolver->rec_start("Filter/List#" + std::to_string(conjunctions.size()));
       ValueScope vs(each, resolver);
-      int status = eval_conjunction_clauses(conjunctions, &vs, eval_guard_clause);
+      int status;
+      try {
+        status = eval_conjunction_clauses(conjunctions, &vs, eval_guard_clause);
+      } catch (...) {
+        if (rec) resolver->rec_end(RT_FILTER, pay_status(ST_FAIL));
+        throw;
+      }
+      if (rec) resolver->rec_end(RT_FILTER, pay_status(status));
       if (status == ST_PASS) {
         auto sub = query_retrieval(qi + 1, parts, each, resolver, converter);
         selected.insert(selected.end(), sub.begin(), sub.end());
@@ -2161,7 +2445,10 @@ std::vector<QR> retrieve_filter(const Part* part, int qi,
       return query_retrieval(qi + 1, parts, current, resolver, converter);
     return {};
   }
-  return {QR::unresolved(current)};
+  if (!recording(resolver)) return {QR::unresolved(current)};
+  return {make_ur(current, display_query(parts, qi),
+                  std::string("Filter on value type that was not a struct or array ") +
+                      current->type_info() + " " + path_disp(*current))};
 }
 
 std::vector<QR> retrieve_map_key_filter(const Part* part, int qi,
@@ -2203,12 +2490,17 @@ std::vector<QR> query_retrieval(int qi, const std::vector<Part*>& parts, PVal* c
       return retrieve_key(part, qi, parts, current, resolver, converter);
     case P_INDEX: {
       if (current->kind == K_LIST) {
-        QR qr = retrieve_index(current, part->index);
+        QR qr = retrieve_index(current, part->index, parts, recording(resolver));
         if (qr.tag == T_RESOLVED)
           return query_retrieval(qi + 1, parts, qr.value, resolver, converter);
         return {qr};
       }
-      return {QR::unresolved(current)};
+      if (!recording(resolver)) return {QR::unresolved(current)};
+      return {make_ur(
+          current, display_query(parts, qi),
+          "Attempting to retrieve from index " + std::to_string(part->index) +
+              " but type is not an array at path " + path_disp(*current) +
+              ", type " + current->type_info())};
     }
     case P_ALL_INDICES: {
       // scopes.py:663-681 (eval_context.rs:609-665)
@@ -2260,15 +2552,26 @@ std::vector<QR> retrieve_key(const Part* part, int qi, const std::vector<Part*>&
   if (int_key(key, &idx)) {
     // scopes.py:508-531 (eval_context.rs:392-417)
     if (current->kind == K_LIST) {
-      QR qr = retrieve_index(current, idx);
+      QR qr = retrieve_index(current, idx, parts, recording(resolver));
       if (qr.tag == T_RESOLVED)
         return query_retrieval(qi + 1, parts, qr.value, resolver, converter);
       return {qr};
     }
-    return {QR::unresolved(current)};
+    if (!recording(resolver)) return {QR::unresolved(current)};
+    return {make_ur(current, display_query(parts),
+                    "Attempting to retrieve from index " + std::to_string(idx) +
+                        " but type is not an array at path " + path_disp(*current))};
   }
 
-  if (current->kind != K_MAP) return {QR::unresolved(current)};
+  if (current->kind != K_MAP) {
+    if (!recording(resolver)) return {QR::unresolved(current)};
+    return {make_ur(
+        current, display_query(parts, qi),
+        "Attempting to retrieve from key " + key +
+            " but type is not an struct type at path " + path_disp(*current) +
+            ", Type = " + current->type_info() +
+            ", Value = " + rust_debug_pv(*current))};
+  }
 
   if (part_is_variable(part)) {
     // variable interpolation as a key (scopes.py:545-632;
@@ -2281,16 +2584,33 @@ std::vector<QR> retrieve_key(const Part* part, int qi, const std::vector<Part*>&
         long long check = nxt->index >= 0 ? nxt->index : -nxt->index;
         if (check < static_cast<long long>(keys.size()))
           keys = {keys[static_cast<size_t>(check)]};
-        else
+        else if (!recording(resolver))
           return {QR::unresolved(current)};
+        else
+          return {make_ur(
+              current, display_query(parts, qi),
+              "Index " + std::to_string(check) +
+                  " on the set of values returned for variable " + var +
+                  " on the join, is out of bounds. Length " +
+                  std::to_string(keys.size()))};
       } else if (nxt->type != P_ALL_INDICES && nxt->type != P_KEY) {
         throw GuardErr("This type of query variable interpolation is not supported");
       }
     }
+    bool rec = recording(resolver);
     std::vector<QR> acc;
     for (const QR& each_key : keys) {
       if (each_key.tag == T_UNRESOLVED) {
-        acc.push_back(QR::unresolved(current));
+        if (!rec) {
+          acc.push_back(QR::unresolved(current));
+        } else {
+          acc.push_back(make_ur(
+              current, display_query(parts, qi),
+              "Keys returned for variable " + var +
+                  " could not completely resolve. Path traversed until " +
+                  path_disp(*each_key.traversed_to) +
+                  (each_key.ur_has_reason ? each_key.ur_reason : std::string())));
+        }
         continue;
       }
       PVal* kv = each_key.value;
@@ -2299,8 +2619,13 @@ std::vector<QR> retrieve_key(const Part* part, int qi, const std::vector<Part*>&
         if (nxt_val) {
           auto sub = query_retrieval(qi + 1, parts, nxt_val, resolver, converter);
           acc.insert(acc.end(), sub.begin(), sub.end());
-        } else {
+        } else if (!rec) {
           acc.push_back(QR::unresolved(current));
+        } else {
+          acc.push_back(make_ur(current, display_query(parts, qi),
+                                "Could not locate key = " + kv->s +
+                                    " inside struct at path = " +
+                                    path_disp(*current)));
         }
       } else if (kv->kind == K_LIST) {
         for (PVal* inner : kv->list) {
@@ -2309,8 +2634,13 @@ std::vector<QR> retrieve_key(const Part* part, int qi, const std::vector<Part*>&
             if (nxt_val) {
               auto sub = query_retrieval(qi + 1, parts, nxt_val, resolver, converter);
               acc.insert(acc.end(), sub.begin(), sub.end());
-            } else {
+            } else if (!rec) {
               acc.push_back(QR::unresolved(current));
+            } else {
+              acc.push_back(make_ur(current, display_query(parts, qi),
+                                    "Could not locate key = " + inner->s +
+                                        " inside struct at path = " +
+                                        path_disp(*inner)));
             }
           } else {
             throw NotComparable(
@@ -2340,14 +2670,22 @@ std::vector<QR> retrieve_key(const Part* part, int qi, const std::vector<Part*>&
         return query_retrieval(qi + 1, parts, candidate, resolver, each);
     }
   }
-  return {QR::unresolved(current)};
+  if (!recording(resolver)) return {QR::unresolved(current)};
+  return {make_ur(current, display_query(parts, qi),
+                  "Could not find key " + key + " inside struct at path " +
+                      path_disp(*current))};
 }
 
 std::vector<QR> retrieve_map_key_filter(const Part* part, int qi,
                                         const std::vector<Part*>& parts, PVal* current,
                                         Resolver* resolver, ConvFn converter) {
   // scopes.py:789-837 (eval_context.rs:830-922)
-  if (current->kind != K_MAP) return {QR::unresolved(current)};
+  if (current->kind != K_MAP) {
+    if (!recording(resolver)) return {QR::unresolved(current)};
+    return {make_ur(current, display_query(parts, qi),
+                    std::string("Map Filter for keys was not a struct ") +
+                        current->type_info() + " " + path_disp(*current))};
+  }
   std::vector<QR> rhs;
   switch (part->cw->tag) {
     case LV_QUERY:
@@ -2361,7 +2699,8 @@ std::vector<QR> retrieve_map_key_filter(const Part* part, int qi,
   }
   std::vector<QR> lhs;
   for (const auto& e : current->entries) lhs.push_back(QR::resolved(e.first));
-  auto results = real_binary_operation(lhs, rhs, part->cmp, part->inv, resolver);
+  auto results = real_binary_operation(lhs, rhs, part->cmp, part->inv, "", false,
+                                       "", resolver);
   std::vector<QR> selected;
   for (const auto& rs : results) {
     const QR& qr = rs.first;
@@ -2501,25 +2840,64 @@ std::vector<PVal*> fn_json_parse(EvalState* st, const std::vector<QR>& args) {
   return out;
 }
 
-// Rust Display float formatting via shortest-round-trip like repr()
-// (functions.py:350-355)
+// python repr() for finite doubles: shortest round-trip digits with
+// python's fixed-vs-scientific notation rule (fixed iff -4 <= exp < 16)
+std::string python_float_repr(double f) {
+  if (f == 0.0) return std::signbit(f) ? "-0.0" : "0.0";
+  char buf[64];
+  int prec = 0;
+  for (prec = 0; prec <= 16; prec++) {
+    snprintf(buf, sizeof buf, "%.*e", prec, f);
+    if (strtod(buf, nullptr) == f) break;
+  }
+  // buf: [-]d.dddde±XX
+  std::string s(buf);
+  bool negative = s[0] == '-';
+  size_t start = negative ? 1 : 0;
+  std::string digits;
+  size_t i = start;
+  for (; i < s.size() && s[i] != 'e'; i++)
+    if (s[i] != '.') digits.push_back(s[i]);
+  long long exp10 = strtoll(s.c_str() + i + 1, nullptr, 10);
+  // strip trailing zero digits (shortest form)
+  while (digits.size() > 1 && digits.back() == '0') digits.pop_back();
+  std::string out = negative ? "-" : "";
+  if (exp10 >= -4 && exp10 < 16) {
+    if (exp10 >= 0) {
+      if (static_cast<size_t>(exp10) + 1 >= digits.size()) {
+        out += digits;
+        out.append(static_cast<size_t>(exp10) + 1 - digits.size(), '0');
+        out += ".0";
+      } else {
+        out += digits.substr(0, static_cast<size_t>(exp10) + 1) + "." +
+               digits.substr(static_cast<size_t>(exp10) + 1);
+      }
+    } else {
+      out += "0.";
+      out.append(static_cast<size_t>(-exp10) - 1, '0');
+      out += digits;
+    }
+    return out;
+  }
+  // scientific: mantissa d[.ddd] e sign 2+-digit exponent
+  out += digits.substr(0, 1);
+  if (digits.size() > 1) out += "." + digits.substr(1);
+  out += "e";
+  out += exp10 < 0 ? "-" : "+";
+  long long ae = exp10 < 0 ? -exp10 : exp10;
+  std::string es = std::to_string(ae);
+  if (es.size() < 2) es = "0" + es;
+  out += es;
+  return out;
+}
+
+// Rust Display float formatting (values.py _rust_num / functions.py
+// _format_float): integral floats under 1e16 print bare, the rest
+// match python repr
 std::string format_float(double f) {
   if (f < 1e16 && f > -1e16 && f == static_cast<long long>(f))
     return std::to_string(static_cast<long long>(f));
-  char buf[64];
-  for (int prec = 1; prec <= 17; prec++) {
-    snprintf(buf, sizeof buf, "%.*g", prec, f);
-    if (strtod(buf, nullptr) == f) break;
-  }
-  std::string s(buf);
-  // python repr: "1e+16" style matches %g; strip '+0' exponent padding
-  size_t e = s.find('e');
-  if (e != std::string::npos) {
-    size_t d = e + 1;
-    if (d < s.size() && (s[d] == '+' || s[d] == '-')) d++;
-    while (d + 1 < s.size() && s[d] == '0') s.erase(d, 1);
-  }
-  return s;
+  return python_float_repr(f);
 }
 
 std::vector<PVal*> map_strings(EvalState* st, const std::vector<QR>& args,
@@ -3009,6 +3387,7 @@ struct VER {
   PVal* lhs = nullptr;
   PVal* rhs = nullptr;
   QR ur;  // the unresolved side for V_LHS_UR / V_RHS_UR
+  std::string reason;  // V_NOT_COMP message
   std::vector<PVal*> diff, lhs_list, rhs_list;
 };
 
@@ -3044,8 +3423,9 @@ VER match_value(PVal* lhs, PVal* rhs, CmpFn cmp, RxCache& rx) {
   v.ckind = CK_VALUE;
   try {
     v.tag = cmp(*lhs, *rhs, rx) ? V_SUCCESS : V_FAIL;
-  } catch (const NotComparable&) {
+  } catch (const NotComparable& e) {
     v.tag = V_NOT_COMP;
+    v.reason = e.msg;
   }
   return v;
 }
@@ -3062,10 +3442,13 @@ VER string_in(PVal* lhs, PVal* rhs) {
   v.lhs = lhs;
   v.rhs = rhs;
   v.ckind = CK_VALUE;
-  if (lhs->kind == K_STRING && rhs->kind == K_STRING)
+  if (lhs->kind == K_STRING && rhs->kind == K_STRING) {
     v.tag = rhs->s.find(lhs->s) != std::string::npos ? V_SUCCESS : V_FAIL;
-  else
+  } else {
     v.tag = V_NOT_COMP;
+    v.reason = std::string("Type not comparable, ") + lhs->type_info() + ", " +
+               rhs->type_info();
+  }
   return v;
 }
 
@@ -3099,6 +3482,8 @@ VER contained_in(PVal* lhs, PVal* rhs, RxCache& rx) {
     v.tag = V_NOT_COMP;
     v.lhs = lhs;
     v.rhs = rhs;
+    v.reason = std::string("Can not compare type ") + lhs->type_info() + ", " +
+               rhs->type_info();
     return v;
   }
   if (rhs->kind == K_LIST) {
@@ -3472,10 +3857,36 @@ struct OpResult {
   std::vector<std::pair<QR, int>> statuses;
 };
 
+RecPayload pay_success() {
+  RecPayload p;
+  p.cc = CC_SUCCESS;
+  p.status = ST_PASS;
+  return p;
+}
+
+RecPayload pay_unary(const QR& from, int op, bool op_not, bool has_custom,
+                     const std::string& custom, bool has_msg = false,
+                     const std::string& msg = std::string()) {
+  RecPayload p;
+  p.cc = CC_UNARY;
+  p.status = ST_FAIL;
+  p.has_from = true;
+  p.from = from;
+  p.cmp_op = op;
+  p.cmp_neg = op_not;
+  p.has_custom = has_custom;
+  p.custom = custom;
+  p.has_message = has_msg;
+  p.message = msg;
+  return p;
+}
+
 OpResult unary_operation(const std::vector<Part*>& lhs_query, int op, bool op_not,
-                         bool inverse, Resolver* ctx) {
+                         bool inverse, const std::string& context, bool has_custom,
+                         const std::string& custom, Resolver* ctx) {
   std::vector<QR> lhs = ctx->query(lhs_query);
   OpResult out;
+  bool rec = recording(ctx);
 
   const Part* last = lhs_query.back();
   bool empty_on_expr = last->type == P_FILTER || last->type == P_KEYS ||
@@ -3485,6 +3896,7 @@ OpResult unary_operation(const std::vector<Part*>& lhs_query, int op, bool op_no
     // evaluator.py:142-198 (eval.rs:198-298)
     if (!lhs.empty()) {
       for (const QR& each : lhs) {
+        if (rec) ctx->rec_start(context);
         int status;
         QR qr = each;
         if (each.tag != T_UNRESOLVED) {
@@ -3495,6 +3907,15 @@ OpResult unary_operation(const std::vector<Part*>& lhs_query, int op, bool op_no
           status = op_not ? ST_FAIL : ST_PASS;
         }
         if (inverse) status = (status == ST_FAIL) ? ST_PASS : ST_FAIL;
+        if (rec) {
+          if (status == ST_PASS) {
+            if (rec_success(ctx)) ctx->rec_end(RT_CLAUSE_VALUE_CHECK, pay_success());
+            else ctx->rec_drop();
+          } else {
+            ctx->rec_end(RT_CLAUSE_VALUE_CHECK,
+                         pay_unary(qr, op, op_not, has_custom, custom));
+          }
+        }
         out.statuses.emplace_back(qr, status);
       }
       return out;
@@ -3503,6 +3924,20 @@ OpResult unary_operation(const std::vector<Part*>& lhs_query, int op, bool op_no
     if (inverse) result = !result;
     out.empty = true;
     out.empty_status = result ? ST_PASS : ST_FAIL;
+    if (rec) {
+      if (result && !rec_success(ctx)) return out;
+      ctx->rec_start(context);
+      if (result) {
+        ctx->rec_end(RT_CLAUSE_VALUE_CHECK, pay_success());
+      } else {
+        RecPayload p;
+        p.cc = CC_NO_VALUE_EMPTY;
+        p.status = ST_FAIL;
+        p.has_custom = has_custom;
+        p.custom = custom;
+        ctx->rec_end(RT_CLAUSE_VALUE_CHECK, std::move(p));
+      }
+    }
     return out;
   }
 
@@ -3513,6 +3948,7 @@ OpResult unary_operation(const std::vector<Part*>& lhs_query, int op, bool op_no
   }
 
   for (const QR& each : lhs) {
+    if (rec) ctx->rec_start(context);
     bool r;
     switch (op) {
       case C_EXISTS: r = each.tag != T_UNRESOLVED; break;
@@ -3524,9 +3960,15 @@ OpResult unary_operation(const std::vector<Part*>& lhs_query, int op, bool op_no
         else if (v->kind == K_MAP) r = v->map_empty();
         else if (v->kind == K_STRING) r = v->s.empty();
         else if (v->kind == K_BOOL) r = false;
-        else
-          throw GuardErr(std::string("Attempting EMPTY operation on type ") +
-                         v->type_info() + " that does not support it");
+        else {
+          GuardErr e(std::string("Attempting EMPTY operation on type ") +
+                     v->type_info() + " that does not support it at " + v->path);
+          if (rec)
+            ctx->rec_end(RT_CLAUSE_VALUE_CHECK,
+                         pay_unary(each, op, op_not, has_custom, custom, true,
+                                   e.msg));
+          throw e;
+        }
         break;
       }
       case C_IS_STRING: r = each.tag != T_UNRESOLVED && each.value->kind == K_STRING; break;
@@ -3540,14 +3982,61 @@ OpResult unary_operation(const std::vector<Part*>& lhs_query, int op, bool op_no
     }
     if (op_not) r = !r;
     if (inverse) r = !r;
+    if (rec) {
+      if (r) {
+        if (rec_success(ctx)) ctx->rec_end(RT_CLAUSE_VALUE_CHECK, pay_success());
+        else ctx->rec_drop();
+      } else {
+        ctx->rec_end(RT_CLAUSE_VALUE_CHECK,
+                     pay_unary(each, op, op_not, has_custom, custom));
+      }
+    }
     out.statuses.emplace_back(each, r ? ST_PASS : ST_FAIL);
   }
   return out;
 }
 
+RecPayload pay_comparison(int op, bool neg, const QR& from, bool has_to,
+                          const QR& to, bool has_custom, const std::string& custom,
+                          bool has_msg = false,
+                          const std::string& msg = std::string()) {
+  RecPayload p;
+  p.cc = CC_COMPARISON;
+  p.status = ST_FAIL;
+  p.cmp_op = op;
+  p.cmp_neg = neg;
+  p.has_from = true;
+  p.from = from;
+  p.has_to = has_to;
+  p.to = to;
+  p.has_custom = has_custom;
+  p.custom = custom;
+  p.has_message = has_msg;
+  p.message = msg;
+  return p;
+}
+
+RecPayload pay_in_comparison(int op, bool neg, const QR& from,
+                             std::vector<QR> to_list, bool has_custom,
+                             const std::string& custom) {
+  RecPayload p;
+  p.cc = CC_IN_COMPARISON;
+  p.status = ST_FAIL;
+  p.cmp_op = op;
+  p.cmp_neg = neg;
+  p.has_from = true;
+  p.from = from;
+  p.has_to_list = true;
+  p.to_list = std::move(to_list);
+  p.has_custom = has_custom;
+  p.custom = custom;
+  return p;
+}
+
 OpResult binary_operation(const std::vector<Part*>& lhs_query,
                           const std::vector<QR>& rhs, int op, bool negated,
-                          Resolver* ctx) {
+                          const std::string& context, bool has_custom,
+                          const std::string& custom, Resolver* ctx) {
   std::vector<QR> lhs = ctx->query(lhs_query);
   bool skip = false;
   std::vector<VER> results =
@@ -3558,31 +4047,66 @@ OpResult binary_operation(const std::vector<Part*>& lhs_query,
     out.empty_status = ST_SKIP;
     return out;
   }
+  bool rec = recording(ctx);
+
+  auto record_fail = [&](RecPayload p, const QR& qr) {
+    if (rec) {
+      ctx->rec_start(context);
+      ctx->rec_end(RT_CLAUSE_VALUE_CHECK, std::move(p));
+    }
+    out.statuses.emplace_back(qr, ST_FAIL);
+  };
+  bool rec_pass = rec && rec_success(ctx);
+  auto record_pass = [&](const QR& qr) {
+    if (rec_pass) {
+      ctx->rec_start(context);
+      ctx->rec_end(RT_CLAUSE_VALUE_CHECK, pay_success());
+    }
+    out.statuses.emplace_back(qr, ST_PASS);
+  };
+
   for (const VER& e : results) {
     switch (e.tag) {
       case V_LHS_UR:
-        out.statuses.emplace_back(e.ur, ST_FAIL);
+        record_fail(pay_comparison(op, negated, e.ur, false, QR(), has_custom,
+                                   custom),
+                    e.ur);
         break;
       case V_RHS_UR:
-        out.statuses.emplace_back(QR::resolved(e.lhs), ST_FAIL);
+        record_fail(pay_comparison(op, negated, QR::resolved(e.lhs), true, e.ur,
+                                   has_custom, custom),
+                    QR::resolved(e.lhs));
         break;
       case V_NOT_COMP:
-        out.statuses.emplace_back(QR::resolved(e.lhs), ST_FAIL);
+        record_fail(pay_comparison(op, negated, QR::resolved(e.lhs), true,
+                                   QR::resolved(e.rhs), has_custom, custom, true,
+                                   e.reason),
+                    QR::resolved(e.lhs));
         break;
       case V_SUCCESS:
         if (e.ckind == CK_QUERY_IN) {
-          for (PVal* l : e.lhs_list) out.statuses.emplace_back(QR::resolved(l), ST_PASS);
-        } else if (e.ckind == CK_LIST_IN) {
-          out.statuses.emplace_back(QR::resolved(e.lhs), ST_PASS);
+          for (PVal* l : e.lhs_list) record_pass(QR::resolved(l));
         } else {
-          out.statuses.emplace_back(QR::resolved(e.lhs), ST_PASS);
+          record_pass(QR::resolved(e.lhs));
         }
         break;
       default:  // V_FAIL
-        if (e.ckind == CK_QUERY_IN) {
-          for (PVal* l : e.diff) out.statuses.emplace_back(QR::resolved(l), ST_FAIL);
-        } else {
-          out.statuses.emplace_back(QR::resolved(e.lhs), ST_FAIL);
+        if (e.ckind == CK_VALUE) {
+          record_fail(pay_comparison(op, negated, QR::resolved(e.lhs), true,
+                                     QR::resolved(e.rhs), has_custom, custom),
+                      QR::resolved(e.lhs));
+        } else if (e.ckind == CK_VALUE_IN || e.ckind == CK_LIST_IN) {
+          record_fail(
+              pay_in_comparison(op, negated, QR::resolved(e.lhs),
+                                {QR::resolved(e.rhs)}, has_custom, custom),
+              QR::resolved(e.lhs));
+        } else {  // CK_QUERY_IN
+          std::vector<QR> rhs_qrs;
+          for (PVal* r : e.rhs_list) rhs_qrs.push_back(QR::resolved(r));
+          for (PVal* l : e.diff)
+            record_fail(pay_in_comparison(op, negated, QR::resolved(l), rhs_qrs,
+                                          has_custom, custom),
+                        QR::resolved(l));
         }
     }
   }
@@ -3673,13 +4197,23 @@ std::vector<LCmp> each_lhs_compare(
 std::vector<std::pair<QR, int>> real_binary_operation(const std::vector<QR>& lhs,
                                                       const std::vector<QR>& rhs,
                                                       int op, bool negated,
+                                                      const std::string& context,
+                                                      bool has_custom,
+                                                      const std::string& custom,
                                                       Resolver* ctx) {
   std::vector<std::pair<QR, int>> statuses;
   RxCache& rx = ctx->state()->eng->rx;
+  bool rec = recording(ctx);
   if (op == C_EQ && rhs.size() > 1) op = C_IN;  // eval.rs:986-990
 
   for (const QR& each : lhs) {
     if (each.tag == T_UNRESOLVED) {
+      if (rec) {
+        ctx->rec_start(context);
+        ctx->rec_end(RT_CLAUSE_VALUE_CHECK,
+                     pay_comparison(op, negated, each, false, QR(), has_custom,
+                                    custom));
+      }
       statuses.emplace_back(each, ST_FAIL);
       continue;
     }
@@ -3722,27 +4256,63 @@ std::vector<std::pair<QR, int>> real_binary_operation(const std::vector<QR>& lhs
 
     if (op == C_IN) {
       // _report_at_least_one (evaluator.py:870-920): group by lhs
-      // IDENTITY, PASS iff any comparable outcome true
-      std::vector<std::pair<PVal*, bool>> by_lhs;
+      // IDENTITY, PASS iff any comparable outcome true; FAIL records
+      // collect every rhs seen for that lhs
+      struct Bucket {
+        PVal* key;
+        bool hit = false;
+        std::vector<QR> to_collected;
+      };
+      std::vector<Bucket> by_lhs;
       for (const LCmp& c : r) {
-        PVal* key = c.lhs;
-        bool hit = (c.tag == 0 && c.outcome);
-        bool found = false;
+        Bucket* b = nullptr;
         for (auto& entry : by_lhs)
-          if (entry.first == key) {
-            entry.second = entry.second || hit;
-            found = true;
-            break;
-          }
-        if (!found) by_lhs.emplace_back(key, hit);
+          if (entry.key == c.lhs) { b = &entry; break; }
+        if (!b) {
+          by_lhs.push_back(Bucket{c.lhs});
+          b = &by_lhs.back();
+        }
+        b->hit = b->hit || (c.tag == 0 && c.outcome);
+        if (rec)
+          b->to_collected.push_back(c.tag == 2 ? c.rhs_q : QR::resolved(c.rhs));
       }
-      for (const auto& entry : by_lhs)
-        statuses.emplace_back(QR::resolved(entry.first),
-                              entry.second ? ST_PASS : ST_FAIL);
+      for (auto& entry : by_lhs) {
+        if (rec) {
+          if (entry.hit && !rec_success(ctx)) {
+            statuses.emplace_back(QR::resolved(entry.key), ST_PASS);
+            continue;
+          }
+          ctx->rec_start(context);
+          if (entry.hit)
+            ctx->rec_end(RT_CLAUSE_VALUE_CHECK, pay_success());
+          else
+            ctx->rec_end(RT_CLAUSE_VALUE_CHECK,
+                         pay_in_comparison(op, negated, QR::resolved(entry.key),
+                                           std::move(entry.to_collected),
+                                           has_custom, custom));
+        }
+        statuses.emplace_back(QR::resolved(entry.key),
+                              entry.hit ? ST_PASS : ST_FAIL);
+      }
     } else {
       // _report_all_values (evaluator.py:825-867)
       for (const LCmp& c : r) {
         bool ok = (c.tag == 0 && c.outcome);
+        if (rec) {
+          if (ok && !rec_success(ctx)) {
+            statuses.emplace_back(QR::resolved(c.lhs), ST_PASS);
+            continue;
+          }
+          ctx->rec_start(context);
+          if (ok) {
+            ctx->rec_end(RT_CLAUSE_VALUE_CHECK, pay_success());
+          } else {
+            QR to_qr = c.tag == 2 ? c.rhs_q : QR::resolved(c.rhs);
+            ctx->rec_end(RT_CLAUSE_VALUE_CHECK,
+                         pay_comparison(op, negated, QR::resolved(c.lhs), true,
+                                        to_qr, has_custom, custom));
+          }
+        }
         statuses.emplace_back(QR::resolved(c.lhs), ok ? ST_PASS : ST_FAIL);
       }
     }
@@ -3759,80 +4329,277 @@ int eval_rule_clause(Clause* c, Resolver* resolver);
 
 int eval_guard_access_clause(Clause* gac, Resolver* resolver) {
   bool all_match = gac->query->match_all;
-  OpResult statuses;
-  if (cmp_is_unary(gac->cmp)) {
-    statuses = unary_operation(gac->query->parts, gac->cmp, gac->inv, gac->neg, resolver);
-  } else {
-    if (!gac->cw)
-      throw NotComparable("GuardAccessClause did not have a RHS for compare operation");
-    std::vector<QR> rhs;
-    switch (gac->cw->tag) {
-      case LV_PV: rhs = {QR::literal(gac->cw->pv)}; break;
-      case LV_QUERY: rhs = resolver->query(gac->cw->q->parts); break;
-      default:
-        rhs = resolve_function(gac->cw->fn->name, gac->cw->fn->params, resolver);
-    }
-    statuses = binary_operation(gac->query->parts, rhs, gac->cmp,
-                                gac->inv != false ? gac->inv : false, resolver);
-    // note: negation (`not <clause>`) applies through operator_compare's
-    // `negated` only for unary ops in the reference; binary clauses fold
-    // `!`/`not` into comparator_inverse at parse time and `negation`
-    // stays false — mirrored from evaluator.py:932-975 where binary ops
-    // receive cmp=(op, inverse) and unary ops receive `inverse=negation`
+  bool rec = recording(resolver);
+  std::string display, blk_context;
+  if (rec) {
+    display = display_access_clause(gac);
+    blk_context = "GuardAccessClause#block" + display;
+    resolver->rec_start(blk_context);
   }
-  if (statuses.empty) return statuses.empty_status;
+  OpResult statuses;
+  try {
+    if (cmp_is_unary(gac->cmp)) {
+      statuses = unary_operation(gac->query->parts, gac->cmp, gac->inv, gac->neg,
+                                 display, gac->has_msg, gac->msg, resolver);
+    } else {
+      if (!gac->cw) {
+        if (rec)
+          resolver->rec_end(
+              RT_GUARD_CLAUSE_BLOCK_CHECK,
+              pay_block_msg(ST_FAIL, !all_match,
+                            "Error not RHS for binary clause when handling "
+                            "clause, bailing"));
+        throw NotComparable("GuardAccessClause " + blk_context +
+                            ", did not have a RHS for compare operation");
+      }
+      std::vector<QR> rhs;
+      switch (gac->cw->tag) {
+        case LV_PV: rhs = {QR::literal(gac->cw->pv)}; break;
+        case LV_QUERY: rhs = resolver->query(gac->cw->q->parts); break;
+        default:
+          rhs = resolve_function(gac->cw->fn->name, gac->cw->fn->params, resolver);
+      }
+      statuses = binary_operation(gac->query->parts, rhs, gac->cmp, gac->inv,
+                                  display, gac->has_msg, gac->msg, resolver);
+      // note: `not <clause>` negation applies through operator_compare's
+      // `negated` only for unary ops; binary clauses fold `!`/`not` into
+      // comparator_inverse at parse time (evaluator.py:932-975)
+    }
+  } catch (const NotComparable& e) {
+    // the missing-RHS case already recorded its block check above
+    if (rec && gac->cw)
+      resolver->rec_end(RT_GUARD_CLAUSE_BLOCK_CHECK,
+                        pay_block_msg(ST_FAIL, !all_match,
+                                      "Error " + e.msg +
+                                          " when handling clause, bailing"));
+    throw;
+  } catch (const GuardErr& e) {
+    if (rec)
+      resolver->rec_end(RT_GUARD_CLAUSE_BLOCK_CHECK,
+                        pay_block_msg(ST_FAIL, !all_match,
+                                      "Error " + e.msg +
+                                          " when handling clause, bailing"));
+    throw;
+  }
+  if (statuses.empty) {
+    if (rec)
+      resolver->rec_end(RT_GUARD_CLAUSE_BLOCK_CHECK,
+                        pay_block(statuses.empty_status, all_match));
+    return statuses.empty_status;
+  }
   int fails = 0, passes = 0;
   for (const auto& vs : statuses.statuses) {
     if (vs.second == ST_FAIL) fails++;
     else if (vs.second == ST_PASS) passes++;
   }
-  if (all_match) return fails > 0 ? ST_FAIL : ST_PASS;
-  return passes > 0 ? ST_PASS : ST_FAIL;
+  int outcome;
+  if (all_match) outcome = fails > 0 ? ST_FAIL : ST_PASS;
+  else outcome = passes > 0 ? ST_PASS : ST_FAIL;
+  if (rec)
+    resolver->rec_end(RT_GUARD_CLAUSE_BLOCK_CHECK, pay_block(outcome, !all_match));
+  return outcome;
+}
+
+RecPayload pay_dependent(const std::string& rule, bool has_msg,
+                         const std::string& msg, bool has_custom,
+                         const std::string& custom) {
+  RecPayload p;
+  p.cc = CC_DEPENDENT_RULE;
+  p.status = ST_FAIL;
+  p.name = rule;
+  p.has_message = has_msg;
+  p.message = msg;
+  p.has_custom = has_custom;
+  p.custom = custom;
+  return p;
 }
 
 int eval_guard_named_clause(Clause* gnc, Resolver* resolver) {
   // evaluator.py:1017-1061 (eval.rs:1227-1289)
-  int status = resolver->rule_status(gnc->rule);
-  if (status == ST_PASS) return gnc->neg ? ST_FAIL : ST_PASS;
-  return gnc->neg ? ST_PASS : ST_FAIL;
+  bool rec = recording(resolver);
+  std::string context;
+  if (rec) {
+    context = (gnc->neg ? "not " : "") + gnc->rule;
+    resolver->rec_start(context);
+  }
+  int status;
+  try {
+    status = resolver->rule_status(gnc->rule);
+  } catch (const GuardErr& e) {
+    if (rec)
+      resolver->rec_end(RT_CLAUSE_VALUE_CHECK,
+                        pay_dependent(gnc->rule, true,
+                                      context + " failed due to error " + e.msg,
+                                      gnc->has_msg, gnc->msg));
+    throw;
+  }
+  int outcome;
+  if (status == ST_PASS) outcome = gnc->neg ? ST_FAIL : ST_PASS;
+  else outcome = gnc->neg ? ST_PASS : ST_FAIL;
+  if (rec) {
+    if (outcome == ST_PASS) {
+      if (rec_success(resolver))
+        resolver->rec_end(RT_CLAUSE_VALUE_CHECK, pay_success());
+      else
+        resolver->rec_drop();
+    } else
+      resolver->rec_end(RT_CLAUSE_VALUE_CHECK,
+                        pay_dependent(gnc->rule, false, "", gnc->has_msg,
+                                      gnc->msg));
+  }
+  return outcome;
 }
 
 int eval_general_block_clause(const std::vector<Assign>& assigns, const Conj& conj,
-                              Resolver* resolver, int (*eval_fn)(Clause*, Resolver*)) {
+                              Resolver* resolver, int (*eval_fn)(Clause*, Resolver*),
+                              const char* context = CTX_GUARD_DISJ) {
   BlockScope scope(assigns, resolver->root(), resolver);
-  return eval_conjunction_clauses(conj, &scope, eval_fn);
+  return eval_conjunction_clauses(conj, &scope, eval_fn, context);
 }
 
 int eval_guard_block_clause(Clause* bc, Resolver* resolver) {
   // evaluator.py:1075-1164 (eval.rs:1303-1426)
   bool match_all = bc->query->match_all;
-  std::vector<QR> block_values = resolver->query(bc->query->parts);
-  if (block_values.empty()) return bc->not_empty ? ST_FAIL : ST_SKIP;
+  bool rec = recording(resolver);
+  std::string context;
+  if (rec) {
+    context = "BlockGuardClause#" + loc_str(bc->loc);
+    resolver->rec_start(context);
+  }
+  std::vector<QR> block_values;
+  try {
+    block_values = resolver->query(bc->query->parts);
+  } catch (...) {
+    if (rec)
+      resolver->rec_end(RT_BLOCK_GUARD_CHECK, pay_block(ST_FAIL, !match_all));
+    throw;
+  }
+  if (block_values.empty()) {
+    int status = bc->not_empty ? ST_FAIL : ST_SKIP;
+    if (rec)
+      resolver->rec_end(RT_BLOCK_GUARD_CHECK, pay_block(status, !match_all));
+    return status;
+  }
   int fails = 0, passes = 0;
   for (const QR& each : block_values) {
-    if (each.tag == T_UNRESOLVED) { fails++; continue; }
+    if (each.tag == T_UNRESOLVED) {
+      fails++;
+      if (rec) {
+        std::string guard_cxt = "GuardBlockAccessClause#" + loc_str(bc->loc);
+        resolver->rec_start(guard_cxt);
+        RecPayload p;
+        p.cc = CC_MISSING_BLOCK_VALUE;
+        p.status = ST_FAIL;
+        p.has_from = true;
+        p.from = each;
+        p.has_message = true;
+        p.message = "Query " + display_query(bc->query->parts) +
+                    " did not resolve to correct value, reason " +
+                    (each.ur_has_reason ? each.ur_reason : std::string());
+        resolver->rec_end(RT_CLAUSE_VALUE_CHECK, std::move(p));
+      }
+      continue;
+    }
     ValueScope vs(each.value, resolver);
-    int status = eval_general_block_clause(bc->assigns, bc->conj, &vs, eval_guard_clause);
+    int status;
+    try {
+      status = eval_general_block_clause(bc->assigns, bc->conj, &vs,
+                                         eval_guard_clause);
+    } catch (const GuardErr& e) {
+      if (rec)
+        resolver->rec_end(RT_BLOCK_GUARD_CHECK,
+                          pay_block_msg(ST_FAIL, !match_all,
+                                        "Error " + e.msg +
+                                            " when handling block clause, bailing"));
+      throw;
+    } catch (const NotComparable& e) {
+      if (rec)
+        resolver->rec_end(RT_BLOCK_GUARD_CHECK,
+                          pay_block_msg(ST_FAIL, !match_all,
+                                        "Error " + e.msg +
+                                            " when handling block clause, bailing"));
+      throw;
+    }
     if (status == ST_PASS) passes++;
     else if (status == ST_FAIL) fails++;
   }
+  int status;
   if (match_all)
-    return fails > 0 ? ST_FAIL : (passes > 0 ? ST_PASS : ST_SKIP);
-  return passes > 0 ? ST_PASS : (fails > 0 ? ST_FAIL : ST_SKIP);
+    status = fails > 0 ? ST_FAIL : (passes > 0 ? ST_PASS : ST_SKIP);
+  else
+    status = passes > 0 ? ST_PASS : (fails > 0 ? ST_FAIL : ST_SKIP);
+  if (rec) resolver->rec_end(RT_BLOCK_GUARD_CHECK, pay_block(status, !match_all));
+  return status;
 }
 
-int eval_when_condition_block(const Conj& conditions, const std::vector<Assign>& assigns,
-                              const Conj& conj, Resolver* resolver) {
+int eval_when_condition_block(const char* context, const Conj& conditions,
+                              const std::vector<Assign>& assigns, const Conj& conj,
+                              Resolver* resolver) {
   // evaluator.py:1167-1221 (eval.rs:1428-1502)
-  int status = eval_conjunction_clauses(conditions, resolver, eval_when_clause);
-  if (status != ST_PASS) return ST_SKIP;
-  return eval_general_block_clause(assigns, conj, resolver, eval_guard_clause);
+  bool rec = recording(resolver);
+  std::string when_context;
+  if (rec) {
+    resolver->rec_start(context);
+    when_context = std::string(context) + "/When";
+    resolver->rec_start(when_context);
+  }
+  int status;
+  try {
+    status = eval_conjunction_clauses(conditions, resolver, eval_when_clause,
+                                      CTX_WHEN_DISJ);
+  } catch (const GuardErr& e) {
+    if (rec) {
+      resolver->rec_end(RT_WHEN_CONDITION, pay_status(ST_FAIL));
+      resolver->rec_end(RT_WHEN_CHECK,
+                        pay_block_msg(ST_FAIL, false,
+                                      "Error " + e.msg +
+                                          " during type condition evaluation, bailing"));
+    }
+    throw;
+  } catch (const NotComparable& e) {
+    if (rec) {
+      resolver->rec_end(RT_WHEN_CONDITION, pay_status(ST_FAIL));
+      resolver->rec_end(RT_WHEN_CHECK,
+                        pay_block_msg(ST_FAIL, false,
+                                      "Error " + e.msg +
+                                          " during type condition evaluation, bailing"));
+    }
+    throw;
+  }
+  if (status != ST_PASS) {
+    if (rec) {
+      resolver->rec_end(RT_WHEN_CONDITION, pay_status(status));
+      resolver->rec_end(RT_WHEN_CHECK, pay_block(ST_SKIP, false));
+    }
+    return ST_SKIP;
+  }
+  if (rec) resolver->rec_end(RT_WHEN_CONDITION, pay_status(ST_PASS));
+  try {
+    status = eval_general_block_clause(assigns, conj, resolver, eval_guard_clause);
+  } catch (const GuardErr& e) {
+    if (rec)
+      resolver->rec_end(RT_WHEN_CHECK,
+                        pay_block_msg(ST_FAIL, false,
+                                      "Error " + e.msg +
+                                          " during type condition evaluation, bailing"));
+    throw;
+  } catch (const NotComparable& e) {
+    if (rec)
+      resolver->rec_end(RT_WHEN_CHECK,
+                        pay_block_msg(ST_FAIL, false,
+                                      "Error " + e.msg +
+                                          " during type condition evaluation, bailing"));
+    throw;
+  }
+  if (rec) resolver->rec_end(RT_WHEN_CHECK, pay_block(status, false));
+  return status;
 }
 
 // _ResolvedParameterContext (evaluator.py:1224-1269; eval.rs:1504-1572)
 struct ResolvedParameterContext : Resolver {
   std::unordered_map<std::string, std::vector<QR>> resolved;
   Resolver* parent;
+  Clause* call = nullptr;  // the ParameterizedNamedRuleClause
 
   explicit ResolvedParameterContext(Resolver* p) : parent(p) {}
 
@@ -3853,6 +4620,17 @@ struct ResolvedParameterContext : Resolver {
     parent->add_capture(name, key);
   }
   EvalState* state() override { return parent->state(); }
+  void rec_start(std::string ctx) override { parent->rec_start(std::move(ctx)); }
+  void rec_end(int rt, RecPayload p) override {
+    // evaluator.py:1256-1269: rewrite the called rule's RuleCheck
+    // message to the call site's custom message
+    if (rt == RT_RULE_CHECK && call && p.name == call->named->rule) {
+      p.has_message = call->named->has_msg;
+      p.message = call->named->has_msg ? call->named->msg : std::string();
+    }
+    parent->rec_end(rt, std::move(p));
+  }
+  void rec_drop() override { parent->rec_drop(); }
 };
 
 int eval_parameterized_rule_call(Clause* call, Resolver* resolver) {
@@ -3861,6 +4639,7 @@ int eval_parameterized_rule_call(Clause* call, Resolver* resolver) {
   if (pr->params.size() != call->params.size())
     throw GuardErr("Arity mismatch for called parameter rule " + call->named->rule);
   ResolvedParameterContext ctx(resolver);
+  ctx.call = call;
   for (size_t idx = 0; idx < call->params.size(); idx++) {
     LetValue* each = call->params[idx];
     const std::string& name = pr->params[idx];
@@ -3881,7 +4660,8 @@ int eval_guard_clause(Clause* c, Resolver* resolver) {
     case CL_NAMED: return eval_guard_named_clause(c, resolver);
     case CL_BLOCK: return eval_guard_block_clause(c, resolver);
     case CL_WHEN:
-      return eval_when_condition_block(c->conditions, c->assigns, c->conj, resolver);
+      return eval_when_condition_block("GuardConditionClause", c->conditions,
+                                       c->assigns, c->conj, resolver);
     case CL_CALL: return eval_parameterized_rule_call(c, resolver);
     default: throw GuardErr("Unknown guard clause");
   }
@@ -3897,55 +4677,231 @@ int eval_when_clause(Clause* c, Resolver* resolver) {
   }
 }
 
+RecPayload pay_type_check(const std::string& type_name, int status,
+                          bool has_msg = false,
+                          const std::string& msg = std::string()) {
+  RecPayload p;
+  p.name = type_name;
+  p.status = status;
+  p.at_least_one = false;
+  p.has_message = has_msg;
+  p.message = msg;
+  return p;
+}
+
 int eval_type_block_clause(Clause* tb, Resolver* resolver) {
   // evaluator.py:1324-1461 (eval.rs:1649-1822)
+  bool rec = recording(resolver);
+  std::string context = "TypeBlock#" + tb->type_name;
+  if (rec) resolver->rec_start(context);
   if (tb->has_conditions) {
-    int status = eval_conjunction_clauses(tb->conditions, resolver, eval_when_clause);
-    if (status != ST_PASS) return ST_SKIP;
+    if (rec) resolver->rec_start(context + "/When");
+    int status;
+    try {
+      status = eval_conjunction_clauses(tb->conditions, resolver, eval_when_clause,
+                                        CTX_WHEN_DISJ);
+    } catch (const GuardErr& e) {
+      if (rec) {
+        resolver->rec_end(RT_TYPE_CONDITION, pay_status(ST_FAIL));
+        resolver->rec_end(RT_TYPE_CHECK,
+                          pay_type_check(tb->type_name, ST_FAIL, true,
+                                         "Error " + e.msg +
+                                             " during type condition evaluation, bailing"));
+      }
+      throw;
+    } catch (const NotComparable& e) {
+      if (rec) {
+        resolver->rec_end(RT_TYPE_CONDITION, pay_status(ST_FAIL));
+        resolver->rec_end(RT_TYPE_CHECK,
+                          pay_type_check(tb->type_name, ST_FAIL, true,
+                                         "Error " + e.msg +
+                                             " during type condition evaluation, bailing"));
+      }
+      throw;
+    }
+    if (status != ST_PASS) {
+      if (rec) {
+        resolver->rec_end(RT_TYPE_CONDITION, pay_status(status));
+        resolver->rec_end(RT_TYPE_CHECK, pay_type_check(tb->type_name, ST_SKIP));
+      }
+      return ST_SKIP;
+    }
+    if (rec) resolver->rec_end(RT_TYPE_CONDITION, pay_status(ST_PASS));
   }
-  std::vector<QR> values = resolver->query(tb->tb_query);
-  if (values.empty()) return ST_SKIP;
+  std::vector<QR> values;
+  try {
+    values = resolver->query(tb->tb_query);
+  } catch (...) {
+    if (rec)
+      resolver->rec_end(RT_TYPE_CHECK, pay_type_check(tb->type_name, ST_FAIL));
+    throw;
+  }
+  if (values.empty()) {
+    if (rec)
+      resolver->rec_end(RT_TYPE_CHECK, pay_type_check(tb->type_name, ST_SKIP));
+    return ST_SKIP;
+  }
   int fails = 0, passes = 0;
+  int idx = -1;
   for (const QR& each : values) {
-    if (each.tag == T_UNRESOLVED)
+    idx++;
+    if (each.tag == T_UNRESOLVED) {
+      if (rec)
+        resolver->rec_end(
+            RT_TYPE_CHECK,
+            pay_type_check(tb->type_name, ST_FAIL, each.ur_has_reason,
+                           each.ur_reason));
       throw GuardErr("Unable to resolve type block query: " + tb->type_name);
+    }
+    std::string block_context;
+    if (rec) {
+      block_context = context + "/" + std::to_string(idx);
+      resolver->rec_start(block_context);
+    }
     ValueScope vs(each.value, resolver);
-    int status = eval_general_block_clause(tb->assigns, tb->conj, &vs, eval_guard_clause);
+    int status;
+    try {
+      status = eval_general_block_clause(tb->assigns, tb->conj, &vs,
+                                         eval_guard_clause);
+    } catch (const GuardErr& e) {
+      if (rec) {
+        resolver->rec_end(RT_TYPE_BLOCK, pay_status(ST_FAIL));
+        resolver->rec_end(RT_TYPE_CHECK,
+                          pay_type_check(tb->type_name, ST_FAIL, true,
+                                         "Error " + e.msg +
+                                             " during type block evaluation, bailing"));
+      }
+      throw;
+    } catch (const NotComparable& e) {
+      if (rec) {
+        resolver->rec_end(RT_TYPE_BLOCK, pay_status(ST_FAIL));
+        resolver->rec_end(RT_TYPE_CHECK,
+                          pay_type_check(tb->type_name, ST_FAIL, true,
+                                         "Error " + e.msg +
+                                             " during type block evaluation, bailing"));
+      }
+      throw;
+    }
+    if (rec) resolver->rec_end(RT_TYPE_BLOCK, pay_status(status));
     if (status == ST_PASS) passes++;
     else if (status == ST_FAIL) fails++;
   }
-  return fails > 0 ? ST_FAIL : (passes > 0 ? ST_PASS : ST_SKIP);
+  int status = fails > 0 ? ST_FAIL : (passes > 0 ? ST_PASS : ST_SKIP);
+  if (rec) resolver->rec_end(RT_TYPE_CHECK, pay_type_check(tb->type_name, status));
+  return status;
 }
 
 int eval_rule_clause(Clause* c, Resolver* resolver) {
   // evaluator.py:1464-1472 (eval.rs:1824-1835)
   if (c->t == CL_TYPE_BLOCK) return eval_type_block_clause(c, resolver);
   if (c->t == CL_WHEN)
-    return eval_when_condition_block(c->conditions, c->assigns, c->conj, resolver);
+    return eval_when_condition_block("RuleClause", c->conditions, c->assigns,
+                                     c->conj, resolver);
   return eval_guard_clause(c, resolver);
 }
 
 int eval_rule(RuleC* rule, Resolver* resolver) {
   // evaluator.py:1475-1530 (eval.rs:1837-1906)
+  bool rec = recording(resolver);
+  if (rec) resolver->rec_start(rule->name);
   if (rule->has_conditions) {
-    int status = eval_conjunction_clauses(rule->conditions, resolver, eval_when_clause);
-    if (status != ST_PASS) return ST_SKIP;
+    if (rec) resolver->rec_start("Rule#" + rule->name + "/When");
+    int status;
+    try {
+      status = eval_conjunction_clauses(rule->conditions, resolver,
+                                        eval_when_clause, CTX_WHEN_DISJ);
+    } catch (...) {
+      if (rec) {
+        resolver->rec_end(RT_RULE_CONDITION, pay_status(ST_FAIL));
+        resolver->rec_end(RT_RULE_CHECK, pay_named(rule->name, ST_FAIL));
+      }
+      throw;
+    }
+    if (status != ST_PASS) {
+      if (rec) {
+        resolver->rec_end(RT_RULE_CONDITION, pay_status(status));
+        resolver->rec_end(RT_RULE_CHECK, pay_named(rule->name, ST_SKIP));
+      }
+      return ST_SKIP;
+    }
+    if (rec) resolver->rec_end(RT_RULE_CONDITION, pay_status(ST_PASS));
   }
-  BlockScope scope(rule->assigns, resolver->root(), resolver);
-  return eval_conjunction_clauses(rule->conj, &scope, eval_rule_clause);
+  int status;
+  try {
+    BlockScope scope(rule->assigns, resolver->root(), resolver);
+    status = eval_conjunction_clauses(rule->conj, &scope, eval_rule_clause,
+                                      CTX_RULE_DISJ);
+  } catch (...) {
+    if (rec) resolver->rec_end(RT_RULE_CHECK, pay_named(rule->name, ST_FAIL));
+    throw;
+  }
+  if (rec) resolver->rec_end(RT_RULE_CHECK, pay_named(rule->name, status));
+  return status;
+}
+
+// eval_rules_file (evaluator.py:1533-1564; eval.rs:1915-1968) —
+// per-rule statuses out; wraps everything in the FileCheck record
+int eval_rules_file_rec(Engine* eng, Resolver* resolver,
+                        const std::string& data_file_name,
+                        std::vector<int>* statuses_out) {
+  bool rec = recording(resolver);
+  if (rec)
+    resolver->rec_start("File(rules=" + std::to_string(eng->rules.size()) + ")");
+  int fails = 0, passes = 0;
+  for (RuleC* each_rule : eng->rules) {
+    int status;
+    try {
+      status = eval_rule(each_rule, resolver);
+    } catch (...) {
+      // python quirk mirrored: the File record ends with a RuleCheck
+      // payload on error (evaluator.py:1543-1551)
+      if (rec) resolver->rec_end(RT_RULE_CHECK, pay_named(each_rule->name, ST_FAIL));
+      throw;
+    }
+    if (statuses_out) statuses_out->push_back(status);
+    if (status == ST_PASS) passes++;
+    else if (status == ST_FAIL) fails++;
+  }
+  int overall = fails > 0 ? ST_FAIL : (passes > 0 ? ST_PASS : ST_SKIP);
+  if (rec) resolver->rec_end(RT_FILE_CHECK, pay_named(data_file_name, overall));
+  return overall;
 }
 
 int eval_conjunction_clauses(const Conj& conjunctions, Resolver* resolver,
-                             int (*eval_fn)(Clause*, Resolver*)) {
-  // evaluator.py:1567-1634 (eval.rs:1971-2065)
+                             int (*eval_fn)(Clause*, Resolver*),
+                             const char* context) {
+  // evaluator.py:1567-1634 (eval.rs:1971-2065) — the context embeds the
+  // reference's generic type name, pinned by reporters
+  bool rec = recording(resolver);
   int num_passes = 0, num_fails = 0;
   for (const auto& conjunction : conjunctions) {
     int disjunction_fails = 0;
+    bool multiple_ors = conjunction.size() > 1;
+    if (rec && multiple_ors) resolver->rec_start(context);
     bool passed = false;
     for (Clause* disjunction : conjunction) {
-      int status = eval_fn(disjunction, resolver);
+      int status;
+      try {
+        status = eval_fn(disjunction, resolver);
+      } catch (const GuardErr& e) {
+        if (rec && multiple_ors)
+          resolver->rec_end(RT_DISJUNCTION,
+                            pay_block_msg(ST_FAIL, true,
+                                          "Disjunction failed due to error " +
+                                              e.msg + ", bailing"));
+        throw;
+      } catch (const NotComparable& e) {
+        if (rec && multiple_ors)
+          resolver->rec_end(RT_DISJUNCTION,
+                            pay_block_msg(ST_FAIL, true,
+                                          "Disjunction failed due to error " +
+                                              e.msg + ", bailing"));
+        throw;
+      }
       if (status == ST_PASS) {
         num_passes++;
+        if (rec && multiple_ors)
+          resolver->rec_end(RT_DISJUNCTION, pay_block(ST_PASS, true));
         passed = true;
         break;
       }
@@ -3953,10 +4909,828 @@ int eval_conjunction_clauses(const Conj& conjunctions, Resolver* resolver,
     }
     if (passed) continue;
     if (disjunction_fails > 0) num_fails++;
+    if (rec && multiple_ors)
+      resolver->rec_end(
+          RT_DISJUNCTION,
+          pay_block(disjunction_fails > 0 ? ST_FAIL : ST_SKIP, true));
   }
   if (num_fails > 0) return ST_FAIL;
   if (num_passes > 0) return ST_PASS;
   return ST_SKIP;
+}
+
+// ---------------------------------------------------------------------------
+// Record-tree JSON emission (consumed by guard_tpu/core/ast_serde.py
+// records_from_wire, which rebuilds the EventRecord tree for
+// commands/report.py)
+// ---------------------------------------------------------------------------
+void json_escape(const std::string& s, std::string& out) {
+  out.push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out.push_back(static_cast<char>(c));
+        }
+    }
+  }
+  out.push_back('"');
+}
+
+void pv_json(const PVal& pv, std::string& out) {
+  out += "{\"k\":" + std::to_string(pv.kind);
+  out += ",\"p\":[";
+  json_escape(pv.path, out);
+  out += "," + std::to_string(pv.line) + "," + std::to_string(pv.col) + "]";
+  switch (pv.kind) {
+    case K_NULL: break;
+    case K_STRING: case K_REGEX: case K_CHAR:
+      out += ",\"s\":";
+      json_escape(pv.s, out);
+      break;
+    case K_BOOL:
+      out += ",\"b\":";
+      out += pv.b ? "true" : "false";
+      break;
+    case K_INT:
+      out += ",\"i\":" + std::to_string(pv.i);
+      break;
+    case K_FLOAT:
+      out += ",\"f\":" + format_float(pv.f);
+      if (pv.f == static_cast<long long>(pv.f) && pv.f < 1e16 && pv.f > -1e16)
+        out += ".0";  // keep float typing through python json.loads
+      break;
+    case K_LIST: {
+      out += ",\"items\":[";
+      bool first = true;
+      for (PVal* e : pv.list) {
+        if (!first) out += ",";
+        pv_json(*e, out);
+        first = false;
+      }
+      out += "]";
+      break;
+    }
+    case K_MAP: {
+      out += ",\"entries\":[";
+      bool first = true;
+      for (const auto& e : pv.entries) {
+        if (!first) out += ",";
+        out += "[";
+        pv_json(*e.first, out);
+        out += ",";
+        pv_json(*e.second, out);
+        out += "]";
+        first = false;
+      }
+      out += "]";
+      break;
+    }
+    default: {
+      // ranges only occur as rule literals
+      out += ",\"inc\":" + std::to_string(pv.inc);
+      if (pv.kind == K_RANGE_INT) {
+        out += ",\"lo\":" + std::to_string(pv.ri_lo);
+        out += ",\"hi\":" + std::to_string(pv.ri_hi);
+      } else if (pv.kind == K_RANGE_FLOAT) {
+        out += ",\"lo\":" + format_float(pv.rf_lo);
+        out += ",\"hi\":" + format_float(pv.rf_hi);
+      } else {
+        out += ",\"lo\":";
+        json_escape(pv.rs_lo, out);
+        out += ",\"hi\":";
+        json_escape(pv.rs_hi, out);
+      }
+    }
+  }
+  out += "}";
+}
+
+void qr_json(const QR& qr, std::string& out) {
+  if (qr.tag == T_UNRESOLVED) {
+    out += "{\"t\":\"ur\",\"to\":";
+    pv_json(*qr.traversed_to, out);
+    out += ",\"rem\":";
+    json_escape(qr.ur_remaining, out);
+    out += ",\"reason\":";
+    if (qr.ur_has_reason) json_escape(qr.ur_reason, out);
+    else out += "null";
+    out += "}";
+    return;
+  }
+  out += qr.tag == T_LITERAL ? "{\"t\":\"lit\",\"pv\":" : "{\"t\":\"res\",\"pv\":";
+  pv_json(*qr.value, out);
+  out += "}";
+}
+
+void opt_str_json(bool has, const std::string& s, std::string& out) {
+  if (has) json_escape(s, out);
+  else out += "null";
+}
+
+void rec_json(const Rec& r, std::string& out) {
+  out += "{\"c\":";
+  json_escape(r.context, out);
+  out += ",\"k\":";
+  if (!r.has_container) {
+    out += "null";
+  } else {
+    json_escape(RT_NAMES[r.rt], out);
+    out += ",\"p\":{";
+    const RecPayload& p = r.p;
+    switch (r.rt) {
+      case RT_FILE_CHECK: case RT_RULE_CHECK:
+        out += "\"name\":";
+        json_escape(p.name, out);
+        out += ",\"status\":" + std::to_string(p.status);
+        out += ",\"msg\":";
+        opt_str_json(p.has_message, p.message, out);
+        break;
+      case RT_RULE_CONDITION: case RT_TYPE_CONDITION: case RT_TYPE_BLOCK:
+      case RT_FILTER: case RT_WHEN_CONDITION:
+        out += "\"status\":" + std::to_string(p.status);
+        break;
+      case RT_TYPE_CHECK:
+        out += "\"type_name\":";
+        json_escape(p.name, out);
+        out += ",\"status\":" + std::to_string(p.status);
+        out += ",\"alo\":";
+        out += p.at_least_one ? "true" : "false";
+        out += ",\"msg\":";
+        opt_str_json(p.has_message, p.message, out);
+        break;
+      case RT_WHEN_CHECK: case RT_DISJUNCTION: case RT_BLOCK_GUARD_CHECK:
+      case RT_GUARD_CLAUSE_BLOCK_CHECK:
+        out += "\"status\":" + std::to_string(p.status);
+        out += ",\"alo\":";
+        out += p.at_least_one ? "true" : "false";
+        out += ",\"msg\":";
+        opt_str_json(p.has_message, p.message, out);
+        break;
+      default: {  // RT_CLAUSE_VALUE_CHECK
+        out += "\"cc\":";
+        json_escape(CC_NAMES[p.cc], out);
+        if (p.cc == CC_NO_VALUE_EMPTY) {
+          out += ",\"custom\":";
+          opt_str_json(p.has_custom, p.custom, out);
+        } else if (p.cc != CC_SUCCESS) {
+          out += ",\"status\":" + std::to_string(p.status);
+          out += ",\"msg\":";
+          opt_str_json(p.has_message, p.message, out);
+          out += ",\"custom\":";
+          opt_str_json(p.has_custom, p.custom, out);
+          if (p.cc == CC_DEPENDENT_RULE) {
+            out += ",\"rule\":";
+            json_escape(p.name, out);
+          }
+          if (p.has_from) {
+            out += ",\"from\":";
+            qr_json(p.from, out);
+          }
+          if (p.cc == CC_COMPARISON) {
+            out += ",\"cmp\":[\"";
+            out += CMP_NAME[p.cmp_op];
+            out += "\",";
+            out += p.cmp_neg ? "true" : "false";
+            out += "],\"to\":";
+            if (p.has_to) qr_json(p.to, out);
+            else out += "null";
+          } else if (p.cc == CC_IN_COMPARISON || p.cc == CC_UNARY) {
+            out += ",\"cmp\":[\"";
+            out += CMP_NAME[p.cmp_op];
+            out += "\",";
+            out += p.cmp_neg ? "true" : "false";
+            out += "]";
+            if (p.cc == CC_IN_COMPARISON) {
+              out += ",\"to_list\":[";
+              bool first = true;
+              for (const QR& q : p.to_list) {
+                if (!first) out += ",";
+                qr_json(q, out);
+                first = false;
+              }
+              out += "]";
+            }
+          }
+        }
+      }
+    }
+    out += "}";
+  }
+  out += ",\"ch\":[";
+  bool first = true;
+  for (const Rec* ch : r.children) {
+    if (!first) out += ",";
+    rec_json(*ch, out);
+    first = false;
+  }
+  out += "]}";
+}
+
+
+// ---------------------------------------------------------------------------
+// Direct simplified-report emission (commands/report.py
+// simplified_report_from_root / _failed_clauses / _clause_value_report,
+// porting eval_context.rs:1966-2435). This is the fail-rerun fast
+// path: only failing content serializes, and Python consumes the
+// report dict with zero object rebuilding.
+// ---------------------------------------------------------------------------
+
+// python json.dumps(x, separators=(',',':')) over a plain projection
+// (ensure_ascii=True: non-ascii -> \uXXXX lowercase, surrogate pairs)
+void py_json_string(const std::string& s, std::string& out) {
+  out.push_back('"');
+  size_t i = 0, n = s.size();
+  while (i < n) {
+    unsigned char c = s[i];
+    if (c < 0x80) {
+      switch (c) {
+        case '"': out += "\\\""; break;
+        case '\\': out += "\\\\"; break;
+        case '\n': out += "\\n"; break;
+        case '\t': out += "\\t"; break;
+        case '\r': out += "\\r"; break;
+        case '\b': out += "\\b"; break;
+        case '\f': out += "\\f"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof buf, "\\u%04x", c);
+            out += buf;
+          } else {
+            out.push_back(static_cast<char>(c));
+          }
+      }
+      i++;
+      continue;
+    }
+    // decode utf-8 -> \uXXXX (python ensure_ascii)
+    unsigned cp = 0;
+    int extra = 0;
+    if ((c & 0xE0) == 0xC0) { cp = c & 0x1F; extra = 1; }
+    else if ((c & 0xF0) == 0xE0) { cp = c & 0x0F; extra = 2; }
+    else if ((c & 0xF8) == 0xF0) { cp = c & 0x07; extra = 3; }
+    else throw Unsupported("invalid utf-8 in report string");
+    if (i + extra >= n) throw Unsupported("invalid utf-8 in report string");
+    for (int k = 1; k <= extra; k++) {
+      unsigned char cc = s[i + k];
+      if ((cc & 0xC0) != 0x80) throw Unsupported("invalid utf-8 in report string");
+      cp = (cp << 6) | (cc & 0x3F);
+    }
+    i += extra + 1;
+    char buf[16];
+    if (cp < 0x10000) {
+      snprintf(buf, sizeof buf, "\\u%04x", cp);
+      out += buf;
+    } else {
+      cp -= 0x10000;
+      snprintf(buf, sizeof buf, "\\u%04x\\u%04x", 0xD800 + (cp >> 10),
+               0xDC00 + (cp & 0x3FF));
+      out += buf;
+    }
+  }
+  out.push_back('"');
+}
+
+// python float repr (json.dumps uses it)
+std::string py_float_repr(double f) {
+  if (f != f || f == 1.0 / 0.0 || f == -1.0 / 0.0)
+    throw Unsupported("non-finite float in report");
+  return python_float_repr(f);
+}
+
+std::string range_repr(const PVal& pv) {
+  // values.py Range.__repr__: "r[lo,hi)" with python number rendering
+  std::string o = (pv.inc & LOWER_INCLUSIVE) ? "[" : "(";
+  std::string c = (pv.inc & UPPER_INCLUSIVE) ? "]" : ")";
+  std::string a, b;
+  if (pv.kind == K_RANGE_INT) {
+    a = std::to_string(pv.ri_lo);
+    b = std::to_string(pv.ri_hi);
+  } else if (pv.kind == K_RANGE_FLOAT) {
+    a = py_float_repr(pv.rf_lo);
+    b = py_float_repr(pv.rf_hi);
+  } else {
+    a = "'" + pv.rs_lo + "'";
+    b = "'" + pv.rs_hi + "'";
+  }
+  return "r" + o + a + "," + b + c;
+}
+
+// to_plain projection emitted as compact json (dict/list/scalars)
+void plain_json(const PVal& pv, std::string& out) {
+  switch (pv.kind) {
+    case K_NULL: out += "null"; break;
+    case K_STRING: case K_CHAR: py_json_string(pv.s, out); break;
+    case K_REGEX: py_json_string("/" + pv.s + "/", out); break;
+    case K_BOOL: out += pv.b ? "true" : "false"; break;
+    case K_INT: out += std::to_string(pv.i); break;
+    case K_FLOAT: out += py_float_repr(pv.f); break;
+    case K_LIST: {
+      out.push_back('[');
+      bool first = true;
+      for (PVal* e : pv.list) {
+        if (!first) out.push_back(',');
+        plain_json(*e, out);
+        first = false;
+      }
+      out.push_back(']');
+      break;
+    }
+    case K_MAP: {
+      out.push_back('{');
+      bool first = true;
+      for (const auto& e : pv.entries) {
+        if (!first) out.push_back(',');
+        py_json_string(e.first->s, out);
+        out.push_back(':');
+        plain_json(*e.second, out);
+        first = false;
+      }
+      out.push_back('}');
+      break;
+    }
+    default: py_json_string(range_repr(pv), out);
+  }
+}
+
+// python repr of a plain projection (embedded in the IN message)
+void py_repr_string(const std::string& s, std::string& out) {
+  if (!ascii_only(s)) throw Unsupported("non-ascii repr in report");
+  bool has_sq = s.find('\'') != std::string::npos;
+  bool has_dq = s.find('"') != std::string::npos;
+  char quote = (has_sq && !has_dq) ? '"' : '\'';
+  out.push_back(quote);
+  for (unsigned char c : s) {
+    if (c == '\\') out += "\\\\";
+    else if (c == static_cast<unsigned char>(quote)) { out.push_back('\\'); out.push_back(quote); }
+    else if (c == '\n') out += "\\n";
+    else if (c == '\r') out += "\\r";
+    else if (c == '\t') out += "\\t";
+    else if (c < 0x20 || c == 0x7f) {
+      char buf[8];
+      snprintf(buf, sizeof buf, "\\x%02x", c);
+      out += buf;
+    } else out.push_back(static_cast<char>(c));
+  }
+  out.push_back(quote);
+}
+
+void plain_repr(const PVal& pv, std::string& out) {
+  switch (pv.kind) {
+    case K_NULL: out += "None"; break;
+    case K_STRING: case K_CHAR: py_repr_string(pv.s, out); break;
+    case K_REGEX: py_repr_string("/" + pv.s + "/", out); break;
+    case K_BOOL: out += pv.b ? "True" : "False"; break;
+    case K_INT: out += std::to_string(pv.i); break;
+    case K_FLOAT: out += py_float_repr(pv.f); break;
+    case K_LIST: {
+      out.push_back('[');
+      bool first = true;
+      for (PVal* e : pv.list) {
+        if (!first) out += ", ";
+        plain_repr(*e, out);
+        first = false;
+      }
+      out.push_back(']');
+      break;
+    }
+    case K_MAP: {
+      out.push_back('{');
+      bool first = true;
+      for (const auto& e : pv.entries) {
+        if (!first) out += ", ";
+        py_repr_string(e.first->s, out);
+        out += ": ";
+        plain_repr(*e.second, out);
+        first = false;
+      }
+      out.push_back('}');
+      break;
+    }
+    default: py_repr_string(range_repr(pv), out);
+  }
+}
+
+// report.py _pv_json: {"path": ..., "value": to_plain}
+void rep_pv_json(const PVal& pv, std::string& out) {
+  out += "{\"path\":";
+  py_json_string(pv.path, out);
+  out += ",\"value\":";
+  plain_json(pv, out);
+  out += "}";
+}
+
+// report.py _pv_display: "Path={path}[L:{l},C:{c}] Value={compact json}"
+std::string rep_pv_display(const PVal& pv) {
+  std::string v;
+  plain_json(pv, v);
+  return "Path=" + pv.path + "[L:" + std::to_string(pv.line) + ",C:" +
+         std::to_string(pv.col) + "] Value=" + v;
+}
+
+void rep_ur_json(const QR& qr, std::string& out) {
+  out += "{\"traversed_to\":";
+  rep_pv_json(*qr.traversed_to, out);
+  out += ",\"remaining_query\":";
+  py_json_string(qr.ur_remaining, out);
+  out += ",\"reason\":";
+  if (qr.ur_has_reason) py_json_string(qr.ur_reason, out);
+  else out += "null";
+  out += "}";
+}
+
+void rep_cmp_json(int op, bool neg, std::string& out) {
+  out += "[\"";
+  out += CMP_NAME[op];
+  out += "\",";
+  out += neg ? "true" : "false";
+  out += "]";
+}
+
+void rep_location_json(const PVal& pv, std::string& out) {
+  out += "{\"line\":" + std::to_string(pv.line) +
+         ",\"col\":" + std::to_string(pv.col) + "}";
+}
+
+const char* UNARY_FAIL_MSG[][2] = {
+    // indexed by Cmp enum starting at C_EXISTS; (plain, negated)
+    {"did not exist", "existed"},
+    {"was not empty", "was empty"},
+    {"was not string", "was a string "},
+    {"was not list", "was a list "},
+    {"was not struct", "was a struct"},
+    {"was not bool", "was bool"},
+    {"was not int", "was int"},
+    {"was not float", "was float"},
+    {"was not null", "was null"},
+};
+
+const char* BINARY_FAIL_MSG[][2] = {
+    // indexed by Cmp enum C_EQ..C_GE; (plain, negated)
+    {"not equal to", "equal to"},
+    {"not in", "in"},
+    {"not greater than", "greater than"},
+    {"not less than", "less than"},
+    {"not less than equal to", "less than equal to"},
+    {"not greater than equal", "greater than equal to"},
+};
+
+std::string msgs_json(const std::string& custom, const std::string& error,
+                      const PVal* loc_pv) {
+  std::string out = "{\"custom_message\":";
+  py_json_string(custom, out);
+  out += ",\"error_message\":";
+  py_json_string(error, out);
+  if (loc_pv) {
+    out += ",\"location\":";
+    rep_location_json(*loc_pv, out);
+  }
+  out += "}";
+  return out;
+}
+
+// _clause_value_report (report.py:146-389)
+void clause_value_report(const Rec& current, std::string& out, bool* first) {
+  const RecPayload& p = current.p;
+  auto emit = [&](const std::string& body) {
+    if (!*first) out += ",";
+    out += body;
+    *first = false;
+  };
+  switch (p.cc) {
+    case CC_SUCCESS:
+      return;
+    case CC_NO_VALUE_EMPTY: {
+      std::string custom = p.has_custom ? p.custom : "";
+      std::string folded;
+      for (char c : custom) folded += (c == '\n') ? ';' : c;
+      std::string body = "{\"Clause\":{\"Unary\":{\"context\":";
+      py_json_string(current.context, body);
+      body += ",\"check\":{\"UnResolvedContext\":";
+      py_json_string(current.context, body);
+      body += "},\"messages\":{\"custom_message\":";
+      py_json_string(folded, body);
+      body += ",\"error_message\":";
+      py_json_string("Check was not compliant as variable in context [" +
+                         current.context + "] was not empty",
+                     body);
+      body += "}}}}";
+      emit(body);
+      return;
+    }
+    case CC_DEPENDENT_RULE: {
+      std::string body = "{\"Clause\":{\"Unary\":{\"context\":";
+      py_json_string(current.context, body);
+      body += ",\"check\":{\"UnResolvedContext\":";
+      py_json_string(p.name, body);
+      body += "},\"messages\":{\"custom_message\":";
+      py_json_string(p.has_custom ? p.custom : "", body);
+      body += ",\"error_message\":";
+      py_json_string("Check was not compliant as dependent rule [" + p.name +
+                         "] did not PASS. Context [" + current.context + "]",
+                     body);
+      body += "}}}}";
+      emit(body);
+      return;
+    }
+    case CC_MISSING_BLOCK_VALUE: {
+      const QR& ur = p.from;
+      std::string body = "{\"Block\":{\"context\":";
+      py_json_string(current.context, body);
+      body += ",\"messages\":{\"custom_message\":";
+      py_json_string(p.has_custom ? p.custom : "", body);
+      body += ",\"error_message\":";
+      py_json_string("Check was not compliant as property [" + ur.ur_remaining +
+                         "] is missing. Value traversed to [" +
+                         rep_pv_display(*ur.traversed_to) + "]",
+                     body);
+      body += ",\"location\":";
+      rep_location_json(*ur.traversed_to, body);
+      body += "},\"unresolved\":";
+      rep_ur_json(ur, body);
+      body += "}}";
+      emit(body);
+      return;
+    }
+    case CC_UNARY: {
+      if (p.status != ST_FAIL) return;
+      const char* const* pair = UNARY_FAIL_MSG[p.cmp_op - C_EXISTS];
+      std::string cmp_msg = p.cmp_neg ? pair[1] : pair[0];
+      std::string err =
+          p.has_message ? ("Error = [" + p.message + "]") : std::string();
+      std::string body = "{\"Clause\":{\"Unary\":{\"check\":";
+      std::string message;
+      const PVal* loc_pv;
+      if (p.from.tag == T_UNRESOLVED) {
+        message = "Check was not compliant as property [" + p.from.ur_remaining +
+                  "] is missing. Value traversed to [" +
+                  rep_pv_display(*p.from.traversed_to) + "]." + err;
+        body += "{\"UnResolved\":{\"value\":";
+        rep_ur_json(p.from, body);
+        body += ",\"comparison\":";
+        rep_cmp_json(p.cmp_op, p.cmp_neg, body);
+        body += "}}";
+        loc_pv = p.from.traversed_to;
+      } else {
+        const PVal& res = *p.from.value;
+        message = "Check was not compliant as property [" + res.path + "] " +
+                  cmp_msg + "." + err;
+        body += "{\"Resolved\":{\"value\":";
+        rep_pv_json(res, body);
+        body += ",\"comparison\":";
+        rep_cmp_json(p.cmp_op, p.cmp_neg, body);
+        body += "}}";
+        loc_pv = &res;
+      }
+      body += ",\"context\":";
+      py_json_string(current.context, body);
+      body += ",\"messages\":" +
+              msgs_json(p.has_custom ? p.custom : "", message, loc_pv);
+      body += "}}}";
+      emit(body);
+      return;
+    }
+    case CC_COMPARISON: {
+      if (p.status != ST_FAIL) return;
+      std::string err =
+          p.has_message ? (" Error = [" + p.message + "]") : std::string();
+      auto unresolved_body = [&](const QR& ur, const std::string& which) {
+        std::string message = "Check was not compliant as property [" +
+                              ur.ur_remaining + "] to compare " + which +
+                              " is missing. Value traversed to [" +
+                              rep_pv_display(*ur.traversed_to) + "]." + err;
+        std::string body = "{\"Clause\":{\"Binary\":{\"context\":";
+        py_json_string(current.context, body);
+        body += ",\"messages\":" +
+                msgs_json(p.has_custom ? p.custom : "", message, ur.traversed_to);
+        body += ",\"check\":{\"UnResolved\":{\"value\":";
+        rep_ur_json(ur, body);
+        body += ",\"comparison\":";
+        rep_cmp_json(p.cmp_op, p.cmp_neg, body);
+        body += "}}}}}";
+        return body;
+      };
+      if (p.from.tag == T_UNRESOLVED) {
+        emit(unresolved_body(p.from, "from"));
+        return;
+      }
+      if (!p.has_to) return;
+      if (p.to.tag == T_UNRESOLVED) {
+        emit(unresolved_body(p.to, "to"));
+        return;
+      }
+      const char* const* pair = BINARY_FAIL_MSG[p.cmp_op];
+      std::string op_msg = p.cmp_neg ? pair[1] : pair[0];
+      const PVal& res = *p.from.value;
+      std::string message = "Check was not compliant as property value [" +
+                            rep_pv_display(res) + "] " + op_msg + " value [" +
+                            rep_pv_display(*p.to.value) + "]." + err;
+      std::string body = "{\"Clause\":{\"Binary\":{\"context\":";
+      py_json_string(current.context, body);
+      body += ",\"messages\":" +
+              msgs_json(p.has_custom ? p.custom : "", message, &res);
+      body += ",\"check\":{\"Resolved\":{\"from\":";
+      rep_pv_json(res, body);
+      body += ",\"to\":";
+      rep_pv_json(*p.to.value, body);
+      body += ",\"comparison\":";
+      rep_cmp_json(p.cmp_op, p.cmp_neg, body);
+      body += "}}}}}";
+      emit(body);
+      return;
+    }
+    case CC_IN_COMPARISON: {
+      if (p.status != ST_FAIL) return;
+      const PVal* from_pv = p.from.tag == T_UNRESOLVED ? p.from.traversed_to
+                                                       : p.from.value;
+      std::vector<const PVal*> to_vals;
+      for (const QR& t : p.to_list)
+        if (t.tag != T_UNRESOLVED) to_vals.push_back(t.value);
+      std::string repr_list = "[";
+      bool first_r = true;
+      for (const PVal* v : to_vals) {
+        if (!first_r) repr_list += ", ";
+        plain_repr(*v, repr_list);
+        first_r = false;
+      }
+      repr_list += "]";
+      std::string message = "Check was not compliant as property [" +
+                            from_pv->path + "] was not present in [" +
+                            repr_list + "]";
+      std::string body = "{\"Clause\":{\"Binary\":{\"context\":";
+      py_json_string(current.context, body);
+      body += ",\"messages\":{\"custom_message\":";
+      if (p.has_custom) py_json_string(p.custom, body);
+      else body += "null";
+      body += ",\"error_message\":";
+      py_json_string(message, body);
+      body += ",\"location\":";
+      rep_location_json(*from_pv, body);
+      body += "},\"check\":{\"InResolved\":{\"from\":";
+      rep_pv_json(*from_pv, body);
+      body += ",\"to\":[";
+      bool first_t = true;
+      for (const PVal* v : to_vals) {
+        if (!first_t) body += ",";
+        rep_pv_json(*v, body);
+        first_t = false;
+      }
+      body += "],\"comparison\":";
+      rep_cmp_json(p.cmp_op, p.cmp_neg, body);
+      body += "}}}}}";
+      emit(body);
+      return;
+    }
+    default:
+      return;
+  }
+}
+
+// _failed_clauses (report.py:91-144)
+void failed_clauses(const std::vector<Rec*>& children, std::string& out,
+                    bool* first) {
+  for (const Rec* current : children) {
+    if (!current->has_container) {
+      failed_clauses(current->children, out, first);
+      continue;
+    }
+    const RecPayload& p = current->p;
+    switch (current->rt) {
+      case RT_RULE_CHECK:
+        if (p.status == ST_FAIL) {
+          if (!*first) out += ",";
+          *first = false;
+          out += "{\"Rule\":{\"name\":";
+          py_json_string(p.name, out);
+          out += ",\"metadata\":{},\"messages\":{\"custom_message\":";
+          if (p.has_message) py_json_string(p.message, out);
+          else out += "null";
+          out += ",\"error_message\":null},\"checks\":[";
+          bool inner_first = true;
+          failed_clauses(current->children, out, &inner_first);
+          out += "]}}";
+        }
+        break;
+      case RT_BLOCK_GUARD_CHECK:
+        if (p.status == ST_FAIL) {
+          if (current->children.empty()) {
+            if (!*first) out += ",";
+            *first = false;
+            out += "{\"Block\":{\"context\":";
+            py_json_string(current->context, out);
+            out += ",\"messages\":{\"custom_message\":null,\"error_message\":"
+                   "\"query for block clause did not retrieve any value\"},"
+                   "\"unresolved\":null}}";
+          } else {
+            failed_clauses(current->children, out, first);
+          }
+        }
+        break;
+      case RT_DISJUNCTION:
+        if (p.status == ST_FAIL) {
+          if (!*first) out += ",";
+          *first = false;
+          out += "{\"Disjunctions\":{\"checks\":[";
+          bool inner_first = true;
+          failed_clauses(current->children, out, &inner_first);
+          out += "]}}";
+        }
+        break;
+      case RT_GUARD_CLAUSE_BLOCK_CHECK:
+      case RT_TYPE_BLOCK:
+      case RT_WHEN_CHECK:
+        if (p.status == ST_FAIL) failed_clauses(current->children, out, first);
+        break;
+      case RT_TYPE_CHECK:
+        if (p.status == ST_FAIL) failed_clauses(current->children, out, first);
+        break;
+      case RT_CLAUSE_VALUE_CHECK:
+        clause_value_report(*current, out, first);
+        break;
+      default:
+        break;
+    }
+  }
+}
+
+// simplified_report_from_root (report.py:391-415) + per-rule statuses
+std::string report_json(const Rec& root, const std::string& data_file_name) {
+  if (!root.has_container || root.rt != RT_FILE_CHECK)
+    throw GuardErr("root record is not a FileCheck");
+  const char* STATUS_NAME[] = {"PASS", "FAIL", "SKIP"};
+  std::vector<std::string> compliant, not_applicable;
+  std::vector<Rec*> failed;
+  // rule name -> merged status (report.py rule_statuses_from_root)
+  std::vector<std::pair<std::string, int>> statuses;
+  for (const Rec* each : root.children) {
+    if (!each->has_container || each->rt != RT_RULE_CHECK) continue;
+    int st = each->p.status;
+    const std::string& name = each->p.name;
+    if (st == ST_PASS) compliant.push_back(name);
+    else if (st == ST_SKIP) not_applicable.push_back(name);
+    else failed.push_back(const_cast<Rec*>(each));
+    bool found = false;
+    for (auto& e : statuses) {
+      if (e.first == name) {
+        found = true;
+        if (e.second == ST_SKIP && st != ST_SKIP) e.second = st;
+        else if (st == ST_FAIL) e.second = ST_FAIL;
+        break;
+      }
+    }
+    if (!found) statuses.emplace_back(name, st);
+  }
+  auto uniq_sorted = [](std::vector<std::string>& v) {
+    std::sort(v.begin(), v.end());
+    v.erase(std::unique(v.begin(), v.end()), v.end());
+  };
+  uniq_sorted(compliant);
+  uniq_sorted(not_applicable);
+
+  std::string out = "{\"overall\":" + std::to_string(root.p.status);
+  out += ",\"statuses\":{";
+  bool first = true;
+  for (const auto& e : statuses) {
+    if (!first) out += ",";
+    py_json_string(e.first, out);
+    out += ":" + std::to_string(e.second);
+    first = false;
+  }
+  out += "},\"report\":{\"name\":";
+  py_json_string(data_file_name, out);
+  out += ",\"metadata\":{},\"status\":\"";
+  out += STATUS_NAME[root.p.status];
+  out += "\",\"not_compliant\":[";
+  bool fc_first = true;
+  failed_clauses(failed, out, &fc_first);
+  out += "],\"not_applicable\":[";
+  first = true;
+  for (const auto& n : not_applicable) {
+    if (!first) out += ",";
+    py_json_string(n, out);
+    first = false;
+  }
+  out += "],\"compliant\":[";
+  first = true;
+  for (const auto& n : compliant) {
+    if (!first) out += ",";
+    py_json_string(n, out);
+    first = false;
+  }
+  out += "]}}";
+  return out;
 }
 
 }  // namespace
@@ -4038,6 +5812,115 @@ int32_t guard_oracle_eval(void* handle, const char* doc_json, int32_t* statuses_
 int32_t guard_oracle_eval_raw(void* handle, const char* doc_json,
                               int32_t* statuses_out, int32_t cap, char** err_out) {
   return eval_doc_modes(handle, doc_json, true, statuses_out, cap, err_out);
+}
+
+// Report mode: evaluate one compact-wire document (with locations)
+// and return {"overall": st, "statuses": {...}, "report": {...}} — the
+// simplified report (report.py shape) built natively from failing
+// records only. NULL + err on decline/error.
+char* guard_oracle_eval_report(void* handle, const char* doc_wire,
+                               const char* data_file_name, char** err_out) {
+  if (err_out) *err_out = nullptr;
+  auto* h = static_cast<OracleHandle*>(handle);
+  try {
+    EvalState st;
+    st.eng = &h->eng;
+    st.trk.enabled = true;
+    st.trk.skip_success = true;
+    DocParser dp{doc_wire, doc_wire + strlen(doc_wire), 0, &st.arena};
+    PVal* doc = dp.compact();
+    dp.ws();
+    if (dp.p != dp.end) throw GuardErr("doc: trailing data");
+    RootScope scope(&h->eng, doc, &st);
+    eval_rules_file_rec(&h->eng, &scope,
+                        data_file_name ? data_file_name : "", nullptr);
+    if (!st.trk.final_rec) throw GuardErr("no record tree produced");
+    return dup_msg(report_json(*st.trk.final_rec,
+                               data_file_name ? data_file_name : ""));
+  } catch (const Unsupported& e) {
+    if (err_out) *err_out = dup_msg("unsupported: " + e.msg);
+  } catch (const GuardErr& e) {
+    if (err_out) *err_out = dup_msg("error: " + e.msg);
+  } catch (const NotComparable& e) {
+    if (err_out) *err_out = dup_msg("error: " + e.msg);
+  } catch (const std::exception& e) {
+    if (err_out) *err_out = dup_msg(std::string("error: ") + e.what());
+  }
+  return nullptr;
+}
+
+// Report mode straight from raw JSON text: the parser tracks
+// pyyaml-compatible source marks so report locations equal the
+// loader's. Non-ascii content declines (mark columns count chars).
+char* guard_oracle_eval_report_raw(void* handle, const char* raw_json,
+                                   const char* data_file_name, char** err_out) {
+  if (err_out) *err_out = nullptr;
+  auto* h = static_cast<OracleHandle*>(handle);
+  try {
+    size_t len = strlen(raw_json);
+    for (size_t i = 0; i < len; i++)
+      if (static_cast<unsigned char>(raw_json[i]) >= 0x80)
+        throw Unsupported("non-ascii document for mark tracking");
+    EvalState st;
+    st.eng = &h->eng;
+    st.trk.enabled = true;
+    st.trk.skip_success = true;
+    DocParser dp{raw_json, raw_json + len, 0, &st.arena};
+    dp.track_locs = true;
+    dp.line_start = raw_json;
+    PVal* doc = dp.raw();
+    dp.ws();
+    if (dp.p != dp.end) throw GuardErr("doc: trailing data");
+    RootScope scope(&h->eng, doc, &st);
+    eval_rules_file_rec(&h->eng, &scope,
+                        data_file_name ? data_file_name : "", nullptr);
+    if (!st.trk.final_rec) throw GuardErr("no record tree produced");
+    return dup_msg(report_json(*st.trk.final_rec,
+                               data_file_name ? data_file_name : ""));
+  } catch (const Unsupported& e) {
+    if (err_out) *err_out = dup_msg("unsupported: " + e.msg);
+  } catch (const GuardErr& e) {
+    if (err_out) *err_out = dup_msg("error: " + e.msg);
+  } catch (const NotComparable& e) {
+    if (err_out) *err_out = dup_msg("error: " + e.msg);
+  } catch (const std::exception& e) {
+    if (err_out) *err_out = dup_msg(std::string("error: ") + e.what());
+  }
+  return nullptr;
+}
+
+// Records mode: evaluate one rich-wire document (paths + locations)
+// and return the full evaluation record tree as JSON. NULL + err on
+// decline/error; caller frees the result via guard_oracle_free_str.
+char* guard_oracle_eval_records(void* handle, const char* doc_wire,
+                                const char* data_file_name, char** err_out) {
+  if (err_out) *err_out = nullptr;
+  auto* h = static_cast<OracleHandle*>(handle);
+  try {
+    EvalState st;
+    st.eng = &h->eng;
+    st.trk.enabled = true;
+    JParser p{doc_wire, doc_wire + strlen(doc_wire)};
+    JValue j = p.parse();
+    PVal* doc = pv_from_wire(j, st.arena);
+    RootScope scope(&h->eng, doc, &st);
+    eval_rules_file_rec(&h->eng, &scope,
+                        data_file_name ? data_file_name : "", nullptr);
+    if (!st.trk.final_rec) throw GuardErr("no record tree produced");
+    std::string out;
+    out.reserve(1 << 14);
+    rec_json(*st.trk.final_rec, out);
+    return dup_msg(out);
+  } catch (const Unsupported& e) {
+    if (err_out) *err_out = dup_msg("unsupported: " + e.msg);
+  } catch (const GuardErr& e) {
+    if (err_out) *err_out = dup_msg("error: " + e.msg);
+  } catch (const NotComparable& e) {
+    if (err_out) *err_out = dup_msg("error: " + e.msg);
+  } catch (const std::exception& e) {
+    if (err_out) *err_out = dup_msg(std::string("error: ") + e.what());
+  }
+  return nullptr;
 }
 
 void guard_oracle_free(void* handle) { delete static_cast<OracleHandle*>(handle); }

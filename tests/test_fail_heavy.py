@@ -82,6 +82,15 @@ def test_pooled_rerun_matches_inline(tmp_path, monkeypatch):
     import guard_tpu.ops.backend as backend
 
     rules, data = _mk_corpus(tmp_path, 60, fail_every=1)  # all fail
+    # the native records engine serves rich reruns when available —
+    # disable it so the Python pool path is actually exercised
+    import guard_tpu.ops.native_oracle as no_mod
+    from guard_tpu.ops.native_oracle import NativeUnsupported
+
+    def refuse(rf):
+        raise NativeUnsupported("disabled: exercising the python pool")
+
+    monkeypatch.setattr(no_mod, "NativeOracle", refuse)
     # force the pool on (min jobs low; this CI box reports 1 CPU)
     monkeypatch.setattr(backend, "_POOL_MIN_JOBS", 8)
     monkeypatch.setattr(os, "cpu_count", lambda: 4)

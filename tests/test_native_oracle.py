@@ -285,6 +285,209 @@ rule r when Resources exists { %joined exists }
 
 
 # ---------------------------------------------------------------------------
+# records mode: the full evaluation record tree must be byte-identical
+# (serde encoding) to the Python evaluator's, so reports built from it
+# are bit-exact
+# ---------------------------------------------------------------------------
+def _records_differential(rules_text, docs_plain, name="rec.guard"):
+    import json as _json
+
+    from guard_tpu.commands.report import (
+        serde_record_json,
+        simplified_report_from_root,
+    )
+
+    rf = parse_rules_file(rules_text, name)
+    native = NativeOracle(rf)
+    checked = declined = 0
+    try:
+        for i, dp in enumerate(docs_plain):
+            doc = from_plain(dp)
+            try:
+                nat_root = native.eval_records(doc, f"d{i}.json")
+            except NativeUnsupported:
+                declined += 1
+                continue
+            except NativeEvalError:
+                with pytest.raises(GuardError):
+                    _python_statuses(rf, doc)
+                checked += 1
+                continue
+            scope = RootScope(rf, doc)
+            eval_rules_file(rf, scope, f"d{i}.json")
+            py_root = scope.reset_recorder().extract()
+            nat_j = _json.dumps(serde_record_json(nat_root), sort_keys=True)
+            py_j = _json.dumps(serde_record_json(py_root), sort_keys=True)
+            assert nat_j == py_j, f"{name} doc {i}: record trees differ"
+            assert simplified_report_from_root(
+                nat_root, f"d{i}.json"
+            ) == simplified_report_from_root(py_root, f"d{i}.json")
+            checked += 1
+    finally:
+        native.close()
+    return checked, declined
+
+
+def test_corpus_records_differential():
+    guard_files = sorted(CORPUS.glob("*.guard"))
+    total_checked = total_declined = 0
+    for g in guard_files:
+        spec = yaml.safe_load((CORPUS / "tests" / f"{g.stem}_tests.yaml").read_text())
+        docs_plain = [case.get("input") or {} for case in spec]
+        checked, declined = _records_differential(g.read_text(), docs_plain, g.name)
+        total_checked += checked
+        total_declined += declined
+    assert total_checked > 700, (total_checked, total_declined)
+    assert total_declined < total_checked / 20, (total_checked, total_declined)
+
+
+def test_examples_records_differential():
+    pairs = 0
+    for g in sorted(EXAMPLES.rglob("*.guard")):
+        tests_dir = g.parent / "tests"
+        if not tests_dir.is_dir():
+            continue
+        for spec_file in sorted(tests_dir.glob(f"{g.stem}*_tests.yaml")):
+            spec = yaml.safe_load(spec_file.read_text())
+            docs_plain = [case.get("input") or {} for case in spec]
+            checked, _ = _records_differential(g.read_text(), docs_plain, g.name)
+            pairs += checked
+    assert pairs > 20, pairs
+
+
+def test_semantic_shapes_records_differential():
+    # the same edge shapes the statuses differential drives, now at
+    # record-tree fidelity (custom messages + unresolved reasons incl.)
+    _records_differential(
+        """
+rule r1 when Resources exists { Resources.a.Missing exists <<must exist>> }
+rule r2 when Resources exists { not Resources.a.Missing empty }
+rule r3 when Resources exists { Resources.a.N != 6 }
+rule r4 when Resources exists { Resources.a.Tags[*].K == 'x' or Resources.a.N >= 5 }
+rule blocky when Resources exists {
+    Resources.* {
+        Type exists
+        when N exists { N IN r[0, 10) }
+    }
+}
+rule downstream when Resources exists {
+    blocky
+}
+rule typed when Resources exists {
+    Resources.*[ Type == 'A' ].N == 5
+}
+""",
+        DOCS,
+    )
+    _records_differential(
+        """
+rule check(expected) {
+    Resources.*.Type == %expected <<wrong type>>
+}
+rule call_a when Resources exists { check('A') }
+rule keyed when Resources exists { Resources[ keys == /^a/ ].Type == 'A' }
+rule qq when Resources exists { Resources.a.Type == Resources.b.Type }
+""",
+        DOCS,
+    )
+
+
+# ---------------------------------------------------------------------------
+# report mode: the natively-assembled simplified report (the path the
+# backend and bench actually use) must byte-equal the Python one
+# ---------------------------------------------------------------------------
+def _report_differential(rules_text, docs_plain, name="rep.guard"):
+    import json as _json
+
+    from guard_tpu.commands.report import (
+        rule_statuses_from_root,
+        simplified_report_from_root,
+    )
+    from guard_tpu.core.loader import load_document
+
+    rf = parse_rules_file(rules_text, name)
+    native = NativeOracle(rf)
+    checked = declined = 0
+    try:
+        for i, dp in enumerate(docs_plain):
+            raw = _json.dumps(dp)
+            doc = load_document(raw, f"d{i}.json")  # real loader marks
+            for source in ("raw", "pv"):
+                try:
+                    if source == "raw":
+                        nat = native.eval_report_raw(raw, f"d{i}.json")
+                    else:
+                        nat = native.eval_report(doc, f"d{i}.json")
+                except NativeUnsupported:
+                    declined += 1
+                    continue
+                except NativeEvalError:
+                    with pytest.raises(GuardError):
+                        _python_statuses(rf, doc)
+                    checked += 1
+                    continue
+                rep, statuses, overall = nat
+                scope = RootScope(rf, doc)
+                st = eval_rules_file(rf, scope, f"d{i}.json")
+                root = scope.reset_recorder().extract()
+                assert rep == simplified_report_from_root(root, f"d{i}.json"), (
+                    f"{name} doc {i} [{source}]: report differs"
+                )
+                assert statuses == rule_statuses_from_root(root)
+                assert overall == st
+                checked += 1
+    finally:
+        native.close()
+    return checked, declined
+
+
+def test_corpus_report_differential():
+    guard_files = sorted(CORPUS.glob("*.guard"))
+    total_checked = total_declined = 0
+    for g in guard_files:
+        spec = yaml.safe_load((CORPUS / "tests" / f"{g.stem}_tests.yaml").read_text())
+        docs_plain = [case.get("input") or {} for case in spec]
+        checked, declined = _report_differential(g.read_text(), docs_plain, g.name)
+        total_checked += checked
+        total_declined += declined
+    assert total_checked > 1400, (total_checked, total_declined)  # raw + pv legs
+    assert total_declined < total_checked / 20, (total_checked, total_declined)
+
+
+def test_semantic_shapes_report_differential():
+    _report_differential(
+        """
+rule r1 when Resources exists { Resources.a.Missing exists <<must exist>> }
+rule r2 when Resources exists { not Resources.a.Missing empty }
+rule r3 when Resources exists { Resources.a.N != 6 }
+rule r4 when Resources exists { Resources.a.Tags[*].K == 'x' or Resources.a.N >= 5 }
+rule in_list when Resources exists { Resources.*.Type IN ['A', 'B'] }
+rule blocky when Resources exists {
+    Resources.* {
+        Type exists
+        when N exists { N IN r[0, 10) }
+    }
+}
+rule downstream when Resources exists {
+    blocky
+}
+""",
+        DOCS,
+    )
+
+
+def test_report_float_rendering_differential():
+    # the review-found %g divergence class: integral and exponent-range
+    # floats embedded in report messages
+    docs = [
+        {"N": v}
+        for v in [10.0, 20.0, 100000.0, 1e15, 1e16, 1e17, 0.0001, 1.5e-5,
+                   2.5, -10.0, 123456789012345680.0, 0.1]
+    ]
+    _report_differential("rule r { N == 5 }", docs, "floats.guard")
+
+
+# ---------------------------------------------------------------------------
 # the decline path: uncertain constructs fall back, never guess
 # ---------------------------------------------------------------------------
 def test_unsupported_regex_declines():

@@ -105,14 +105,47 @@ def test_statuses_only_needs_zero_python_even_failing(tmp_path, monkeypatch):
     assert n_python == 0
 
 
-def test_failing_docs_get_python_only_for_reports(tmp_path, monkeypatch):
+def test_failing_docs_need_zero_python_via_records(tmp_path, monkeypatch):
     rules, data, n_fail = _mk_corpus(tmp_path, 8, fail_every=2)
+    assert n_fail > 0
     rc, n_python, out = _run_counting(monkeypatch, [
         "validate", "-r", str(rules), "-d", str(data), "--backend", "tpu",
     ])
     assert rc == 19, out
-    # rich reports: exactly the failing docs hit the Python oracle
-    assert n_python == n_fail
+    # rich reports for failing docs come from the native records
+    # engine — the Python oracle is not invoked at all
+    assert n_python == 0
+    # and the report content is real: the failing rule is named
+    assert "sse" in out
+
+
+def test_yaml_flow_docs_not_misrouted(tmp_path, monkeypatch):
+    # flow-style YAML sniffs as JSON ('{' first byte) but is NOT JSON;
+    # the backend must fall back to the loaded-tree wire, not error
+    # (round-4 review finding)
+    rules = tmp_path / "r.guard"
+    rules.write_text(RULES)
+    data = tmp_path / "data"
+    data.mkdir()
+    (data / "t.yaml").write_text(
+        "{Resources: {b: {Name: ok, Properties: {Enc: false}}}}"
+    )
+    args = ["validate", "-r", str(rules), "-d", str(data), "--backend", "tpu"]
+    w1 = Writer.buffered()
+    rc1 = run(args, writer=w1, reader=Reader())
+    assert rc1 == 19, w1.err.getvalue()
+
+    from guard_tpu.ops.native_oracle import NativeUnsupported
+    import guard_tpu.ops.native_oracle as no_mod
+
+    def refuse(rf):
+        raise NativeUnsupported("disabled for differential")
+
+    monkeypatch.setattr(no_mod, "NativeOracle", refuse)
+    w2 = Writer.buffered()
+    rc2 = run(args, writer=w2, reader=Reader())
+    assert rc1 == rc2
+    assert w1.out.getvalue() == w2.out.getvalue()
 
 
 def test_output_identical_with_and_without_native(tmp_path, monkeypatch):
