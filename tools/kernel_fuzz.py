@@ -315,22 +315,105 @@ def rand_rules(rng, ti, tags):
     return "\n\n".join(parts)
 
 
-def oracle_statuses(rf, doc):
+def rand_big_doc(rng):
+    """Bucket-crossing document: a wide+deep tree targeting the 16k+
+    node buckets (the O(N) gather formulation's home turf)."""
+    wide = {}
+    # sized to CROSS the 8192-node bucket without blowing the nightly
+    # budget on one trial (pairwise rule files at big buckets are
+    # O(N^2) lanes on the CPU runner)
+    n_items = rng.randint(200, 550)
+    for i in range(n_items):
+        entry = {
+            "Type": rng.choice(TYPES),
+            "Name": f"r{i}",
+            "Size": rng.choice(NUMS),
+            "Tags": [
+                {"K": rng.choice(STRS), "V": rng.choice(STRS)}
+                for _ in range(rng.randint(0, 6))
+            ],
+        }
+        # occasional deep chain
+        if rng.random() < 0.1:
+            node = entry
+            for d in range(rng.randint(10, 60)):
+                node["Next"] = {"Depth": d}
+                node = node["Next"]
+        wide[f"res{i}"] = entry
+    return {"Resources": wide}
+
+
+_native_cache = {}
+
+
+def _native_for(rules_text, rf):
+    from guard_tpu.ops.native_oracle import (
+        NativeOracle,
+        NativeUnsupported,
+        native_available,
+    )
+
+    if not native_available():
+        return None
+    native = _native_cache.get(rules_text)
+    if native is None:
+        try:
+            native = NativeOracle(rf)
+        except NativeUnsupported:
+            return None
+        if len(_native_cache) > 64:
+            for o in _native_cache.values():
+                o.close()
+            _native_cache.clear()
+        _native_cache[rules_text] = native
+    return native
+
+
+def native_leg(rules_text, rf, doc, py_root, py_statuses, label):
+    """The third differential leg: ONE native eval_report call yields
+    both the merged statuses and the simplified report; both must match
+    the python oracle's single evaluation (py_root). Returns a list of
+    divergence strings."""
+    from guard_tpu.commands.report import simplified_report_from_root
+    from guard_tpu.ops.native_oracle import (
+        NativeEvalError,
+        NativeUnsupported,
+    )
+
+    native = _native_for(rules_text, rf)
+    if native is None:
+        return []
+    try:
+        rep, statuses, _overall = native.eval_report(doc, "fuzz.json")
+    except (NativeUnsupported, NativeEvalError):
+        return []
+    out = []
+    nat = {n: s.value for n, s in statuses.items()}
+    if nat != py_statuses:
+        out.append(f"{label}: NATIVE={nat} python={py_statuses}")
+    py_rep = simplified_report_from_root(py_root, "fuzz.json")
+    if rep != py_rep:
+        out.append(f"{label}: native report != python report")
+    return out
+
+
+def oracle_statuses(rf, doc, with_root=False):
     from guard_tpu.commands.report import rule_statuses_from_root
     from guard_tpu.core.errors import GuardError
     from guard_tpu.core.evaluator import eval_rules_file
     from guard_tpu.core.scopes import RootScope
 
-    scope = RootScope(rf, doc)
+    scope = RootScope(rf, doc, )
     try:
-        eval_rules_file(rf, scope, None)
+        eval_rules_file(rf, scope, "fuzz.json" if with_root else None)
     except GuardError:
-        return None
+        return (None, None) if with_root else None
     root = scope.reset_recorder().extract()
-    return {n: s.value for n, s in rule_statuses_from_root(root).items()}
+    statuses = {n: s.value for n, s in rule_statuses_from_root(root).items()}
+    return (statuses, root) if with_root else statuses
 
 
-def run_trial(rng, ti, tags) -> tuple:
+def run_trial(rng, ti, tags, big_docs=False) -> tuple:
     """One differential trial. Returns (checked, divergences list)."""
     from guard_tpu.core.errors import GuardError
     from guard_tpu.core.parser import parse_rules_file
@@ -345,7 +428,13 @@ def run_trial(rng, ti, tags) -> tuple:
         rf = parse_rules_file(rules_text, "fuzz.guard")
     except GuardError:
         return 0, []
-    docs_plain = [rand_doc(rng) for _ in range(6)]
+    if big_docs and ti % 17 == 16:
+        # bucket-crossing leg (nightly only — big buckets compile for
+        # ~20-40s cold): two big documents exercise the extended (16k+)
+        # buckets and the O(N) gather formulation
+        docs_plain = [rand_big_doc(rng)]
+    else:
+        docs_plain = [rand_doc(rng) for _ in range(6)]
     docs = [from_plain(d) for d in docs_plain]
     fn_vars, fn_vals, fn_err = precompute_fn_values(rf, docs)
     batch, interner = encode_batch(
@@ -362,7 +451,7 @@ def run_trial(rng, ti, tags) -> tuple:
     for di in range(len(docs)):
         if di in fn_err:
             continue  # routed to the oracle (error path) by design
-        oracle = oracle_statuses(rf, docs[di])
+        oracle, py_root = oracle_statuses(rf, docs[di], with_root=True)
         if oracle is None:
             if not (unsure is not None and bool(unsure[di].any())):
                 divergences.append(
@@ -370,6 +459,13 @@ def run_trial(rng, ti, tags) -> tuple:
                     f"flag\n{rules_text}\n{docs_plain[di]}"
                 )
             continue
+        # third leg: one native eval, statuses + report vs python
+        for d in native_leg(
+            rules_text, rf, docs[di], py_root, oracle, f"trial={ti} doc={di}"
+        ):
+            divergences.append(
+                f"{d}\nRULES:\n{rules_text}\nDOC: {docs_plain[di]}"
+            )
         for ri, crule in enumerate(compiled.rules):
             if unsure is not None and bool(unsure[di, ri]):
                 continue
@@ -465,7 +561,7 @@ def main() -> int:
         if corpus and not args.no_corpus and trials % 5 == 4:
             checked, div = run_corpus_trial(rng, rng.choice(corpus))
         else:
-            checked, div = run_trial(rng, trials, tags)
+            checked, div = run_trial(rng, trials, tags, big_docs=True)
         total_checked += checked
         all_divergences.extend(div)
         trials += 1
