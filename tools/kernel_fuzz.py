@@ -319,10 +319,14 @@ def rand_big_doc(rng):
     """Bucket-crossing document: a wide+deep tree targeting the 16k+
     node buckets (the O(N) gather formulation's home turf)."""
     wide = {}
-    # sized to CROSS the 8192-node bucket without blowing the nightly
-    # budget on one trial (pairwise rule files at big buckets are
-    # O(N^2) lanes on the CPU runner)
-    n_items = rng.randint(200, 550)
+    # ~20 encoded nodes per item: the common draw crosses the 8192
+    # bucket into 16384, and one in four reaches the 32768 bucket (the
+    # 65536 top bucket stays out — pairwise rule files there are too
+    # slow for a time-budgeted CPU soak)
+    if rng.random() < 0.25:
+        n_items = rng.randint(900, 1600)
+    else:
+        n_items = rng.randint(450, 850)
     for i in range(n_items):
         entry = {
             "Type": rng.choice(TYPES),
@@ -356,15 +360,19 @@ def _native_for(rules_text, rf):
     if not native_available():
         return None
     native = _native_cache.get(rules_text)
+    if native is False:
+        return None  # cached negative: this rule file doesn't compile
     if native is None:
+        if len(_native_cache) > 64:
+            for o in _native_cache.values():
+                if o is not False:
+                    o.close()
+            _native_cache.clear()
         try:
             native = NativeOracle(rf)
         except NativeUnsupported:
+            _native_cache[rules_text] = False
             return None
-        if len(_native_cache) > 64:
-            for o in _native_cache.values():
-                o.close()
-            _native_cache.clear()
         _native_cache[rules_text] = native
     return native
 
@@ -385,8 +393,12 @@ def native_leg(rules_text, rf, doc, py_root, py_statuses, label):
         return []
     try:
         rep, statuses, _overall = native.eval_report(doc, "fuzz.json")
-    except (NativeUnsupported, NativeEvalError):
-        return []
+    except NativeUnsupported:
+        return []  # declined: the documented fall-back contract
+    except NativeEvalError as e:
+        # the python oracle SUCCEEDED on this doc (caller checked), so
+        # a native evaluation error is itself a divergence
+        return [f"{label}: native errors ({e}) where python succeeds"]
     out = []
     nat = {n: s.value for n, s in statuses.items()}
     if nat != py_statuses:
@@ -430,7 +442,7 @@ def run_trial(rng, ti, tags, big_docs=False) -> tuple:
         return 0, []
     if big_docs and ti % 17 == 16:
         # bucket-crossing leg (nightly only — big buckets compile for
-        # ~20-40s cold): two big documents exercise the extended (16k+)
+        # ~20-40s cold): ONE big document exercises the extended
         # buckets and the O(N) gather formulation
         docs_plain = [rand_big_doc(rng)]
     else:

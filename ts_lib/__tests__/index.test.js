@@ -7,7 +7,7 @@
 const fs = require("fs");
 const os = require("os");
 const path = require("path");
-const { validate, EXIT_CODES } = require("../dist/index.js");
+const { validate, preflight, EXIT_CODES } = require("../dist/index.js");
 
 const REPO = path.resolve(__dirname, "..", "..");
 // a shim that invokes the in-repo CLI; validate() accepts any cliPath
@@ -77,4 +77,34 @@ test("missing rules path rejects", async () => {
 
 test("exit-code protocol constants match the reference", () => {
   expect(EXIT_CODES).toEqual({ success: 0, validationFailure: 19, error: 5 });
+});
+
+describe("preflight", () => {
+  test("resolves with the engine banner for a working CLI", async () => {
+    const banner = await preflight(CLI);
+    expect(banner).toMatch(/^guard-tpu /);
+  });
+
+  test("missing CLI raises an actionable install hint", async () => {
+    await expect(preflight("/nonexistent/guard-tpu-nope")).rejects.toThrow(
+      /pip install guard-tpu/
+    );
+  });
+
+  test("non-guard-tpu binaries are called out", async () => {
+    // /bin/echo answers --version with something un-guard-tpu-like
+    await expect(preflight("/bin/echo")).rejects.toThrow(
+      /not the guard-tpu CLI/
+    );
+  });
+
+  test("validate() preflights before walking files", async () => {
+    await expect(
+      validate({
+        rulesPath: "/tmp",
+        dataPath: "/tmp",
+        cliPath: "/nonexistent/guard-tpu-nope",
+      })
+    ).rejects.toThrow(/pip install guard-tpu/);
+  });
 });

@@ -76,6 +76,71 @@ export interface GuardTpuSession {
   close(): void;
 }
 
+const PREFLIGHT_TIMEOUT_MS = 30000;
+const preflightCache = new Map();
+
+function installHint(cli: string): string {
+  return (
+    `guard-tpu CLI not found at '` + cli + `'.\n` +
+    `This npm package drives the installed guard-tpu engine (Python); it\n` +
+    `does not bundle it. To fix:\n` +
+    `  1. install the engine:  pip install guard-tpu   (or pipx install guard-tpu)\n` +
+    `  2. ensure its bin dir is on PATH (try: guard-tpu --version), or\n` +
+    `  3. pass an explicit path: validate({ cliPath: "/path/to/guard-tpu", ... })`
+  );
+}
+
+/**
+ * Check the guard-tpu engine is reachable and answers `--version`.
+ * Runs once per distinct cliPath (cached); validate() calls it
+ * automatically, and createSession() surfaces the same actionable
+ * error through its first rejected request when the spawn fails.
+ */
+export function preflight(cliPath?: string): Promise<string> {
+  const cli = cliPath ?? "guard-tpu";
+  const cached = preflightCache.get(cli);
+  if (cached) return cached;
+  const check = new Promise((resolve, reject) => {
+    execFile(cli, ["--version"], { timeout: PREFLIGHT_TIMEOUT_MS }, (err, stdout, stderr) => {
+      const anyErr = err as NodeJS.ErrnoException | null;
+      if (anyErr) {
+        preflightCache.delete(cli);
+        if (anyErr.code === "ENOENT") {
+          reject(new Error(installHint(cli)));
+          return;
+        }
+        if (typeof anyErr.code === "number") {
+          // the CLI exists but --version crashed: surface its stderr
+          const tail = String(stderr ?? "").trim().slice(-2000);
+          reject(
+            new Error(
+              `guard-tpu preflight: '` + cli + ` --version' exited ` +
+                anyErr.code + (tail ? `:\n` + tail : ``)
+            )
+          );
+          return;
+        }
+        reject(new Error(`guard-tpu preflight failed: ` + anyErr.message));
+        return;
+      }
+      const banner = String(stdout ?? "").trim();
+      if (!banner.startsWith("guard-tpu")) {
+        preflightCache.delete(cli);
+        reject(
+          new Error(
+            `'` + cli + ` --version' answered '` + banner +
+              `' — not the guard-tpu CLI. Point cliPath at the real entry point.`
+          )
+        );
+        return;
+      }
+      resolve(banner);
+    });
+  }) as Promise<string>;
+  preflightCache.set(cli, check);
+  return check;
+}
+
 const RULE_EXTENSIONS = new Set([".guard", ".ruleset"]);
 const DATA_EXTENSIONS = new Set([".json", ".yaml", ".yml", ".jsn", ".template"]);
 
@@ -132,6 +197,7 @@ function runCli(
  */
 export async function validate(input: ValidateInput): Promise<SarifLog> {
   const cli = input.cliPath ?? "guard-tpu";
+  await preflight(cli);
   const ruleFiles = await collectFiles(input.rulesPath, RULE_EXTENSIONS);
   const dataFiles = await collectFiles(input.dataPath, DATA_EXTENSIONS);
   if (ruleFiles.length === 0) throw new Error(`no rule files under ${input.rulesPath}`);
@@ -173,7 +239,11 @@ export function createSession(options?: SessionOptions): GuardTpuSession {
   let closed = false;
 
   child.on("error", (err) => {
-    spawnError = new Error(`guard-tpu serve failed to start: ${err.message}`);
+    const anyErr = err as NodeJS.ErrnoException;
+    spawnError =
+      anyErr.code === "ENOENT"
+        ? new Error(installHint(cli))
+        : new Error(`guard-tpu serve failed to start: ${err.message}`);
     while (waiters.length > 0) {
       const w = waiters.shift();
       if (w) w.reject(spawnError);

@@ -1,0 +1,17 @@
+// guard-tpu postinstall smoke: this npm package drives the installed
+// guard-tpu engine (Python) — warn loudly when it is absent, but never
+// fail the install (CI images often install the engine afterwards).
+const { execFile } = require("child_process");
+
+execFile("guard-tpu", ["--version"], { timeout: 30000 }, (err, stdout) => {
+  if (err) {
+    console.warn(
+      "\n[guard-tpu] engine preflight: the 'guard-tpu' CLI was not found on PATH.\n" +
+        "[guard-tpu] The npm package is a wrapper; install the engine with:\n" +
+        "[guard-tpu]     pip install guard-tpu     (or pipx install guard-tpu)\n" +
+        "[guard-tpu] or pass { cliPath } to validate()/createSession().\n"
+    );
+    return;
+  }
+  console.log(`[guard-tpu] engine preflight OK: ${String(stdout).trim()}`);
+});
