@@ -70,6 +70,7 @@ def build_parser() -> argparse.ArgumentParser:
         default="single-line-summary",
         choices=["single-line-summary", "json", "yaml", "junit"],
     )
+    t.add_argument("--backend", default="cpu", choices=["cpu", "tpu"])
 
     s = sub.add_parser(
         "sweep",
@@ -155,6 +156,7 @@ def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reade
                 last_modified=args.last_modified,
                 verbose=args.verbose,
                 output_format=args.output_format,
+                backend=args.backend,
             ).execute(writer, reader)
         if args.command == "sweep":
             from .commands.sweep import Sweep

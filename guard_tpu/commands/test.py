@@ -89,6 +89,7 @@ class Test:
     last_modified: bool = False
     verbose: bool = False
     output_format: str = "single-line-summary"
+    backend: str = "cpu"
 
     def execute(self, writer: Writer, reader: Reader) -> int:
         if self.directory is not None and (self.rules or self.test_data):
@@ -191,6 +192,60 @@ class Test:
         return pairs
 
     # -- spec execution (reporters/test/generic.rs:24-137) ------------
+    def _device_by_rules(self, rf, rule_file_name: str, specs):
+        """`--backend tpu`: one batched device evaluation over every
+        spec input of this rule file (validate's contract — statuses
+        from the device, rich output stays on the oracle). Returns one
+        Optional[by_rules dict] per spec; None routes that spec to the
+        oracle (host-fallback rules, kernel-unsure results, oversized
+        docs, or anything that fails to encode)."""
+        from ..core.values import from_plain as _fp
+        from ..ops.backend import _STATUS, _honor_platform_env
+        from ..ops.encoder import encode_batch
+        from ..ops.fnvars import precompute_fn_values, precomputable_fn_vars
+        from ..ops.ir import compile_rules_file
+        from ..parallel.mesh import ShardedBatchEvaluator
+
+        _honor_platform_env()
+        # same contract as tpu_validate (ops/backend.py): function-let
+        # precompute before encode, bucketed evaluation with oversized
+        # docs routed host-side, unsure flags to the oracle
+        fn_err = set()
+        try:
+            docs = [_fp(spec.input) for spec in specs]
+            if precomputable_fn_vars(rf):
+                fn_vars, fn_vals, fn_err = precompute_fn_values(rf, docs)
+                batch, interner = encode_batch(
+                    docs, fn_values=fn_vals, fn_var_order=fn_vars
+                )
+            else:
+                batch, interner = encode_batch(docs)
+            compiled = compile_rules_file(rf, interner)
+            if compiled.host_rules or not compiled.rules:
+                return [None] * len(specs)
+            evaluator = ShardedBatchEvaluator(compiled)
+            statuses, unsure, host_docs = evaluator.evaluate_bucketed(batch)
+        except Exception:
+            return [None] * len(specs)
+        out = []
+        for di in range(len(specs)):
+            if (
+                di in fn_err
+                or di in host_docs
+                or bool(batch.num_exotic[di])
+                or (unsure is not None and bool(unsure[di].any()))
+            ):
+                out.append(None)
+                continue
+            by_rules: Dict[str, List[Status]] = {}
+            for ri, crule in enumerate(compiled.rules):
+                name = get_rule_name(rule_file_name, crule.name)
+                by_rules.setdefault(name, []).append(
+                    _STATUS[int(statuses[di, ri])]
+                )
+            out.append(by_rules)
+        return out
+
     def _run_specs(self, writer: Writer, rf, rule_file_name: str, test_files):
         exit_code = TEST_SUCCESS_STATUS_CODE
         counter = 1
@@ -203,27 +258,34 @@ class Test:
                 writer.writeln(f"Error processing {e}")
                 exit_code = TEST_ERROR_STATUS_CODE
                 continue
-            for spec in specs:
+            device_results = None
+            if self.backend == "tpu" and not self.verbose:
+                device_results = self._device_by_rules(rf, rule_file_name, specs)
+            for spec_idx, spec in enumerate(specs):
                 if self.output_format == "single-line-summary":
                     writer.writeln(f"Test Case #{counter}")
                     if spec.name:
                         writer.writeln(f"Name: {spec.name}")
-                try:
-                    root = from_plain(spec.input)
-                    scope = RootScope(rf, root)
-                    eval_rules_file(rf, scope, None)
-                except GuardError as e:
-                    writer.writeln(f"Error processing {e}")
-                    exit_code = TEST_ERROR_STATUS_CODE
-                    counter += 1
-                    continue
-                top = scope.reset_recorder().extract()
-                if self.verbose and self.output_format == "single-line-summary":
-                    # the reference prints the event tree right after
-                    # the case header, before the expectation lines
-                    # (test.rs verbose path)
-                    print_verbose_tree(writer, top)
-                by_rules = _rule_statuses(top, rule_file_name)
+                by_rules = None
+                if device_results is not None:
+                    by_rules = device_results[spec_idx]
+                if by_rules is None:
+                    try:
+                        root = from_plain(spec.input)
+                        scope = RootScope(rf, root)
+                        eval_rules_file(rf, scope, None)
+                    except GuardError as e:
+                        writer.writeln(f"Error processing {e}")
+                        exit_code = TEST_ERROR_STATUS_CODE
+                        counter += 1
+                        continue
+                    top = scope.reset_recorder().extract()
+                    if self.verbose and self.output_format == "single-line-summary":
+                        # the reference prints the event tree right
+                        # after the case header, before the expectation
+                        # lines (test.rs verbose path)
+                        print_verbose_tree(writer, top)
+                    by_rules = _rule_statuses(top, rule_file_name)
                 passed_lines: List[str] = []
                 failed_lines: List[str] = []
                 spec_report = {

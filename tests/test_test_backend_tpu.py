@@ -1,0 +1,115 @@
+"""`test --backend tpu` (VERDICT r3 item 9): the expectation-suite
+runner exercises the device path — statuses from the batched kernels,
+rich output (verbose trees, error paths) from the oracle — with output
+identical to the CPU backend."""
+
+import pathlib
+import random
+
+import pytest
+
+from guard_tpu.cli import run
+from guard_tpu.utils.io import Reader, Writer
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CORPUS = REPO / "corpus" / "rules"
+
+
+def _run(args):
+    w = Writer.buffered()
+    rc = run(args, writer=w, reader=Reader())
+    return rc, w.out.getvalue(), w.err.getvalue()
+
+
+@pytest.mark.parametrize("fmt", ["single-line-summary", "json", "junit"])
+def test_corpus_sample_identical_under_both_backends(fmt):
+    rng = random.Random(fmt)
+    sample = rng.sample(sorted(CORPUS.glob("*.guard")), 5)
+    for g in sample:
+        args_base = [
+            "test",
+            "--rules-file", str(g),
+            "--test-data", str(CORPUS / "tests" / f"{g.stem}_tests.yaml"),
+        ]
+        if fmt != "single-line-summary":
+            args_base += ["--output-format", fmt]
+        cpu = _run(args_base + ["--backend", "cpu"])
+        tpu = _run(args_base + ["--backend", "tpu"])
+        assert cpu == tpu, f"{g.name} [{fmt}]: backend outputs differ"
+
+
+def test_directory_mode_identical(tmp_path):
+    # a small directory with the dir/tests/ pairing convention
+    rules = tmp_path / "r1.guard"
+    rules.write_text("rule named { Resources.*.Name exists }\n")
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    (tests_dir / "r1_tests.yaml").write_text(
+        "- name: t1\n"
+        "  input: {Resources: {a: {Name: x}}}\n"
+        "  expectations: {rules: {named: PASS}}\n"
+        "- name: t2\n"
+        "  input: {Resources: {a: {}}}\n"
+        "  expectations: {rules: {named: FAIL}}\n"
+    )
+    cpu = _run(["test", "-d", str(tmp_path), "--backend", "cpu"])
+    tpu = _run(["test", "-d", str(tmp_path), "--backend", "tpu"])
+    assert cpu == tpu
+    assert cpu[0] == 0
+
+
+def test_failing_expectation_exit_code_from_device(tmp_path):
+    rules = tmp_path / "r1.guard"
+    rules.write_text("rule named { Resources.*.Name exists }\n")
+    spec = tmp_path / "t.yaml"
+    spec.write_text(
+        "- name: wrong\n"
+        "  input: {Resources: {a: {Name: x}}}\n"
+        "  expectations: {rules: {named: FAIL}}\n"
+    )
+    rc, out, _ = _run([
+        "test", "--rules-file", str(rules), "--test-data", str(spec),
+        "--backend", "tpu",
+    ])
+    assert rc == 7  # TEST_FAILURE_STATUS_CODE
+    assert "Expected = FAIL" in out
+
+
+def test_function_let_rules_identical(tmp_path):
+    # review-found bug class: precomputable function lets must go
+    # through the fn-precompute + re-encode contract, not a bare batch
+    rules = tmp_path / "r.guard"
+    rules.write_text(
+        "let names = Resources.*.Name\n"
+        "let up = to_upper(%names)\n"
+        'rule upper_ok { %up == "X" }\n'
+    )
+    spec = tmp_path / "t.yaml"
+    spec.write_text(
+        "- name: t\n"
+        "  input: {Resources: {a: {Name: x}}}\n"
+        "  expectations: {rules: {upper_ok: PASS}}\n"
+        "- name: t2\n"
+        "  input: {Resources: {a: {Name: zz}}}\n"
+        "  expectations: {rules: {upper_ok: FAIL}}\n"
+    )
+    base = ["test", "--rules-file", str(rules), "--test-data", str(spec)]
+    cpu = _run(base + ["--backend", "cpu"])
+    tpu = _run(base + ["--backend", "tpu"])
+    assert cpu == tpu
+    assert cpu[0] == 0
+
+
+def test_verbose_stays_on_oracle(tmp_path):
+    # verbose needs the record tree: the tpu flag must not change its
+    # output either (the device path is bypassed)
+    rules = tmp_path / "r1.guard"
+    rules.write_text("rule named { Resources.*.Name exists }\n")
+    spec = tmp_path / "t.yaml"
+    spec.write_text(
+        "- name: t\n"
+        "  input: {Resources: {a: {Name: x}}}\n"
+        "  expectations: {rules: {named: PASS}}\n"
+    )
+    base = ["test", "--rules-file", str(rules), "--test-data", str(spec), "-v"]
+    assert _run(base + ["--backend", "cpu"]) == _run(base + ["--backend", "tpu"])
