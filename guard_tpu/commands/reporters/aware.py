@@ -26,6 +26,93 @@ from ...core.values import MAP, PV
 from ...utils.io import Writer
 from ..report import iter_clause_failures
 
+def _top_level_json_keys(content: str):
+    """Top-level object keys of a JSON document without building the
+    tree; None when the content isn't a JSON object parse (YAML,
+    scalars, garbage — the caller materializes the real tree then),
+    an empty set for arrays (neither cfn nor tf shape applies)."""
+    n = len(content)
+
+    def skip_ws(i):
+        while i < n and content[i] in " \t\r\n":
+            i += 1
+        return i
+
+    def skip_string(i):
+        """i at the opening quote; returns index past the close, or -1."""
+        i += 1
+        while i < n:
+            c = content[i]
+            if c == "\\":
+                i += 2
+                continue
+            if c == '"':
+                return i + 1
+            i += 1
+        return -1
+
+    i = skip_ws(0)
+    if i >= n:
+        return None
+    if content[i] == "[":
+        return set()
+    if content[i] != "{":
+        return None
+    i += 1
+    keys = set()
+    while True:
+        i = skip_ws(i)
+        if i >= n:
+            return None
+        if content[i] == "}":
+            return keys
+        if content[i] == ",":
+            i += 1
+            continue
+        if content[i] != '"':
+            return None
+        close = skip_string(i)
+        if close < 0:
+            return None
+        raw_key = content[i + 1 : close - 1]
+        if "\\" in raw_key:
+            # escaped spellings (\u0052esources...) need the real
+            # parser — decline the probe entirely
+            return None
+        keys.add(raw_key)
+        i = skip_ws(close)
+        if i >= n or content[i] != ":":
+            return None
+        i = skip_ws(i + 1)
+        if i >= n:
+            return None
+        c = content[i]
+        if c == '"':
+            i = skip_string(i)
+            if i < 0:
+                return None
+        elif c in "{[":
+            depth = 1
+            i += 1
+            while i < n and depth:
+                ch = content[i]
+                if ch == '"':
+                    i = skip_string(i)
+                    if i < 0:
+                        return None
+                    continue
+                if ch in "{[":
+                    depth += 1
+                elif ch in "}]":
+                    depth -= 1
+                i += 1
+            if depth:
+                return None
+        else:
+            while i < n and content[i] not in ",}":
+                i += 1
+
+
 def console_chain(
     writer: Writer,
     data_file_name: str,
@@ -70,9 +157,30 @@ def console_chain(
         else:
             writer.write(_json.dumps(rep, indent=2))
         return
+    # `data_pv` may be a DataFile whose tree builds lazily. The aware
+    # reporters read the tree only for shape detection plus failure
+    # attribution; for failure-free reports the shape answer (has a
+    # top-level "Resources" / "resource_changes" key?) comes from a
+    # cheap raw-JSON key scan, so passing documents never build trees.
+    pv = data_pv
+    if not isinstance(data_pv, PV):  # a DataFile: tree builds lazily
+        if not report["not_compliant"]:
+            keys = getattr(data_pv, "_top_keys", False)
+            if keys is False:
+                keys = _top_level_json_keys(data_content)
+                data_pv._top_keys = keys
+            if keys is not None:
+                if "Resources" in keys or "resource_changes" in keys:
+                    return  # cfn/tf applies, nothing to print (no failures)
+                generic_single_line(
+                    writer, data_file_name, rules_file_name, report,
+                    rule_statuses, show,
+                )
+                return
+        pv = data_pv.path_value
     handled = cfn_single_line(
-        writer, data_file_name, data_content, rules_file_name, data_pv, report
-    ) or tf_single_line(writer, data_file_name, rules_file_name, data_pv, report)
+        writer, data_file_name, data_content, rules_file_name, pv, report
+    ) or tf_single_line(writer, data_file_name, rules_file_name, pv, report)
     if not handled:
         generic_single_line(
             writer, data_file_name, rules_file_name, report, rule_statuses, show

@@ -180,7 +180,7 @@ class Sweep:
             try:
                 content = p.read_text()
                 data_files.append(
-                    DataFile(name=p.name, content=content, path_value=None)
+                    DataFile(name=p.name, content=content, _pv=None)
                 )
             except OSError as e:
                 writer.writeln_err(f"skipping {p}: {e}")
@@ -225,14 +225,14 @@ class Sweep:
         content, so the Python PV is only materialized for oracle
         fallbacks / function precompute. A parse failure marks the
         doc (excluded from tallies) and counts one error."""
-        if df.path_value is None and not getattr(df, "_pv_failed", False):
+        if df._pv is None and not getattr(df, "_pv_failed", False):
             try:
-                df.path_value = load_document(df.content, df.name)
+                df._pv = load_document(df.content, df.name)
             except GuardError as e:
                 df._pv_failed = True
                 writer.writeln_err(f"skipping {df.name}: {e}")
                 err_box[0] += 1
-        return df.path_value
+        return df._pv
 
     def _padded_pvs(self, data_files, writer, err_box):
         """Python documents for every file, unparseable ones replaced

@@ -49,9 +49,25 @@ SHOW_SUMMARY_TYPES = ("all", "pass", "fail", "skip", "none")
 
 @dataclass
 class DataFile:
+    """One data document. `path_value` may be built lazily (tpu
+    backend): the native encoder and native oracle work from raw
+    content, so the Python tree is only materialized when something
+    actually walks it (oracle fallbacks, aware reporters on failing
+    docs, --input-parameters merging)."""
+
     name: str
     content: str
-    path_value: PV
+    _pv: Optional[PV] = None
+
+    @property
+    def path_value(self) -> PV:
+        if self._pv is None:
+            self._pv = load_document(self.content, self.name)
+        return self._pv
+
+    @path_value.setter
+    def path_value(self, value: PV) -> None:
+        self._pv = value
 
 
 @dataclass
@@ -126,21 +142,29 @@ class Validate:
             for i, content in enumerate(data):
                 c = content if isinstance(content, str) else json.dumps(content)
                 data_files.append(
-                    DataFile(name=f"DATA_STDIN[{i + 1}]", content=c, path_value=load_document(c))
+                    DataFile(name=f"DATA_STDIN[{i + 1}]", content=c, _pv=load_document(c))
                 )
             return data_files
         if self.data:
             for f in gather(self.data, DATA_FILE_EXTENSIONS, self.last_modified):
                 content = f.read_text()
+                # tpu backend: LAZY document build (sweep measured the
+                # eager build at ~40% of all-lowered JSON sweep time);
+                # parse errors surface on first access, which the
+                # backend reaches before any evaluation output
                 data_files.append(
                     DataFile(
-                        name=f.name, content=content, path_value=load_document(content, f.name)
+                        name=f.name,
+                        content=content,
+                        _pv=None
+                        if self.backend == "tpu"
+                        else load_document(content, f.name),
                     )
                 )
         else:
             content = reader.read()
             data_files.append(
-                DataFile(name="STDIN", content=content, path_value=load_document(content))
+                DataFile(name="STDIN", content=content, _pv=load_document(content))
             )
         return data_files
 
@@ -188,7 +212,7 @@ class Validate:
                 DataFile(
                     name=f"DATA_STDIN[{i + 1}]",
                     content=d if isinstance(d, str) else json.dumps(d),
-                    path_value=load_document(d if isinstance(d, str) else json.dumps(d)),
+                    _pv=load_document(d if isinstance(d, str) else json.dumps(d)),
                 )
                 for i, d in enumerate(data_strs)
             ]
@@ -228,14 +252,26 @@ class Validate:
             return ERROR_STATUS_CODE
 
         if input_params is not None:
-            for df in data_files:
-                merged = _clone_pv(input_params).merge(df.path_value)
-                df.path_value = merged
+            try:
+                for df in data_files:
+                    merged = _clone_pv(input_params).merge(df.path_value)
+                    df.path_value = merged
+            except (GuardError, OSError) as e:
+                # lazily-built trees surface parse errors here with the
+                # same message + exit-code contract as eager loads
+                writer.writeln_err(str(e))
+                return ERROR_STATUS_CODE
 
         if self.backend == "tpu":
             from ..ops.backend import tpu_validate
 
-            return tpu_validate(self, rule_files, data_files, writer)
+            try:
+                return tpu_validate(self, rule_files, data_files, writer)
+            except GuardError as e:
+                # lazily-built documents surface parse errors here with
+                # the same message + exit-code contract as eager loads
+                writer.writeln_err(str(e))
+                return ERROR_STATUS_CODE
 
         overall = Status.SKIP
         had_fail = False

@@ -131,12 +131,19 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
     from ..commands.reporters.structured import write_structured
     from ..parallel.mesh import ShardedBatchEvaluator
 
-    docs = [df.path_value for df in data_files]
-    if not docs or not rule_files:
+    if not data_files or not rule_files:
         return SUCCESS_STATUS_CODE
 
+    # Python document trees build LAZILY (DataFile.path_value): on
+    # all-JSON corpora the native encoder, device kernels and native
+    # oracle run entirely from raw content, and the eager per-doc tree
+    # build (~40% of all-lowered sweep time, measured round 3) is paid
+    # only by the docs something actually walks.
+    def _docs():
+        return [df.path_value for df in data_files]
+
     batch = interner = None
-    if all(df.content.lstrip()[:1] in ("{", "[") for df in data_files):
+    if all(_looks_json(df.content) for df in data_files):
         # JSON corpus: the native C++ data loader (native/encoder.cpp)
         from .native_encoder import encode_json_batch_native, native_available
 
@@ -150,7 +157,7 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
             except RuntimeError:
                 pass
     if batch is None:
-        batch, interner = encode_batch(docs)
+        batch, interner = encode_batch(_docs())
 
     errors = 0
     had_fail = False
@@ -166,6 +173,7 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
 
         rbatch = batch
         if precomputable_fn_vars(rule_file.rules):
+            docs = _docs()
             fn_vars, fn_vals, fn_err = precompute_fn_values(
                 rule_file.rules, docs
             )
@@ -491,7 +499,7 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
             if not validate.structured:
                 console_chain(
                     writer, data_file.name, data_file.content,
-                    data_file.path_value, rule_file.name,
+                    data_file, rule_file.name,
                     doc_status, rule_statuses, report, validate.show_summary,
                     validate.output_format,
                 )

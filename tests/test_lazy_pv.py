@@ -1,0 +1,123 @@
+"""Lazy document trees in plain validate (VERDICT r3 item 4): on the
+tpu backend, JSON corpora evaluate natively from raw content and the
+Python tree builds only for documents something actually walks."""
+
+import json
+
+import pytest
+
+import guard_tpu.commands.validate as vmod
+from guard_tpu.cli import run
+from guard_tpu.commands.reporters.aware import _top_level_json_keys
+from guard_tpu.utils.io import Reader, Writer
+
+RULES = "rule named { Resources.*.Name exists }\n"
+
+
+def _mk(tmp_path, n, fail_every=0):
+    (tmp_path / "r.guard").write_text(RULES)
+    data = tmp_path / "data"
+    data.mkdir()
+    for i in range(n):
+        body = {"Resources": {"a": {}}} if fail_every and i % fail_every == 0 \
+            else {"Resources": {"a": {"Name": f"n{i}"}}}
+        (data / f"t{i}.json").write_text(json.dumps(body))
+    return tmp_path / "r.guard", data
+
+
+def _run(args):
+    w = Writer.buffered()
+    rc = run(args, writer=w, reader=Reader())
+    return rc, w.out.getvalue(), w.err.getvalue()
+
+
+def test_passing_json_corpus_builds_zero_trees(tmp_path, monkeypatch):
+    rules, data = _mk(tmp_path, 6)
+    loads = {"n": 0}
+    real = vmod.load_document
+
+    def counting(content, name=""):
+        loads["n"] += 1
+        return real(content, name)
+
+    monkeypatch.setattr(vmod, "load_document", counting)
+    rc, out, err = _run([
+        "validate", "-r", str(rules), "-d", str(data), "--backend", "tpu",
+    ])
+    assert rc == 0, err
+    # all-passing JSON corpus: native encode + device statuses + the
+    # raw-JSON shape probe — no Python tree ever builds
+    assert loads["n"] == 0
+
+
+def test_failing_docs_materialize_only_themselves(tmp_path, monkeypatch):
+    rules, data = _mk(tmp_path, 6, fail_every=3)
+    loads = {"n": 0}
+    real = vmod.load_document
+
+    def counting(content, name=""):
+        loads["n"] += 1
+        return real(content, name)
+
+    monkeypatch.setattr(vmod, "load_document", counting)
+    rc, out, err = _run([
+        "validate", "-r", str(rules), "-d", str(data), "--backend", "tpu",
+    ])
+    assert rc == 19, err
+    # failing docs (2 of 6) need trees for the aware failure report;
+    # passing docs stay raw
+    assert 0 < loads["n"] <= 2
+
+
+def test_lazy_output_identical_to_cpu_backend(tmp_path):
+    # the cpu backend is fully eager and takes the pre-change reporter
+    # path (real PVs, no probe) — the strongest identity baseline
+    rules, data = _mk(tmp_path, 8, fail_every=2)
+    base = ["validate", "-r", str(rules), "-d", str(data)]
+    lazy_tpu = _run(base + ["--backend", "tpu"])
+    eager_cpu = _run(base)
+    assert lazy_tpu[0] == eager_cpu[0]
+    assert lazy_tpu[1] == eager_cpu[1]
+
+
+def test_escaped_key_spelling_matches_cpu(tmp_path):
+    # \u0052esources == "Resources": the probe must decline (build the
+    # tree) rather than misclassify the document shape
+    (tmp_path / "r.guard").write_text(RULES)
+    data = tmp_path / "data"
+    data.mkdir()
+    (data / "t.json").write_text(
+        '{"\\u0052esources": {"a": {"Name": "x"}}}'
+    )
+    base = ["validate", "-r", str(tmp_path / "r.guard"), "-d", str(data),
+            "--show-summary", "pass"]
+    tpu = _run(base + ["--backend", "tpu"])
+    cpu = _run(base)
+    assert tpu[0] == cpu[0]
+    assert tpu[1] == cpu[1]
+
+
+def test_broken_doc_keeps_error_contract(tmp_path):
+    rules, data = _mk(tmp_path, 2)
+    (data / "bad.json").write_text("{this is not json: [")
+    rc, out, err = _run([
+        "validate", "-r", str(rules), "-d", str(data), "--backend", "tpu",
+    ])
+    assert rc == 5
+    assert err.strip()
+
+
+def test_top_level_json_keys_scanner():
+    f = _top_level_json_keys
+    assert f('{"Resources": {"a": 1}, "Outputs": []}') == {"Resources", "Outputs"}
+    assert f('  {"a": [1, {"Resources": 2}], "b": "x{y}"}') == {"a", "b"}
+    assert f('{"a": "s\\"t", "b": 1}') == {"a", "b"}
+    assert f("[1, 2]") == set()
+    assert f('{"dup": 1, "dup": 2}') == {"dup"}
+    assert f("Resources:\n  a: 1\n") is None  # YAML
+    assert f("") is None
+    assert f('{"unterminated": ') is None
+    # nested resource_changes must NOT count as top-level
+    assert "resource_changes" not in f(
+        '{"plan": {"resource_changes": []}, "x": 1}'
+    )
