@@ -54,7 +54,13 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("--print-json", "-p", action="store_true")
     v.add_argument("--payload", "-P", action="store_true")
     v.add_argument("--structured", "-z", action="store_true")
-    v.add_argument("--backend", default="cpu", choices=["cpu", "tpu"])
+    v.add_argument(
+        "--backend",
+        default="auto",
+        choices=["auto", "cpu", "native", "tpu"],
+        help="auto (default) = compiled C++ engine when built, else "
+        "pure-Python; native/cpu force one; tpu = JAX batch engine",
+    )
     v.add_argument("--statuses-only", action="store_true")
 
     t = sub.add_parser("test", help="Test rules against expectations")
@@ -70,7 +76,13 @@ def build_parser() -> argparse.ArgumentParser:
         default="single-line-summary",
         choices=["single-line-summary", "json", "yaml", "junit"],
     )
-    t.add_argument("--backend", default="cpu", choices=["cpu", "tpu"])
+    t.add_argument(
+        "--backend",
+        default="auto",
+        choices=["auto", "cpu", "native", "tpu"],
+        help="auto (default) = compiled C++ engine when built, else "
+        "pure-Python; native/cpu force one; tpu = JAX batch engine",
+    )
 
     s = sub.add_parser(
         "sweep",

@@ -15,7 +15,7 @@ Protocol (one line in, one line out):
   request:  {"rules": [..], "data": [..]}          (payload contract,
             validate.rs:507-513) plus optional
             {"output_format": "sarif"|"json"|"yaml",
-             "backend": "cpu"|"tpu", "verbose": bool}
+             "backend": "auto"|"cpu"|"native"|"tpu", "verbose": bool}
   response: {"code": <exit code 0|19|5>, "output": "<stdout text>",
              "error": "<stderr text>"}
 
@@ -61,7 +61,7 @@ class Serve:
                     output_format=out_fmt,
                     show_summary=["none"] if structured else ["fail"],
                     verbose=bool(req.get("verbose", False)),
-                    backend=req.get("backend", "cpu"),
+                    backend=req.get("backend", "auto"),
                 )
                 buf = Writer.buffered()
                 code = cmd.execute(buf, Reader.from_string(payload))

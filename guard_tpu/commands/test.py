@@ -92,6 +92,8 @@ class Test:
     backend: str = "cpu"
 
     def execute(self, writer: Writer, reader: Reader) -> int:
+        from .validate import ensure_native_built, resolve_backend
+
         if self.directory is not None and (self.rules or self.test_data):
             writer.writeln_err("directory conflicts with rules-file/test-data")
             return TEST_ERROR_STATUS_CODE
@@ -100,6 +102,12 @@ class Test:
                 "must specify either --dir or both --rules-file and --test-data"
             )
             return TEST_ERROR_STATUS_CODE
+        self.backend = resolve_backend(self.backend)
+        if self.backend == "native":
+            err = ensure_native_built()
+            if err:
+                writer.writeln_err(err)
+                return TEST_ERROR_STATUS_CODE
 
         if self.directory is not None:
             pairs = self._ordered_test_directory(Path(self.directory))
@@ -246,11 +254,37 @@ class Test:
             out.append(by_rules)
         return out
 
+    def _native_by_rules(self, native, rf, rule_file_name: str, spec):
+        """`--backend native`: per-rule status lists from the compiled
+        engine (same grouping as _rule_statuses over the record tree —
+        one top-level RuleCheck per guard rule, file order). None routes
+        the spec to the Python oracle (engine declined)."""
+        from ..ops.native_oracle import NativeEvalError, NativeUnsupported
+
+        try:
+            raw = native.eval_doc(from_plain(spec.input))
+        except (NativeUnsupported, NativeEvalError, GuardError):
+            return None
+        st = {0: Status.PASS, 1: Status.FAIL, 2: Status.SKIP}
+        out: Dict[str, List[Status]] = {}
+        for rule, s in zip(rf.guard_rules, raw):
+            name = get_rule_name(rule_file_name, rule.rule_name)
+            out.setdefault(name, []).append(st[s])
+        return out
+
     def _run_specs(self, writer: Writer, rf, rule_file_name: str, test_files):
         exit_code = TEST_SUCCESS_STATUS_CODE
         counter = 1
         cases: List[JunitTestCase] = []
         reports: List[dict] = []
+        native = None
+        if self.backend == "native" and not self.verbose:
+            from ..ops.native_oracle import NativeOracle, NativeUnsupported
+
+            try:
+                native = NativeOracle(rf)
+            except NativeUnsupported:
+                native = None
         for tf in test_files:
             try:
                 specs = _load_specs(tf)
@@ -269,6 +303,10 @@ class Test:
                 by_rules = None
                 if device_results is not None:
                     by_rules = device_results[spec_idx]
+                if by_rules is None and native is not None:
+                    by_rules = self._native_by_rules(
+                        native, rf, rule_file_name, spec
+                    )
                 if by_rules is None:
                     try:
                         root = from_plain(spec.input)
@@ -355,4 +393,6 @@ class Test:
                     writer.writeln()
                 reports.append(spec_report)
                 counter += 1
+        if native is not None:
+            native.close()
         return exit_code, cases, reports

@@ -7,9 +7,20 @@ walks rule/data paths (or a stdin JSON payload `{rules, data}`), merges
 the reference exit codes (0 pass / 19 fail / 5 error,
 commands/mod.rs:69-71).
 
-Extension over the reference: `--backend=tpu` batch-evaluates all
-(doc x rule) statuses on the JAX/TPU engine (guard_tpu/ops), falling
-back to the CPU oracle per failing document for rich reports.
+Extensions over the reference:
+
+* `--backend=tpu` batch-evaluates all (doc x rule) statuses on the
+  JAX/TPU engine (guard_tpu/ops), falling back to the CPU oracle per
+  failing document for rich reports.
+* `--backend=native` evaluates on the compiled C++ engine
+  (native/oracle.cpp) — the economics of the reference's compiled Rust
+  evaluator (`/root/reference/guard/src/rules/eval.rs:1915`) on hosts
+  without an accelerator. Output is byte-identical to the Python
+  evaluator's (corpus-wide differential, tests/test_native_oracle.py);
+  any construct outside the engine's certain-parity subset declines
+  per (rule-file, document) pair and falls back to Python.
+* `--backend=auto` (the CLI default) resolves to `native` when the
+  compiled engine is built and `cpu` otherwise.
 """
 
 from __future__ import annotations
@@ -46,6 +57,44 @@ ERROR_STATUS_CODE = 5  # commands/mod.rs:71
 OUTPUT_FORMATS = ("single-line-summary", "json", "yaml", "junit", "sarif")
 SHOW_SUMMARY_TYPES = ("all", "pass", "fail", "skip", "none")
 
+BACKENDS = ("auto", "cpu", "native", "tpu")
+
+
+def _looks_json(content: str) -> bool:
+    """First non-space byte sniff without copying the document."""
+    for ch in content[:256]:
+        if ch in " \t\r\n":
+            continue
+        return ch in "{["
+    return False
+
+
+def resolve_backend(backend: str) -> str:
+    """`auto` picks the compiled C++ engine when its shared library is
+    already built, the pure-Python evaluator otherwise (auto never
+    triggers a compile; explicit `native` does, via
+    ensure_native_built)."""
+    if backend != "auto":
+        return backend
+    from ..ops.native_oracle import native_available
+
+    return "native" if native_available() else "cpu"
+
+
+def ensure_native_built() -> Optional[str]:
+    """None when the compiled engine is usable (building it on first
+    use if needed); an error message otherwise. Shared by validate and
+    test so the wording never drifts."""
+    from ..ops.native_oracle import build_native, native_available
+
+    if native_available() or build_native():
+        return None
+    return (
+        "native backend requested but the compiled engine is not built "
+        "and could not be compiled (native/build_oracle.sh needs a C++ "
+        "toolchain); use --backend cpu"
+    )
+
 
 @dataclass
 class DataFile:
@@ -58,6 +107,8 @@ class DataFile:
     name: str
     content: str
     _pv: Optional[PV] = None
+    # native backend: content pre-validated as JSON (raw fast path ok)
+    _raw_ok: bool = False
 
     @property
     def path_value(self) -> PV:
@@ -91,7 +142,7 @@ class Validate:
     print_json: bool = False
     payload: bool = False
     structured: bool = False
-    backend: str = "cpu"  # cpu | tpu
+    backend: str = "cpu"  # auto | cpu | native | tpu (BACKENDS)
     # TPU backend only: skip the oracle fail-rerun — failing documents
     # report rule-level statuses without per-clause detail, so
     # fail-heavy corpora stay device-bound instead of oracle-bound
@@ -151,14 +202,18 @@ class Validate:
                 # tpu backend: LAZY document build (sweep measured the
                 # eager build at ~40% of all-lowered JSON sweep time);
                 # parse errors surface on first access, which the
-                # backend reaches before any evaluation output
+                # backend reaches before any evaluation output. The
+                # native backend is lazy for JSON-sniffing documents:
+                # the compiled engine parses raw JSON itself, so the
+                # Python tree only builds on declines/fallbacks.
+                lazy = self.backend == "tpu" or (
+                    self.backend == "native" and _looks_json(content)
+                )
                 data_files.append(
                     DataFile(
                         name=f.name,
                         content=content,
-                        _pv=None
-                        if self.backend == "tpu"
-                        else load_document(content, f.name),
+                        _pv=None if lazy else load_document(content, f.name),
                     )
                 )
         else:
@@ -201,9 +256,78 @@ class Validate:
             merged = doc if merged is None else merged.merge(doc)
         return merged
 
+    # -- native engine (--backend native) -----------------------------
+    def _native_for(self, rule_file):
+        """Compiled-engine handle for one rules file, or None when the
+        engine declines the file (fall back to Python for every pair)."""
+        from ..ops.native_oracle import NativeOracle, NativeUnsupported
+
+        try:
+            return NativeOracle(rule_file.rules)
+        except NativeUnsupported:
+            return None
+
+    def _native_pair(self, native, data_file):
+        """One (rules-file, document) evaluation on the compiled engine.
+        Returns (status, rule_statuses, report, root_record-or-None), or
+        None when the engine declines this document (Python fallback).
+        ParseError (a lazy document failing to load) propagates."""
+        from ..ops.native_oracle import NativeEvalError, NativeUnsupported
+
+        try:
+            if self.verbose or self.print_json:
+                # verbose/print-json need the full record tree; the
+                # native tree is byte-equivalent to the Python
+                # evaluator's (serde-pinned differential)
+                root = native.eval_records(data_file.path_value, data_file.name)
+                report = simplified_report_from_root(root, data_file.name)
+                return (
+                    root.container.payload.status,
+                    rule_statuses_from_root(root),
+                    report,
+                    root,
+                )
+            raw_ok = not self.input_params and (
+                data_file._raw_ok
+                or (
+                    data_file._pv is not None
+                    and _looks_json(data_file.content)
+                )
+            )
+            if raw_ok:
+                try:
+                    report, statuses, status = native.eval_report_raw(
+                        data_file.content, data_file.name
+                    )
+                    return status, statuses, report, None
+                except (NativeUnsupported, NativeEvalError):
+                    # flow-style YAML sniffing as JSON, or a decline —
+                    # the loaded tree is authoritative
+                    pass
+            report, statuses, status = native.eval_report(
+                data_file.path_value, data_file.name
+            )
+            return status, statuses, report, None
+        except (NativeUnsupported, NativeEvalError):
+            # declined, or an evaluation error: the Python path
+            # reproduces genuine errors with the exact message
+            return None
+
     # -- execution ----------------------------------------------------
     def execute(self, writer: Writer, reader: Reader) -> int:
+        if self.backend not in BACKENDS:
+            raise GuardError(
+                f"unknown backend `{self.backend}` (choose from "
+                f"{', '.join(BACKENDS)})"
+            )
+        # argument conflicts report before any (potentially slow)
+        # native-engine build is attempted
         self._validate_args()
+        self.backend = resolve_backend(self.backend)
+        if self.backend == "native":
+            err = ensure_native_built()
+            if err:
+                raise GuardError(err)
 
         if self.payload:
             payload_content = reader.read()
@@ -262,6 +386,26 @@ class Validate:
                 writer.writeln_err(str(e))
                 return ERROR_STATUS_CODE
 
+        if self.backend == "native":
+            # up-front validation of lazily-loaded documents: a document
+            # that parses under neither JSON nor the YAML loader must
+            # error BEFORE any evaluation output, exactly like the eager
+            # loader. Valid JSON earns the raw fast path into the
+            # engine; malformed-JSON-but-valid-YAML (flow style) simply
+            # loses raw eligibility and evaluates from its tree.
+            try:
+                for df in data_files:
+                    if df._pv is None:
+                        try:
+                            json.loads(df.content)
+                        except ValueError:
+                            df.path_value  # loads or raises ParseError
+                        else:
+                            df._raw_ok = True
+            except (GuardError, OSError) as e:
+                writer.writeln_err(str(e))
+                return ERROR_STATUS_CODE
+
         if self.backend == "tpu":
             from ..ops.backend import tpu_validate
 
@@ -280,23 +424,50 @@ class Validate:
         # (reporters/validate/xml.rs:22-61)
         junit_suites = {df.name: [] for df in data_files}
 
+        use_native = self.backend == "native"
         for rule_file in rule_files:
+            native = self._native_for(rule_file) if use_native else None
             for data_file in data_files:
-                try:
-                    scope = RootScope(rule_file.rules, data_file.path_value)
-                    status = eval_rules_file(rule_file.rules, scope, data_file.name)
-                except GuardError as e:
-                    writer.writeln_err(str(e))
-                    errors += 1
-                    junit_suites[data_file.name].append(
-                        JunitTestCase(
-                            name=rule_file.name, status=Status.FAIL, error=str(e)
+                native_res = None
+                if native is not None:
+                    try:
+                        native_res = self._native_pair(native, data_file)
+                    except ParseError as e:
+                        # lazily-built JSON documents keep the eager
+                        # loader's message + exit-code contract
+                        writer.writeln_err(str(e))
+                        native.close()
+                        return ERROR_STATUS_CODE
+                if native_res is not None:
+                    status, rule_statuses, report, root_record = native_res
+                else:
+                    try:
+                        # materialized separately from evaluation: a
+                        # lazy document failing to LOAD is fatal (the
+                        # eager loader's contract), while evaluation
+                        # errors below keep per-pair isolation — even
+                        # the built-in functions' ParseErrors
+                        pv = data_file.path_value
+                    except ParseError as e:
+                        writer.writeln_err(str(e))
+                        if native is not None:
+                            native.close()
+                        return ERROR_STATUS_CODE
+                    try:
+                        scope = RootScope(rule_file.rules, pv)
+                        status = eval_rules_file(rule_file.rules, scope, data_file.name)
+                    except GuardError as e:
+                        writer.writeln_err(str(e))
+                        errors += 1
+                        junit_suites[data_file.name].append(
+                            JunitTestCase(
+                                name=rule_file.name, status=Status.FAIL, error=str(e)
+                            )
                         )
-                    )
-                    continue
-                root_record = scope.reset_recorder().extract()
-                report = simplified_report_from_root(root_record, data_file.name)
-                rule_statuses = rule_statuses_from_root(root_record)
+                        continue
+                    root_record = scope.reset_recorder().extract()
+                    report = simplified_report_from_root(root_record, data_file.name)
+                    rule_statuses = rule_statuses_from_root(root_record)
                 all_reports.append(report)
                 from .reporters.junit import failure_info_from_report
 
@@ -316,7 +487,7 @@ class Validate:
                 if not self.structured:
                     console_chain(
                         writer, data_file.name, data_file.content,
-                        data_file.path_value, rule_file.name,
+                        data_file, rule_file.name,
                         status, rule_statuses, report, self.show_summary,
                         self.output_format,
                     )
@@ -330,6 +501,8 @@ class Validate:
                                 ensure_ascii=False,
                             )
                         )
+            if native is not None:
+                native.close()
 
         if self.structured:
             if self.output_format in ("json", "yaml"):

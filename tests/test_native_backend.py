@@ -1,0 +1,240 @@
+"""`--backend native` (VERDICT r4 item 3): the compiled C++ engine
+(native/oracle.cpp) as a first-class CPU evaluation backend for
+validate/test — byte-identical output to the pure-Python evaluator,
+declining constructs fall back per (rule-file, document) pair, and the
+CLI default (`auto`) resolves to it when the library is built.
+
+Reference bar: compiled-engine evaluation everywhere
+(/root/reference/guard/src/rules/eval.rs:1915)."""
+
+import json
+
+import pytest
+
+import guard_tpu.commands.validate as vmod
+from guard_tpu.cli import run
+from guard_tpu.commands.validate import Validate, resolve_backend
+from guard_tpu.ops.native_oracle import native_available
+from guard_tpu.utils.io import Reader, Writer
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native oracle not built"
+)
+
+RULES = """\
+rule s3_sse {
+    Resources.*[ Type == "AWS::S3::Bucket" ] {
+        Properties.BucketEncryption exists
+        <<Bucket must be encrypted>>
+    }
+}
+rule named when s3_sse {
+    Resources.*.Name exists
+}
+"""
+
+
+def _run(args):
+    w = Writer.buffered()
+    rc = run(args, writer=w, reader=Reader())
+    return rc, w.out.getvalue(), w.err.getvalue()
+
+
+def _mk(tmp_path, docs):
+    (tmp_path / "r.guard").write_text(RULES)
+    data = tmp_path / "data"
+    data.mkdir()
+    for name, body in docs.items():
+        (data / name).write_text(
+            body if isinstance(body, str) else json.dumps(body)
+        )
+    return str(tmp_path / "r.guard"), str(data)
+
+
+PASS_DOC = {
+    "Resources": {
+        "b": {
+            "Type": "AWS::S3::Bucket",
+            "Properties": {"BucketEncryption": {"k": "v"}},
+            "Name": "x",
+        }
+    }
+}
+FAIL_DOC = {"Resources": {"b": {"Type": "AWS::S3::Bucket", "Properties": {}}}}
+
+
+def test_auto_resolves_to_native():
+    assert resolve_backend("auto") == "native"
+    assert resolve_backend("cpu") == "cpu"
+    assert resolve_backend("tpu") == "tpu"
+
+
+@pytest.mark.parametrize(
+    "extra",
+    [
+        [],
+        ["--verbose"],
+        ["--print-json"],
+        ["--show-summary", "all"],
+        ["-o", "yaml"],
+        ["--structured", "-o", "sarif", "--show-summary", "none"],
+        ["--structured", "-o", "json", "--show-summary", "none"],
+        ["--structured", "-o", "junit", "--show-summary", "none"],
+    ],
+)
+def test_validate_byte_parity_vs_cpu(tmp_path, extra):
+    rules, data = _mk(
+        tmp_path, {"a_fail.json": FAIL_DOC, "b_pass.json": PASS_DOC}
+    )
+    base = ["validate", "-r", rules, "-d", data] + extra
+    nat = _run(base + ["--backend", "native"])
+    cpu = _run(base + ["--backend", "cpu"])
+    assert nat == cpu
+    assert nat[0] == 19
+
+
+def test_default_backend_is_auto_and_matches_cpu(tmp_path):
+    rules, data = _mk(tmp_path, {"t.json": FAIL_DOC})
+    default = _run(["validate", "-r", rules, "-d", data])
+    cpu = _run(["validate", "-r", rules, "-d", data, "--backend", "cpu"])
+    assert default == cpu
+
+
+def test_yaml_documents_take_tree_path(tmp_path):
+    # YAML docs can't go raw-JSON into the engine: the PV wire path
+    # must produce the same bytes
+    rules, data = _mk(
+        tmp_path,
+        {"t.yaml": "Resources:\n  b:\n    Type: AWS::S3::Bucket\n    Properties: {}\n"},
+    )
+    nat = _run(["validate", "-r", rules, "-d", data, "--backend", "native"])
+    cpu = _run(["validate", "-r", rules, "-d", data, "--backend", "cpu"])
+    assert nat == cpu
+    assert nat[0] == 19
+
+
+def test_passing_json_corpus_builds_zero_trees(tmp_path, monkeypatch):
+    rules, data = _mk(
+        tmp_path, {f"t{i}.json": PASS_DOC for i in range(5)}
+    )
+    loads = {"n": 0}
+    real = vmod.load_document
+
+    def counting(content, name=""):
+        loads["n"] += 1
+        return real(content, name)
+
+    monkeypatch.setattr(vmod, "load_document", counting)
+    rc, out, err = _run(
+        ["validate", "-r", rules, "-d", data, "--backend", "native"]
+    )
+    assert rc == 0, err
+    # the compiled engine evaluates raw JSON; the aware reporter's
+    # shape probe answers from a key scan — no Python tree builds
+    assert loads["n"] == 0
+
+
+def test_broken_json_doc_keeps_error_contract(tmp_path):
+    # unparseable doc sorted AFTER a good one: the error must still
+    # surface before ANY evaluation output (eager-loader contract; the
+    # lazy docs are pre-validated up front)
+    rules, data = _mk(
+        tmp_path, {"a_ok.json": PASS_DOC, "zbad.json": "{this is not json: ["}
+    )
+    nat = _run(["validate", "-r", rules, "-d", data, "--backend", "native"])
+    cpu = _run(["validate", "-r", rules, "-d", data, "--backend", "cpu"])
+    assert nat == cpu
+    assert nat[0] == 5
+    assert nat[1] == ""  # no partial evaluation output
+
+
+def test_flow_yaml_sniffing_as_json_keeps_tree_path(tmp_path):
+    # valid YAML flow mapping that json.loads rejects: loses raw
+    # eligibility but must still evaluate (from its tree), not error
+    rules, data = _mk(tmp_path, {"t.json": '{"Resources": {"b": {"Type": "AWS::S3::Bucket", "Properties": { }, }}}'})
+    nat = _run(["validate", "-r", rules, "-d", data, "--backend", "native"])
+    cpu = _run(["validate", "-r", rules, "-d", data, "--backend", "cpu"])
+    assert nat == cpu
+
+
+def test_eval_time_parse_error_keeps_pair_isolation(tmp_path):
+    # json_parse raising ParseError at EVALUATION time is an evaluation
+    # error (per-pair isolation, exit 5 after the loop) — not a fatal
+    # document-load error (code-review finding r5)
+    (tmp_path / "r.guard").write_text(
+        "rule r { let parsed = json_parse(bad) %parsed exists }\n"
+    )
+    data = tmp_path / "data"
+    data.mkdir()
+    (data / "a.json").write_text(json.dumps({"bad": "{not json"}))
+    (data / "b.json").write_text(json.dumps({"bad": '{"k": 1}'}))
+    base = ["validate", "-r", str(tmp_path / "r.guard"), "-d", str(data),
+            "--show-summary", "all"]
+    nat = _run(base + ["--backend", "native"])
+    cpu = _run(base + ["--backend", "cpu"])
+    assert nat == cpu
+    assert nat[0] == 5
+    # the second document still evaluated (isolation, not abort)
+    assert "b.json" in nat[1]
+
+
+def test_decline_falls_back_to_python(tmp_path):
+    # non-ASCII literal: outside the engine's certain-parity subset
+    # (conservative classifier) — the pair must fall back to Python
+    # and still match the cpu backend byte-for-byte
+    (tmp_path / "r.guard").write_text(
+        'rule uni { Resources.*.Tag == "héllo" }\n'
+    )
+    data = tmp_path / "data"
+    data.mkdir()
+    (data / "t.json").write_text(
+        json.dumps({"Resources": {"a": {"Tag": "héllo"}}})
+    )
+    base = ["validate", "-r", str(tmp_path / "r.guard"), "-d", str(data),
+            "--show-summary", "pass"]
+    nat = _run(base + ["--backend", "native"])
+    cpu = _run(base + ["--backend", "cpu"])
+    assert nat == cpu
+
+
+def test_test_command_byte_parity(tmp_path):
+    (tmp_path / "r.guard").write_text(RULES)
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    (tests_dir / "r_tests.yaml").write_text(
+        json.dumps(
+            [
+                {
+                    "name": "fails",
+                    "input": FAIL_DOC,
+                    "expectations": {"rules": {"s3_sse": "FAIL", "named": "SKIP"}},
+                },
+                {
+                    "name": "passes",
+                    "input": PASS_DOC,
+                    "expectations": {"rules": {"s3_sse": "PASS", "named": "PASS"}},
+                },
+            ]
+        )
+    )
+    for fmt in ("single-line-summary", "json"):
+        base = ["test", "--dir", str(tmp_path), "-o", fmt]
+        nat = _run(base + ["--backend", "native"])
+        cpu = _run(base + ["--backend", "cpu"])
+        assert nat == cpu
+        assert nat[0] == 0
+
+
+def test_builder_api_backend_native(tmp_path):
+    from guard_tpu.api import ValidateBuilder
+
+    rules, data = _mk(tmp_path, {"t.json": FAIL_DOC})
+    results = {}
+    for be in ("native", "cpu"):
+        code, out, err = (
+            ValidateBuilder().rules([rules]).data([data]).backend(be)
+            .try_build_and_execute()
+        )
+        results[be] = (code, out, err)
+    assert results["native"] == results["cpu"]
+    assert results["native"][0] == 19
