@@ -246,10 +246,16 @@ class Sweep:
         ]
 
     def _eval_tpu(self, data_files, rule_files, per_doc, writer, err_box) -> int:
+        from ..ops.backend import _honor_platform_env
         from ..ops.encoder import encode_batch
         from ..ops.ir import FAIL, PASS, SKIP, compile_rules_file
         from ..ops.native_encoder import encode_json_batch_native, native_available
         from ..parallel.mesh import ShardedBatchEvaluator
+
+        # JAX_PLATFORMS=cpu in the env is not reliably honored by
+        # plugin discovery (a wedged TPU tunnel hangs device init);
+        # mirror it programmatically before the first device query
+        _honor_platform_env()
 
         _status = {PASS: Status.PASS, FAIL: Status.FAIL, SKIP: Status.SKIP}
         if not data_files:

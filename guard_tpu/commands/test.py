@@ -103,7 +103,9 @@ class Test:
             )
             return TEST_ERROR_STATUS_CODE
         self.backend = resolve_backend(self.backend)
-        if self.backend == "native":
+        # verbose mode never touches the compiled engine (_run_specs
+        # needs rich per-case record trees), so don't build/require it
+        if self.backend == "native" and not self.verbose:
             err = ensure_native_built()
             if err:
                 writer.writeln_err(err)

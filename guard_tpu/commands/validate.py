@@ -287,12 +287,19 @@ class Validate:
                     report,
                     root,
                 )
+            # sniff-path docs (eager-loaded, not json.loads-validated)
+            # whose raw attempt failed once — e.g. flow-style YAML —
+            # would fail the raw parse again for EVERY rule file: skip
+            # raw after the first failure. json-validated (_raw_ok)
+            # docs keep retrying: their raw failures are rule-specific
+            # declines/eval errors, not parse failures.
+            sniff_raw = (
+                data_file._pv is not None
+                and not getattr(data_file, "_raw_sniff_failed", False)
+                and _looks_json(data_file.content)
+            )
             raw_ok = not self.input_params and (
-                data_file._raw_ok
-                or (
-                    data_file._pv is not None
-                    and _looks_json(data_file.content)
-                )
+                data_file._raw_ok or sniff_raw
             )
             if raw_ok:
                 try:
@@ -303,7 +310,8 @@ class Validate:
                 except (NativeUnsupported, NativeEvalError):
                     # flow-style YAML sniffing as JSON, or a decline —
                     # the loaded tree is authoritative
-                    pass
+                    if not data_file._raw_ok:
+                        data_file._raw_sniff_failed = True
             report, statuses, status = native.eval_report(
                 data_file.path_value, data_file.name
             )

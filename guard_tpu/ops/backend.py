@@ -41,13 +41,10 @@ _WORKER_RULES: dict = {}
 _POOL_MIN_JOBS = 48
 
 
-def _looks_json(content: str) -> bool:
-    """First non-space byte sniff without copying the document."""
-    for ch in content[:256]:
-        if ch in " \t\r\n":
-            continue
-        return ch in "{["
-    return False
+# single copy of the raw-JSON sniff: both backends must agree on raw
+# eligibility (the import is jax-free — this module defers every jax
+# import into tpu_validate)
+from ..commands.validate import _looks_json  # noqa: E402
 
 
 def _oracle_pool_init(rule_texts) -> None:
@@ -115,6 +112,35 @@ def _honor_platform_env() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    _setup_compile_cache()
+
+
+_cache_configured = False
+
+
+def _setup_compile_cache() -> None:
+    """Opt-in persistent XLA compilation cache
+    (`GUARD_TPU_JAX_CACHE=<dir>`): with the literals-as-inputs kernels
+    the trace for a (rule-file structure, bucket shape) is
+    corpus-independent, so its compiled executable is stable across
+    PROCESSES too — a warm CLI start skips XLA compilation entirely
+    (tracing still runs; in-process reuse via
+    parallel/mesh._shared_evaluator_fns skips both)."""
+    global _cache_configured
+    if _cache_configured:
+        return
+    import os
+
+    path = os.environ.get("GUARD_TPU_JAX_CACHE", "").strip()
+    if path and path != "0":
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # guard workloads compile many small executables; cache all
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _cache_configured = True
 
 
 def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:

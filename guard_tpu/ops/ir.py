@@ -131,13 +131,20 @@ class CrossScopeRootVar(Unlowerable):
 # ---------------------------------------------------------------------------
 @dataclass
 class StepKey:
-    key_ids: List[int]  # original key id + case-converted aliases
+    # original key STRING + case-converted aliases (deduped by value).
+    # The IR carries strings, not interned ids: ids are corpus-dependent
+    # and live in the runtime `lits` array (CompiledRules.lit_values),
+    # so the kernel trace is corpus-INDEPENDENT and executables reuse
+    # across validate invocations / sweep chunks / serve requests.
+    key_names: List[str]
     drop_unres: bool = False  # `some`-marked variable splice
     # slot into CompiledRules.kidc_tables: host-precomputed (D, N)
-    # "this node has a child under one of key_ids" column — the
+    # "this node has a child under one of the keys" column — the
     # resolved/miss check is static per node, so the kernel never pays
     # a count-children reduction for it
     kc_slot: int = -1
+    # slots into the runtime lits array, parallel to key_names
+    lit_slots: List[int] = field(default_factory=list)
 
 
 @dataclass
@@ -147,10 +154,14 @@ class StepKeyInterpLit:
     string is a separate EXACT key lookup (no case-converter retry) —
     hits concatenate, each miss is its own UnResolved entry."""
 
-    key_ids: List[int]  # one interned id per literal string (-99 absent)
-    # per-key has-child column slots (parallel to key_ids): the
+    # one literal string per entry; None = a key that can never match
+    # (out-of-bounds literal index) — binds to the never-matching id
+    key_names: List[Optional[str]]
+    # per-key has-child column slots (parallel to key_names): the
     # per-(map, key) miss check is static per node
     kc_slots: List[int] = field(default_factory=list)
+    # runtime lits slots, parallel to key_names
+    lit_slots: List[int] = field(default_factory=list)
 
 
 @dataclass
@@ -298,7 +309,10 @@ class RhsSpec:
     # 'substr' | 'never' (literal kinds no document scalar can ever be
     # comparable with, e.g. char ranges — docs never contain CHAR nodes)
     kind: str
-    str_id: int = -1
+    # the literal string itself ('str' kind); its interned id is bound
+    # at batch time through the runtime lits array (str_slot)
+    str_val: Optional[str] = None
+    str_slot: int = -1
     bits: Optional[np.ndarray] = None  # (S,) bool for regex/substr
     # (S,) bool tables for lexicographic string ordering vs the literal
     # (path_value.rs:1048-1070 via compare_values; gt = ~le, ge = ~lt)
@@ -450,7 +464,7 @@ class CompiledRules:
     # steps (_assign_bit_slots); computed per batch in device_arrays.
     kidc_tables: List[tuple] = field(default_factory=list)
     # folded StepKeyChain specs (StepKeyChain docstring): per chain a
-    # tuple of (key_ids tuple, drop_unres) per step, resolved per
+    # tuple of (key_names tuple, drop_unres) per step, resolved per
     # batch into the chF/chM/chA columns
     chain_tables: List[tuple] = field(default_factory=list)
     # non-empty when a lowered rule reads a precomputed function
@@ -469,6 +483,26 @@ class CompiledRules:
     # extended buckets (encoder.NODE_BUCKETS_EXTENDED) since every
     # remaining primitive is O(N) in gather mode
     needs_pairwise: bool = False
+    # the literals-as-inputs table: one entry per unique rule-literal
+    # string the kernel compares against (key lookups, string-equality
+    # RHS). The kernel reads interned ids from a runtime (L,) int32
+    # array (lit_values) instead of baking them into the trace — the
+    # trace depends only on rule STRUCTURE, so executables reuse across
+    # corpora, invocations, sweep chunks and serve requests. None
+    # entries bind to the never-matching id.
+    lit_names: List[Optional[str]] = field(default_factory=list)
+
+    def lit_values(self, interner: Optional[Interner] = None) -> np.ndarray:
+        """Bind lit_names against an interner: (L,) int32 of interned
+        ids, -99 (never matches any node) for absent strings."""
+        itn = interner if interner is not None else self.interner
+        vals = []
+        for name in self.lit_names:
+            i = -1 if name is None else itn.lookup(name)
+            vals.append(i if i >= 0 else -99)
+        if not vals:
+            vals = [-99]  # keep the runtime arg non-empty / stable
+        return np.asarray(vals, dtype=np.int32)
 
     def device_arrays(self, batch) -> dict:
         """Everything the kernel reads, as a flat dict of (D, ...)
@@ -524,18 +558,31 @@ class CompiledRules:
             out[f"bits{i}"] = col
         if self.kidc_tables:
             for i, spec in enumerate(self.kidc_tables):
-                out[f"kidc{i}"] = _has_child_col(batch, spec)
+                out[f"kidc{i}"] = _has_child_col(batch, spec, self.interner)
         for i, spec in enumerate(self.chain_tables):
-            f, m, a = _chain_columns(batch, spec)
+            f, m, a = _chain_columns(batch, spec, self.interner)
             out[f"chF{i}"] = f
             out[f"chM{i}"] = m
             out[f"chA{i}"] = a
         return out
 
 
-def _has_child_col(batch, spec) -> np.ndarray:
-    """(D, N) bool: node has a child matching `spec` — ("k", *key_ids)
-    = under one of the key ids; ("i", index) = at the list index.
+def _resolve_key_names(names, interner: Interner) -> np.ndarray:
+    """Key-name strings -> present interned ids (absent strings can
+    never match a document key, so they simply drop out)."""
+    ids = []
+    for name in names:
+        if name is None:
+            continue
+        i = interner.lookup(name)
+        if i >= 0:
+            ids.append(i)
+    return np.asarray(ids if ids else [-99], dtype=np.int64)
+
+
+def _has_child_col(batch, spec, interner: Interner) -> np.ndarray:
+    """(D, N) bool: node has a child matching `spec` — ("k", *names)
+    = under one of the key strings; ("i", index) = at the list index.
     Shared by the kidc_tables columns and the chain deep-miss columns
     so padding/edge_valid handling cannot drift between them."""
     d, n = batch.node_kind.shape
@@ -544,7 +591,7 @@ def _has_child_col(batch, spec) -> np.ndarray:
         + np.maximum(batch.edge_parent, 0)
     )
     if spec[0] == "k":
-        match = np.isin(batch.edge_key_id, np.asarray(spec[1:]))
+        match = np.isin(batch.edge_key_id, _resolve_key_names(spec[1:], interner))
     else:  # ("i", index)
         match = batch.edge_index == spec[1]
     match &= batch.edge_valid
@@ -555,11 +602,11 @@ def _has_child_col(batch, spec) -> np.ndarray:
     )
 
 
-def _chain_columns(batch, spec):
+def _chain_columns(batch, spec, interner: Interner):
     """Host columns for one folded StepKeyChain (StepKeyChain
     docstring): walk the static parent structure once per level.
 
-    spec = ((key_ids, drop_unres), ...) per step, length k >= 2.
+    spec = ((key_names, drop_unres), ...) per step, length k >= 2.
     Returns (full (D,N) bool, deep-miss (D,N) bool, anchor (D,N)
     int32): full marks nodes whose k-deep ancestor key path matches
     every step; deep-miss marks nodes prefix-matched through position
@@ -571,8 +618,8 @@ def _chain_columns(batch, spec):
     pclip = np.maximum(parent, 0)
     key_id = batch.node_key_id
 
-    def has_child(ids) -> np.ndarray:
-        return _has_child_col(batch, ("k",) + tuple(ids))
+    def has_child(names) -> np.ndarray:
+        return _has_child_col(batch, ("k",) + tuple(names), interner)
 
     k = len(spec)
     full = np.zeros((d, n), dtype=bool)
@@ -582,8 +629,8 @@ def _chain_columns(batch, spec):
     # = the ancestor j levels up (the prospective basis node)
     match_prev = None
     anc_prev = None
-    for j, (ids, _du) in enumerate(spec):
-        kh = np.isin(key_id, np.asarray(ids))
+    for j, (names, _du) in enumerate(spec):
+        kh = np.isin(key_id, _resolve_key_names(names, interner))
         if j == 0:
             match_j = kh & valid
             anc_j = np.where(match_j, pclip, 0)
@@ -598,9 +645,9 @@ def _chain_columns(batch, spec):
             full = match_j
             anchor = np.where(match_j, anc_j, anchor)
         else:
-            nxt_ids, nxt_du = spec[pos]
+            nxt_names, nxt_du = spec[pos]
             if not nxt_du:
-                mj = match_j & ~has_child(nxt_ids)
+                mj = match_j & ~has_child(nxt_names)
                 # pairwise-disjoint keys make positions unique: no
                 # overwrite can occur here
                 miss |= mj
@@ -826,19 +873,19 @@ class _RuleLowering:
 
         def lit_step(lit: PV) -> StepKeyInterpLit:
             vals = lit.val if lit.kind == 7 else [lit]  # LIST
-            ids = []
+            names = []
             for v in vals:
                 if v.kind != STRING:
                     # non-string keys raise NotComparable on the oracle
                     raise Unlowerable("non-string literal key interpolation")
-                ids.append(self.interner.lookup(v.val))
+                names.append(v.val)
             if interp_index is not None and interp_index > 0:
                 # a literal var is ONE entry in the result list
                 # (the whole list literal), so any index but 0 is out
                 # of bounds: every candidate map UnResolves — the
                 # never-matching key id reproduces exactly that
-                return StepKeyInterpLit(key_ids=[-99])
-            return StepKeyInterpLit(key_ids=[i if i >= 0 else -99 for i in ids])
+                return StepKeyInterpLit(key_names=[None])
+            return StepKeyInterpLit(key_names=names)
 
         def query_interp(q: AccessQuery, q_vars) -> StepKeyInterpVar:
             # the variable resolves against its BINDING scope, which for
@@ -914,15 +961,16 @@ class _RuleLowering:
                 return StepIndex(abs(int(part.name)))
             except ValueError:
                 pass
-            kid = self.interner.lookup(part.name)
-            ids = [kid] if kid >= 0 else []
+            # the key string + its case-converted aliases, deduped by
+            # VALUE — corpus-independent (ids bind at batch time via
+            # the lits array; absent strings bind to the never-matching
+            # id, reproducing the old absent-alias pruning exactly)
+            names = [part.name]
             for conv in CONVERTERS:
-                alias = self.interner.lookup(conv(part.name))
-                if alias >= 0 and alias not in ids:
-                    ids.append(alias)
-            if not ids:
-                ids = [-99]  # key absent from corpus: always unresolved
-            return StepKey(key_ids=ids)
+                alias = conv(part.name)
+                if alias not in names:
+                    names.append(alias)
+            return StepKey(key_names=names)
         if isinstance(part, QAllValues):
             if part.name is not None:
                 raise Unlowerable("variable capture in projection")
@@ -1018,7 +1066,7 @@ class _RuleLowering:
             )
             return RhsSpec(
                 kind="str",
-                str_id=self.interner.lookup(lit),
+                str_val=lit,
                 bits=self.interner.substring_bits(-1, lit),
                 # ordering tables only when the clause actually orders
                 lt_bits=np.array(
@@ -1623,6 +1671,118 @@ def compile_rules_file(rules_file: RulesFile, interner: Interner) -> CompiledRul
     return out
 
 
+def trace_signature(compiled: CompiledRules) -> str:
+    """Canonical string of everything the kernel TRACE depends on — the
+    rule program structure, slot assignments, operators and the
+    corpus-independent baked scalars (numeric keys, indices, counts) —
+    and nothing bound at runtime (interned ids, bit-table contents,
+    document columns). Two CompiledRules with equal signatures trace to
+    identical jaxprs at equal bucket shapes, so jitted evaluators key on
+    (signature, mesh, shape) for cross-invocation executable reuse
+    (parallel/mesh.py _shared_evaluator_fns)."""
+    out: List[str] = []
+    add = out.append
+
+    def rhs(r: Optional[RhsSpec]) -> None:
+        if r is None:
+            add("~")
+            return
+        add(
+            f"R({r.kind},{r.str_slot},{r.bits_slot},{r.lt_slot},"
+            f"{r.le_slot},{r.num_key},{r.num_kind},{r.range_lo_key},"
+            f"{r.range_hi_key},{r.range_incl},{r.range_kind},"
+            f"{r.struct_slot},{int(r.struct_is_list)})"
+        )
+        if r.items is not None:
+            add("[")
+            for it in r.items:
+                rhs(it)
+            add("]")
+
+    def steps(ss) -> None:
+        add("{")
+        for s in ss:
+            if isinstance(s, StepKeyChain):
+                add(f"C{s.chain_slot}")
+                steps(s.steps)
+            elif isinstance(s, StepKey):
+                add(f"K{tuple(s.lit_slots)},{int(s.drop_unres)},{s.kc_slot};")
+            elif isinstance(s, StepKeyInterpLit):
+                add(f"L{tuple(s.lit_slots)},{tuple(s.kc_slots)};")
+            elif isinstance(s, StepKeyInterpVar):
+                add(f"V{s.index}")
+                steps(s.var_steps)
+            elif isinstance(s, StepFnVar):
+                add(f"F{s.key_id};")
+            elif isinstance(s, StepAllValues):
+                add("*;")
+            elif isinstance(s, StepAllIndices):
+                add("I;")
+            elif isinstance(s, StepIndex):
+                add(f"X{s.index},{s.kc_slot};")
+            elif isinstance(s, StepFilter):
+                add(f"f{int(s.expand_maps)}{int(s.scalar_self)}")
+                conjs(s.conjunctions)
+            elif isinstance(s, StepKeysMatch):
+                add(f"M{s.op.value},{int(s.op_not)}")
+                rhs(s.rhs)
+        add("}")
+
+    def node(n) -> None:
+        if isinstance(n, CClause):
+            add(
+                f"c({n.op.value},{int(n.op_not)},{int(n.negation)},"
+                f"{int(n.match_all)},{int(n.empty_on_expr)},"
+                f"{int(n.eval_from_root)},{int(n.rhs_query_from_root)}"
+            )
+            steps(n.steps)
+            rhs(n.rhs)
+            if n.rhs_query_steps is not None:
+                steps(n.rhs_query_steps)
+        elif isinstance(n, CCountClause):
+            add(f"n({n.static_status},{n.cmp}")
+            steps(n.steps)
+        elif isinstance(n, CBlockClause):
+            add(f"b({int(n.match_all)},{int(n.not_empty)}")
+            steps(n.query_steps)
+            conjs(n.inner)
+        elif isinstance(n, CWhenBlock):
+            add("w(")
+            if n.conditions is None:
+                add("~")
+            else:
+                conjs(n.conditions)
+            conjs(n.inner)
+        elif isinstance(n, CNamedRef):
+            add(f"r({tuple(n.rule_indices)},{int(n.negation)}")
+        add(")")
+
+    def conjs(cc) -> None:
+        add("<")
+        for disj in cc:
+            add("|")
+            for n in disj:
+                node(n)
+        add(">")
+
+    for r in compiled.rules:
+        add("RULE(")
+        if r.conditions is None:
+            add("~")
+        else:
+            conjs(r.conditions)
+        conjs(r.conjunctions)
+        add(")")
+    add(
+        f"|E{compiled.str_empty_slot}|S{int(compiled.needs_struct_ids)}"
+        f"|U{int(compiled.needs_unsure)}|T{len(compiled.struct_literals)}"
+        f"|B{len(compiled.bit_tables)}|H{len(compiled.kidc_tables)}"
+        f"|N{len(compiled.chain_tables)}|L{len(compiled.lit_names)}"
+        f"|K{int(compiled.needs_str_rank)}|P{int(compiled.needs_pairwise)}"
+    )
+    return "".join(out)
+
+
 def _fold_key_chains(compiled: CompiledRules) -> None:
     """Peephole over every step list: fold maximal runs of >= 2
     StepKeys whose key-id sets are pairwise disjoint into StepKeyChain
@@ -1643,7 +1803,7 @@ def _fold_key_chains(compiled: CompiledRules) -> None:
         def flush():
             if len(run) >= 2:
                 spec = tuple(
-                    (tuple(s.key_ids), s.drop_unres) for s in run
+                    (tuple(s.key_names), s.drop_unres) for s in run
                 )
                 out.append(
                     StepKeyChain(steps=list(run), chain_slot=chain_slot(spec))
@@ -1654,9 +1814,13 @@ def _fold_key_chains(compiled: CompiledRules) -> None:
 
         for s in steps:
             if isinstance(s, StepKey):
-                ids = set(s.key_ids)
+                # disjointness by key STRING (corpus-independent): a
+                # shared string means a node could match two positions;
+                # strings absent from a given corpus match nothing, so
+                # string-disjointness implies id-disjointness
+                names = set(s.key_names)
                 overlapping = any(
-                    ids & set(prev.key_ids) for prev in run
+                    names & set(prev.key_names) for prev in run
                 )
                 if overlapping:
                     flush()
@@ -1714,6 +1878,7 @@ def _assign_bit_slots(compiled: CompiledRules) -> None:
     Empty clauses."""
     seen = {}
     seen_kidc = {}
+    seen_lits = {}
     uses_empty = [False]
     uses_fn = [False]
     uses_interp = [False]
@@ -1731,9 +1896,20 @@ def _assign_bit_slots(compiled: CompiledRules) -> None:
             compiled.bit_tables.append((arr, target))
         return seen[k]
 
+    def lit_slot(name: Optional[str]) -> int:
+        # one runtime lits entry per unique literal string (None = the
+        # never-matching id); slot order is walk order — structural,
+        # corpus-independent
+        if name not in seen_lits:
+            seen_lits[name] = len(compiled.lit_names)
+            compiled.lit_names.append(name)
+        return seen_lits[name]
+
     def do_rhs(rhs: Optional[RhsSpec], target: str, op) -> None:
         if rhs is None:
             return
+        if rhs.kind == "str":
+            rhs.str_slot = lit_slot(rhs.str_val)
         reads_bits = (
             rhs.kind == "regex" and op in (CmpOperator.Eq, CmpOperator.In)
         ) or (rhs.kind == "str" and op == CmpOperator.In)
@@ -1765,19 +1941,23 @@ def _assign_bit_slots(compiled: CompiledRules) -> None:
             elif isinstance(s, StepFnVar):
                 uses_fn[0] = True
             elif isinstance(s, StepKey):
+                s.lit_slots = [lit_slot(n) for n in s.key_names]
                 if not s.drop_unres:
-                    s.kc_slot = kidc_slot(("k",) + tuple(s.key_ids))
+                    s.kc_slot = kidc_slot(("k",) + tuple(s.key_names))
             elif isinstance(s, StepKeyChain):
                 # only the FIRST step's has-child column is read (the
                 # inline position-0 miss); deeper misses live in the
                 # chain's static chM column
-                if not s.steps[0].drop_unres:
-                    s.steps[0].kc_slot = kidc_slot(
-                        ("k",) + tuple(s.steps[0].key_ids)
+                first = s.steps[0]
+                first.lit_slots = [lit_slot(n) for n in first.key_names]
+                if not first.drop_unres:
+                    first.kc_slot = kidc_slot(
+                        ("k",) + tuple(first.key_names)
                     )
             elif isinstance(s, StepKeyInterpLit):
+                s.lit_slots = [lit_slot(n) for n in s.key_names]
                 s.kc_slots = [
-                    kidc_slot(("k", kid)) for kid in s.key_ids
+                    kidc_slot(("k", n)) for n in s.key_names
                 ]
             elif isinstance(s, StepIndex):
                 s.kc_slot = kidc_slot(("i", s.index))

@@ -157,6 +157,11 @@ class _DocArrays:
             int(k[3:]): v for k, v in arrays.items() if k.startswith("chA")
         }
         self.empty_slot = -1  # set by build_doc_evaluator
+        # the literals-as-inputs table: (L,) int32 of interned ids for
+        # every rule-literal string (CompiledRules.lit_values), passed
+        # as a RUNTIME argument (vmap in_axes=None) so the trace carries
+        # only static slot indices — corpus-independent, reusable
+        self.lits: Optional[jnp.ndarray] = None
         self.n = self.node_kind.shape[0]
         # trace-time accumulator of per-clause "unsure" bits (shapes the
         # kernel cannot decide exactly, routed to the oracle by the
@@ -300,6 +305,15 @@ def run_steps(d: _DocArrays, steps: List[Step], sel, rule_statuses=None,
     return sel, acc.finalize(d, scalar)
 
 
+def _key_hit(d: _DocArrays, lit_slots: List[int]) -> jnp.ndarray:
+    """(N,) bool: node key id equals any of the slots' runtime literal
+    ids (absent strings bind to -99 and never match)."""
+    kh = jnp.zeros(d.n, bool)
+    for sl in lit_slots:
+        kh = kh | (d.node_key_id == d.lits[sl])
+    return kh
+
+
 def _select_at(d: _DocArrays, vec: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """(N,) vec, (N,) static per-node indices -> vec[idx] — the one
     permutation a folded key chain pays (one-hot compare-reduce below
@@ -330,14 +344,7 @@ def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None,
             resolved = (
                 d.kidc[first.kc_slot]
                 if first.kc_slot >= 0
-                else _count_children(
-                    d,
-                    jnp.isin(
-                        d.node_key_id,
-                        jnp.asarray(first.key_ids, dtype=jnp.int32),
-                    ),
-                )
-                > 0
+                else _count_children(d, _key_hit(d, first.lit_slots)) > 0
             )
             acc.add(sel, (sel > 0) & ~resolved)
         # deep misses (positions 1..k-1, drop_unres steps pre-excluded
@@ -360,9 +367,7 @@ def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None,
     else:
         psel = _parent_select(d, sel)  # label of each node's parent
     if isinstance(step, StepKey):
-        kh = jnp.zeros(d.n, bool)
-        for kid in step.key_ids:
-            kh = kh | (d.node_key_id == kid)
+        kh = _key_hit(d, step.lit_slots)
         new_sel = jnp.where(kh, psel, 0)
         if not step.drop_unres:
             # resolved = "has a child under one of the key ids" — a
@@ -384,12 +389,13 @@ def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None,
         is_map_sel = (sel > 0) & (d.node_kind == MAP)
         acc.add(sel, (sel > 0) & (d.node_kind != MAP))
         kh_any = jnp.zeros(d.n, bool)
-        for i, kid in enumerate(step.key_ids):
-            kh_any = kh_any | (d.node_key_id == kid)
+        for i, sl in enumerate(step.lit_slots):
+            hit = d.node_key_id == d.lits[sl]
+            kh_any = kh_any | hit
             has = (
                 d.kidc[step.kc_slots[i]]
                 if i < len(step.kc_slots)
-                else _count_children(d, d.node_key_id == kid) > 0
+                else _count_children(d, hit) > 0
             )
             acc.add(sel, is_map_sel & ~has)
         # a key id implies a map parent, so psel needs no extra guard
@@ -548,7 +554,7 @@ def _rhs_match_on_keys(d: _DocArrays, rhs: RhsSpec, op: CmpOperator) -> jnp.ndar
         if op == CmpOperator.In:
             # `keys in 'lit'`: substring containment (operators.rs:218-230)
             return d.bits[rhs.bits_slot] & (d.node_key_id >= 0)
-        return d.node_key_id == rhs.str_id
+        return d.node_key_id == d.lits[rhs.str_slot]
     if rhs.kind == "regex":
         return d.bits[rhs.bits_slot] & (d.node_key_id >= 0)
     if rhs.kind == "list":
@@ -607,7 +613,7 @@ def _compare_scalar_full(d: _DocArrays, rhs: RhsSpec, op: CmpOperator,
     if op == CmpOperator.Eq or op == CmpOperator.In:
         if rhs.kind == "str":
             comparable = kind == STRING
-            return comparable & (d.scalar_id == rhs.str_id), comparable
+            return comparable & (d.scalar_id == d.lits[rhs.str_slot]), comparable
         if rhs.kind == "regex":
             comparable = kind == STRING
             return comparable & d.bits[rhs.bits_slot], comparable
@@ -1436,9 +1442,10 @@ def build_doc_evaluator(compiled: CompiledRules, with_unsure: bool = False,
     target backend when known (mesh evaluators)."""
     empty_slot = compiled.str_empty_slot
 
-    def evaluate(arrays: Dict[str, jnp.ndarray]):
+    def evaluate(arrays: Dict[str, jnp.ndarray], lits: jnp.ndarray):
         n = arrays["node_kind"].shape[-1]
         d = _DocArrays(arrays, gather_mode=_use_gather(n, platform))
+        d.lits = lits
         d.empty_slot = empty_slot
         d.rule_unsure = []
         statuses: List[jnp.ndarray] = []
@@ -1467,8 +1474,13 @@ class BatchEvaluator:
     def __init__(self, compiled: CompiledRules):
         self.compiled = compiled
         self._with_unsure = compiled.needs_unsure
+        # lits is batch-constant (in_axes=None): the runtime binding of
+        # rule-literal strings to this corpus's interned ids
         self._fn = jax.jit(
-            jax.vmap(build_doc_evaluator(compiled, with_unsure=self._with_unsure))
+            jax.vmap(
+                build_doc_evaluator(compiled, with_unsure=self._with_unsure),
+                in_axes=(0, None),
+            )
         )
         self.last_unsure: Optional[np.ndarray] = None
 
@@ -1478,7 +1490,7 @@ class BatchEvaluator:
             k: jnp.asarray(v)
             for k, v in self.compiled.device_arrays(batch).items()
         }
-        out = self._fn(arrays)
+        out = self._fn(arrays, jnp.asarray(self.compiled.lit_values()))
         if self._with_unsure:
             statuses, unsure = out
             self.last_unsure = np.asarray(unsure)

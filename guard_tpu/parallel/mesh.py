@@ -22,6 +22,7 @@ compiled-rule list across a second mesh axis; statuses concatenate.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -30,7 +31,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.encoder import DocBatch
-from ..ops.ir import CompiledRules
+from ..ops.ir import CompiledRules, trace_signature
 from ..ops.kernels import build_doc_evaluator
 
 DOC_AXIS = "docs"
@@ -77,6 +78,98 @@ def pad_to_multiple(batch_arrays: Dict[str, np.ndarray], multiple: int) -> Tuple
     return out, d
 
 
+# Shared jitted evaluators, keyed by (trace signature, mesh, knobs):
+# the literals-as-inputs design (ir.StepKey / CompiledRules.lit_values)
+# makes the kernel trace depend only on rule STRUCTURE, so re-compiling
+# the same rule file against a new corpus — the next validate
+# invocation in a serve session, the next sweep chunk, the next test
+# spec file — reuses the jitted function (and its per-bucket-shape
+# executables) instead of paying ~seconds of re-trace + XLA compile.
+_SHARED_FNS: "OrderedDict[tuple, tuple]" = OrderedDict()
+_SHARED_FNS_MAX = 64
+
+
+def _mesh_key(mesh: Mesh) -> tuple:
+    # platform included: device ids are unique only per backend
+    # (CpuDevice 0 and TpuDevice 0 coexist), and an explicit CPU mesh
+    # on a TPU host must never hit a cached TPU-sharded executable
+    return (
+        tuple((d.platform, d.id) for d in mesh.devices.flat),
+        tuple(int(x) for x in mesh.devices.shape),
+        tuple(mesh.axis_names),
+    )
+
+
+def _shared_evaluator_fns(compiled: CompiledRules, mesh: Mesh):
+    """(jitted batch fn, jitted summary fn) for this rule program
+    structure on this mesh — cached across CompiledRules instances."""
+    from ..ops import kernels
+
+    with_unsure = compiled.needs_unsure
+    key = (
+        trace_signature(compiled),
+        _mesh_key(mesh),
+        with_unsure,
+        # formulation knobs are process-mutable (tools/tune_gather.py
+        # sweeps GATHER_MIN_NODES): bake them into the cache key
+        kernels.GATHER_MIN_NODES,
+        kernels.GATHER_ALWAYS_ON_CPU,
+    )
+    hit = _SHARED_FNS.get(key)
+    if hit is not None:
+        _SHARED_FNS.move_to_end(key)
+        return hit
+
+    # the mesh's platform, not the process default, decides the
+    # primitive formulation (an explicit CPU mesh on a TPU host
+    # must still get the CPU gather override)
+    doc_eval = build_doc_evaluator(
+        compiled,
+        with_unsure=with_unsure,
+        platform=mesh.devices.flat[0].platform,
+    )
+    # every input array is doc-major: one sharding as a pytree
+    # prefix covers the whole arrays dict. The doc axis shards
+    # over EVERY mesh axis, so the same evaluator runs on a flat
+    # 1-D mesh or a hierarchical (dcn, ici) multi-slice mesh. The
+    # lits binding is batch-constant: replicated, in_axes=None.
+    doc_spec = P(tuple(mesh.axis_names))
+    in_spec = NamedSharding(mesh, doc_spec)
+    out_spec = NamedSharding(mesh, doc_spec)
+    replicated = NamedSharding(mesh, P())
+    fn = jax.jit(
+        jax.vmap(doc_eval, in_axes=(0, None)),
+        in_shardings=(in_spec, replicated),
+        out_shardings=(out_spec, out_spec) if with_unsure else out_spec,
+    )
+
+    # aggregate summary: per-rule (n_pass, n_fail, n_skip) — the only
+    # cross-chip reduction (SURVEY.md §2.3 "communication backend");
+    # n_valid masks out docs added by mesh padding
+    def summarize(arrays, lits, n_valid):
+        out = jax.vmap(doc_eval, in_axes=(0, None))(arrays, lits)
+        statuses = out[0] if with_unsure else out
+        valid = (jnp.arange(statuses.shape[0]) < n_valid)[:, None]
+        counts = jnp.stack(
+            [
+                jnp.sum((statuses == 0) & valid, axis=0),
+                jnp.sum((statuses == 1) & valid, axis=0),
+                jnp.sum((statuses == 2) & valid, axis=0),
+            ]
+        )
+        return statuses, counts
+
+    summary_fn = jax.jit(
+        summarize,
+        in_shardings=(in_spec, replicated, replicated),
+        out_shardings=(out_spec, replicated),
+    )
+    _SHARED_FNS[key] = (fn, summary_fn)
+    while len(_SHARED_FNS) > _SHARED_FNS_MAX:
+        _SHARED_FNS.popitem(last=False)
+    return fn, summary_fn
+
+
 class ShardedBatchEvaluator:
     """DP-sharded (docs x rules) status evaluator over a device mesh.
     When the rule file compares against query RHS, `last_unsure` holds
@@ -86,56 +179,17 @@ class ShardedBatchEvaluator:
         self.compiled = compiled
         self.mesh = mesh if mesh is not None else default_mesh()
         self._with_unsure = compiled.needs_unsure
-        # the mesh's platform, not the process default, decides the
-        # primitive formulation (an explicit CPU mesh on a TPU host
-        # must still get the CPU gather override)
-        doc_eval = build_doc_evaluator(
-            compiled,
-            with_unsure=self._with_unsure,
-            platform=self.mesh.devices.flat[0].platform,
-        )
-        # every input array is doc-major: one sharding as a pytree
-        # prefix covers the whole arrays dict. The doc axis shards
-        # over EVERY mesh axis, so the same evaluator runs on a flat
-        # 1-D mesh or a hierarchical (dcn, ici) multi-slice mesh.
-        doc_spec = P(tuple(self.mesh.axis_names))
-        in_spec = NamedSharding(self.mesh, doc_spec)
-        out_spec = NamedSharding(self.mesh, doc_spec)
-        self._fn = jax.jit(
-            jax.vmap(doc_eval),
-            in_shardings=(in_spec,),
-            out_shardings=(out_spec, out_spec) if self._with_unsure else out_spec,
-        )
+        self._fn, self._summary_fn = _shared_evaluator_fns(compiled, self.mesh)
         self.last_unsure = None
-
-        # aggregate summary: per-rule (n_pass, n_fail, n_skip) — the only
-        # cross-chip reduction (SURVEY.md §2.3 "communication backend");
-        # n_valid masks out docs added by mesh padding
-        def summarize(arrays, n_valid):
-            out = jax.vmap(doc_eval)(arrays)  # (D, R) int8
-            statuses = out[0] if self._with_unsure else out
-            valid = (jnp.arange(statuses.shape[0]) < n_valid)[:, None]
-            counts = jnp.stack(
-                [
-                    jnp.sum((statuses == 0) & valid, axis=0),
-                    jnp.sum((statuses == 1) & valid, axis=0),
-                    jnp.sum((statuses == 2) & valid, axis=0),
-                ]
-            )
-            return statuses, counts
-
-        replicated = NamedSharding(self.mesh, P())
-        self._summary_fn = jax.jit(
-            summarize,
-            in_shardings=(in_spec, replicated),
-            out_shardings=(out_spec, replicated),
-        )
 
     def _arrays(self, batch: DocBatch):
         return pad_to_multiple(
             self.compiled.device_arrays(batch),
             self.mesh.devices.size,
         )
+
+    def _lits(self) -> np.ndarray:
+        return self.compiled.lit_values()
 
     def dispatch(self, batch: DocBatch):
         """Launch evaluation WITHOUT blocking (JAX dispatch is async):
@@ -146,7 +200,7 @@ class ShardedBatchEvaluator:
         # arrays on this evaluator's mesh; jnp.asarray would commit them
         # to the default device first (wrong backend on TPU hosts when
         # the mesh is a CPU mesh).
-        return self._fn(arrays), d
+        return self._fn(arrays, self._lits()), d
 
     def __call__(self, batch: DocBatch) -> np.ndarray:
         out, d = self.dispatch(batch)
@@ -162,7 +216,7 @@ class ShardedBatchEvaluator:
 
     def with_summary(self, batch: DocBatch) -> Tuple[np.ndarray, np.ndarray]:
         arrays, d = self._arrays(batch)
-        statuses, counts = self._summary_fn(arrays, np.int32(d))
+        statuses, counts = self._summary_fn(arrays, self._lits(), np.int32(d))
         return np.asarray(statuses)[:d], np.asarray(counts)
 
 

@@ -185,6 +185,7 @@ def _slice_compiled(compiled: CompiledRules, indices: List[int]) -> CompiledRule
         needs_str_rank=compiled.needs_str_rank,
         needs_pairwise=compiled.needs_pairwise,
         fn_vars=compiled.fn_vars,
+        lit_names=compiled.lit_names,  # lit slots stay valid: shared table
     )
 
 

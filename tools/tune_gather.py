@@ -97,15 +97,16 @@ def measure_bucket(n_nodes: int, n_docs: int, formulation: str) -> float:
         k: jax.device_put(jnp.asarray(v))
         for k, v in compiled.device_arrays(batch).items()
     }
+    lits = jax.device_put(jnp.asarray(compiled.lit_values()))
 
     def make_loop(iters: int):
         @jax.jit
-        def loop(arrs):
+        def loop(arrs, lits):
             def body(_, acc):
                 dep = jnp.minimum(acc % 2, 0).astype(jnp.int32)
                 a2 = dict(arrs)
                 a2["node_kind"] = arrs["node_kind"] + dep
-                st = jax.vmap(doc_eval)(a2)
+                st = jax.vmap(doc_eval, in_axes=(0, None))(a2, lits)
                 return acc + jnp.sum(st.astype(jnp.int32))
 
             return lax.fori_loop(0, iters, body, jnp.int32(0))
@@ -116,17 +117,17 @@ def measure_bucket(n_nodes: int, n_docs: int, formulation: str) -> float:
         ts = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            int(fn(arrays))
+            int(fn(arrays, lits))
             ts.append(time.perf_counter() - t0)
         return sorted(ts)[len(ts) // 2]
 
     fn1 = make_loop(1)
-    int(fn1(arrays))
+    int(fn1(arrays, lits))
     t1 = med(fn1)
     k = 9
     while True:
         fnk = make_loop(k)
-        int(fnk(arrays))
+        int(fnk(arrays, lits))
         tk = med(fnk)
         if tk >= 2.5 * t1 or k >= 1025:
             break
