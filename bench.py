@@ -382,13 +382,24 @@ def measure(rules_text: str, docs, min_rules: int, n_cpu: int = 256):
         if t_k >= 2.5 * t_1 or k_inner >= 1025:
             break
         k_inner = (k_inner - 1) * 4 + 1
-    per_iter = max((t_k - t_1) / (k_inner - 1), 1e-9)
-    tpu_docs_per_sec = n_docs / per_iter
+    # run-to-run spread: repeat the whole (t_1, t_k) differenced
+    # measurement — on a shared/noisy host the spread tells a regression
+    # from box noise (VERDICT r4: the r03->r04 CPU headline delta had
+    # no variance bars to judge it against)
+    vals = []
+    for _ in range(3):
+        r1 = _med(fn1)
+        rk = _med(fnk)
+        vals.append(n_docs / max((rk - r1) / (k_inner - 1), 1e-9))
+    vals.sort()
+    tpu_docs_per_sec = vals[len(vals) // 2]
+    spread = {"min": round(vals[0], 1), "median": round(tpu_docs_per_sec, 1),
+              "max": round(vals[-1], 1), "reps": len(vals)}
 
     cpu_docs_per_sec = _cpu_oracle_docs_per_sec(rf, docs, n_cpu)
     native = _native_docs_per_sec(rf, docs, min(n_cpu * 4, len(docs)))
     vs_native = tpu_docs_per_sec / native if native else None
-    return tpu_docs_per_sec, tpu_docs_per_sec / cpu_docs_per_sec, vs_native
+    return tpu_docs_per_sec, tpu_docs_per_sec / cpu_docs_per_sec, vs_native, spread
 
 
 def measure_corpus():
@@ -512,8 +523,15 @@ def measure_corpus():
         if t_k >= 2.5 * t_1 or k_inner >= 257:
             break
         k_inner = (k_inner - 1) * 4 + 1
-    per_iter = max((t_k - t_1) / (k_inner - 1), 1e-9)
-    docs_per_sec = n_docs / per_iter
+    vals = []
+    for _ in range(3):
+        r1 = _med(fn1)
+        rk = _med(fnk)
+        vals.append(n_docs / max((rk - r1) / (k_inner - 1), 1e-9))
+    vals.sort()
+    docs_per_sec = vals[len(vals) // 2]
+    spread = {"min": round(vals[0], 1), "median": round(docs_per_sec, 1),
+              "max": round(vals[-1], 1), "reps": len(vals)}
 
     # oracle: all corpus rule files over a sample of docs, with the
     # per-file error isolation the validate loop applies
@@ -523,7 +541,7 @@ def measure_corpus():
     cpu_docs_per_sec = _cpu_oracle_docs_per_sec(
         rfs, docs, n_cpu=8, isolate_errors=True
     )
-    return docs_per_sec, rules_total, docs_per_sec / cpu_docs_per_sec
+    return docs_per_sec, rules_total, docs_per_sec / cpu_docs_per_sec, spread
 
 
 def measure_rule_sharded(n_rules: int = 64, n_docs: int = 2048):
@@ -625,29 +643,32 @@ def measure_fail_heavy(frac_fail: float, statuses_only: bool, n_docs: int = 1024
     # raw JSON content as the org-sweep data loader would hold it
     raw_docs = [json.dumps(d) for d in docs_plain]
 
-    t0 = time.perf_counter()
-    statuses = np.asarray(ev(batch))
-    n_fail_rerun = 0
-    if not statuses_only:
-        fail_rows = (statuses == 1).any(axis=1)
-        for di in range(n_docs):
-            if fail_rows[di]:
-                if native is not None:
-                    native.eval_report_raw(raw_docs[di], f"d{di}")
-                else:
-                    scope = RootScope(rf, docs[di])
-                    eval_rules_file(rf, scope, None)
-                    simplified_report_from_root(
-                        scope.reset_recorder().extract(), f"d{di}"
-                    )
-                n_fail_rerun += 1
-    t1 = time.perf_counter()
+    vals = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        statuses = np.asarray(ev(batch))
+        n_fail_rerun = 0
+        if not statuses_only:
+            fail_rows = (statuses == 1).any(axis=1)
+            for di in range(n_docs):
+                if fail_rows[di]:
+                    if native is not None:
+                        native.eval_report_raw(raw_docs[di], f"d{di}")
+                    else:
+                        scope = RootScope(rf, docs[di])
+                        eval_rules_file(rf, scope, None)
+                        simplified_report_from_root(
+                            scope.reset_recorder().extract(), f"d{di}"
+                        )
+                    n_fail_rerun += 1
+        vals.append(n_docs / (time.perf_counter() - t0))
     if native is not None:
         native.close()
-    return n_docs / (t1 - t0)
+    vals.sort()
+    return vals[len(vals) // 2]
 
 
-def _emit(metric: str, value: float, vs: float, vs_native=None) -> None:
+def _emit(metric: str, value: float, vs: float, vs_native=None, spread=None) -> None:
     # `vs_baseline` is required by the driver contract; `vs_oracle` is
     # the honest name: the divisor is this framework's own pure-Python
     # CPU oracle, NOT the reference's native engine (no Rust toolchain
@@ -667,6 +688,7 @@ def _emit(metric: str, value: float, vs: float, vs_native=None) -> None:
                     if vs_native is not None
                     else {}
                 ),
+                **({"spread": spread} if spread is not None else {}),
                 "baseline_note": "vs_oracle divides by this repo's pure-Python CPU oracle (flattering); vs_native divides by this repo's own compiled C++ statuses oracle (native/oracle.cpp), the honest stand-in for the reference's Rust engine, which is unbuildable in this env",
             }
         ),
@@ -691,36 +713,36 @@ def main() -> None:
 
     # config 2 (headline, the driver's one-line contract)
     docs = [from_plain(make_template(rng, i)) for i in range(4096)]
-    v, r, vn = measure(RULES, docs, min_rules=4)
-    _emit("templates_validated_per_sec_per_chip", v, r, vn)
+    v, r, vn, sp = measure(RULES, docs, min_rules=4)
+    _emit("templates_validated_per_sec_per_chip", v, r, vn, sp)
     if not run_all:
         return
 
     # config 1: single-rule encryption set
-    v, r, vn = measure(ENCRYPTION_RULES, docs, min_rules=1)
-    _emit("config1_encryption_templates_per_sec", v, r, vn)
+    v, r, vn, sp = measure(ENCRYPTION_RULES, docs, min_rules=1)
+    _emit("config1_encryption_templates_per_sec", v, r, vn, sp)
 
     # config 3: AWS Config configuration-item stream
     items = [from_plain(make_config_item(rng, i)) for i in range(8192)]
-    v, r, vn = measure(CONFIG_ITEM_RULES, items, min_rules=4)
-    _emit("config3_config_items_per_sec", v, r, vn)
+    v, r, vn, sp = measure(CONFIG_ITEM_RULES, items, min_rules=4)
+    _emit("config3_config_items_per_sec", v, r, vn, sp)
 
     # config 4: Terraform plans, deep trees (4096-doc steady-state
     # batch measured ~10% over 2048 on v5e; 8192 regresses)
     plans = [from_plain(make_tf_plan(rng, i)) for i in range(4096)]
-    v, r, vn = measure(TF_RULES, plans, min_rules=3)
-    _emit("config4_tf_plans_per_sec", v, r, vn)
+    v, r, vn, sp = measure(TF_RULES, plans, min_rules=3)
+    _emit("config4_tf_plans_per_sec", v, r, vn, sp)
 
     # config 5: regex-heavy registry-style ruleset
-    v, r, vn = measure(regex_heavy_rules(16), docs, min_rules=16)
-    _emit("config5_regex_registry_templates_per_sec", v, r, vn)
+    v, r, vn, sp = measure(regex_heavy_rules(16), docs, min_rules=16)
+    _emit("config5_regex_registry_templates_per_sec", v, r, vn, sp)
 
     # config 5b: the REAL registry scale — all rules of the vendored
     # 250-file corpus in one compiled evaluator (the per-file rule
     # groups parallel/rules.py shards across sub-meshes, here back to
     # back on one chip)
-    v, rules_total, r = measure_corpus()
-    _emit("config5b_corpus_250files_templates_per_sec", v, r)
+    v, rules_total, r, sp = measure_corpus()
+    _emit("config5b_corpus_250files_templates_per_sec", v, r, spread=sp)
     _emit(
         "config5b_corpus_doc_rule_pairs_per_sec", v * rules_total, r
     )

@@ -309,9 +309,16 @@ class Validate:
                     return status, statuses, report, None
                 except (NativeUnsupported, NativeEvalError):
                     # flow-style YAML sniffing as JSON, or a decline —
-                    # the loaded tree is authoritative
+                    # the loaded tree is authoritative. Only genuine
+                    # PARSE failures disable raw for later rule files
+                    # (rule-specific declines say nothing about them)
                     if not data_file._raw_ok:
-                        data_file._raw_sniff_failed = True
+                        try:
+                            json.loads(data_file.content)
+                        except ValueError:
+                            data_file._raw_sniff_failed = True
+                        else:
+                            data_file._raw_ok = True
             report, statuses, status = native.eval_report(
                 data_file.path_value, data_file.name
             )

@@ -247,7 +247,12 @@ class FnSlots:
     """Everything the encoder / lowering / precompute agree on."""
 
     slots: List[_Slot]
-    var_slots: Dict[Tuple[int, str], int]  # function lets
+    # function lets, keyed by the BINDING's FunctionExpr identity: the
+    # let's value object uniquely names the binding, so the same
+    # (rule, name) bound in several when blocks disambiguates for free
+    # (the lowering resolves the name through its scoped block_vars and
+    # looks the winning object up here)
+    var_slots: Dict[int, int]  # id(FunctionExpr) -> slot
     lit_slots: Dict[Tuple[int, str], int]  # literal lets used as heads
     expr_slots: Dict[int, int]  # id(FunctionExpr) -> slot (inline uses)
     pv_slots: Dict[int, int]  # id(PV) -> slot (literal call arguments)
@@ -272,7 +277,7 @@ def fn_slots(rf: RulesFile) -> FnSlots:
     """
     excluded = _excluded_fn_vars(rf)
     slots: List[_Slot] = []
-    var_slots: Dict[Tuple[int, str], int] = {}
+    var_slots: Dict[int, int] = {}
     lit_slots: Dict[Tuple[int, str], int] = {}
     expr_slots: Dict[int, int] = {}
     pv_slots: Dict[int, int] = {}
@@ -281,21 +286,17 @@ def fn_slots(rf: RulesFile) -> FnSlots:
         slots.append(slot)
         return len(slots) - 1
 
-    # function lets, incl. when-block lets at root basis; a (rule, name)
-    # bound more than once (body + when block, or two when blocks) is
-    # ambiguous under the lowering's (rule_idx, var) lookup — skip both
-    # so rules touching the name stay host-side
+    # function lets, incl. when-block lets at root basis. A (rule,
+    # name) bound in MORE THAN ONE when block gets one slot per
+    # binding (the occurrence index keeps encode keys unique); the
+    # precompute resolves each through its own block chain, and the
+    # lowering disambiguates by the binding's FunctionExpr identity
     fn_lets = [t for t in _fn_lets(rf) if t[1] not in excluded]
-    name_counts: Dict[Tuple[int, str], int] = {}
-    for ri, var, _fx, _chain in fn_lets:
-        name_counts[(ri, var)] = name_counts.get((ri, var), 0) + 1
-    for ri, var, fx, chain in fn_lets:
-        if name_counts[(ri, var)] > 1:
-            continue
-        var_slots[(ri, var)] = add(
+    for occ, (ri, var, fx, chain) in enumerate(fn_lets):
+        var_slots[id(fx)] = add(
             _Slot(
-                key=("fn", ri, var), kind="fn", rule_idx=ri, var=var,
-                chain=tuple(chain),
+                key=("fn", ri, var, occ), kind="fn", rule_idx=ri,
+                var=var, chain=tuple(chain),
             )
         )
 

@@ -104,10 +104,6 @@ HOST_ONLY_CONSTRUCTS = {
         "inline function call in a value scope whose query argument "
         "resolves per candidate origin"
     ),
-    "fn_let_multi_when_block": (
-        "a (rule, name) function `let` bound in more than one when "
-        "block has an ambiguous precompute key"
-    ),
     "cross_scope_value_var": (
         "a variable bound in a non-root value scope used in another "
         "scope re-resolves per origin"
@@ -706,6 +702,9 @@ class _RuleLowering:
         # the kernel lowers — value = number of RESOLVED entries of the
         # argument query (functions/collections.rs:6-23)
         self.var_counts = {}
+        # file-level function lets by name (the binding OBJECT is the
+        # var_slots key, so lookups go name -> fx -> slot)
+        self.var_file_fns = {}
         for let in rules_file.assignments:
             if isinstance(let.value, AccessQuery):
                 self.var_queries[let.var] = let.value
@@ -716,6 +715,8 @@ class _RuleLowering:
                 # host-side, except count-over-query (see var_counts)
                 self.var_queries[let.var] = None
                 fx = let.value
+                if isinstance(fx, FunctionExpr):
+                    self.var_file_fns[let.var] = fx
                 if (
                     isinstance(fx, FunctionExpr)
                     and fx.name == "count"
@@ -786,10 +787,11 @@ class _RuleLowering:
             if var in block_vars:
                 v, tok = block_vars[var]
                 if isinstance(v, FunctionExpr):
-                    key = (self._cur_rule_idx, var)
-                    if tok == 0 and key in self.var_functions:
-                        # rule-body function let (root binding basis)
-                        return fn_var_steps(self.var_functions[key])
+                    # the binding OBJECT disambiguates same-named lets
+                    # bound in several root-basis when blocks (the
+                    # block_vars merge already resolved shadowing)
+                    if tok == 0 and id(v) in self.var_functions:
+                        return fn_var_steps(self.var_functions[id(v)])
                     raise Unlowerable(
                         f"function variable {var} outside precompute"
                     )
@@ -803,8 +805,13 @@ class _RuleLowering:
                         slot = self.fn_layout.pv_slots.get(id(v))
                     if slot is not None:
                         return fn_var_steps(slot)
-            elif (-1, var) in self.var_functions:
-                return fn_var_steps(self.var_functions[(-1, var)])
+            elif (
+                var in self.var_file_fns
+                and id(self.var_file_fns[var]) in self.var_functions
+            ):
+                return fn_var_steps(
+                    self.var_functions[id(self.var_file_fns[var])]
+                )
             elif var in self.var_queries:
                 v, tok = self.var_queries[var], 0
             elif var in self.var_literals:
@@ -936,14 +943,16 @@ class _RuleLowering:
                 # rule-body let: binds at the root basis like file lets
                 return query_interp(v, block_vars)
             if isinstance(v, FunctionExpr) and tok == 0:
-                key = (self._cur_rule_idx, var)
-                if key in self.var_functions:
-                    return fn_interp(self.var_functions[key])
+                if id(v) in self.var_functions:
+                    return fn_interp(self.var_functions[id(v)])
             raise Unlowerable("block-scoped query variable interpolation")
         if var in self.var_literals:
             return lit_step(self.var_literals[var])
-        if (-1, var) in self.var_functions:
-            return fn_interp(self.var_functions[(-1, var)])
+        if (
+            var in self.var_file_fns
+            and id(self.var_file_fns[var]) in self.var_functions
+        ):
+            return fn_interp(self.var_functions[id(self.var_file_fns[var])])
         q = self.var_queries.get(var)
         if q is None or not isinstance(q, AccessQuery):
             raise Unlowerable(f"variable {var} not interpolatable")

@@ -14,7 +14,10 @@ document axis is the data-parallel axis:
     — per-chip work is purely local, and only the final pass/fail count
     reduction crosses chips (`jnp.sum` -> psum over ICI/DCN);
   * multi-host: the same code runs under `jax.distributed` since all
-    collectives are XLA-inserted.
+    collectives are XLA-inserted — exercised for real by
+    tests/test_multihost_distributed.py (2 processes x 4 virtual CPU
+    devices, one global (dcn, ici) mesh, gloo collectives, per-process
+    oracle parity on the addressable shard).
 
 Rule-axis parallelism (huge registries) composes on top by splitting the
 compiled-rule list across a second mesh axis; statuses concatenate.
@@ -22,6 +25,8 @@ compiled-rule list across a second mesh axis; statuses concatenate.
 
 from __future__ import annotations
 
+import copy
+import dataclasses
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
@@ -100,6 +105,49 @@ def _mesh_key(mesh: Mesh) -> tuple:
     )
 
 
+def _scrub_arrays(o, seen: set) -> None:
+    """Generic structural walk setting every numpy-array field under
+    the IR to None (the trace reads only scalars and slots; the (S,)
+    bit tables are bound per batch through device_arrays)."""
+    if id(o) in seen:
+        return
+    seen.add(id(o))
+    if dataclasses.is_dataclass(o) and not isinstance(o, type):
+        for f in dataclasses.fields(o):
+            v = getattr(o, f.name)
+            if isinstance(v, np.ndarray):
+                setattr(o, f.name, None)
+            elif isinstance(v, (list, tuple, dict)) or dataclasses.is_dataclass(v):
+                _scrub_arrays(v, seen)
+    elif isinstance(o, (list, tuple)):
+        for e in o:
+            _scrub_arrays(e, seen)
+    elif isinstance(o, dict):
+        for e in o.values():
+            _scrub_arrays(e, seen)
+
+
+def _slim_for_trace(compiled: CompiledRules) -> CompiledRules:
+    """A structure-only CompiledRules for the cached trace closure:
+    same rules IR (deep-copied, numpy tables scrubbed), no interner,
+    no bit tables, no struct literals — the cache must not pin the
+    first corpus's string table for the process lifetime."""
+    rules = copy.deepcopy(compiled.rules)
+    _scrub_arrays(rules, set())
+    return CompiledRules(
+        rules=rules,
+        host_rules=[],
+        interner=None,
+        str_empty_bits=None,
+        needs_struct_ids=compiled.needs_struct_ids,
+        needs_unsure=compiled.needs_unsure,
+        str_empty_slot=compiled.str_empty_slot,
+        needs_str_rank=compiled.needs_str_rank,
+        needs_pairwise=compiled.needs_pairwise,
+        lit_names=list(compiled.lit_names),
+    )
+
+
 def _shared_evaluator_fns(compiled: CompiledRules, mesh: Mesh):
     """(jitted batch fn, jitted summary fn) for this rule program
     structure on this mesh — cached across CompiledRules instances."""
@@ -122,9 +170,11 @@ def _shared_evaluator_fns(compiled: CompiledRules, mesh: Mesh):
 
     # the mesh's platform, not the process default, decides the
     # primitive formulation (an explicit CPU mesh on a TPU host
-    # must still get the CPU gather override)
+    # must still get the CPU gather override). The closure lives for
+    # the cache's lifetime, so it captures a SLIM structural clone —
+    # not the first corpus's interner / bit tables / struct literals
     doc_eval = build_doc_evaluator(
-        compiled,
+        _slim_for_trace(compiled),
         with_unsure=with_unsure,
         platform=mesh.devices.flat[0].platform,
     )

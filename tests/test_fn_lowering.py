@@ -267,7 +267,7 @@ rule r2 when Resources exists { %ok exists }
 """,
         "x.guard",
     )
-    assert precomputable_fn_vars(rf) == [("fn", -1, "ok")]
+    assert precomputable_fn_vars(rf) == [("fn", -1, "ok", 0)]
 
 
 def test_fn_error_doc_reported():
@@ -286,9 +286,9 @@ rule ok when Resources exists { some %n >= 0 }
         from_plain({"Resources": {"a": {"Size": "not-a-number"}}}),
     ]
     fn_vars, fn_vals, fn_err = precompute_fn_values(rf, docs)
-    assert fn_vars == [("fn", -1, "n")]
+    assert fn_vars == [("fn", -1, "n", 0)]
     assert fn_err == {1}
-    assert fn_vals[0][("fn", -1, "n")][0].val == 42
+    assert fn_vals[0][("fn", -1, "n", 0)][0].val == 42
 
 
 def test_backend_cli_fn_parity(tmp_path):
@@ -575,5 +575,82 @@ rule CALLS when %svcs exists {
             {"Resources": {"a": {"Type": "Svc", "Arn": "arn:aws:123"}}},
             {"Resources": {"a": {"Type": "Svc", "Arn": "arn:aws:999"}}},
             {"Resources": {"y": {"Type": "Other"}}},
+        ],
+    )
+
+
+def test_same_fn_let_in_two_when_blocks():
+    """Round 5 (VERDICT r4 item 5): the same function-let NAME bound in
+    TWO root-basis when blocks lowers — each binding gets its own
+    precompute slot keyed by the binding's FunctionExpr identity, and
+    the when-block scoping resolves shadowing exactly like the oracle."""
+    _differential(
+        """
+rule r {
+    when Resources exists {
+        let u = to_upper(Resources.*.Name)
+        some %u == 'ALPHA'
+    }
+    when Outputs exists {
+        let u = to_upper(Outputs.*.Name)
+        some %u == 'BETA'
+    }
+}
+""",
+        [
+            {"Resources": {"a": {"Name": "alpha"}},
+             "Outputs": {"o": {"Name": "beta"}}},
+            {"Resources": {"a": {"Name": "alpha"}}},
+            {"Outputs": {"o": {"Name": "nope"}}},
+            {"Other": 1},
+        ],
+    )
+
+
+def test_fn_let_shadows_file_let_across_when_blocks():
+    """Shadowing: a when-block binding must win over the file-level
+    binding of the same name inside its block, and the file binding
+    must win outside."""
+    _differential(
+        """
+let u = to_upper(Resources.*.Kind)
+
+rule outer when Resources exists { some %u == 'FILE' }
+rule inner {
+    when Resources exists {
+        let u = to_lower(Resources.*.Name)
+        some %u == 'block'
+    }
+}
+""",
+        [
+            {"Resources": {"a": {"Kind": "file", "Name": "BLOCK"}}},
+            {"Resources": {"a": {"Kind": "other", "Name": "nope"}}},
+        ],
+    )
+
+
+def test_nested_when_blocks_same_name_three_bindings():
+    """Three bindings of one name across body + nested whens: every
+    use site resolves its innermost binding's slot."""
+    _differential(
+        """
+rule r {
+    let u = to_upper(Resources.*.Tag)
+    some %u == 'BODY'
+    when Resources exists {
+        let u = to_upper(Resources.*.Name)
+        some %u == 'WHEN1'
+        when Resources exists {
+            let u = to_lower(Resources.*.Name)
+            some %u == 'when2'
+        }
+    }
+}
+""",
+        [
+            {"Resources": {"a": {"Tag": "body", "Name": "When1"}}},
+            {"Resources": {"a": {"Tag": "body", "Name": "WHEN2"}}},
+            {"Resources": {"a": {"Tag": "x", "Name": "y"}}},
         ],
     )

@@ -44,18 +44,6 @@ rule r when Resources exists {
     Resources.* { Name == to_lower(Name) }
 }
 """,
-    "fn_let_multi_when_block": """
-rule r {
-    when Resources exists {
-        let u = to_upper(Resources.*.Name)
-        %u !empty
-    }
-    when Outputs exists {
-        let u = to_upper(Outputs.*.Name)
-        %u !empty
-    }
-}
-""",
     "cross_scope_value_var": """
 rule r when Resources exists {
     Resources.* {
@@ -92,6 +80,22 @@ rule r when Resources exists {
 let kinds = Resources.*.Type
 rule r when Resources exists {
     Resources.*.Properties[ Kind IN %kinds ] exists
+}
+""",
+    # round 5: the same function-let NAME bound in several when blocks
+    # disambiguates by binding identity (fnvars keys slots on the
+    # FunctionExpr object); differential coverage in
+    # tests/test_fn_lowering.py::test_same_fn_let_in_two_when_blocks
+    "fn_let_multi_when_block": """
+rule r {
+    when Resources exists {
+        let u = to_upper(Resources.*.Name)
+        %u !empty
+    }
+    when Outputs exists {
+        let u = to_upper(Outputs.*.Name)
+        %u !empty
+    }
 }
 """,
 }

@@ -331,9 +331,10 @@ rule r {
     _differential(rules, docs)
 
 
-def test_duplicate_when_let_name_stays_host():
-    # two when blocks binding the same function-let name: ambiguous
-    # under the (rule, name) precompute key -> host fallback
+def test_duplicate_when_let_name_lowers():
+    # two when blocks binding the same function-let name: round 5 keys
+    # precompute slots on the binding's expression identity, so both
+    # bindings lower and resolve through their own block chains
     rules = """
 rule r {
     when Parameters.A exists {
@@ -349,8 +350,10 @@ rule r {
     docs = [
         {"Parameters": {"A": "a", "B": "B"},
          "Resources": {"X": "A", "Y": "b"}},
+        {"Parameters": {"A": "a"}, "Resources": {"X": "nope", "Y": "b"}},
+        {"Parameters": {"B": "Q"}, "Resources": {"X": "A", "Y": "q"}},
     ]
-    _differential(rules, docs, expect_host=1)
+    _differential(rules, docs, expect_host=0)
 
 
 # ---------------------------------------------------------------------------

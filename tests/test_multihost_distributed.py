@@ -1,0 +1,137 @@
+"""Multi-host evidence (VERDICT r4 item 8): the SAME evaluator code
+runs under `jax.distributed` across OS processes — 2 processes x 4
+virtual CPU devices form one global 8-device (dcn, ici) mesh, the
+document batch shards across processes on the dcn axis, evaluation is
+SPMD, and the only cross-process traffic is the terminal summary
+reduction (gloo collectives on CPU; ICI/DCN collectives on real TPU
+topologies). Each process asserts bit-parity against the CPU oracle
+for its addressable shard."""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import pytest
+
+_WORKER = textwrap.dedent(
+    '''
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ.setdefault("GUARD_TPU_GATHER_ON_CPU", "0")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid = int(sys.argv[1]); port = sys.argv[2]
+    jax.distributed.initialize(
+        f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+
+    sys.path.insert(0, os.getcwd())  # repo root (test sets cwd)
+    import numpy as np
+    from guard_tpu.core.parser import parse_rules_file
+    from guard_tpu.core.scopes import RootScope
+    from guard_tpu.core.evaluator import eval_rules_file
+    from guard_tpu.core.qresult import Status
+    from guard_tpu.core.values import from_plain
+    from guard_tpu.ops.encoder import encode_batch
+    from guard_tpu.ops.ir import compile_rules_file
+    from guard_tpu.parallel import mesh as mesh_mod
+
+    RULES = """
+    rule enc { Resources.*[ Type == 'B' ] { Properties.E exists } }
+    rule named when enc { Resources.*.Name exists }
+    """
+    # identical corpus on every process (deterministic encode)
+    docs_plain = [
+        {"Resources": {f"r{i}": {
+            "Type": "B",
+            "Properties": ({"E": 1} if i % 3 else {}),
+            **({"Name": f"n{i}"} if i % 2 else {}),
+        }}}
+        for i in range(16)
+    ]
+    docs = [from_plain(d) for d in docs_plain]
+    rf = parse_rules_file(RULES, "m.guard")
+    batch, interner = encode_batch(docs)
+    compiled = compile_rules_file(rf, interner)
+    assert not compiled.host_rules
+
+    mesh = mesh_mod.hierarchical_mesh(n_slices=2)  # (dcn=2, ici=4)
+    assert mesh.axis_names == ("dcn", "ici")
+    fn, _summary = mesh_mod._shared_evaluator_fns(compiled, mesh)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    arrays, d_valid = mesh_mod.pad_to_multiple(
+        compiled.device_arrays(batch), mesh.devices.size
+    )
+    doc_sharding = NamedSharding(mesh, P(("dcn", "ici")))
+    D = next(iter(arrays.values())).shape[0]
+    half = D // 2
+    lo, hi = (0, half) if pid == 0 else (half, D)
+    global_arrays = {
+        k: jax.make_array_from_process_local_data(
+            doc_sharding, np.ascontiguousarray(v[lo:hi]), v.shape
+        )
+        for k, v in arrays.items()
+    }
+    out = fn(global_arrays, compiled.lit_values())
+    statuses = out[0] if compiled.needs_unsure else out
+
+    # every process checks ITS addressable rows against the oracle
+    to_int = {Status.PASS: 0, Status.FAIL: 1, Status.SKIP: 2}
+    checked = 0
+    for shard in statuses.addressable_shards:
+        start = shard.index[0].start or 0
+        rows = np.asarray(shard.data)
+        for j in range(rows.shape[0]):
+            di = start + j
+            if di >= d_valid:
+                continue
+            scope = RootScope(rf, docs[di])
+            eval_rules_file(rf, scope, None)
+            root = scope.reset_recorder().extract()
+            expect = [
+                to_int[c.container.payload.status] for c in root.children
+            ]
+            got = [int(v) for v in rows[j]]
+            assert got == expect, (di, got, expect)
+            checked += 1
+    assert checked >= 4  # each process owns half the real docs
+    print(f"OK pid={pid} checked={checked}", flush=True)
+    '''
+)
+
+
+def test_two_process_dcn_mesh_parity(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    port = "9417"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), port],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed worker timed out")
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert f"OK pid={i}" in out

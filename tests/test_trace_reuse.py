@@ -8,7 +8,6 @@ its per-bucket executables instead of re-tracing and re-compiling."""
 import json
 
 import numpy as np
-import pytest
 
 from guard_tpu.core.parser import parse_rules_file
 from guard_tpu.core.scopes import RootScope
@@ -127,9 +126,6 @@ def test_validate_invocations_share_executables(tmp_path):
     for seed in (7, 8):
         data = tmp_path / f"data{seed}"
         data.mkdir()
-        for i, doc in enumerate(_docs(seed, 3)):
-            # re-plain via the PV walk is awkward; write JSON directly
-            pass
         for i in range(3):
             (data / f"t{i}.json").write_text(
                 json.dumps(
