@@ -382,19 +382,7 @@ def measure(rules_text: str, docs, min_rules: int, n_cpu: int = 256):
         if t_k >= 2.5 * t_1 or k_inner >= 1025:
             break
         k_inner = (k_inner - 1) * 4 + 1
-    # run-to-run spread: repeat the whole (t_1, t_k) differenced
-    # measurement — on a shared/noisy host the spread tells a regression
-    # from box noise (VERDICT r4: the r03->r04 CPU headline delta had
-    # no variance bars to judge it against)
-    vals = []
-    for _ in range(3):
-        r1 = _med(fn1)
-        rk = _med(fnk)
-        vals.append(n_docs / max((rk - r1) / (k_inner - 1), 1e-9))
-    vals.sort()
-    tpu_docs_per_sec = vals[len(vals) // 2]
-    spread = {"min": round(vals[0], 1), "median": round(tpu_docs_per_sec, 1),
-              "max": round(vals[-1], 1), "reps": len(vals)}
+    tpu_docs_per_sec, spread = _measure_spread(_med, fn1, fnk, k_inner, n_docs)
 
     cpu_docs_per_sec = _cpu_oracle_docs_per_sec(rf, docs, n_cpu)
     native = _native_docs_per_sec(rf, docs, min(n_cpu * 4, len(docs)))
@@ -523,15 +511,7 @@ def measure_corpus():
         if t_k >= 2.5 * t_1 or k_inner >= 257:
             break
         k_inner = (k_inner - 1) * 4 + 1
-    vals = []
-    for _ in range(3):
-        r1 = _med(fn1)
-        rk = _med(fnk)
-        vals.append(n_docs / max((rk - r1) / (k_inner - 1), 1e-9))
-    vals.sort()
-    docs_per_sec = vals[len(vals) // 2]
-    spread = {"min": round(vals[0], 1), "median": round(docs_per_sec, 1),
-              "max": round(vals[-1], 1), "reps": len(vals)}
+    docs_per_sec, spread = _measure_spread(_med, fn1, fnk, k_inner, n_docs)
 
     # oracle: all corpus rule files over a sample of docs, with the
     # per-file error isolation the validate loop applies
@@ -666,6 +646,26 @@ def measure_fail_heavy(frac_fail: float, statuses_only: bool, n_docs: int = 1024
         native.close()
     vals.sort()
     return vals[len(vals) // 2]
+
+
+def _measure_spread(med, fn1, fnk, k_inner: int, n_docs: int, reps: int = 3):
+    """(median throughput, spread dict): repeat the whole (t_1, t_k)
+    differenced measurement `reps` times — on a shared/noisy host the
+    spread tells a regression from box noise (VERDICT r4: the r03->r04
+    CPU headline delta had no variance bars to judge it against)."""
+    vals = []
+    for _ in range(reps):
+        r1 = med(fn1)
+        rk = med(fnk)
+        vals.append(n_docs / max((rk - r1) / (k_inner - 1), 1e-9))
+    vals.sort()
+    median = vals[len(vals) // 2]
+    return median, {
+        "min": round(vals[0], 1),
+        "median": round(median, 1),
+        "max": round(vals[-1], 1),
+        "reps": len(vals),
+    }
 
 
 def _emit(metric: str, value: float, vs: float, vs_native=None, spread=None) -> None:

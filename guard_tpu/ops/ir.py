@@ -473,11 +473,12 @@ class CompiledRules:
     # order between arbitrary document strings: a per-node rank column
     # over the lexicographically sorted intern table
     needs_str_rank: bool = False
-    # any rule builds (N, N)-shaped pairwise matrices (query-RHS
-    # compares, variable key interpolation): such rule files keep the
-    # standard node-bucket ceiling; files without them evaluate on the
-    # extended buckets (encoder.NODE_BUCKETS_EXTENDED) since every
-    # remaining primitive is O(N) in gather mode
+    # any rule uses pairwise constructions (query-RHS compares,
+    # variable key interpolation). They no longer cap the bucket size:
+    # gather mode evaluates them through O(N log N) sorted-set joins
+    # (kernels._in_set_sorted and friends), and this flag now only
+    # forces gather above 8,192 nodes (the one-hot arm still builds
+    # (N, N) matrices, fine at small buckets only)
     needs_pairwise: bool = False
     # the literals-as-inputs table: one entry per unique rule-literal
     # string the kernel compares against (key lookups, string-equality

@@ -437,26 +437,51 @@ def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None,
         # non-string key values raise NotComparable on the oracle
         # (scopes._retrieve_key:621-631): flag the document unsure
         d.unsure_acc.append(jnp.any(flat & ~is_str))
-        # match[c, v]: child c sits under a key equal to var string v
-        vids = jnp.where(good, d.scalar_id, -7)
-        match = (d.node_key_id[:, None] == vids[None, :]) & good[None, :]
-        kh = jnp.any(match, axis=1)
         is_map_sel = (sel > 0) & (d.node_kind == MAP)
         acc.add(sel, (sel > 0) & (d.node_kind != MAP))
-        # found[s, v]: map s has a child under key v — one boolean
-        # matmul on the MXU instead of an (N, N, N) reduction
-        oh = _parent_onehot(d)  # [c, p]
-        found = (
-            jnp.matmul(
-                oh.astype(jnp.float32).T,
-                match.astype(jnp.float32),
-                preferred_element_type=jnp.float32,
+        if d.gather_mode:
+            # O(N log N): key-hit via a sorted set join; per-map
+            # matched-entry counts via distinct (parent, key) child
+            # pairs weighted by the var multiset's per-string
+            # multiplicity (kernels.py sorted primitives)
+            zeros = jnp.zeros(d.n, jnp.int32)
+            vs = jnp.where(good, d.scalar_id, -1)
+            kh = _in_set_sorted(
+                d.n, zeros, d.node_key_id, d.node_key_id >= 0,
+                zeros, vs, good,
             )
-            > 0.0
-        )  # (p, v)
-        miss_counts = jnp.sum(
-            (~found) & good[None, :], axis=1, dtype=jnp.int32
-        )
+            pk_mask = (d.node_key_id >= 0) & (d.node_parent >= 0)
+            f_pk = _distinct_first_sorted(
+                d.node_parent, d.node_key_id, pk_mask
+            )
+            mult = _set_count_sorted(
+                d.n, zeros, d.node_key_id, f_pk, zeros, vs, good
+            )
+            matched = jax.ops.segment_sum(
+                jnp.where(f_pk, mult, 0),
+                jnp.maximum(d.node_parent, 0),
+                num_segments=d.n,
+            )
+            miss_counts = jnp.sum(good, dtype=jnp.int32) - matched
+        else:
+            # match[c, v]: child c sits under a key equal to var string v
+            vids = jnp.where(good, d.scalar_id, -7)
+            match = (d.node_key_id[:, None] == vids[None, :]) & good[None, :]
+            kh = jnp.any(match, axis=1)
+            # found[s, v]: map s has a child under key v — one boolean
+            # matmul on the MXU instead of an (N, N, N) reduction
+            oh = _parent_onehot(d)  # [c, p]
+            found = (
+                jnp.matmul(
+                    oh.astype(jnp.float32).T,
+                    match.astype(jnp.float32),
+                    preferred_element_type=jnp.float32,
+                )
+                > 0.0
+            )  # (p, v)
+            miss_counts = jnp.sum(
+                (~found) & good[None, :], axis=1, dtype=jnp.int32
+            )
         acc.add_count(sel, jnp.where(is_map_sel, miss_counts, 0))
         # every UnResolved entry in the variable's own resolution is
         # re-reported per selected candidate
@@ -834,6 +859,118 @@ def _eval_binary_outcomes(d: _DocArrays, c: CClause, sel_leaf):
 # ---------------------------------------------------------------------------
 # clause / block / conjunction evaluation — all per-origin (N+1,) int8
 # ---------------------------------------------------------------------------
+# ---------------------------------------------------------------------------
+# sorted (O(N log N)) set primitives — the gather-mode replacement for
+# the (N, N) pairwise matrices in query-RHS compares and key
+# interpolation. Each builds on ONE lexicographic lax.sort plus O(N)
+# scans/segment-sums, so big node buckets (encoder.NODE_BUCKETS_EXTENDED)
+# stay feasible for every rule file.
+# ---------------------------------------------------------------------------
+
+
+def _runs(org_s: jnp.ndarray, key_s: jnp.ndarray) -> jnp.ndarray:
+    """Run ids over a SORTED (org, key) sequence (equal pairs share a
+    run)."""
+    start = jnp.concatenate(
+        [
+            jnp.ones((1,), bool),
+            (org_s[1:] != org_s[:-1]) | (key_s[1:] != key_s[:-1]),
+        ]
+    )
+    return jnp.cumsum(start.astype(jnp.int32)) - 1
+
+
+def _set_count_sorted(
+    n_out: int,
+    q_org: jnp.ndarray,
+    q_key: jnp.ndarray,
+    q_mask: jnp.ndarray,
+    s_org: jnp.ndarray,
+    s_key: jnp.ndarray,
+    s_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """(n_out,) int32: for masked query entry i (at index q_idx[i] =
+    its position), the number of masked SET entries with the same
+    (org, key). Masked-out query entries read 0. One lexicographic
+    sort + O(N) scans."""
+    nq = q_org.shape[0]
+    org = jnp.concatenate(
+        [jnp.where(s_mask, s_org, -1), jnp.where(q_mask, q_org, -2)]
+    ).astype(jnp.int32)
+    key = jnp.concatenate([s_key, q_key]).astype(jnp.int32)
+    side = jnp.concatenate(
+        [jnp.zeros(s_org.shape[0], jnp.int32), jnp.ones(nq, jnp.int32)]
+    )
+    idx = jnp.concatenate(
+        [jnp.zeros(s_org.shape[0], jnp.int32), jnp.arange(nq, dtype=jnp.int32)]
+    )
+    org_s, key_s, side_s, idx_s = jax.lax.sort(
+        (org, key, side, idx), num_keys=2
+    )
+    m = org_s.shape[0]
+    run_id = _runs(org_s, key_s)
+    is_set = (side_s == 0) & (org_s >= 0)
+    per_run = jax.ops.segment_sum(
+        is_set.astype(jnp.int32), run_id, num_segments=m
+    )
+    cnt = jnp.take(per_run, run_id)
+    tgt = jnp.where((side_s == 1) & (org_s >= 0), idx_s, n_out)
+    out = jnp.zeros(n_out + 1, jnp.int32).at[tgt].max(
+        jnp.where((side_s == 1) & (org_s >= 0), cnt, 0)
+    )
+    return out[:n_out]
+
+
+def _in_set_sorted(
+    n_out: int, q_org, q_key, q_mask, s_org, s_key, s_mask
+) -> jnp.ndarray:
+    """(n_out,) bool: masked query entry has ANY matching masked set
+    entry with equal (org, key)."""
+    return (
+        _set_count_sorted(n_out, q_org, q_key, q_mask, s_org, s_key, s_mask)
+        > 0
+    )
+
+
+def _distinct_first_sorted(org, key, mask) -> jnp.ndarray:
+    """(N,) bool: True at exactly one representative entry per distinct
+    (org, key) among masked entries."""
+    n = org.shape[0]
+    o = jnp.where(mask, org, -1).astype(jnp.int32)
+    k = jnp.where(mask, key, -1).astype(jnp.int32)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    o_s, k_s, idx_s = jax.lax.sort((o, k, idx), num_keys=3)
+    start = jnp.concatenate(
+        [jnp.ones((1,), bool), (o_s[1:] != o_s[:-1]) | (k_s[1:] != k_s[:-1])]
+    )
+    first = start & (o_s >= 0)
+    return jnp.zeros(n, bool).at[idx_s].set(first)
+
+
+def _seg_min_max_keys(seg, mask, hi, lo, num_segments):
+    """Per-segment exact (hi, lo)-key minimum and maximum over masked
+    entries: ((min_hi, min_lo), (max_hi, max_lo)), int32 each. Empty
+    segments read extreme sentinels (callers gate on counts)."""
+    BIG = jnp.int32(2**31 - 1)
+    SMALL = jnp.int32(-(2**31) + 1)
+    seg_c = jnp.where(mask, seg, num_segments - 1)
+    min_hi = jax.ops.segment_min(
+        jnp.where(mask, hi, BIG), seg_c, num_segments=num_segments
+    )
+    max_hi = jax.ops.segment_max(
+        jnp.where(mask, hi, SMALL), seg_c, num_segments=num_segments
+    )
+    at_min = mask & (hi == jnp.take(min_hi, seg_c))
+    at_max = mask & (hi == jnp.take(max_hi, seg_c))
+    min_lo = jax.ops.segment_min(
+        jnp.where(at_min, lo, BIG), seg_c, num_segments=num_segments
+    )
+    max_lo = jax.ops.segment_max(
+        jnp.where(at_max, lo, SMALL), seg_c, num_segments=num_segments
+    )
+    return (min_hi, min_lo), (max_hi, max_lo)
+
+
 def _flatten_one_level(d: _DocArrays, sel_v: jnp.ndarray) -> jnp.ndarray:
     """selected()/flattened() (operators.rs:116-144): selected LIST
     values are replaced by their elements (one level); everything else
@@ -842,6 +979,92 @@ def _flatten_one_level(d: _DocArrays, sel_v: jnp.ndarray) -> jnp.ndarray:
     child = jnp.where((d.node_parent_kind == LIST) & (psel > 0), psel, 0)
     keep = jnp.where((sel_v > 0) & (d.node_kind != LIST), sel_v, 0)
     return jnp.maximum(child, keep)
+
+
+def _ordering_outcomes_sorted(d: _DocArrays, c: CClause, lf, rf,
+                              lhs_here, rhs_here):
+    """(fail_per_i, pass_per_i) for ordering ops against a query RHS
+    without the (N, N) pair matrix: the same-kind total order means
+    '∃ y: x < y' collapses to 'x < max(same-kind rhs of my origin)'
+    (and dually for the other ops / the ¬ok side), so per-(origin,
+    kind-class) count/min/max segment aggregates decide every
+    element. NULLs all compare equal; cross-kind or non-orderable
+    pairs FAIL (path_value.rs:1048-1070)."""
+    K = 5  # INT, FLOAT, STRING, NULL, other
+    kind = d.node_kind
+    kc = jnp.where(
+        kind == INT, 0,
+        jnp.where(
+            kind == FLOAT, 1,
+            jnp.where(kind == STRING, 2, jnp.where(kind == NULL, 3, 4)),
+        ),
+    ).astype(jnp.int32)
+    is_str = kind == STRING
+    key_hi = jnp.where(is_str, d.str_rank, d.num_hi)
+    key_lo = jnp.where(is_str, 0, d.num_lo)
+
+    shared = c.rhs_query_from_root
+    # shared-RHS labels are 1 (scalar-mode run); per-origin otherwise
+    r_org = rf
+    nseg = (d.n + 1) * K
+    seg = r_org * K + kc
+    cnt = jax.ops.segment_sum(
+        jnp.where(rhs_here, 1, 0), jnp.where(rhs_here, seg, 0),
+        num_segments=nseg,
+    )
+    (min_hi, min_lo), (max_hi, max_lo) = _seg_min_max_keys(
+        seg, rhs_here, key_hi, key_lo, nseg
+    )
+
+    # effective operator: the `not` inversion complements within the
+    # same-kind total order (¬(x<y) ⟺ x>=y; null pairs included since
+    # lt=gt=False there)
+    op = c.op
+    if c.op_not:
+        op = {
+            CmpOperator.Lt: CmpOperator.Ge, CmpOperator.Ge: CmpOperator.Lt,
+            CmpOperator.Le: CmpOperator.Gt, CmpOperator.Gt: CmpOperator.Le,
+        }[op]
+
+    o_look = jnp.ones(d.n, jnp.int32) if shared else lf
+    seg_same = o_look * K + kc
+    cnt_same = jnp.take(cnt, seg_same)
+    total = jnp.zeros(d.n, jnp.int32)
+    for k in range(K):
+        total = total + jnp.take(cnt, o_look * K + k)
+    mnh = jnp.take(min_hi, seg_same)
+    mnl = jnp.take(min_lo, seg_same)
+    mxh = jnp.take(max_hi, seg_same)
+    mxl = jnp.take(max_lo, seg_same)
+
+    def _lt(ah, al, bh, bl):
+        return (ah < bh) | ((ah == bh) & (al < bl))
+
+    x_lt_max = _lt(key_hi, key_lo, mxh, mxl)
+    x_le_max = ~_lt(mxh, mxl, key_hi, key_lo)
+    x_gt_min = _lt(mnh, mnl, key_hi, key_lo)
+    x_ge_min = ~_lt(key_hi, key_lo, mnh, mnl)
+    if op == CmpOperator.Lt:
+        ok_some, nok_some, null_ok = x_lt_max, x_ge_min, False
+    elif op == CmpOperator.Le:
+        ok_some, nok_some, null_ok = x_le_max, x_gt_min, True
+    elif op == CmpOperator.Gt:
+        ok_some, nok_some, null_ok = x_gt_min, x_le_max, False
+    else:  # Ge
+        ok_some, nok_some, null_ok = x_ge_min, x_lt_max, True
+
+    has_same = cnt_same > 0
+    orderable_x = kc <= 2
+    is_null_x = kc == 3
+    pass_scalar = orderable_x & has_same & ok_some
+    pass_null = is_null_x & has_same & null_ok
+    pass_per_i = lhs_here & (pass_scalar | pass_null)
+
+    fail_cross = (total - jnp.where(kc <= 3, cnt_same, 0)) > 0
+    fail_same = orderable_x & has_same & nok_some
+    fail_null = is_null_x & has_same & (not null_ok)
+    fail_per_i = lhs_here & (fail_cross | fail_same | fail_null)
+    return fail_per_i, pass_per_i
 
 
 def _eval_query_rhs_ordering(d: _DocArrays, c: CClause, sel, rule_statuses,
@@ -878,6 +1101,27 @@ def _eval_query_rhs_ordering(d: _DocArrays, c: CClause, sel, rule_statuses,
     rf = _flatten_one_level(d, rhs_sel)
     lhs_here = lf > 0
     rhs_here = rf > 0
+
+    if d.gather_mode:
+        # O(N log N): per-(origin, kind-class) rhs count/min/max
+        # aggregates replace the (N, N) cartesian comparison
+        fail_per_i, pass_per_i = _ordering_outcomes_sorted(
+            d, c, lf, rf, lhs_here, rhs_here
+        )
+        cnt_fail = _segment_count(d, lf, fail_per_i)
+        cnt_pass = _segment_count(d, lf, pass_per_i)
+        n_lhs_flat = _segment_count(d, lf, jnp.ones(d.n, bool))
+        any_fail = (
+            (cnt_fail > 0)
+            | (lhs_unres > 0)
+            | ((rhs_unres > 0) & (n_lhs_flat > 0))
+        )
+        if c.match_all:
+            st = jnp.where(any_fail, FAIL, PASS).astype(jnp.int8)
+        else:
+            st = jnp.where(cnt_pass > 0, PASS, FAIL).astype(jnp.int8)
+        skip = ((n_lhs + lhs_unres) == 0) | ((n_rhs + rhs_unres) == 0)
+        return jnp.where(skip, jnp.int8(SKIP), st)
 
     kind = d.node_kind
     same_kind = kind[:, None] == kind[None, :]
@@ -963,6 +1207,17 @@ def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses,
         n_rhs = _segment_count(d, rhs_sel, ones)
     lhs_total = n_lhs + lhs_unres
     rhs_total = n_rhs + rhs_unres
+
+    if d.gather_mode:
+        # O(N log N) sorted-set formulation (big buckets / CPU): no
+        # (N, N) matrix is ever built
+        q_success = _query_rhs_success_sorted(
+            d, c, lhs_sel, rhs_sel, n_lhs, n_rhs, lhs_total, rhs_total
+        )
+        return _query_rhs_finish(
+            d, c, q_success, n_lhs, lhs_unres, rhs_unres,
+            lhs_total, rhs_total,
+        )
 
     sid = d.struct_id
     eq = (sid[:, None] == sid[None, :]) & (sid[:, None] >= 0)  # (N,N) loose_eq
@@ -1117,6 +1372,13 @@ def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses,
             rdiff_cnt = _segment_count(d, lhs_sel, lhs_here & ~in_diff)
             q_success = jnp.where(q_success, False, rdiff_cnt == 0)
 
+    return _query_rhs_finish(
+        d, c, q_success, n_lhs, lhs_unres, rhs_unres, lhs_total, rhs_total
+    )
+
+
+def _query_rhs_finish(d, c, q_success, n_lhs, lhs_unres, rhs_unres,
+                      lhs_total, rhs_total):
     # unresolved entries survive the inversion as FAILs; rhs-unresolved
     # entries exist only when some lhs resolved (evaluator._eq_operation)
     entry_fail = (lhs_unres > 0) | ((rhs_unres > 0) & (n_lhs > 0))
@@ -1131,6 +1393,178 @@ def _eval_query_rhs_clause(d: _DocArrays, c: CClause, sel, rule_statuses,
         st = jnp.where(q_success & (n_lhs > 0), PASS, FAIL).astype(jnp.int8)
     skip = (lhs_total == 0) | (rhs_total == 0)
     return jnp.where(skip, jnp.int8(SKIP), st)
+
+
+def _query_rhs_success_sorted(d: _DocArrays, c: CClause, lhs_sel, rhs_sel,
+                              n_lhs, n_rhs, lhs_total, rhs_total):
+    """(N+1,) bool per-origin query_in / containment success — the
+    sorted-set counterpart of the dense arm below, reproducing
+    operators.rs:552-594 (Eq query_in set-difference), :434-451 (In)
+    and the reverse-diff inversion (operators.rs:637-646) through
+    per-(origin, struct-id) joins instead of (N, N) matrices.
+
+    The one construction with irreducibly per-PAIR semantics — a list
+    LHS value contained in a SUBSET-mode list RHS value
+    (operators.rs:256-321 list-vs-list without a list first element) —
+    flags the document unsure instead (the oracle reproduces it
+    exactly); every other arm is exact here."""
+    sid = d.struct_id
+    shared = c.rhs_query_from_root
+    lhs_here = lhs_sel > 0
+    rhs_here = rhs_sel > 0
+    valid_l = lhs_here & (sid >= 0)
+    valid_r = rhs_here & (sid >= 0)
+    zeros = jnp.zeros(d.n, jnp.int32)
+    # membership org keys: real origins per side, or one shared key
+    l_org = zeros if shared else lhs_sel
+    r_org = zeros if shared else rhs_sel
+
+    if c.op == CmpOperator.Eq:
+        m_lhs = _in_set_sorted(d.n, l_org, sid, valid_l, r_org, sid, valid_r)
+        cnt_lhs_not_in = _segment_count(d, lhs_sel, lhs_here & ~m_lhs)
+        if shared:
+            # reverse side per origin o: #rhs values loose_eq-present in
+            # o's lhs set = sum over DISTINCT (o, sid) lhs entries of
+            # the global per-sid rhs count
+            w = _set_count_sorted(
+                d.n, zeros, sid, valid_l, zeros, sid, valid_r
+            )
+            f = _distinct_first_sorted(lhs_sel, sid, valid_l)
+            cnt_rhs_in = jax.ops.segment_sum(
+                jnp.where(f, w, 0), jnp.where(f, lhs_sel, 0),
+                num_segments=d.n + 1,
+            )
+            cnt_rhs_not_in = n_rhs - cnt_rhs_in
+        else:
+            m_rhs = _in_set_sorted(
+                d.n, r_org, sid, valid_r, l_org, sid, valid_l
+            )
+            cnt_rhs_not_in = _segment_count(d, rhs_sel, rhs_here & ~m_rhs)
+        use_lhs_diff = n_lhs > n_rhs
+        diff_cnt = jnp.where(use_lhs_diff, cnt_lhs_not_in, cnt_rhs_not_in)
+        q_success = diff_cnt == 0
+        if c.op_not:
+            use_rhs_rdiff = rhs_total >= lhs_total
+            if shared:
+                # diff side per origin: lhs-side diff members are plain
+                # node sets; the rhs-side diff of origin o is
+                # {r: sid_r not in lhsset(o)}, whose membership at a
+                # node x collapses to sid_x ∉ lhsset(o)
+                diff_l = valid_l & ~m_lhs
+                # rdiff over the LHS side (per origin o, lhs i of o):
+                #   diff=lhs: i ∈ diff_l sids of o?
+                #   diff=rhs: no lhs sid can be outside its own lhs set
+                #     -> in_diff is False -> every lhs counts
+                m_l_in_dl = _in_set_sorted(
+                    d.n, lhs_sel, sid, valid_l, lhs_sel, sid, diff_l
+                )
+                rdiff_a_l = _segment_count(
+                    d, lhs_sel, lhs_here & ~m_l_in_dl
+                )
+                rdiff_a = jnp.where(use_lhs_diff, rdiff_a_l, n_lhs)
+                # rdiff over the RHS side (shared rhs values, per o):
+                #   diff=lhs: #rhs with sid ∉ diffl-sids(o)
+                #   diff=rhs: ¬in_diff ⟺ sid ∈ lhsset(o)
+                f_d = _distinct_first_sorted(lhs_sel, sid, diff_l)
+                w = _set_count_sorted(
+                    d.n, zeros, sid, valid_l, zeros, sid, valid_r
+                )
+                cnt_rhs_in_dl = jax.ops.segment_sum(
+                    jnp.where(f_d, w, 0), jnp.where(f_d, lhs_sel, 0),
+                    num_segments=d.n + 1,
+                )
+                rdiff_b = jnp.where(
+                    use_lhs_diff, n_rhs - cnt_rhs_in_dl, cnt_rhs_in
+                )
+            else:
+                # the FORWARD diff side is chosen by RESOLVED counts,
+                # the REVERSE side independently by TOTAL counts (see
+                # the dense arm's comment); diff members carry lhs OR
+                # rhs labels
+                use_l_at_lhs = jnp.take(
+                    use_lhs_diff, jnp.where(lhs_here, lhs_sel, 0)
+                )
+                use_l_at_rhs = jnp.take(
+                    use_lhs_diff, jnp.where(rhs_here, rhs_sel, 0)
+                )
+                diff_l = valid_l & ~m_lhs & use_l_at_lhs
+                diff_r = valid_r & ~m_rhs & ~use_l_at_rhs
+                set_org = jnp.concatenate(
+                    [jnp.where(diff_l, lhs_sel, 0),
+                     jnp.where(diff_r, rhs_sel, 0)]
+                )
+                set_sid = jnp.concatenate([sid, sid])
+                set_mask = jnp.concatenate([diff_l, diff_r])
+                in_diff_l = _in_set_sorted(
+                    d.n, lhs_sel, sid, valid_l, set_org, set_sid, set_mask
+                )
+                in_diff_r = _in_set_sorted(
+                    d.n, rhs_sel, sid, valid_r, set_org, set_sid, set_mask
+                )
+                rdiff_a = _segment_count(d, lhs_sel, lhs_here & ~in_diff_l)
+                rdiff_b = _segment_count(d, rhs_sel, rhs_here & ~in_diff_r)
+            rdiff_cnt = jnp.where(use_rhs_rdiff, rdiff_b, rdiff_a)
+            q_success = jnp.where(q_success, False, rdiff_cnt == 0)
+        return q_success
+
+    # In: contained_in per lhs value (operators.rs:256-321). Set
+    # sources by lhs shape: any-kind lhs matches rhs values by sid and
+    # scalar/map lhs additionally match INSIDE rhs lists; list lhs
+    # match list RHS values only in membership mode (first element is
+    # itself a list). Subset-mode list-list pairs flag unsure.
+    is_list = d.node_kind == LIST
+    first_is_list = _count_children(d, (d.node_index == 0) & is_list) > 0
+    membership_mode = first_is_list & (d.child_count > 0)
+    # children of rhs-selected lists carry the parent's origin key
+    pr_org = jnp.take(r_org, jnp.maximum(d.node_parent, 0))
+    p_rhs_list = (
+        jnp.take((rhs_here & is_list).astype(jnp.int32),
+                 jnp.maximum(d.node_parent, 0)) > 0
+    ) & (d.node_parent >= 0)
+    p_memb = (
+        jnp.take((rhs_here & is_list & membership_mode).astype(jnp.int32),
+                 jnp.maximum(d.node_parent, 0)) > 0
+    ) & (d.node_parent >= 0)
+    child_valid = p_rhs_list & (sid >= 0)
+    child_memb_valid = p_memb & (sid >= 0)
+    # non-list lhs: rhs values (eq) ∪ children of rhs lists
+    s_org_nl = jnp.concatenate([r_org, pr_org])
+    s_sid_nl = jnp.concatenate([sid, sid])
+    s_mask_nl = jnp.concatenate([valid_r, child_valid])
+    m_nonlist = _in_set_sorted(
+        d.n, l_org, sid, valid_l & ~is_list, s_org_nl, s_sid_nl, s_mask_nl
+    )
+    # list lhs: non-list rhs values (eq) ∪ children of membership-mode
+    # rhs lists
+    s_org_l = jnp.concatenate([r_org, pr_org])
+    s_sid_l = jnp.concatenate([sid, sid])
+    s_mask_l = jnp.concatenate([valid_r & ~is_list, child_memb_valid])
+    m_list = _in_set_sorted(
+        d.n, l_org, sid, valid_l & is_list, s_org_l, s_sid_l, s_mask_l
+    )
+    m_lhs = jnp.where(is_list, m_list, m_nonlist)
+    # subset-mode pairs (list lhs vs non-membership list rhs) are per
+    # PAIR: route the document to the oracle when one can exist
+    a = _segment_count(d, lhs_sel, lhs_here & is_list)
+    b_mask = rhs_here & is_list & ~membership_mode
+    if shared:
+        b_any = jnp.sum(b_mask, dtype=jnp.int32) > 0
+        subset_possible = jnp.any((a > 0) & b_any)
+    else:
+        b = _segment_count(d, rhs_sel, b_mask)
+        subset_possible = jnp.any((a > 0) & (b > 0))
+    d.unsure_acc.append(subset_possible)
+
+    cnt_lhs_not_in = _segment_count(d, lhs_sel, lhs_here & ~m_lhs)
+    q_success = cnt_lhs_not_in == 0
+    if c.op_not:
+        diff_lhs = valid_l & ~m_lhs
+        in_diff = _in_set_sorted(
+            d.n, lhs_sel, sid, valid_l, lhs_sel, sid, diff_lhs
+        )
+        rdiff_cnt = _segment_count(d, lhs_sel, lhs_here & ~in_diff)
+        q_success = jnp.where(q_success, False, rdiff_cnt == 0)
+    return q_success
 
 
 def eval_clause(d: _DocArrays, c: CClause, sel, rule_statuses=None,
@@ -1439,12 +1873,21 @@ def build_doc_evaluator(compiled: CompiledRules, with_unsure: bool = False,
     one-hot's N^2 lane count is quadratic in bucket size while the
     walk only ever touches N parent edges) — and gather at EVERY
     bucket on CPU backends (GATHER_ALWAYS_ON_CPU). `platform` is the
-    target backend when known (mesh evaluators)."""
+    target backend when known (mesh evaluators). Rule files with
+    pairwise constructions (query-RHS compares, key interpolation)
+    FORCE gather mode above 8,192 nodes regardless of the tuned
+    threshold: their one-hot arm builds (N, N) matrices, which only
+    the sorted-set gather formulations keep feasible at the extended
+    buckets."""
     empty_slot = compiled.str_empty_slot
+    force_gather_over = 8192 if compiled.needs_pairwise else None
 
     def evaluate(arrays: Dict[str, jnp.ndarray], lits: jnp.ndarray):
         n = arrays["node_kind"].shape[-1]
-        d = _DocArrays(arrays, gather_mode=_use_gather(n, platform))
+        gather = _use_gather(n, platform) or (
+            force_gather_over is not None and n > force_gather_over
+        )
+        d = _DocArrays(arrays, gather_mode=gather)
         d.lits = lits
         d.empty_slot = empty_slot
         d.rule_unsure = []

@@ -282,21 +282,18 @@ def evaluate_bucketed(evaluator, n_rules: int, batch: DocBatch):
     everyone to the largest document wastes quadratic work in the
     one-hot buckets); documents beyond the active ceiling are left
     SKIP-filled and returned in `host_docs` for CPU-oracle evaluation.
-    Rule files without pairwise (N, N) matrices use the extended
-    buckets — documents up to 64k nodes stay on device."""
+    EVERY rule file uses the extended buckets (documents up to 64k
+    nodes stay on device): pairwise constructions — query-RHS compares
+    and variable key interpolation — evaluate through the O(N log N)
+    sorted-set formulations in gather mode (kernels._in_set_sorted and
+    friends), so no (N, N) matrix exists at the big buckets."""
     from ..ops.encoder import (
-        NODE_BUCKETS,
         NODE_BUCKETS_EXTENDED,
         split_batch_by_size,
     )
     from ..ops.ir import SKIP
 
-    compiled = getattr(evaluator, "compiled", None)
-    buckets = (
-        NODE_BUCKETS
-        if compiled is None or compiled.needs_pairwise
-        else NODE_BUCKETS_EXTENDED
-    )
+    buckets = NODE_BUCKETS_EXTENDED
     groups, oversize = split_batch_by_size(batch, buckets)
     statuses = np.full((batch.n_docs, n_rules), SKIP, np.int8)
     unsure = np.zeros((batch.n_docs, n_rules), bool)

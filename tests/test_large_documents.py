@@ -109,3 +109,75 @@ def test_beyond_last_bucket_routes_to_oracle():
     assert (batch.node_kind[0] >= 0).sum() > NODE_BUCKETS[-1]
     groups, oversize = split_batch_by_size(batch)
     assert set(int(i) for i in oversize) == {0} and not groups
+
+
+PAIRWISE_RULES = """
+let names = Settings.*
+
+rule q_rhs when resource_changes exists {
+    some resource_changes[*].change.after.tags.env ==
+        resource_changes[*].change.after.acl
+}
+
+rule q_in when resource_changes exists {
+    resource_changes[*].change.after.tags.env IN
+        resource_changes[*].change.after.allowed
+}
+
+rule interp when Settings exists {
+    Top.%names exists
+}
+
+rule ordering when resource_changes exists {
+    some resource_changes[*].change.after.rank <
+        resource_changes[*].change.after.cap
+}
+"""
+
+
+def test_33k_node_documents_with_pairwise_rules_stay_on_device():
+    """VERDICT r4 item 4: query-RHS compares, IN containment, key
+    interpolation and ordering against a query RHS all evaluate ON
+    DEVICE for documents far beyond the old 8,192-node pairwise
+    ceiling — the gather-mode sorted-set formulations never build an
+    (N, N) matrix."""
+    from guard_tpu.parallel.mesh import ShardedBatchEvaluator
+
+    rng = np.random.default_rng(13)
+
+    def plan(n_changes):
+        p = _big_plan(rng, n_changes, 6)
+        for j, ch in enumerate(p["resource_changes"]):
+            after = ch["change"]["after"]
+            after["allowed"] = ["private", f"x{j % 7}"]
+            after["rank"] = int(rng.integers(0, 50))
+            after["cap"] = int(rng.integers(0, 50))
+        p["Settings"] = {"s1": "alpha", "s2": "beta"}
+        p["Top"] = {"alpha": 1} if n_changes % 2 else {"gamma": 1}
+        return p
+
+    # ~48 nodes per change: 640 -> ~31k nodes (32768 bucket)
+    docs_plain = [plan(640), plan(25)]
+    docs = [from_plain(p) for p in docs_plain]
+    batch, interner = encode_batch(docs)
+    n_real = (batch.node_kind >= 0).sum(axis=1)
+    assert n_real[0] > 16384, int(n_real[0])
+
+    rf = parse_rules_file(PAIRWISE_RULES, "pairwise.guard")
+    compiled = compile_rules_file(rf, interner)
+    assert not compiled.host_rules
+    assert compiled.needs_pairwise
+    ev = ShardedBatchEvaluator(compiled)
+    statuses, unsure, host_docs = ev.evaluate_bucketed(batch)
+    assert not host_docs, "33k-node doc must stay on device"
+
+    # the subset-mode escape hatch must not swallow anything here:
+    # these shapes carry no list-vs-list IN pairs, so EVERY (doc,
+    # rule) decides on device — the feature this test pins
+    assert unsure.sum() == 0, unsure
+    for di, doc in enumerate(docs):
+        oracle = _oracle(rf, doc)
+        for ri, crule in enumerate(compiled.rules):
+            assert STATUS[int(statuses[di, ri])] == oracle[crule.name], (
+                f"doc {di} rule {crule.name}"
+            )

@@ -109,7 +109,13 @@ _WORKER = textwrap.dedent(
 def test_two_process_dcn_mesh_parity(tmp_path):
     worker = tmp_path / "worker.py"
     worker.write_text(_WORKER)
-    port = "9417"
+    # ephemeral port: a fixed one collides with concurrent runs or a
+    # leftover worker from a timed-out previous run
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     procs = [
