@@ -41,9 +41,12 @@ import numpy as np
 # from fused one-hot masked reductions (O(N^2) lanes, fastest for small
 # docs where the compare fuses into the consuming reduction) to XLA
 # gather / segment-sum (O(N) work, the only formulation whose cost
-# scales linearly with document size). Overridable for bake-off probes
-# (tools/tune_gather.py).
-GATHER_MIN_NODES = int(os.environ.get("GUARD_TPU_GATHER_MIN_NODES", "4096"))
+# scales linearly with document size). Default from the round-5
+# on-chip bake-off (tools/tune_gather.py on v5e, 2026-07-31): one-hot
+# won every bucket through 8,192 (941 vs 696 docs/s there); gather
+# first won at 16,384 (349 vs 224 docs/s). Overridable for bake-off
+# probes.
+GATHER_MIN_NODES = int(os.environ.get("GUARD_TPU_GATHER_MIN_NODES", "16384"))
 
 # on CPU backends real gathers are cheap and the one-hot's N^2 lanes
 # are not (tools/tune_gather.py measured gather 6-33x faster even at
@@ -951,8 +954,14 @@ def _seg_min_max_keys(seg, mask, hi, lo, num_segments):
     """Per-segment exact (hi, lo)-key minimum and maximum over masked
     entries: ((min_hi, min_lo), (max_hi, max_lo)), int32 each. Empty
     segments read extreme sentinels (callers gate on counts)."""
+    # sentinels must not OUTRANK legitimate keys: lo lanes span the
+    # full int32 range (encoder.num_key maps the integer 0 to
+    # lo = -2**31), so SMALL is exactly INT32_MIN — an excluded entry
+    # then ties a legitimate minimum-lo value instead of beating it,
+    # which leaves segment_max results correct (same argument for BIG
+    # on the min side)
     BIG = jnp.int32(2**31 - 1)
-    SMALL = jnp.int32(-(2**31) + 1)
+    SMALL = jnp.int32(-(2**31))
     seg_c = jnp.where(mask, seg, num_segments - 1)
     min_hi = jax.ops.segment_min(
         jnp.where(mask, hi, BIG), seg_c, num_segments=num_segments
