@@ -147,10 +147,18 @@ class EncodedDoc:
     # document contains a number with no exact device encoding (NaN or
     # an int outside i64): must be evaluated by the CPU oracle
     num_exotic: bool = False
-    # (slot, node index) of each precomputed function-result ROOT
-    # (ops/fnvars.py): orphan subtrees appended after the document,
-    # tagged post-batch with the reserved fn_key_id(slot)
+    # (slot, root node index, origin node index) of each precomputed
+    # function-result ROOT (ops/fnvars.py): orphan subtrees appended
+    # after the document, tagged post-batch with the reserved
+    # fn_key_id(slot). origin = -1 for shared (root-basis) slots;
+    # per-origin slots ('pexpr') carry the candidate node the result
+    # belongs to (the fn_origin column the kernels select by)
     fn_roots: list = field(default_factory=list)
+    # a per-origin result's origin path did not map back to a node —
+    # cannot happen for origins enumerated from this same tree, but if
+    # it ever does the document must route to the CPU oracle rather
+    # than silently losing its RHS
+    fn_origin_miss: bool = False
 
 
 def encode_document(
@@ -167,6 +175,14 @@ def encode_document(
     e_key: List[int] = []
     e_index: List[int] = []
     exotic = [False]
+    # origin-path -> node index, only built when a per-origin function
+    # result needs mapping back to its candidate node
+    # record paths during the MAIN doc visit only (result subtrees
+    # carry fabricated paths that must not shadow document nodes)
+    want_paths = [
+        any(len(fr) > 2 and fr[2] is not None for fr in fn_results or [])
+    ]
+    path_idx: dict = {}
 
     def push_num(kind: int, v) -> None:
         key = num_key(kind, v)
@@ -178,6 +194,8 @@ def encode_document(
 
     def visit(pv: PV, parent: int) -> int:
         idx = len(kinds)
+        if want_paths[0]:
+            path_idx[pv.path.s] = idx
         kinds.append(pv.kind)
         parents.append(parent)
         k = pv.kind
@@ -236,11 +254,23 @@ def encode_document(
     # precomputed function results: orphan subtrees (parent -1 -> no
     # traversal step ever reaches them; internal edges are real so
     # walks INTO the results work normally)
+    want_paths[0] = False
     fn_roots = []
-    for slot, pv in fn_results or []:
-        fn_roots.append((slot, visit(pv, -1)))
+    origin_miss = False
+    for fr in fn_results or []:
+        slot, pv = fr[0], fr[1]
+        opath = fr[2] if len(fr) > 2 else None
+        if opath is None:
+            origin = -1
+        else:
+            origin = path_idx.get(opath, -2)
+            if origin == -2:
+                origin_miss = True
+                continue
+        fn_roots.append((slot, visit(pv, -1), origin))
     return EncodedDoc(
         fn_roots=fn_roots,
+        fn_origin_miss=origin_miss,
         node_kind=np.array(kinds, dtype=np.int32),
         node_parent=np.array(parents, dtype=np.int32),
         scalar_id=np.array(scalar_ids, dtype=np.int32),
@@ -302,6 +332,12 @@ class DocBatch:
     # beyond-i64 int); such docs route to the CPU oracle like oversize
     # ones (split_batch_by_size) so the device never decides them
     num_exotic: np.ndarray = None
+    # (D, N) int32, only when the batch carries per-origin function
+    # results (ops/fnvars.py 'pexpr' slots): the candidate node index a
+    # result root belongs to, -1 everywhere else. None when no
+    # per-origin slot exists — the column ships to the device only for
+    # rule files that read it (ir.CompiledRules.needs_fn_origin)
+    fn_origin: np.ndarray = None
 
     def __post_init__(self):
         if self.num_exotic is None:
@@ -340,6 +376,8 @@ class DocBatch:
             "node_index": self.node_index,
             "node_parent_kind": self.node_parent_kind,
         }
+        if self.fn_origin is not None:
+            out["fn_origin"] = self.fn_origin
         if include_struct:
             out["struct_id"] = self.struct_ids()
         return out
@@ -601,12 +639,14 @@ def _round_up(n: int, multiple: int = 8) -> int:
 # below kernels.GATHER_MIN_NODES where the compare fuses into the
 # consuming reduction); buckets at and above that threshold trace the
 # O(N) gather/segment-sum formulation instead, so the per-doc cost
-# stays proportional to document size. Rule files that build pairwise
-# (N, N) matrices (query-RHS compares, variable key interpolation —
-# CompiledRules.needs_pairwise) stop at the standard ceiling; all other
-# rule files evaluate documents up to 64k nodes on device via the
-# extended buckets, and only documents beyond the active ceiling route
-# to the CPU oracle (ops/backend.py)
+# stays proportional to document size. EVERY rule file uses the
+# extended buckets (documents up to 64k nodes stay on device): as of
+# round 5 the pairwise constructions (query-RHS compares, variable key
+# interpolation — CompiledRules.needs_pairwise) evaluate through
+# O(N log N) sorted-set joins in gather mode, which needs_pairwise
+# forces above 8,192 nodes, so no (N, N) matrix exists at the big
+# buckets. Only documents beyond the last bucket route to the CPU
+# oracle (ops/backend.py)
 NODE_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096, 8192)
 NODE_BUCKETS_EXTENDED = NODE_BUCKETS + (16384, 32768, 65536)
 
@@ -657,6 +697,11 @@ def split_batch_by_size(
             node_index=batch.node_index[idx, :m_nodes],
             node_parent_kind=batch.node_parent_kind[idx, :m_nodes],
             num_exotic=batch.num_exotic[idx],
+            fn_origin=(
+                batch.fn_origin[idx, :m_nodes]
+                if batch.fn_origin is not None
+                else None
+            ),
         )
         groups.append((sub, idx))
     return groups, oversize
@@ -677,15 +722,23 @@ def encode_batch(docs: List[PV], interner: Optional[Interner] = None,
     fn_key_id(slot) in the derived node_key_id column.
     """
     interner = interner if interner is not None else Interner()
+    any_per_origin = False
     if fn_values is not None and fn_var_order:
         encoded = []
         for i, d in enumerate(docs):
             per = fn_values[i]
-            flat = [
-                (slot, pv)
-                for slot, var in enumerate(fn_var_order)
-                for pv in per.get(var, [])
-            ]
+            flat = []
+            for slot, var in enumerate(fn_var_order):
+                vals = per.get(var, [])
+                if isinstance(vals, dict):
+                    # per-origin slot ('pexpr'): {origin path: [PV]}
+                    any_per_origin = True
+                    for opath, pvs in vals.items():
+                        for pv in pvs:
+                            flat.append((slot, pv, opath))
+                else:
+                    for pv in vals:
+                        flat.append((slot, pv, None))
             encoded.append(encode_document(d, interner, fn_results=flat))
     else:
         encoded = [encode_document(d, interner) for d in docs]
@@ -738,7 +791,13 @@ def encode_batch(docs: List[PV], interner: Optional[Interner] = None,
     # struct-id child grouping and parent-kind derivation
     from .fnvars import fn_key_id
 
+    if any_per_origin:
+        batch.fn_origin = np.full((d, n), -1, dtype=np.int32)
     for i, enc in enumerate(encoded):
-        for slot, idx in enc.fn_roots:
+        for slot, idx, origin in enc.fn_roots:
             batch.node_key_id[i, idx] = fn_key_id(slot)
+            if origin >= 0:
+                batch.fn_origin[i, idx] = origin
+        if enc.fn_origin_miss:
+            batch.num_exotic[i] = True
     return batch, interner

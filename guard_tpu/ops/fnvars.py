@@ -232,14 +232,20 @@ def _head_var_names(rf: RulesFile) -> Set[str]:
 @dataclass
 class _Slot:
     key: tuple  # opaque encode-order key
-    kind: str  # 'fn' | 'lit' | 'expr'
+    kind: str  # 'fn' | 'lit' | 'expr' | 'pexpr'
     rule_idx: int  # -1 = file scope
     var: str = ""  # fn/lit
     pv: object = None  # lit
-    fx: object = None  # expr (FunctionExpr)
+    fx: object = None  # expr/pexpr (FunctionExpr)
     # enclosing-Block chain (rule body + nested when-blocks) the
     # precompute folds into a scope stack; empty = file/rule scope
     chain: tuple = ()
+    # 'pexpr' only: the value-scope path from the root-basis chain down
+    # to the clause — ('block', BlockGuardClause) / ('type', TypeBlock)
+    # / ('when', WhenBlockClause) entries the precompute replays to
+    # enumerate candidate origins exactly like the oracle
+    # (evaluator.eval_guard_block_clause / eval_type_block_clause)
+    vs_path: tuple = ()
 
 
 @dataclass
@@ -256,6 +262,11 @@ class FnSlots:
     lit_slots: Dict[Tuple[int, str], int]  # literal lets used as heads
     expr_slots: Dict[int, int]  # id(FunctionExpr) -> slot (inline uses)
     pv_slots: Dict[int, int]  # id(PV) -> slot (literal call arguments)
+    # id(FunctionExpr) -> slot for origin-DEPENDENT inline calls in
+    # value scopes: precomputed once per (document, candidate origin),
+    # selected per origin label by the kernels (ir.StepFnVar
+    # per_origin)
+    pexpr_slots: Dict[int, int] = None
 
     @property
     def keys(self) -> List[tuple]:
@@ -281,6 +292,7 @@ def fn_slots(rf: RulesFile) -> FnSlots:
     lit_slots: Dict[Tuple[int, str], int] = {}
     expr_slots: Dict[int, int] = {}
     pv_slots: Dict[int, int] = {}
+    pexpr_slots: Dict[int, int] = {}
 
     def add(slot: _Slot) -> int:
         slots.append(slot)
@@ -387,10 +399,38 @@ def fn_slots(rf: RulesFile) -> FnSlots:
                 names.update(let.var for let in b.assignments)
             return names
 
-        def on_expr(fx, chain, in_vs, vs_bound, ri=ri):
-            if id(fx) in expr_slots or not usable_expr(fx):
+        def on_expr(fx, chain, in_vs, vs_bound, vs_path=(),
+                    lhs_root=False, ri=ri):
+            if (
+                id(fx) in expr_slots
+                or id(fx) in pexpr_slots
+                or not usable_expr(fx)
+            ):
                 return
             if in_vs and not _root_safe(fx, bound_names(chain), vs_bound):
+                # origin-DEPENDENT inline call: the result genuinely
+                # differs per candidate, so it precomputes per origin
+                # (kind 'pexpr') — the encoder tags each result subtree
+                # with its origin node and the kernels select per
+                # origin label (ir.StepFnVar per_origin). Only scope
+                # chains made of block / type-block / when-block
+                # entries enumerate origins exactly; calls inside
+                # query FILTERS stay host-side (mid-query candidate
+                # sets are not re-derivable here). A clause whose LHS
+                # evaluates from the ROOT basis (head variable bound on
+                # the root chain -> ir raises CrossScopeRootVar and
+                # then refuses the per-origin RHS) gets no slot either:
+                # the lowering could never consume it, so precomputing
+                # and encoding its results would be pure waste.
+                if lhs_root or any(e[0] == "filter" for e in vs_path):
+                    return
+                pexpr_slots[id(fx)] = add(
+                    _Slot(
+                        key=("pexpr", ri, len(pexpr_slots)), kind="pexpr",
+                        rule_idx=ri, fx=fx, chain=tuple(chain),
+                        vs_path=tuple(vs_path),
+                    )
+                )
                 return
             expr_slots[id(fx)] = add(
                 _Slot(
@@ -399,21 +439,36 @@ def fn_slots(rf: RulesFile) -> FnSlots:
                 )
             )
 
-        def walk_parts(parts, chain, vs_bound, ri=ri):
+        def walk_parts(parts, chain, vs_bound, vs_path=(), ri=ri):
             for part in parts:
                 if isinstance(part, QFilter):
                     for disj in part.conjunctions:
                         for cc in disj:
-                            walk_clause(cc, chain, True, vs_bound)
+                            walk_clause(
+                                cc, chain, True, vs_bound,
+                                vs_path + (("filter", part),),
+                            )
 
-        def walk_clause(c, chain, in_vs, vs_bound, ri=ri):
+        def walk_clause(c, chain, in_vs, vs_bound, vs_path=(), ri=ri):
             if isinstance(c, GuardAccessClause):
                 cw = c.access_clause.compare_with
                 if isinstance(cw, FunctionExpr):
-                    on_expr(cw, chain, in_vs, vs_bound)
-                walk_parts(c.access_clause.query.query, chain, vs_bound)
+                    # mirror of ir's CrossScopeRootVar: a head variable
+                    # bound on the root chain (and not shadowed in the
+                    # value scope) re-roots the LHS at the document
+                    # root, which the per-origin RHS then refuses
+                    parts = c.access_clause.query.query
+                    lhs_root = bool(
+                        in_vs
+                        and parts
+                        and part_is_variable(parts[0])
+                        and part_variable(parts[0]) not in vs_bound
+                        and part_variable(parts[0]) in bound_names(chain)
+                    )
+                    on_expr(cw, chain, in_vs, vs_bound, vs_path, lhs_root)
+                walk_parts(c.access_clause.query.query, chain, vs_bound, vs_path)
                 if isinstance(cw, AccessQuery):
-                    walk_parts(cw.query, chain, vs_bound)
+                    walk_parts(cw.query, chain, vs_bound, vs_path)
             elif isinstance(c, ParameterizedNamedRuleClause):
                 for p in c.parameters:
                     if isinstance(p, FunctionExpr):
@@ -436,18 +491,19 @@ def fn_slots(rf: RulesFile) -> FnSlots:
                                 )
                             )
                     elif isinstance(p, AccessQuery):
-                        walk_parts(p.query, chain, vs_bound)
+                        walk_parts(p.query, chain, vs_bound, vs_path)
             elif isinstance(c, WhenBlockClause):
                 for disj in c.conditions or []:
                     for cc in disj:
-                        walk_clause(cc, chain, in_vs, vs_bound)
+                        walk_clause(cc, chain, in_vs, vs_bound, vs_path)
                 if in_vs:
                     vb = vs_bound | {
                         let.var for let in c.block.assignments
                     }
+                    vp = vs_path + (("when", c),)
                     for disj in c.block.conjunctions:
                         for cc in disj:
-                            walk_clause(cc, chain, True, vb)
+                            walk_clause(cc, chain, True, vb, vp)
                 else:
                     ch = chain + (c.block,)
                     for disj in c.block.conjunctions:
@@ -455,16 +511,18 @@ def fn_slots(rf: RulesFile) -> FnSlots:
                             walk_clause(cc, ch, False, vs_bound)
             elif isinstance(c, (BlockGuardClause, TypeBlock)):
                 if isinstance(c, BlockGuardClause):
-                    walk_parts(c.query.query, chain, vs_bound)
+                    walk_parts(c.query.query, chain, vs_bound, vs_path)
+                    vp = vs_path + (("block", c),)
                 else:
-                    walk_parts(c.query, chain, vs_bound)
+                    walk_parts(c.query, chain, vs_bound, vs_path)
                     for disj in c.conditions or []:
                         for cc in disj:
-                            walk_clause(cc, chain, in_vs, vs_bound)
+                            walk_clause(cc, chain, in_vs, vs_bound, vs_path)
+                    vp = vs_path + (("type", c),)
                 vb = vs_bound | {let.var for let in c.block.assignments}
                 for disj in c.block.conjunctions:
                     for cc in disj:
-                        walk_clause(cc, chain, True, vb)
+                        walk_clause(cc, chain, True, vb, vp)
 
         base_chain = (rule.block,)
         for disj in rule.conditions or []:
@@ -477,6 +535,7 @@ def fn_slots(rf: RulesFile) -> FnSlots:
     return FnSlots(
         slots=slots, var_slots=var_slots, lit_slots=lit_slots,
         expr_slots=expr_slots, pv_slots=pv_slots,
+        pexpr_slots=pexpr_slots,
     )
 
 
@@ -506,12 +565,93 @@ def precompute_fn_values(
     errors: Set[int] = set()
     if not layout.slots:
         return keys, [{} for _ in docs], errors
-    from ..core.scopes import BlockScope, RootScope, resolve_function  # lazy
+    from ..core.scopes import (  # lazy
+        BlockScope,
+        RootScope,
+        ValueScope,
+        resolve_function,
+    )
+
+    def _pexpr_scopes(slot, base_scope, cache):
+        """[(origin PV, resolver)] replaying the slot's value-scope
+        path with the SAME scope shapes the oracle builds: each
+        block/type-block level resolves its query in the current scope
+        and wraps every RESOLVED value in ValueScope + BlockScope
+        (evaluator.eval_guard_block_clause:1126 /
+        eval_type_block_clause:1424 -> eval_general_block_clause:1071);
+        when-blocks keep the origin and add their lets. Origins are
+        reached by strictly-descending traversal, so each innermost
+        origin has exactly one scope chain. `cache` memoizes the pairs
+        per (base scope, vs_path) within one document: k calls in the
+        same block replay its queries and when-gates once, not k
+        times."""
+        ckey = (id(base_scope),) + tuple(id(n) for _k, n in slot.vs_path)
+        hit = cache.get(ckey)
+        if hit is not None:
+            return hit
+        from ..core.evaluator import (  # lazy (cycle via scopes)
+            eval_conjunction_clauses,
+            eval_when_clause,
+        )
+        from ..core.qresult import Status
+
+        def when_passes(conditions, sc) -> bool:
+            """eval.rs:1428-1502 gate: only PASSing conditions enter
+            the block — origins behind a false/skipped guard are NOT
+            precomputed, so a guard protecting a call from bad input
+            (`when Limit == /^[0-9]+$/ { ... parse_int(Limit) ... }`)
+            keeps its documents on the device path instead of flagging
+            spurious fn errors. A RAISE during condition evaluation
+            propagates: the caller flags the doc and the oracle
+            reproduces the error."""
+            if not conditions:
+                return True
+            return (
+                eval_conjunction_clauses(
+                    conditions, sc, eval_when_clause,
+                    context=(
+                        "cfn_guard::rules::exprs::WhenGuardClause"
+                        "#disjunction"
+                    ),
+                )
+                == Status.PASS
+            )
+
+        pairs = [(None, base_scope)]
+        for kind, node in slot.vs_path:
+            if kind == "when":
+                pairs = [
+                    (o, BlockScope(node.block, sc.root(), sc))
+                    for o, sc in pairs
+                    if when_passes(node.conditions, sc)
+                ]
+                continue
+            q = node.query.query if kind == "block" else node.query
+            new = []
+            for _o, sc in pairs:
+                if kind == "type" and not when_passes(
+                    getattr(node, "conditions", None), sc
+                ):
+                    # type-block conditions gate at the OUTER scope
+                    # (eval_type_block_clause) — a non-PASS gate means
+                    # no origins at all
+                    continue
+                for qr in sc.query(q):
+                    if qr.tag != RESOLVED:
+                        continue
+                    vs = ValueScope(qr.value, sc)
+                    new.append(
+                        (qr.value, BlockScope(node.block, vs.root(), vs))
+                    )
+            pairs = new
+        cache[ckey] = pairs
+        return pairs
 
     for i, doc in enumerate(docs):
         per: Dict[tuple, List[PV]] = {}
         root = RootScope(rf, doc)
         chain_scopes: Dict[tuple, BlockScope] = {}
+        pexpr_cache: Dict[tuple, list] = {}
 
         def scope_for(chain):
             """Fold the slot's enclosing-Block chain (rule body +
@@ -538,6 +678,26 @@ def precompute_fn_values(
                         )
                         if q.tag == RESOLVED
                     ]
+                elif slot.kind == "pexpr":
+                    # origin-dependent inline call: one result list per
+                    # candidate origin, keyed by the origin node's path
+                    # (unique per node; the encoder maps it back to the
+                    # node index for the fn_origin column)
+                    per_origin: Dict[str, List[PV]] = {}
+                    for origin, sc in _pexpr_scopes(
+                        slot, scope_for(slot.chain), pexpr_cache
+                    ):
+                        opath = origin.path.s
+                        if opath in per_origin:
+                            continue
+                        per_origin[opath] = [
+                            q.value
+                            for q in resolve_function(
+                                slot.fx.name, slot.fx.parameters, sc
+                            )
+                            if q.tag == RESOLVED
+                        ]
+                    per[slot.key] = per_origin
                 else:  # inline expression
                     per[slot.key] = [
                         q.value

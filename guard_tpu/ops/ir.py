@@ -100,9 +100,12 @@ HOST_ONLY_CONSTRUCTS = {
         "parse_char produces CHAR nodes, which documents otherwise "
         "never contain"
     ),
-    "per_origin_inline_call": (
-        "inline function call in a value scope whose query argument "
-        "resolves per candidate origin"
+    "per_origin_inline_call_in_filter": (
+        "inline function call inside a query FILTER whose query "
+        "argument resolves per candidate — filter candidates are "
+        "mid-query selections the per-origin precompute cannot "
+        "replay (block/type-block/when-block scopes DO lower via "
+        "per-origin precompute as of round 5, fnvars 'pexpr' slots)"
     ),
     "cross_scope_value_var": (
         "a variable bound in a non-root value scope used in another "
@@ -274,12 +277,18 @@ class StepKeyChain:
 class StepFnVar:
     """Select the precomputed result roots of a function variable
     (ops/fnvars.py): orphan nodes tagged with the reserved negative
-    key id. Only reachable from the root basis (function lets bind at
-    the root scope), so the selection carries origin label 1. Function
+    key id. Shared slots are reachable only from the root basis
+    (function lets bind at the root scope), so the selection carries
+    origin label 1. `per_origin` slots ('pexpr' — inline calls whose
+    query arguments resolve per candidate) select instead the result
+    roots whose fn_origin column matches a currently-selected origin,
+    relabelled with that origin's label — the per-origin query-RHS
+    compare arms then join LHS and RHS per origin exactly. Function
     variables never hold UnResolved entries (scopes.resolve_function
     drops None results), so no UnResolved accounting applies."""
 
     key_id: int
+    per_origin: bool = False
 
 
 Step = Union[
@@ -473,6 +482,9 @@ class CompiledRules:
     # order between arbitrary document strings: a per-node rank column
     # over the lexicographically sorted intern table
     needs_str_rank: bool = False
+    # any lowered rule reads a PER-ORIGIN function variable (StepFnVar
+    # per_origin): device_arrays must ship the batch's fn_origin column
+    needs_fn_origin: bool = False
     # any rule uses pairwise constructions (query-RHS compares,
     # variable key interpolation). They no longer cap the bucket size:
     # gather mode evaluates them through O(N log N) sorted-set joins
@@ -518,6 +530,12 @@ class CompiledRules:
             "node_index": batch.node_index,
             "node_parent_kind": batch.node_parent_kind,
         }
+        if self.needs_fn_origin:
+            out["fn_origin"] = (
+                batch.fn_origin
+                if batch.fn_origin is not None
+                else np.full_like(batch.node_kind, -1)
+            )
         if self.needs_struct_ids:
             out["struct_id"] = batch.struct_ids()
         if self.struct_literals:
@@ -751,6 +769,7 @@ class _RuleLowering:
         self.needs_struct_ids = False
         self.needs_unsure = False
         self.needs_str_rank = False
+        self.needs_fn_origin = False
         self.struct_literals: List[PV] = []
 
     def _push_scope(self):
@@ -1387,13 +1406,52 @@ class _RuleLowering:
                 # call (resolved in the clause's scope,
                 # eval_guard_access_clause -> resolve_function)
                 if isinstance(ac.compare_with, FunctionExpr):
+                    from .fnvars import fn_key_id
+
                     slot = self.fn_layout.expr_slots.get(
                         id(ac.compare_with)
                     )
                     if slot is None:
-                        raise
-                    from .fnvars import fn_key_id
-
+                        # origin-dependent inline call: per-origin
+                        # precomputed results ('pexpr',
+                        # fnvars._pexpr_scopes) joined per origin by
+                        # the non-shared query-RHS arms
+                        pslot = self.fn_layout.pexpr_slots.get(
+                            id(ac.compare_with)
+                        )
+                        if pslot is None:
+                            raise
+                        if eval_from_root:
+                            # LHS broadcasts from the root while the
+                            # RHS differs per origin — labels cannot
+                            # join (same refusal as the query analogue)
+                            raise Unlowerable(
+                                "root-based LHS with per-origin fn RHS"
+                            )
+                        self.needs_fn_origin = True
+                        if ac.comparator in (
+                            CmpOperator.Eq, CmpOperator.In,
+                        ):
+                            self.needs_struct_ids = True
+                        else:
+                            self.needs_str_rank = True
+                        return CClause(
+                            steps=steps,
+                            op=ac.comparator,
+                            op_not=ac.comparator_inverse,
+                            negation=gac.negation,
+                            match_all=ac.query.match_all,
+                            rhs=None,
+                            empty_on_expr=empty_on_expr,
+                            rhs_query_steps=[
+                                StepFnVar(
+                                    key_id=fn_key_id(pslot),
+                                    per_origin=True,
+                                )
+                            ],
+                            eval_from_root=False,
+                            rhs_query_from_root=False,
+                        )
                     rhs_query_steps = [StepFnVar(key_id=fn_key_id(slot))]
                     rhs_root_basis = True
                     if not eval_from_root:
@@ -1641,10 +1699,12 @@ def compile_rules_file(rules_file: RulesFile, interner: Interner) -> CompiledRul
     needs_struct = False
     needs_unsure = False
     needs_rank = False
+    needs_fn_origin = False
     for rule_idx, rule in enumerate(rules_file.guard_rules):
         lowering.needs_struct_ids = False
         lowering.needs_unsure = False
         lowering.needs_str_rank = False
+        lowering.needs_fn_origin = False
         lowering._cur_rule_idx = rule_idx
         mark = len(lowering.struct_literals)
         try:
@@ -1660,6 +1720,7 @@ def compile_rules_file(rules_file: RulesFile, interner: Interner) -> CompiledRul
         needs_struct = needs_struct or lowering.needs_struct_ids
         needs_unsure = needs_unsure or lowering.needs_unsure
         needs_rank = needs_rank or lowering.needs_str_rank
+        needs_fn_origin = needs_fn_origin or lowering.needs_fn_origin
     str_empty_bits = np.array(
         [len(s) == 0 for s in interner.strings], dtype=bool
     )
@@ -1672,6 +1733,7 @@ def compile_rules_file(rules_file: RulesFile, interner: Interner) -> CompiledRul
         needs_unsure=needs_unsure or needs_struct,
         struct_literals=lowering.struct_literals,
         needs_str_rank=needs_rank,
+        needs_fn_origin=needs_fn_origin,
     )
     _fold_key_chains(out)
     if _assign_bit_slots(out):
@@ -1723,7 +1785,7 @@ def trace_signature(compiled: CompiledRules) -> str:
                 add(f"V{s.index}")
                 steps(s.var_steps)
             elif isinstance(s, StepFnVar):
-                add(f"F{s.key_id};")
+                add(f"F{s.key_id},{int(s.per_origin)};")
             elif isinstance(s, StepAllValues):
                 add("*;")
             elif isinstance(s, StepAllIndices):

@@ -126,6 +126,7 @@ class _DocArrays:
         self.node_index = arrays["node_index"]
         self.node_parent_kind = arrays["node_parent_kind"]
         self.struct_id = arrays.get("struct_id")  # only for query-RHS rules
+        self.fn_origin = arrays.get("fn_origin")  # only per-origin fn rules
         # per-struct-literal (N,) bool columns (encoder.struct_literal_tri):
         # exact compare_eq match/comparable + loose_eq membership
         self.stri_m = {
@@ -252,23 +253,39 @@ class _UnresAcc:
     matter: key interpolation charges one UnResolved per missing
     (map, key) pair, so a single node can carry several miss events."""
 
-    __slots__ = ("miss_labels", "miss_count")
+    __slots__ = ("miss_labels", "miss_count", "touched")
 
     def __init__(self, d: _DocArrays):
         self.miss_labels = jnp.zeros(d.n, jnp.int32)
         self.miss_count = jnp.zeros(d.n, jnp.int32)
+        self.touched = False
 
     def add(self, sel, miss) -> None:
         # every call site's `miss` implies sel > 0
         self.miss_labels = jnp.where(miss, sel, self.miss_labels)
         self.miss_count = self.miss_count + miss.astype(jnp.int32)
+        self.touched = True
 
     def add_count(self, sel, counts) -> None:
         """Charge `counts` (int32 per node, 0 where none) miss events."""
         self.miss_labels = jnp.where(counts > 0, sel, self.miss_labels)
         self.miss_count = self.miss_count + counts
+        self.touched = True
 
     def finalize(self, d: _DocArrays, scalar: bool):
+        if not self.touched:
+            # no step recorded a miss event (e.g. an RHS walk that is
+            # a single StepFnVar, which charges no UnResolved): the
+            # counts are structurally zero. Returning the constant
+            # directly matters beyond speed — the all-constant
+            # segment_sum this would otherwise emit (zero weights
+            # scattered at constant zero indices) CRASHES the TPU AOT
+            # compiler (scatter_emitter.cc CHECK operand_indices.size()
+            # == 1 (2 vs. 1), reproduced round 5 on v5e)
+            return (
+                jnp.int32(0) if scalar
+                else jnp.zeros(d.n + 1, jnp.int32)
+            )
         if scalar:
             return jnp.sum(self.miss_count, dtype=jnp.int32)
         weight = jnp.where(self.miss_labels > 0, self.miss_count, 0)
@@ -357,10 +374,21 @@ def run_step(d: _DocArrays, step: Step, sel, acc: _UnresAcc, rule_statuses=None,
 
     if isinstance(step, StepFnVar):
         # precomputed function-result roots (ops/fnvars.py): orphan
-        # nodes tagged with the reserved key id. Reached only from the
-        # root basis, so the selection is origin label 1; function
-        # variables never carry UnResolved entries.
+        # nodes tagged with the reserved key id; function variables
+        # never carry UnResolved entries.
         hit = d.node_key_id == step.key_id
+        if step.per_origin:
+            # per-origin results ('pexpr'): each result root carries
+            # the candidate node it belongs to in the fn_origin
+            # column. The incoming selection labels each candidate
+            # with its own origin label (eval_block_clause /
+            # StepFilter: idx + 1), so sel[fn_origin] both gates the
+            # result (0 when its origin is not currently selected)
+            # and relabels it with the origin's label — the
+            # per-origin query-RHS join then matches LHS and RHS of
+            # the same candidate exactly.
+            lab = _select_at(d, sel, jnp.maximum(d.fn_origin, 0))
+            return jnp.where(hit & (d.fn_origin >= 0), lab, 0)
         return jnp.where(hit, jnp.int32(1), jnp.int32(0))
 
     if sel_is_root:

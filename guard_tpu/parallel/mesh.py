@@ -77,7 +77,7 @@ def pad_to_multiple(batch_arrays: Dict[str, np.ndarray], multiple: int) -> Tuple
     out = {}
     for k, v in batch_arrays.items():
         pad = np.zeros((target - d,) + v.shape[1:], dtype=v.dtype)
-        if k in ("node_kind", "struct_id"):
+        if k in ("node_kind", "struct_id", "fn_origin"):
             pad = pad - 1  # padding docs are all-padding nodes
         out[k] = np.concatenate([v, pad], axis=0)
     return out, d
@@ -144,6 +144,7 @@ def _slim_for_trace(compiled: CompiledRules) -> CompiledRules:
         str_empty_slot=compiled.str_empty_slot,
         needs_str_rank=compiled.needs_str_rank,
         needs_pairwise=compiled.needs_pairwise,
+        needs_fn_origin=compiled.needs_fn_origin,
         lit_names=list(compiled.lit_names),
     )
 

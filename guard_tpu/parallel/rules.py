@@ -184,6 +184,7 @@ def _slice_compiled(compiled: CompiledRules, indices: List[int]) -> CompiledRule
         struct_literals=compiled.struct_literals,
         needs_str_rank=compiled.needs_str_rank,
         needs_pairwise=compiled.needs_pairwise,
+        needs_fn_origin=compiled.needs_fn_origin,
         fn_vars=compiled.fn_vars,
         lit_names=compiled.lit_names,  # lit slots stay valid: shared table
     )

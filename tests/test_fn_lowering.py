@@ -654,3 +654,301 @@ rule r {
             {"Resources": {"a": {"Tag": "x", "Name": "y"}}},
         ],
     )
+
+
+# ---------------------------------------------------------------------------
+# Round 5: per-origin inline calls ('pexpr' slots) — inline function
+# calls in value scopes whose query arguments resolve per candidate
+# origin. Precomputed once per (document, origin) on the host
+# (fnvars._pexpr_scopes), encoded with the fn_origin column, and
+# selected per origin label by the kernels (StepFnVar per_origin).
+# Reference semantics: eval_context.rs:1483-1485 (ValueScope query
+# re-rooting) + resolve_function in the clause's scope.
+# ---------------------------------------------------------------------------
+
+PER_ORIGIN_DOCS = [
+    {"Resources": {
+        "a": {"Name": "abc", "Limit": "10", "Size": 5,
+              "Tags": ["x", "y"], "Type": "A"},
+        "b": {"Name": "DEF", "Limit": "3", "Size": 5,
+              "Tags": ["z"], "Type": "B"},
+    }},
+    {"Resources": {
+        "a": {"Name": "xyz", "Limit": "100", "Size": 1,
+              "Tags": [], "Type": "A"},
+    }},
+    {"Other": 1},
+]
+
+
+def test_per_origin_inline_call_in_block():
+    """The canonical shape: `Resources.* { Name == to_lower(Name) }` —
+    the argument query re-roots at each candidate, so the RHS differs
+    per origin."""
+    _differential(
+        """
+rule r when Resources exists {
+    Resources.* { Name == to_lower(Name) }
+}
+""",
+        PER_ORIGIN_DOCS,
+    )
+
+
+def test_per_origin_ordering_compare():
+    """Ordering against a per-origin function result exercises the
+    non-shared query-RHS ordering arm with per-origin labels."""
+    _differential(
+        """
+rule r when Resources exists {
+    Resources.* { Size < parse_int(Limit) }
+}
+""",
+        PER_ORIGIN_DOCS,
+    )
+
+
+def test_per_origin_in_type_block():
+    """Type-block sugar: origins are the type-filtered resources
+    (eval_type_block_clause:1424)."""
+    _differential(
+        """
+rule r when Resources exists {
+    AWS::X::Y {
+        Properties.Ref == to_upper(Properties.Base)
+    }
+}
+""",
+        [
+            {"Resources": {"a": {
+                "Type": "AWS::X::Y",
+                "Properties": {"Ref": "ONE", "Base": "one"},
+            }}},
+            {"Resources": {"a": {
+                "Type": "AWS::X::Y",
+                "Properties": {"Ref": "one", "Base": "one"},
+            }}},
+            {"Resources": {"a": {"Type": "Other",
+                                 "Properties": {"Ref": "x", "Base": "y"}}}},
+        ],
+    )
+
+
+def test_per_origin_nested_blocks():
+    """Origins compose through nested value scopes: the innermost
+    candidate set is the composition of both block queries, and each
+    result binds to its innermost origin."""
+    _differential(
+        """
+rule r when Groups exists {
+    Groups.* {
+        Members.* { Id == to_lower(Id) }
+    }
+}
+""",
+        [
+            {"Groups": {
+                "g1": {"Members": {"m1": {"Id": "aa"}, "m2": {"Id": "BB"}}},
+                "g2": {"Members": {"m3": {"Id": "cc"}}},
+            }},
+            {"Groups": {"g1": {"Members": {"m1": {"Id": "ok"}}}}},
+            {"Other": 1},
+        ],
+    )
+
+
+def test_per_origin_when_block_and_vs_let():
+    """A when block inside the value scope adds its lets to the
+    resolution scope; the call references a value-scope-bound variable
+    (vars_ & vs_bound — the other way a call becomes origin-dependent)."""
+    _differential(
+        """
+rule r when Resources exists {
+    Resources.* {
+        when Type == 'A' {
+            let parts = Tags[*]
+            Name == join(%parts, ',')
+        }
+    }
+}
+""",
+        [
+            {"Resources": {
+                "a": {"Type": "A", "Name": "x,y", "Tags": ["x", "y"]},
+                "b": {"Type": "A", "Name": "nope", "Tags": ["z"]},
+            }},
+            {"Resources": {"a": {"Type": "B", "Name": "n", "Tags": ["t"]}}},
+            {"Resources": {"a": {"Type": "A", "Name": "z", "Tags": ["z"]}}},
+        ],
+    )
+
+
+def test_per_origin_in_membership():
+    """IN against a per-origin result set (json_parse produces a list
+    per origin; membership joins per origin label)."""
+    _differential(
+        """
+rule r when Resources exists {
+    Resources.* { Name IN json_parse(Allowed) }
+}
+""",
+        [
+            {"Resources": {
+                "a": {"Name": "x", "Allowed": '["x", "y"]'},
+                "b": {"Name": "q", "Allowed": '["x", "y"]'},
+            }},
+            {"Resources": {"a": {"Name": "y", "Allowed": '["y"]'}}},
+        ],
+    )
+
+
+def test_per_origin_mixed_with_shared_expr():
+    """A root-safe inline call (shared slot) and a per-origin call in
+    the same file keep distinct slot namespaces."""
+    _differential(
+        """
+let names = Resources.*.Name
+rule shared when Resources exists { 'abc,DEF' == join(%names, ',') }
+rule perorigin when Resources exists {
+    Resources.* { Name == to_upper(Name) }
+}
+""",
+        [
+            {"Resources": {"a": {"Name": "abc"}, "b": {"Name": "DEF"}}},
+            {"Resources": {"a": {"Name": "ABC"}}},
+        ],
+    )
+
+
+def test_per_origin_fn_error_doc_routes_to_oracle():
+    """A document on which the per-origin precompute raises (parse_int
+    on a non-numeric string) lands in the error set and must evaluate
+    on the oracle — statuses via the backend stay identical."""
+    from guard_tpu.ops.fnvars import precomputable_fn_vars
+
+    rules = """
+rule r when Resources exists {
+    Resources.* { Size < parse_int(Limit) }
+}
+"""
+    rf = parse_rules_file(rules, "fn.guard")
+    docs = [
+        from_plain({"Resources": {"a": {"Size": 1, "Limit": "10"}}}),
+        from_plain({"Resources": {"a": {"Size": 1, "Limit": "oops"}}}),
+    ]
+    assert precomputable_fn_vars(rf)
+    fn_vars, fn_vals, fn_err = precompute_fn_values(rf, docs)
+    assert fn_err == {1}, "non-numeric Limit doc must flag a fn error"
+    batch, interner = encode_batch(
+        docs, fn_values=fn_vals, fn_var_order=fn_vars
+    )
+    compiled = compile_rules_file(rf, interner)
+    assert not compiled.host_rules
+    ev = BatchEvaluator(compiled)
+    statuses = ev(batch)
+    # doc 0 decides on device and must match the oracle
+    assert STATUS[int(statuses[0, 0])] == _oracle(rf, docs[0])["r"]
+
+
+def test_per_origin_inside_filter_stays_host():
+    """Calls inside query FILTERS remain host-only: filter candidates
+    are mid-query selections the precompute cannot replay
+    (ir.HOST_ONLY_CONSTRUCTS)."""
+    rules = """
+rule r when Resources exists {
+    Resources.*[ Name == to_lower(Name) ] exists
+}
+"""
+    rf = parse_rules_file(rules, "fn.guard")
+    batch, interner = encode_batch(
+        [from_plain(PER_ORIGIN_DOCS[0])]
+    )
+    compiled = compile_rules_file(rf, interner)
+    assert [r.rule_name for r in compiled.host_rules] == ["r"]
+
+
+def test_per_origin_backend_cli_parity(tmp_path):
+    """End-to-end: `validate --backend tpu` over per-origin rules is
+    byte-identical to the CPU backend."""
+    import json as _json
+    import subprocess
+    import sys
+
+    rules = tmp_path / "r.guard"
+    rules.write_text(
+        "rule r when Resources exists {\n"
+        "    Resources.* { Name == to_lower(Name) }\n"
+        "}\n"
+    )
+    for i, doc in enumerate(PER_ORIGIN_DOCS):
+        (tmp_path / f"d{i}.json").write_text(_json.dumps(doc))
+    outs = {}
+    for backend in ("cpu", "tpu"):
+        args = [sys.executable, "-m", "guard_tpu.cli", "validate",
+                "-r", str(rules), "-d", str(tmp_path),
+                "--show-summary", "all"]
+        if backend == "tpu":
+            args += ["--backend", "tpu"]
+        proc = subprocess.run(args, capture_output=True, text=True,
+                              timeout=300)
+        outs[backend] = (proc.returncode, proc.stdout)
+    assert outs["cpu"] == outs["tpu"]
+
+
+def test_per_origin_when_guard_protects_call():
+    """The defensive-guard idiom: `when <guard> { fn(...) }` must NOT
+    precompute the call for guard-false origins — a doc whose bad
+    input is exactly what the guard excludes stays on the device path
+    with no spurious fn error (review finding, round 5)."""
+    rules = """
+rule r when Resources exists {
+    Resources.* {
+        when Limit == /^[0-9]+$/ {
+            Size < parse_int(Limit)
+        }
+    }
+}
+"""
+    docs_plain = [
+        {"Resources": {"a": {"Size": 1, "Limit": "10"}}},
+        # guard-false origin: parse_int would raise, but the oracle
+        # never evaluates it (when-gate SKIPs)
+        {"Resources": {"a": {"Size": 1, "Limit": "oops"}}},
+        {"Resources": {
+            "a": {"Size": 9, "Limit": "5"},
+            "b": {"Size": 1, "Limit": "not-a-number"},
+        }},
+    ]
+    rf = parse_rules_file(rules, "fn.guard")
+    docs = [from_plain(d) for d in docs_plain]
+    fn_vars, fn_vals, fn_err = precompute_fn_values(rf, docs)
+    assert not fn_err, (
+        "guard-false origins must not flag fn errors — the when gate "
+        "excludes them from precompute"
+    )
+    _differential(rules, docs_plain)
+
+
+def test_per_origin_root_lhs_makes_no_slot():
+    """A clause whose LHS re-roots at the document root (head variable
+    bound on the root chain) cannot consume a per-origin RHS — no
+    pexpr slot is created (nothing precomputes or encodes) and the
+    rule falls back to the host."""
+    from guard_tpu.ops.fnvars import fn_slots
+
+    rules = """
+let heads = Resources.*
+rule r when Resources exists {
+    Resources.* { %heads.Name == to_lower(Name) }
+}
+"""
+    rf = parse_rules_file(rules, "fn.guard")
+    layout = fn_slots(rf)
+    assert not layout.pexpr_slots, "refused clause must not reserve a slot"
+    docs = [from_plain({"Resources": {"a": {"Name": "abc"}}})]
+    fn_vars, fn_vals, _ = precompute_fn_values(rf, docs)
+    batch, interner = encode_batch(
+        docs, fn_values=fn_vals, fn_var_order=fn_vars
+    )
+    compiled = compile_rules_file(rf, interner)
+    assert [r.rule_name for r in compiled.host_rules] == ["r"]

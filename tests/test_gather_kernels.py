@@ -207,3 +207,44 @@ def test_top_bucket_33k_nodes_on_device():
     oracle = _oracle(rf, doc)
     for ri, crule in enumerate(compiled.rules):
         assert STATUS[int(statuses[0, ri])] == oracle[crule.name]
+
+
+def test_empty_unres_walk_emits_no_scatter():
+    """A walk that records no UnResolved events (an RHS of just
+    StepFnVar) must finalize to a CONSTANT, not an all-constant
+    segment_sum: the degenerate scatter (zero weights at constant zero
+    indices) crashes the TPU AOT compiler (scatter_emitter.cc CHECK,
+    reproduced round 5 on v5e)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from guard_tpu.ops import kernels
+    from guard_tpu.ops.ir import StepFnVar
+
+    n = 64
+    arrays = {
+        "node_kind": jnp.zeros(n, jnp.int32),
+        "node_parent": jnp.zeros(n, jnp.int32),
+        "scalar_id": jnp.zeros(n, jnp.int32),
+        "num_hi": jnp.zeros(n, jnp.int32),
+        "num_lo": jnp.zeros(n, jnp.int32),
+        "child_count": jnp.zeros(n, jnp.int32),
+        "node_key_id": jnp.zeros(n, jnp.int32),
+        "node_index": jnp.zeros(n, jnp.int32),
+        "node_parent_kind": jnp.zeros(n, jnp.int32),
+        "fn_origin": jnp.full(n, -1, jnp.int32),
+    }
+
+    def walk(sel):
+        d = kernels._DocArrays(arrays, gather_mode=True)
+        return kernels.run_steps(
+            d, [StepFnVar(key_id=-1000, per_origin=True)], sel
+        )
+
+    jaxpr = jax.make_jaxpr(walk)(jnp.zeros(n, jnp.int32))
+    prims = [str(e.primitive) for e in jaxpr.jaxpr.eqns]
+    assert "scatter-add" not in prims and "scatter" not in prims, prims
+    # and the unres output is the structural zero vector
+    _, unres = walk(jnp.zeros(n, jnp.int32))
+    assert np.asarray(unres).sum() == 0

@@ -39,9 +39,9 @@ rule r when Resources exists { %t > 0 }
 let c = parse_char(Resources.*.Name)
 rule r when Resources exists { %c exists }
 """,
-    "per_origin_inline_call": """
+    "per_origin_inline_call_in_filter": """
 rule r when Resources exists {
-    Resources.* { Name == to_lower(Name) }
+    Resources.*[ Name == to_lower(Name) ] exists
 }
 """,
     "cross_scope_value_var": """
@@ -96,6 +96,16 @@ rule r {
         let u = to_upper(Outputs.*.Name)
         %u !empty
     }
+}
+""",
+    # round 5: origin-dependent inline calls in block value scopes
+    # lower via per-origin precompute (fnvars 'pexpr' slots + the
+    # fn_origin column); only the FILTER-nested form above still
+    # refuses. Differential coverage in
+    # tests/test_fn_lowering.py::test_per_origin_inline_call_in_block
+    "per_origin_inline_call_in_block": """
+rule r when Resources exists {
+    Resources.* { Name == to_lower(Name) }
 }
 """,
 }
