@@ -178,11 +178,15 @@ def encode_document(
     # origin-path -> node index, only built when a per-origin function
     # result needs mapping back to its candidate node
     # record paths during the MAIN doc visit only (result subtrees
-    # carry fabricated paths that must not shadow document nodes)
-    want_paths = [
-        any(len(fr) > 2 and fr[2] is not None for fr in fn_results or [])
-    ]
+    # carry fabricated paths that must not shadow document nodes).
+    # Paths are unescaped slash-joined strings, so a map KEY containing
+    # '/' can collide with a genuinely nested path — colliding docs
+    # set the miss flag and route to the oracle instead of silently
+    # mapping an origin to the wrong node (review finding, round 5)
+    # fn_results entries are always (slot, pv, origin_path-or-None)
+    want_paths = [any(fr[2] is not None for fr in fn_results or [])]
     path_idx: dict = {}
+    path_dup = [False]
 
     def push_num(kind: int, v) -> None:
         key = num_key(kind, v)
@@ -195,6 +199,8 @@ def encode_document(
     def visit(pv: PV, parent: int) -> int:
         idx = len(kinds)
         if want_paths[0]:
+            if pv.path.s in path_idx:
+                path_dup[0] = True
             path_idx[pv.path.s] = idx
         kinds.append(pv.kind)
         parents.append(parent)
@@ -256,12 +262,12 @@ def encode_document(
     # walks INTO the results work normally)
     want_paths[0] = False
     fn_roots = []
-    origin_miss = False
-    for fr in fn_results or []:
-        slot, pv = fr[0], fr[1]
-        opath = fr[2] if len(fr) > 2 else None
+    origin_miss = path_dup[0]
+    for slot, pv, opath in fn_results or []:
         if opath is None:
             origin = -1
+        elif origin_miss:
+            continue  # ambiguous path space: doc goes to the oracle
         else:
             origin = path_idx.get(opath, -2)
             if origin == -2:

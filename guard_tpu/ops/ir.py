@@ -113,7 +113,10 @@ HOST_ONLY_CONSTRUCTS = {
     ),
     "variable_capture": (
         "variable capture inside a query projection or filter binds "
-        "per traversal step"
+        "per traversal step — refused only when the captured name is "
+        "actually referenced as %name somewhere in the file "
+        "(unreferenced markers are unobservable and lower as the "
+        "unnamed form)"
     ),
 }
 
@@ -699,6 +702,42 @@ class _PreloweredQuery:
     match_all: bool
 
 
+def _referenced_variable_names(rf: RulesFile) -> set:
+    """Every variable name mentioned as a `%x` query part anywhere in
+    the file, via a generic dataclass walk (queries, filter interiors,
+    function arguments, let values, parameterized-rule bodies — all
+    channels, because the walk is structural, not enumerated)."""
+    import dataclasses as _dc
+
+    seen: set = set()
+    out: set = set()
+
+    def walk(o) -> None:
+        if isinstance(o, (str, bytes, int, float, bool)) or o is None:
+            return
+        if id(o) in seen:
+            return
+        seen.add(id(o))
+        if isinstance(o, QKey):
+            if part_is_variable(o):
+                out.add(part_variable(o))
+            return
+        if isinstance(o, PV):
+            return  # document values never contain query parts
+        if _dc.is_dataclass(o) and not isinstance(o, type):
+            for f in _dc.fields(o):
+                walk(getattr(o, f.name))
+        elif isinstance(o, (list, tuple)):
+            for e in o:
+                walk(e)
+        elif isinstance(o, dict):
+            for e in o.values():
+                walk(e)
+
+    walk(rf)
+    return out
+
+
 class _RuleLowering:
     """Lowers one RulesFile.
 
@@ -753,6 +792,15 @@ class _RuleLowering:
 
         self.fn_layout = fn_slots(rules_file)
         self.var_functions = self.fn_layout.var_slots
+        # every variable NAME referenced anywhere in the file (`%x`
+        # query parts — heads, interpolation, RHS queries, function
+        # arguments, let values, parameterized-call args — found by a
+        # generic structural walk, so no syntactic channel can be
+        # missed). A variable CAPTURE whose name is never referenced is
+        # unobservable (captures only surface through `%name`
+        # resolution, scopes.add_variable_capture_key consumers), so
+        # such markers lower as their unnamed equivalents.
+        self.referenced_vars = _referenced_variable_names(rules_file)
         self._cur_rule_idx = -1  # set per rule by compile_rules_file
         self.rule_index = {}  # name -> [compiled indices], file order
         self.names_total = {}
@@ -1001,17 +1049,19 @@ class _RuleLowering:
                     names.append(alias)
             return StepKey(key_names=names)
         if isinstance(part, QAllValues):
-            if part.name is not None:
+            if part.name is not None and part.name in self.referenced_vars:
                 raise Unlowerable("variable capture in projection")
+            # an unreferenced capture name is unobservable — the
+            # marker lowers as the unnamed projection
             return StepAllValues()
         if isinstance(part, QAllIndices):
-            if part.name is not None:
+            if part.name is not None and part.name in self.referenced_vars:
                 raise Unlowerable("variable capture in projection")
             return StepAllIndices()
         if isinstance(part, QIndex):
             return StepIndex(abs(part.index))
         if isinstance(part, QFilter):
-            if part.name is not None:
+            if part.name is not None and part.name in self.referenced_vars:
                 raise Unlowerable("variable capture in filter")
             if prev == "other":
                 # oracle raises InternalError for maps after such parts
@@ -1036,7 +1086,7 @@ class _RuleLowering:
                 scalar_self=prev == "varhead",
             )
         if isinstance(part, QMapKeyFilter):
-            if part.name is not None:
+            if part.name is not None and part.name in self.referenced_vars:
                 raise Unlowerable("variable capture in keys filter")
             op = part.clause.comparator
             if op not in (CmpOperator.Eq, CmpOperator.In):

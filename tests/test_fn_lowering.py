@@ -952,3 +952,36 @@ rule r when Resources exists {
     )
     compiled = compile_rules_file(rf, interner)
     assert [r.rule_name for r in compiled.host_rules] == ["r"]
+
+
+def test_per_origin_slash_key_path_collision_routes_to_oracle():
+    """Paths are unescaped slash-joined strings, so a map key
+    containing '/' can collide with a nested path ('Resources' ->
+    'x/Name' vs 'Resources' -> 'x' -> 'Name'). Such documents must
+    flag num_exotic (oracle routing) rather than silently gating the
+    per-origin RHS off the wrong node (review finding, round 5)."""
+    rules = """
+rule r when Resources exists {
+    Resources.* { Name == to_lower(Name) }
+}
+"""
+    rf = parse_rules_file(rules, "fn.guard")
+    colliding = {"Resources": {"x/Name": {"Name": "ABC"}, "x": {"Name": "def"}}}
+    clean = {"Resources": {"a": {"Name": "ABC"}}}
+    docs = [from_plain(colliding), from_plain(clean)]
+    fn_vars, fn_vals, fn_err = precompute_fn_values(rf, docs)
+    assert not fn_err
+    batch, interner = encode_batch(
+        docs, fn_values=fn_vals, fn_var_order=fn_vars
+    )
+    assert bool(batch.num_exotic[0]), (
+        "colliding path space must route the doc to the oracle"
+    )
+    assert not bool(batch.num_exotic[1])
+    # the clean doc still decides on device and matches the oracle
+    compiled = compile_rules_file(rf, interner)
+    assert not compiled.host_rules
+    statuses = BatchEvaluator(compiled)(batch)
+    assert STATUS[int(statuses[1, 0])] == _oracle(rf, docs[1])["r"]
+    # and the oracle's answer for the colliding doc is what users get
+    assert _oracle(rf, docs[0])["r"] == "FAIL"

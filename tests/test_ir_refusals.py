@@ -55,6 +55,7 @@ rule r when Resources exists {
     "variable_capture": """
 rule r when Resources exists {
     Resources[ x | Type == 'A' ].Properties exists
+    %x !empty
 }
 """,
 }
@@ -108,6 +109,14 @@ rule r when Resources exists {
     Resources.* { Name == to_lower(Name) }
 }
 """,
+    # round 5: a capture whose name is never referenced as %x is
+    # unobservable (captures only surface through variable
+    # resolution), so the marker lowers as the unnamed equivalent
+    "unreferenced_variable_capture": """
+rule r when Resources exists {
+    Resources[ x | Type == 'A' ].Properties exists
+}
+""",
 }
 
 
@@ -141,3 +150,49 @@ def test_formerly_documented_constructs_lower(construct):
         f"{[r.rule_name for r in compiled.host_rules]}"
     )
     assert [r.name for r in compiled.rules] == ["r"]
+
+
+def test_unreferenced_capture_statuses_match_oracle():
+    """The marker-ignored lowering must be status-identical to the
+    oracle (which still records the capture, unobservably)."""
+    from guard_tpu.commands.report import rule_statuses_from_root
+    from guard_tpu.core.evaluator import eval_rules_file
+    from guard_tpu.core.scopes import RootScope
+    from guard_tpu.ops.kernels import BatchEvaluator
+
+    rules = """
+rule r when Resources exists {
+    Resources[ x | Type == 'A' ].Properties.Enabled == true
+}
+rule proj when Resources exists {
+    Resources[ lid ].Type exists
+}
+"""
+    docs_plain = [
+        DOC,
+        {"Resources": {"a": {"Type": "B", "Properties": {"Enabled": True}}}},
+        {"Resources": {
+            "a": {"Type": "A", "Properties": {"Enabled": False}},
+            "b": {"Type": "A", "Properties": {"Enabled": True}},
+        }},
+    ]
+    rf = parse_rules_file(rules, "cap.guard")
+    docs = [from_plain(d) for d in docs_plain]
+    batch, interner = encode_batch(docs)
+    compiled = compile_rules_file(rf, interner)
+    assert not compiled.host_rules, [r.rule_name for r in compiled.host_rules]
+    statuses = BatchEvaluator(compiled)(batch)
+    S = {0: "PASS", 1: "FAIL", 2: "SKIP"}
+    for di, doc in enumerate(docs):
+        scope = RootScope(rf, doc)
+        eval_rules_file(rf, scope, None)
+        oracle = {
+            n: s.value
+            for n, s in rule_statuses_from_root(
+                scope.reset_recorder().extract()
+            ).items()
+        }
+        for ri, crule in enumerate(compiled.rules):
+            assert S[int(statuses[di, ri])] == oracle[crule.name], (
+                di, crule.name,
+            )

@@ -257,6 +257,34 @@ def rand_rules(rng, ti, tags):
                     f"        Name == to_lower(%{vn}.Name)\n"
                     "    }"
                 )
+        if rng.random() < 0.2:
+            # per-origin inline call (round 5 'pexpr'): the query
+            # argument re-roots at each block candidate, so the RHS
+            # differs per origin; random value kinds exercise the
+            # fn-error -> oracle routing too
+            tags.add("per-origin-call")
+            fn, arg = rng.choice(
+                [
+                    ("to_lower", "Name"), ("to_upper", "Name"),
+                    ("to_upper", "Env"), ("parse_int", "Size"),
+                ]
+            )
+            por_op = rng.choice(["==", "!=", "<", ">=", "in"])
+            inner = f"{rng.choice(KEYS)} {por_op} {fn}({arg})"
+            if rng.random() < 0.4:
+                # defensive-guard idiom: the when gate must exclude
+                # guard-false origins from the precompute
+                tags.add("per-origin-when-guard")
+                inner = (
+                    f"when {arg} exists {{\n"
+                    f"            {inner}\n"
+                    "        }"
+                )
+            body.append(
+                "Resources.* {\n"
+                f"        {inner}\n"
+                "    }"
+            )
         for ci in range(rng.randint(1, 3)):
             if var_names and rng.random() < 0.4:
                 vn, kind = rng.choice(var_names)
