@@ -64,6 +64,60 @@ def fn_key_id(slot: int) -> int:
     return _FN_KEY_BASE - slot
 
 
+def _filter_candidates(prev_part, cur: PV):
+    """Mirror of scopes._retrieve_filter's candidate derivation
+    (eval_context.rs:723-828 / scopes.py:702-770): which values a
+    filter's clause CNF evaluates against, given the value reached by
+    the query prefix and the part class preceding the filter. Returns
+    None where the oracle raises InternalError. The `[*]`-preceded
+    outer-scope case never reaches consumption (ir refuses `filter
+    after [*]` wholesale, so such rules stay on the host)."""
+    from ..core.exprs import QAllIndices, QAllValues, QKey
+    from ..core.values import LIST, MAP
+
+    if prev_part is not None and part_is_variable(prev_part):
+        # after a variable head, maps AND scalars filter themselves in
+        # their own value scope; lists iterate (scopes.py:390-408,
+        # ir.StepFilter scalar_self)
+        if cur.kind == LIST:
+            return list(cur.val)
+        return [cur]
+    if cur.kind == MAP:
+        if isinstance(prev_part, (QAllValues, QAllIndices)):
+            return [cur]
+        if isinstance(prev_part, QKey) or prev_part is None:
+            return list(cur.val.values.values())
+        return None
+    if cur.kind == LIST:
+        return list(cur.val)
+    if isinstance(prev_part, QAllIndices):
+        return [cur]
+    return []
+
+
+def _pvar_bindable(value, excluded: Set[str]) -> bool:
+    """A cross-scope binding precomputes only when nothing in it
+    touches the excluded builtins (now/parse_char — nondeterminism /
+    CHAR nodes) or a transitively-excluded variable."""
+    if isinstance(value, FunctionExpr):
+        vars_: Set[str] = set()
+        names: Set[str] = set()
+        _expr_refs(value, vars_, names)
+        return not (names & _EXCLUDED) and not (vars_ & excluded)
+    if isinstance(value, AccessQuery):
+        return not (_query_vars(value) & excluded)
+    return True
+
+
+def _vs_depth(vs_path: tuple) -> int:
+    """Value-scope DEPTH of a path: block / type-block / filter
+    entries each open a new scope; when-blocks keep the enclosing
+    selection (ir.lower_guard_clause keeps the scope token), so they
+    are transparent. Cross-scope = binding depth strictly shallower
+    than use depth."""
+    return sum(1 for e in vs_path if e[0] != "when")
+
+
 def _query_vars(q: AccessQuery) -> Set[str]:
     out: Set[str] = set()
     for part in q.query:
@@ -122,13 +176,53 @@ def _fn_lets(rf: RulesFile) -> List[Tuple[int, str, FunctionExpr, list]]:
 
 
 def _excluded_fn_vars(rf: RulesFile) -> Set[str]:
-    """Function-let NAMES excluded from precompute (conservative,
-    name-level, fixpoint over possibly-forward var references)."""
+    """Variable NAMES excluded from precompute because their value
+    (transitively, name-level fixpoint over possibly-forward
+    references) touches an excluded builtin. Enumerates EVERY let in
+    the file — root-basis AND value-scope lets, found by a generic
+    structural walk — because a value-scope binding can indirect a
+    precomputable slot to parse_char/now just as well as a root one
+    (`let a = parse_char(Code)  let t = %a  Props[ K == %t ]`);
+    name-level across scopes is a conservative over-approximation
+    (same-named safe lets merely fall back to the host)."""
+    import dataclasses as _dc
+
+    from ..core.exprs import LetExpr
+
+    lets: List[LetExpr] = []
+    seen: Set[int] = set()
+
+    def walk(o) -> None:
+        if isinstance(o, (str, bytes, int, float, bool)) or o is None:
+            return
+        if id(o) in seen:
+            return
+        seen.add(id(o))
+        if isinstance(o, PV):
+            return
+        if isinstance(o, LetExpr):
+            lets.append(o)
+            return
+        if _dc.is_dataclass(o) and not isinstance(o, type):
+            for f in _dc.fields(o):
+                walk(getattr(o, f.name))
+        elif isinstance(o, (list, tuple)):
+            for e in o:
+                walk(e)
+        elif isinstance(o, dict):
+            for e in o.values():
+                walk(e)
+
+    walk(rf)
     info = []
-    for ri, var, fx, _chain in _fn_lets(rf):
-        vars_, names = set(), set()
-        _expr_refs(fx, vars_, names)
-        info.append((var, vars_, names))
+    for let in lets:
+        vars_: Set[str] = set()
+        names: Set[str] = set()
+        if isinstance(let.value, FunctionExpr):
+            _expr_refs(let.value, vars_, names)
+        elif isinstance(let.value, AccessQuery):
+            vars_ = _query_vars(let.value)
+        info.append((let.var, vars_, names))
     excluded = {var for var, _, names in info if names & _EXCLUDED}
     changed = True
     while changed:
@@ -267,6 +361,14 @@ class FnSlots:
     # selected per origin label by the kernels (ir.StepFnVar
     # per_origin)
     pexpr_slots: Dict[int, int] = None
+    # id(AccessQuery) -> slot for CROSS-SCOPE value-scope variable
+    # uses as clause RHS (`Resources.* { let t = Type  Properties[
+    # Kind == %t ] exists }`): the variable re-resolves per enclosing
+    # origin, so its values precompute once per USE-SITE candidate
+    # (resolved through the replayed scope chain, which lands on the
+    # binding origin's scope) and join per origin label exactly like
+    # pexpr results
+    pvar_slots: Dict[int, int] = None
 
     @property
     def keys(self) -> List[tuple]:
@@ -293,6 +395,7 @@ def fn_slots(rf: RulesFile) -> FnSlots:
     expr_slots: Dict[int, int] = {}
     pv_slots: Dict[int, int] = {}
     pexpr_slots: Dict[int, int] = {}
+    pvar_slots: Dict[int, int] = {}
 
     def add(slot: _Slot) -> int:
         slots.append(slot)
@@ -399,7 +502,7 @@ def fn_slots(rf: RulesFile) -> FnSlots:
                 names.update(let.var for let in b.assignments)
             return names
 
-        def on_expr(fx, chain, in_vs, vs_bound, vs_path=(),
+        def on_expr(fx, chain, in_vs, vs_binds, vs_path=(),
                     lhs_root=False, ri=ri):
             if (
                 id(fx) in expr_slots
@@ -407,22 +510,24 @@ def fn_slots(rf: RulesFile) -> FnSlots:
                 or not usable_expr(fx)
             ):
                 return
-            if in_vs and not _root_safe(fx, bound_names(chain), vs_bound):
+            if in_vs and not _root_safe(
+                fx, bound_names(chain), set(vs_binds)
+            ):
                 # origin-DEPENDENT inline call: the result genuinely
                 # differs per candidate, so it precomputes per origin
                 # (kind 'pexpr') — the encoder tags each result subtree
                 # with its origin node and the kernels select per
-                # origin label (ir.StepFnVar per_origin). Only scope
-                # chains made of block / type-block / when-block
-                # entries enumerate origins exactly; calls inside
-                # query FILTERS stay host-side (mid-query candidate
-                # sets are not re-derivable here). A clause whose LHS
+                # origin label (ir.StepFnVar per_origin). The scope
+                # path replays block / type-block / when-block entries
+                # AND query-filter entries (filter candidates derive
+                # from the recorded query prefix exactly like
+                # scopes._retrieve_filter). A clause whose LHS
                 # evaluates from the ROOT basis (head variable bound on
                 # the root chain -> ir raises CrossScopeRootVar and
-                # then refuses the per-origin RHS) gets no slot either:
+                # then refuses the per-origin RHS) gets no slot:
                 # the lowering could never consume it, so precomputing
                 # and encoding its results would be pure waste.
-                if lhs_root or any(e[0] == "filter" for e in vs_path):
+                if lhs_root:
                     return
                 pexpr_slots[id(fx)] = add(
                     _Slot(
@@ -439,43 +544,74 @@ def fn_slots(rf: RulesFile) -> FnSlots:
                 )
             )
 
-        def walk_parts(parts, chain, vs_bound, vs_path=(), ri=ri):
-            for part in parts:
+        def walk_parts(parts, chain, vs_binds, vs_path=(), ri=ri):
+            for pi, part in enumerate(parts):
                 if isinstance(part, QFilter):
+                    # record the query prefix: the precompute derives
+                    # this filter's candidate set from it
+                    vp = vs_path + (("filter", part, tuple(parts[:pi])),)
                     for disj in part.conjunctions:
                         for cc in disj:
-                            walk_clause(
-                                cc, chain, True, vs_bound,
-                                vs_path + (("filter", part),),
-                            )
+                            walk_clause(cc, chain, True, vs_binds, vp)
 
-        def walk_clause(c, chain, in_vs, vs_bound, vs_path=(), ri=ri):
+        def walk_clause(c, chain, in_vs, vs_binds, vs_path=(), ri=ri):
             if isinstance(c, GuardAccessClause):
                 cw = c.access_clause.compare_with
+                parts = c.access_clause.query.query
+                lhs_root = bool(
+                    in_vs
+                    and parts
+                    and part_is_variable(parts[0])
+                    and part_variable(parts[0]) not in vs_binds
+                    and part_variable(parts[0]) in bound_names(chain)
+                )
                 if isinstance(cw, FunctionExpr):
                     # mirror of ir's CrossScopeRootVar: a head variable
                     # bound on the root chain (and not shadowed in the
                     # value scope) re-roots the LHS at the document
                     # root, which the per-origin RHS then refuses
-                    parts = c.access_clause.query.query
-                    lhs_root = bool(
-                        in_vs
-                        and parts
-                        and part_is_variable(parts[0])
-                        and part_variable(parts[0]) not in vs_bound
-                        and part_variable(parts[0]) in bound_names(chain)
-                    )
-                    on_expr(cw, chain, in_vs, vs_bound, vs_path, lhs_root)
-                walk_parts(c.access_clause.query.query, chain, vs_bound, vs_path)
+                    on_expr(cw, chain, in_vs, vs_binds, vs_path, lhs_root)
+                elif (
+                    isinstance(cw, AccessQuery)
+                    and in_vs
+                    and not lhs_root
+                    and len(cw.query) == 1
+                    and part_is_variable(cw.query[0])
+                    and id(cw) not in pvar_slots
+                ):
+                    # cross-scope value-scope variable as clause RHS:
+                    # bound in an ENCLOSING value scope (strictly
+                    # shallower than this clause — same-depth uses
+                    # lower natively), so it re-resolves per origin.
+                    # Precomputed per use-site candidate ('pvar').
+                    # LITERAL bindings are origin-independent and
+                    # already lower through ir.lower_rhs — no slot.
+                    var = part_variable(cw.query[0])
+                    bind = vs_binds.get(var)
+                    if (
+                        bind is not None
+                        and bind[0] < _vs_depth(vs_path)
+                        and not isinstance(bind[1], PV)
+                        and _pvar_bindable(bind[1], excluded)
+                    ):
+                        pvar_slots[id(cw)] = add(
+                            _Slot(
+                                key=("pvar", ri, len(pvar_slots)),
+                                kind="pvar", rule_idx=ri, var=var,
+                                chain=tuple(chain),
+                                vs_path=tuple(vs_path),
+                            )
+                        )
+                walk_parts(parts, chain, vs_binds, vs_path)
                 if isinstance(cw, AccessQuery):
-                    walk_parts(cw.query, chain, vs_bound, vs_path)
+                    walk_parts(cw.query, chain, vs_binds, vs_path)
             elif isinstance(c, ParameterizedNamedRuleClause):
                 for p in c.parameters:
                     if isinstance(p, FunctionExpr):
                         # rule-call args lower at root scope only
                         # (ir.lower_parameterized_call)
                         if not in_vs:
-                            on_expr(p, chain, in_vs, vs_bound)
+                            on_expr(p, chain, in_vs, vs_binds)
                     elif isinstance(p, PV):
                         # literal call argument: the callee may use the
                         # parameter as a query head
@@ -491,16 +627,18 @@ def fn_slots(rf: RulesFile) -> FnSlots:
                                 )
                             )
                     elif isinstance(p, AccessQuery):
-                        walk_parts(p.query, chain, vs_bound, vs_path)
+                        walk_parts(p.query, chain, vs_binds, vs_path)
             elif isinstance(c, WhenBlockClause):
                 for disj in c.conditions or []:
                     for cc in disj:
-                        walk_clause(cc, chain, in_vs, vs_bound, vs_path)
+                        walk_clause(cc, chain, in_vs, vs_binds, vs_path)
                 if in_vs:
-                    vb = vs_bound | {
-                        let.var for let in c.block.assignments
-                    }
                     vp = vs_path + (("when", c),)
+                    # when-blocks keep the enclosing selection, so
+                    # their lets bind at the ENCLOSING depth
+                    vb = dict(vs_binds)
+                    for let in c.block.assignments:
+                        vb[let.var] = (_vs_depth(vs_path), let.value)
                     for disj in c.block.conjunctions:
                         for cc in disj:
                             walk_clause(cc, chain, True, vb, vp)
@@ -508,18 +646,20 @@ def fn_slots(rf: RulesFile) -> FnSlots:
                     ch = chain + (c.block,)
                     for disj in c.block.conjunctions:
                         for cc in disj:
-                            walk_clause(cc, ch, False, vs_bound)
+                            walk_clause(cc, ch, False, vs_binds)
             elif isinstance(c, (BlockGuardClause, TypeBlock)):
                 if isinstance(c, BlockGuardClause):
-                    walk_parts(c.query.query, chain, vs_bound, vs_path)
+                    walk_parts(c.query.query, chain, vs_binds, vs_path)
                     vp = vs_path + (("block", c),)
                 else:
-                    walk_parts(c.query, chain, vs_bound, vs_path)
+                    walk_parts(c.query, chain, vs_binds, vs_path)
                     for disj in c.conditions or []:
                         for cc in disj:
-                            walk_clause(cc, chain, in_vs, vs_bound, vs_path)
+                            walk_clause(cc, chain, in_vs, vs_binds, vs_path)
                     vp = vs_path + (("type", c),)
-                vb = vs_bound | {let.var for let in c.block.assignments}
+                vb = dict(vs_binds)
+                for let in c.block.assignments:
+                    vb[let.var] = (_vs_depth(vp), let.value)
                 for disj in c.block.conjunctions:
                     for cc in disj:
                         walk_clause(cc, chain, True, vb, vp)
@@ -527,15 +667,15 @@ def fn_slots(rf: RulesFile) -> FnSlots:
         base_chain = (rule.block,)
         for disj in rule.conditions or []:
             for c in disj:
-                walk_clause(c, base_chain, False, set())
+                walk_clause(c, base_chain, False, {})
         for disj in rule.block.conjunctions:
             for c in disj:
-                walk_clause(c, base_chain, False, set())
+                walk_clause(c, base_chain, False, {})
 
     return FnSlots(
         slots=slots, var_slots=var_slots, lit_slots=lit_slots,
         expr_slots=expr_slots, pv_slots=pv_slots,
-        pexpr_slots=pexpr_slots,
+        pexpr_slots=pexpr_slots, pvar_slots=pvar_slots,
     )
 
 
@@ -581,11 +721,13 @@ def precompute_fn_values(
         eval_type_block_clause:1424 -> eval_general_block_clause:1071);
         when-blocks keep the origin and add their lets. Origins are
         reached by strictly-descending traversal, so each innermost
-        origin has exactly one scope chain. `cache` memoizes the pairs
-        per (base scope, vs_path) within one document: k calls in the
-        same block replay its queries and when-gates once, not k
-        times."""
-        ckey = (id(base_scope),) + tuple(id(n) for _k, n in slot.vs_path)
+        origin has exactly one scope chain. Query-FILTER entries
+        derive their candidate sets from the recorded query prefix,
+        mirroring scopes._retrieve_filter branch for branch. `cache`
+        memoizes the pairs per (base scope, vs_path) within one
+        document: k calls in the same scope replay its queries and
+        when-gates once, not k times."""
+        ckey = (id(base_scope),) + tuple(id(e[1]) for e in slot.vs_path)
         hit = cache.get(ckey)
         if hit is not None:
             return hit
@@ -618,13 +760,45 @@ def precompute_fn_values(
             )
 
         pairs = [(None, base_scope)]
-        for kind, node in slot.vs_path:
+        for entry in slot.vs_path:
+            kind, node = entry[0], entry[1]
             if kind == "when":
                 pairs = [
                     (o, BlockScope(node.block, sc.root(), sc))
                     for o, sc in pairs
                     if when_passes(node.conditions, sc)
                 ]
+                continue
+            if kind == "filter":
+                # candidates per scopes._retrieve_filter: resolve the
+                # recorded query prefix in the current scope, then
+                # expand per the part class preceding the filter
+                prefix = list(entry[2])
+                prev_part = prefix[-1] if prefix else None
+                new = []
+                for _o, sc in pairs:
+                    if prefix:
+                        curs = [
+                            qr.value
+                            for qr in sc.query(prefix)
+                            if qr.tag == RESOLVED
+                        ]
+                    else:
+                        curs = [sc.root()]
+                    for cur in curs:
+                        cands = _filter_candidates(prev_part, cur)
+                        if cands is None:
+                            # the oracle raises InternalError for
+                            # filters after such parts — route the
+                            # doc there
+                            from ..core.errors import InternalError
+
+                            raise InternalError(
+                                "filter after unexpected query part"
+                            )
+                        for cand in cands:
+                            new.append((cand, ValueScope(cand, sc)))
+                pairs = new
                 continue
             q = node.query.query if kind == "block" else node.query
             new = []
@@ -697,6 +871,30 @@ def precompute_fn_values(
                             )
                             if q.tag == RESOLVED
                         ]
+                    per[slot.key] = per_origin
+                elif slot.kind == "pvar":
+                    # cross-scope value-scope variable as clause RHS:
+                    # resolve the variable through each use-site
+                    # candidate's replayed scope chain (which lands on
+                    # the binding origin's scope, with shadowing and
+                    # single-shot caching exactly like the oracle's).
+                    # UnResolved entries would need per-origin
+                    # UnResolved accounting the kernels don't model —
+                    # such documents route to the oracle instead.
+                    per_origin = {}
+                    for origin, sc in _pexpr_scopes(
+                        slot, scope_for(slot.chain), pexpr_cache
+                    ):
+                        opath = origin.path.s
+                        if opath in per_origin:
+                            continue
+                        rs = sc.resolve_variable(slot.var)
+                        if any(q.tag != RESOLVED for q in rs):
+                            raise GuardError(
+                                "cross-scope variable resolves "
+                                "UnResolved entries; host evaluation"
+                            )
+                        per_origin[opath] = [q.value for q in rs]
                     per[slot.key] = per_origin
                 else:  # inline expression
                     per[slot.key] = [

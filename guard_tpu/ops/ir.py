@@ -100,16 +100,13 @@ HOST_ONLY_CONSTRUCTS = {
         "parse_char produces CHAR nodes, which documents otherwise "
         "never contain"
     ),
-    "per_origin_inline_call_in_filter": (
-        "inline function call inside a query FILTER whose query "
-        "argument resolves per candidate — filter candidates are "
-        "mid-query selections the per-origin precompute cannot "
-        "replay (block/type-block/when-block scopes DO lower via "
-        "per-origin precompute as of round 5, fnvars 'pexpr' slots)"
-    ),
-    "cross_scope_value_var": (
-        "a variable bound in a non-root value scope used in another "
-        "scope re-resolves per origin"
+    "cross_scope_value_var_head": (
+        "a variable bound in a non-root value scope used as a query "
+        "HEAD (or interpolated) in another scope re-resolves per "
+        "origin mid-walk — bare `%v` uses as clause RHS lower via "
+        "per-use-site precompute ('pvar' slots) as of round 5, but a "
+        "head use starts a fresh traversal from per-origin values, "
+        "which the columnar walk cannot replay"
     ),
     "variable_capture": (
         "variable capture inside a query projection or filter binds "
@@ -1545,6 +1542,25 @@ class _RuleLowering:
                     # root selection — both sides resolve there with
                     # the same origin label, so the ordinary per-origin
                     # machinery is already exact
+                except Unlowerable:
+                    # a variable bound in a NON-root value scope used
+                    # across scopes: its values precompute per
+                    # use-site candidate (fnvars 'pvar' slots) and
+                    # join per origin label, exactly like per-origin
+                    # inline calls
+                    pvslot = self.fn_layout.pvar_slots.get(
+                        id(ac.compare_with)
+                    )
+                    if pvslot is None or eval_from_root:
+                        raise
+                    from .fnvars import fn_key_id
+
+                    self.needs_fn_origin = True
+                    rhs_query_steps = [
+                        StepFnVar(
+                            key_id=fn_key_id(pvslot), per_origin=True
+                        )
+                    ]
                 if ac.comparator in (CmpOperator.Eq, CmpOperator.In):
                     self.needs_struct_ids = True
                 else:

@@ -850,10 +850,11 @@ rule r when Resources exists {
     assert STATUS[int(statuses[0, 0])] == _oracle(rf, docs[0])["r"]
 
 
-def test_per_origin_inside_filter_stays_host():
-    """Calls inside query FILTERS remain host-only: filter candidates
-    are mid-query selections the precompute cannot replay
-    (ir.HOST_ONLY_CONSTRUCTS)."""
+def test_per_origin_inside_filter_lowers():
+    """Round 5b: calls inside query FILTERS lower too — candidate
+    sets replay from the recorded query prefix
+    (fnvars._filter_candidates). Differential battery in
+    test_per_origin_call_inside_filter below."""
     rules = """
 rule r when Resources exists {
     Resources.*[ Name == to_lower(Name) ] exists
@@ -864,7 +865,7 @@ rule r when Resources exists {
         [from_plain(PER_ORIGIN_DOCS[0])]
     )
     compiled = compile_rules_file(rf, interner)
-    assert [r.rule_name for r in compiled.host_rules] == ["r"]
+    assert not compiled.host_rules
 
 
 def test_per_origin_backend_cli_parity(tmp_path):
@@ -985,3 +986,226 @@ rule r when Resources exists {
     assert STATUS[int(statuses[1, 0])] == _oracle(rf, docs[1])["r"]
     # and the oracle's answer for the colliding doc is what users get
     assert _oracle(rf, docs[0])["r"] == "FAIL"
+
+
+# ---------------------------------------------------------------------------
+# Round 5b: cross-scope value-scope variables as clause RHS ('pvar'
+# slots) and per-origin calls inside query filters — both ride the
+# per-use-site candidate replay (fnvars._pexpr_scopes filter entries
+# mirroring scopes._retrieve_filter).
+# ---------------------------------------------------------------------------
+
+
+def test_cross_scope_var_rhs_in_filter():
+    """The canonical cross_scope_value_var shape: a block let used
+    inside a filter one scope deeper (`Properties[ Kind == %t ]`)."""
+    _differential(
+        """
+rule r when Resources exists {
+    Resources.* {
+        let t = Type
+        Properties[ Kind == %t ] exists
+    }
+}
+""",
+        [
+            {"Resources": {"a": {
+                "Type": "A",
+                "Properties": {"p1": {"Kind": "A"}, "p2": {"Kind": "B"}},
+            }}},
+            {"Resources": {"a": {
+                "Type": "X", "Properties": {"p1": {"Kind": "A"}},
+            }}},
+            {"Resources": {
+                "a": {"Type": "A", "Properties": {"p": {"Kind": "A"}}},
+                "b": {"Type": "B", "Properties": {"p": {"Kind": "A"}}},
+            }},
+            {"Other": 1},
+        ],
+    )
+
+
+def test_cross_scope_var_rhs_in_nested_block():
+    """Use in a nested block (not a filter): each member compares
+    against ITS group's id."""
+    _differential(
+        """
+rule r when Groups exists {
+    Groups.* {
+        let gid = Id
+        Members.* { Owner == %gid }
+    }
+}
+""",
+        [
+            {"Groups": {
+                "g1": {"Id": "g1x",
+                       "Members": {"m1": {"Owner": "g1x"},
+                                   "m2": {"Owner": "zz"}}},
+                "g2": {"Id": "g2x", "Members": {"m": {"Owner": "g2x"}}},
+            }},
+            {"Groups": {"g1": {"Id": "q", "Members": {"m": {"Owner": "q"}}}}},
+        ],
+    )
+
+
+def test_cross_scope_var_ops_and_shadowing():
+    """Ordering and IN against cross-scope vars; an inner rebinding
+    shadows the outer let for deeper uses."""
+    _differential(
+        """
+rule caps when Resources exists {
+    Resources.* {
+        let cap = Cap
+        Disks[ Size <= %cap ] !empty
+    }
+}
+rule shadow when Resources exists {
+    Resources.* {
+        let t = Outer
+        Props.* {
+            let t = Inner
+            Checks[ V == %t ] exists
+        }
+    }
+}
+""",
+        [
+            {"Resources": {"a": {
+                "Cap": 10,
+                "Disks": {"d1": {"Size": 5}, "d2": {"Size": 50}},
+                "Outer": "o", "Props": {
+                    "p": {"Inner": "i", "Checks": {"c": {"V": "i"}}},
+                },
+            }}},
+            {"Resources": {"a": {
+                "Cap": 1, "Disks": {"d": {"Size": 5}},
+                "Outer": "o", "Props": {
+                    "p": {"Inner": "i", "Checks": {"c": {"V": "o"}}},
+                },
+            }}},
+        ],
+    )
+
+
+def test_cross_scope_var_literal_binding():
+    """A literal let in a value scope used one scope deeper resolves
+    to the literal for every origin."""
+    _differential(
+        """
+rule r when Resources exists {
+    Resources.* {
+        let want = 'gold'
+        Tags[ Tier == %want ] exists
+    }
+}
+""",
+        [
+            {"Resources": {"a": {"Tags": {"t": {"Tier": "gold"}}}}},
+            {"Resources": {"a": {"Tags": {"t": {"Tier": "iron"}}}}},
+        ],
+    )
+
+
+def test_cross_scope_var_unresolved_routes_to_oracle():
+    """An origin where the binding query does not resolve (missing
+    Type) needs per-origin UnResolved accounting the kernels don't
+    model — the doc routes to the oracle via the fn-error channel."""
+    rules = """
+rule r when Resources exists {
+    Resources.* {
+        let t = Type
+        Properties[ Kind == %t ] exists
+    }
+}
+"""
+    rf = parse_rules_file(rules, "fn.guard")
+    docs = [
+        from_plain({"Resources": {"a": {
+            "Type": "A", "Properties": {"p": {"Kind": "A"}},
+        }}}),
+        from_plain({"Resources": {"a": {
+            "Properties": {"p": {"Kind": "A"}},  # no Type
+        }}}),
+    ]
+    fn_vars, fn_vals, fn_err = precompute_fn_values(rf, docs)
+    assert fn_err == {1}
+    batch, interner = encode_batch(
+        docs, fn_values=fn_vals, fn_var_order=fn_vars
+    )
+    compiled = compile_rules_file(rf, interner)
+    assert not compiled.host_rules
+    statuses = BatchEvaluator(compiled)(batch)
+    assert STATUS[int(statuses[0, 0])] == _oracle(rf, docs[0])["r"]
+
+
+def test_per_origin_call_inside_filter():
+    """Per-origin inline calls inside query filters lower via the
+    same candidate replay (formerly the last host-only fn shape)."""
+    _differential(
+        """
+rule r when Resources exists {
+    Resources.*[ Name == to_lower(Name) ] exists
+}
+rule deep when Resources exists {
+    Resources.*.Tags[ Id == to_upper(Id) ] !empty
+}
+""",
+        [
+            {"Resources": {
+                "a": {"Name": "abc", "Tags": {"t": {"Id": "XY"}}},
+                "b": {"Name": "DEF", "Tags": {"t": {"Id": "zz"}}},
+            }},
+            {"Resources": {"a": {"Name": "ZZZ", "Tags": {"t": {"Id": "A"}}}}},
+            {"Other": 1},
+        ],
+    )
+
+
+def test_cross_scope_var_head_use_stays_host():
+    """A HEAD use of a cross-scope variable starts a fresh traversal
+    per origin — still host-only (cross_scope_value_var_head)."""
+    rules = """
+rule r when Resources exists {
+    Resources.* {
+        let t = Type
+        Properties { %t exists }
+    }
+}
+"""
+    rf = parse_rules_file(rules, "fn.guard")
+    batch, interner = encode_batch(
+        [from_plain({"Resources": {"a": {"Type": "A", "Properties": {}}}})]
+    )
+    compiled = compile_rules_file(rf, interner)
+    assert [r.rule_name for r in compiled.host_rules] == ["r"]
+
+
+def test_cross_scope_excluded_indirection_stays_host():
+    """A value-scope let that indirects to an excluded builtin via a
+    SIBLING value-scope let must not precompute (review finding,
+    round 5b): the name-level exclusion fixpoint covers every let in
+    the file, not just root-basis ones."""
+    from guard_tpu.ops.fnvars import fn_slots
+
+    rules = """
+rule r when Resources exists {
+    Resources.* {
+        let a = parse_char(Code)
+        let t = %a
+        Props[ K == %t ] exists
+    }
+}
+"""
+    rf = parse_rules_file(rules, "fn.guard")
+    layout = fn_slots(rf)
+    assert not layout.pvar_slots, "excluded indirection must not slot"
+    docs = [from_plain({"Resources": {"x": {
+        "Code": "k", "Props": {"p": {"K": "k"}},
+    }}})]
+    fn_vars, fn_vals, _ = precompute_fn_values(rf, docs)
+    batch, interner = encode_batch(
+        docs, fn_values=fn_vals, fn_var_order=fn_vars
+    )
+    compiled = compile_rules_file(rf, interner)
+    assert [r.rule_name for r in compiled.host_rules] == ["r"]

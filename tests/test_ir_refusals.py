@@ -39,16 +39,11 @@ rule r when Resources exists { %t > 0 }
 let c = parse_char(Resources.*.Name)
 rule r when Resources exists { %c exists }
 """,
-    "per_origin_inline_call_in_filter": """
-rule r when Resources exists {
-    Resources.*[ Name == to_lower(Name) ] exists
-}
-""",
-    "cross_scope_value_var": """
+    "cross_scope_value_var_head": """
 rule r when Resources exists {
     Resources.* {
         let t = Type
-        Properties[ Kind == %t ] exists
+        Properties { %t exists }
     }
 }
 """,
@@ -115,6 +110,25 @@ rule r when Resources exists {
     "unreferenced_variable_capture": """
 rule r when Resources exists {
     Resources[ x | Type == 'A' ].Properties exists
+}
+""",
+    # round 5: filter candidate sets replay from the recorded query
+    # prefix, so per-origin inline calls inside filters lower too
+    "per_origin_inline_call_in_filter": """
+rule r when Resources exists {
+    Resources.*[ Name == to_lower(Name) ] exists
+}
+""",
+    # round 5: a value-scope variable used as a bare clause RHS in a
+    # DEEPER scope precomputes per use-site candidate ('pvar' slots).
+    # Differential coverage in
+    # tests/test_fn_lowering.py::test_cross_scope_var_rhs_in_filter
+    "cross_scope_value_var_rhs": """
+rule r when Resources exists {
+    Resources.* {
+        let t = Type
+        Properties[ Kind == %t ] exists
+    }
 }
 """,
 }

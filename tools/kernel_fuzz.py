@@ -44,6 +44,8 @@ ALL_TAGS = frozenset(
         "membership-var", "struct-eq", "struct-neq", "struct-regex-member",
         "struct-range-member", "struct-in-list", "list-in-list",
         "neq-var-scope", "when-fn-let", "nested-inline-call",
+        "per-origin-call", "per-origin-when-guard",
+        "per-origin-filter-call", "cross-scope-var",
     }
 )
 
@@ -285,6 +287,42 @@ def rand_rules(rng, ti, tags):
                 f"        {inner}\n"
                 "    }"
             )
+        if rng.random() < 0.15:
+            # per-origin call INSIDE a query filter (round 5b):
+            # candidates replay from the query prefix
+            tags.add("per-origin-filter-call")
+            fn, arg = rng.choice(
+                [("to_lower", "Name"), ("to_upper", "Env")]
+            )
+            body.append(
+                f"Resources.*[ {arg} {rng.choice(['==', '!='])} "
+                f"{fn}({arg}) ] {rng.choice(['exists', '!empty', 'empty'])}"
+            )
+        if rng.random() < 0.15:
+            # cross-scope value-scope variable as clause RHS
+            # (round 5b 'pvar'): bound per resource, used one scope
+            # deeper (filter or nested block)
+            tags.add("cross-scope-var")
+            bind_key = rng.choice(["Type", "Name", "Size"])
+            use_key = rng.choice(KEYS)
+            op = rng.choice(["==", "!=", "in", "<", ">="])
+            if rng.random() < 0.5:
+                body.append(
+                    "Resources.* {\n"
+                    f"        let xv = {bind_key}\n"
+                    f"        Props[ {use_key} {op} %xv ] "
+                    f"{rng.choice(['exists', '!empty'])}\n"
+                    "    }"
+                )
+            else:
+                body.append(
+                    "Resources.* {\n"
+                    f"        let xv = {bind_key}\n"
+                    "        Tags[*] {\n"
+                    f"            {use_key} {op} %xv\n"
+                    "        }\n"
+                    "    }"
+                )
         for ci in range(rng.randint(1, 3)):
             if var_names and rng.random() < 0.4:
                 vn, kind = rng.choice(var_names)
