@@ -369,3 +369,41 @@ class RulesFile:
     assignments: List[LetExpr]
     guard_rules: List[Rule]
     parameterized_rules: List[ParameterizedRule]
+
+
+def walk_expr_tree(obj, visit) -> None:
+    """Generic structural walk over the parsed AST: calls
+    `visit(node)` on every object reached through dataclass fields,
+    lists, tuples and dict values; `visit` returning True stops
+    descent below that node. PVs never contain AST nodes, so the walk
+    stops there; an id-based seen set makes shared subobjects safe.
+    Being structural (not channel-enumerated), new syntax cannot be
+    silently missed by consumers like ir._referenced_variable_names
+    and fnvars._excluded_fn_vars."""
+    import dataclasses as _dc
+
+    from .values import PV
+
+    seen = set()
+
+    def walk(o) -> None:
+        if isinstance(o, (str, bytes, int, float, bool)) or o is None:
+            return
+        if id(o) in seen:
+            return
+        seen.add(id(o))
+        if isinstance(o, PV):
+            return
+        if visit(o):
+            return
+        if _dc.is_dataclass(o) and not isinstance(o, type):
+            for f in _dc.fields(o):
+                walk(getattr(o, f.name))
+        elif isinstance(o, (list, tuple)):
+            for e in o:
+                walk(e)
+        elif isinstance(o, dict):
+            for e in o.values():
+                walk(e)
+
+    walk(obj)

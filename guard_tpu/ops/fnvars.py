@@ -185,35 +185,17 @@ def _excluded_fn_vars(rf: RulesFile) -> Set[str]:
     (`let a = parse_char(Code)  let t = %a  Props[ K == %t ]`);
     name-level across scopes is a conservative over-approximation
     (same-named safe lets merely fall back to the host)."""
-    import dataclasses as _dc
-
-    from ..core.exprs import LetExpr
+    from ..core.exprs import LetExpr, walk_expr_tree
 
     lets: List[LetExpr] = []
-    seen: Set[int] = set()
 
-    def walk(o) -> None:
-        if isinstance(o, (str, bytes, int, float, bool)) or o is None:
-            return
-        if id(o) in seen:
-            return
-        seen.add(id(o))
-        if isinstance(o, PV):
-            return
+    def visit(o) -> bool:
         if isinstance(o, LetExpr):
             lets.append(o)
-            return
-        if _dc.is_dataclass(o) and not isinstance(o, type):
-            for f in _dc.fields(o):
-                walk(getattr(o, f.name))
-        elif isinstance(o, (list, tuple)):
-            for e in o:
-                walk(e)
-        elif isinstance(o, dict):
-            for e in o.values():
-                walk(e)
+            return True
+        return False
 
-    walk(rf)
+    walk_expr_tree(rf, visit)
     info = []
     for let in lets:
         vars_: Set[str] = set()

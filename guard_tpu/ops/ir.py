@@ -701,37 +701,21 @@ class _PreloweredQuery:
 
 def _referenced_variable_names(rf: RulesFile) -> set:
     """Every variable name mentioned as a `%x` query part anywhere in
-    the file, via a generic dataclass walk (queries, filter interiors,
-    function arguments, let values, parameterized-rule bodies — all
-    channels, because the walk is structural, not enumerated)."""
-    import dataclasses as _dc
+    the file (queries, filter interiors, function arguments, let
+    values, parameterized-rule bodies — all channels, because
+    exprs.walk_expr_tree is structural, not enumerated)."""
+    from ..core.exprs import walk_expr_tree
 
-    seen: set = set()
     out: set = set()
 
-    def walk(o) -> None:
-        if isinstance(o, (str, bytes, int, float, bool)) or o is None:
-            return
-        if id(o) in seen:
-            return
-        seen.add(id(o))
+    def visit(o) -> bool:
         if isinstance(o, QKey):
             if part_is_variable(o):
                 out.add(part_variable(o))
-            return
-        if isinstance(o, PV):
-            return  # document values never contain query parts
-        if _dc.is_dataclass(o) and not isinstance(o, type):
-            for f in _dc.fields(o):
-                walk(getattr(o, f.name))
-        elif isinstance(o, (list, tuple)):
-            for e in o:
-                walk(e)
-        elif isinstance(o, dict):
-            for e in o.values():
-                walk(e)
+            return True
+        return False
 
-    walk(rf)
+    walk_expr_tree(rf, visit)
     return out
 
 

@@ -38,10 +38,20 @@ def _domain_cases(domain):
 
 @pytest.mark.parametrize("domain", DOMAINS)
 def test_domain_tpu_parity(domain):
+    from guard_tpu.ops.fnvars import precompute_fn_values
+
     checked = 0
     for rf, case in _domain_cases(domain):
         doc = from_plain(case.get("input") or {})
-        batch, interner = encode_batch([doc])
+        # mirror the backend: function slots precompute per document
+        # BEFORE encode (ops/backend.py) — without this, fn-dependent
+        # rules see no result subtrees and decide wrongly
+        fn_vars, fn_vals, fn_err = precompute_fn_values(rf, [doc])
+        if fn_err:
+            continue  # routed to the oracle by the backend
+        batch, interner = encode_batch(
+            [doc], fn_values=fn_vals, fn_var_order=fn_vars
+        )
         compiled = compile_rules_file(rf, interner)
         if not compiled.rules:
             continue
