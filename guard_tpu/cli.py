@@ -62,6 +62,12 @@ def build_parser() -> argparse.ArgumentParser:
         "pure-Python; native/cpu force one; tpu = JAX batch engine",
     )
     v.add_argument("--statuses-only", action="store_true")
+    v.add_argument(
+        "--no-pack",
+        action="store_true",
+        help="tpu backend: disable fused multi-rule-file dispatch "
+        "(evaluate each rule file through its own executable)",
+    )
 
     t = sub.add_parser("test", help="Test rules against expectations")
     t.add_argument("--rules-file", "-r", dest="rules", default=None)
@@ -104,6 +110,12 @@ def build_parser() -> argparse.ArgumentParser:
         "(rule-axis parallelism for huge registries)",
     )
     s.add_argument("--last-modified", "-m", action="store_true")
+    s.add_argument(
+        "--no-pack",
+        action="store_true",
+        help="tpu backend: disable fused multi-rule-file dispatch "
+        "(evaluate each rule file through its own executable)",
+    )
 
     pt = sub.add_parser("parse-tree", help="Prints the parse tree for a rules file")
     pt.add_argument("--rules", "-r", default=None)
@@ -157,6 +169,7 @@ def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reade
                 structured=args.structured,
                 backend=args.backend,
                 statuses_only=args.statuses_only,
+                pack_rules=not args.no_pack,
             )
             return cmd.execute(writer, reader)
         if args.command == "test":
@@ -181,6 +194,7 @@ def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reade
                 backend=args.backend,
                 rule_shards=args.rule_shards,
                 last_modified=args.last_modified,
+                pack_rules=not args.no_pack,
             ).execute(writer, reader)
         if args.command == "parse-tree":
             return ParseTree(

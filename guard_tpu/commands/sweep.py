@@ -76,6 +76,10 @@ class Sweep:
     backend: str = "tpu"  # tpu | cpu (oracle; mainly for testing)
     rule_shards: int = 1  # >1: rule-axis parallelism (parallel/rules.py)
     last_modified: bool = False
+    # fuse compatible rule files into packed executables (ops/ir
+    # .pack_compiled): one device dispatch per (pack, bucket) instead
+    # of one per rule file; --no-pack restores per-file dispatch
+    pack_rules: bool = True
 
     def execute(self, writer: Writer, reader: Reader) -> int:
         if not self.rules:
@@ -101,14 +105,42 @@ class Sweep:
         manifest_path.parent.mkdir(parents=True, exist_ok=True)
 
         evaluated = skipped = 0
+        todo = []
+        for ci, chunk in enumerate(chunks):
+            sig = _chunk_signature(chunk)
+            prev = done.get(ci)
+            if prev is not None and prev.get("sig") == sig:
+                skipped += 1
+                continue
+            todo.append((ci, sig, chunk))
+
+        # double-buffered encode/execute (tpu backend): while the
+        # device executes chunk k's dispatched packs, the host reads
+        # and columnarizes chunk k+1 (the `prefetch` callback fires
+        # between dispatch and collect — JAX dispatch is async, so the
+        # encode genuinely overlaps device execution instead of
+        # serializing behind each chunk's collection)
+        prepared: Dict[int, tuple] = {}
+
+        def _prepare(j: int) -> None:
+            if self.backend != "tpu" or j >= len(todo):
+                return
+            ci2, _sig2, chunk2 = todo[j]
+            if ci2 in prepared:
+                return
+            err_box2 = [0]
+            dfs = self._read_chunk(chunk2, writer, err_box2)
+            enc = self._encode_chunk(dfs, writer, err_box2)
+            prepared[ci2] = (dfs, enc, err_box2[0])
+
         with manifest_path.open("a") as mf:
-            for ci, chunk in enumerate(chunks):
-                sig = _chunk_signature(chunk)
-                prev = done.get(ci)
-                if prev is not None and prev.get("sig") == sig:
-                    skipped += 1
-                    continue
-                rec = self._evaluate_chunk(ci, sig, chunk, rule_files, writer)
+            for j, (ci, sig, chunk) in enumerate(todo):
+                _prepare(j)
+                rec = self._evaluate_chunk(
+                    ci, sig, chunk, rule_files, writer,
+                    prepared=prepared.pop(ci, None),
+                    prefetch=(lambda j=j: _prepare(j + 1)),
+                )
                 done[ci] = rec
                 mf.write(json.dumps(rec) + "\n")
                 mf.flush()
@@ -162,21 +194,17 @@ class Sweep:
         return rule_files, errors
 
     # -- one chunk ----------------------------------------------------
-    def _evaluate_chunk(
-        self, ci: int, sig: str, chunk: List[Path], rule_files, writer: Writer
-    ) -> dict:
-        counts = {k: 0 for k in _STATUS_NAMES}
-        failed: List[dict] = []
-        errors = 0
-
+    def _read_chunk(
+        self, chunk: List[Path], writer: Writer, err_box
+    ) -> List[DataFile]:
+        """Read chunk files into lazy DataFiles. path_value loads
+        LAZILY (_pv): on the tpu backend the native encoder works from
+        raw content and the Python document build is only needed for
+        oracle fallbacks and function-let precompute — profiling showed
+        the eager build was ~40% of end-to-end sweep wall time on
+        all-lowered JSON corpora."""
         data_files: List[DataFile] = []
         for p in chunk:
-            # path_value loads LAZILY (_pv): on the tpu backend the
-            # native encoder works from raw content and the Python
-            # document build is only needed for oracle fallbacks and
-            # function-let precompute — profiling showed the eager
-            # build was ~40% of end-to-end sweep wall time on
-            # all-lowered JSON corpora
             try:
                 content = p.read_text()
                 data_files.append(
@@ -184,13 +212,32 @@ class Sweep:
                 )
             except OSError as e:
                 writer.writeln_err(f"skipping {p}: {e}")
-                errors += 1
+                err_box[0] += 1
+        return data_files
+
+    def _evaluate_chunk(
+        self, ci: int, sig: str, chunk: List[Path], rule_files,
+        writer: Writer, prepared=None, prefetch=None,
+    ) -> dict:
+        counts = {k: 0 for k in _STATUS_NAMES}
+        failed: List[dict] = []
+        errors = 0
+        err_box = [0]
+
+        if prepared is not None:
+            # read + encoded by the pipeline's prefetch (overlapped
+            # with the previous chunk's device execution)
+            data_files, encoded, pre_err = prepared
+            err_box[0] += pre_err
+        else:
+            data_files = self._read_chunk(chunk, writer, err_box)
+            encoded = None
 
         per_doc: List[Dict[str, Status]] = [dict() for _ in data_files]
-        err_box = [0]
         if self.backend == "tpu":
             errors += self._eval_tpu(
-                data_files, rule_files, per_doc, writer, err_box
+                data_files, rule_files, per_doc, writer, err_box,
+                encoded=encoded, after_dispatch=prefetch,
             )
         else:
             errors += self._eval_oracle(
@@ -245,21 +292,13 @@ class Sweep:
             pv if pv is not None else PV.null(VPath.root()) for pv in pvs
         ]
 
-    def _eval_tpu(self, data_files, rule_files, per_doc, writer, err_box) -> int:
-        from ..ops.backend import _honor_platform_env
+    def _encode_chunk(self, data_files, writer, err_box):
+        """Columnarize one chunk: the native C++ JSON encoder when the
+        whole chunk sniffs as JSON, the Python encoder otherwise.
+        Returns (batch, interner)."""
         from ..ops.encoder import encode_batch
-        from ..ops.ir import FAIL, PASS, SKIP, compile_rules_file
         from ..ops.native_encoder import encode_json_batch_native, native_available
-        from ..parallel.mesh import ShardedBatchEvaluator
 
-        # JAX_PLATFORMS=cpu in the env is not reliably honored by
-        # plugin discovery (a wedged TPU tunnel hangs device init);
-        # mirror it programmatically before the first device query
-        _honor_platform_env()
-
-        _status = {PASS: Status.PASS, FAIL: Status.FAIL, SKIP: Status.SKIP}
-        if not data_files:
-            return 0
         batch = interner = None
         if native_available() and all(
             df.content.lstrip()[:1] in ("{", "[") for df in data_files
@@ -288,8 +327,87 @@ class Sweep:
             batch, interner = encode_batch(
                 self._padded_pvs(data_files, writer, err_box)
             )
+        return batch, interner
+
+    def _eval_pack_sharded(self, items, batch, after_dispatch):
+        """Rule-axis parallelism with PACKS as the unit: the packable
+        files split across `rule_shards` device groups, each group one
+        packed executable on its own sub-mesh; all (group, bucket)
+        work dispatches before anything collects. Returns the same
+        {file_idx: (statuses, unsure, host_docs)} map as
+        backend._evaluate_packs."""
+        import numpy as np
+
+        from ..ops.encoder import NODE_BUCKETS_EXTENDED, split_batch_by_size
+        from ..ops.ir import SKIP, PackIncompatible
+        from ..parallel.rules import PackShardedEvaluator
+
+        try:
+            ev = PackShardedEvaluator(
+                [c for _, c in items], rule_shards=self.rule_shards
+            )
+        except PackIncompatible:
+            if after_dispatch is not None:
+                after_dispatch()
+            return {}
+        groups, oversize = split_batch_by_size(batch, NODE_BUCKETS_EXTENDED)
+        host_docs = {int(i) for i in oversize}
+        pending = [(idx, ev.dispatch(sub)) for sub, idx in groups]
+        if after_dispatch is not None:
+            after_dispatch()
+        statuses = np.full((batch.n_docs, ev.n_rules), SKIP, np.int8)
+        unsure = np.zeros((batch.n_docs, ev.n_rules), bool)
+        for idx, handle in pending:
+            st, un = ev.collect(handle)
+            statuses[idx] = st
+            if un is not None:
+                unsure[idx] = un
+        results = {}
+        base = 0
+        for fi, c in items:
+            r = len(c.rules)
+            results[fi] = (
+                statuses[:, base : base + r],
+                unsure[:, base : base + r],
+                set(host_docs),
+            )
+            base += r
+        return results
+
+    def _eval_tpu(self, data_files, rule_files, per_doc, writer, err_box,
+                  encoded=None, after_dispatch=None) -> int:
+        import os
+
+        from ..ops.backend import _evaluate_packs, _honor_platform_env
+        from ..ops.encoder import encode_batch
+        from ..ops.ir import (
+            FAIL,
+            PASS,
+            SKIP,
+            compile_rules_file,
+            pack_compatible,
+        )
+        from ..parallel.mesh import ShardedBatchEvaluator
+
+        # JAX_PLATFORMS=cpu in the env is not reliably honored by
+        # plugin discovery (a wedged TPU tunnel hangs device init);
+        # mirror it programmatically before the first device query
+        _honor_platform_env()
+
+        _status = {PASS: Status.PASS, FAIL: Status.FAIL, SKIP: Status.SKIP}
+        if not data_files:
+            if after_dispatch is not None:
+                after_dispatch()
+            return 0
+        if encoded is not None:
+            batch, interner = encoded
+        else:
+            batch, interner = self._encode_chunk(data_files, writer, err_box)
 
         errors = 0
+        # lower every rule file up-front (pack planning needs the full
+        # registry before the first dispatch)
+        prep = []
         for rf in rule_files:
             from ..ops.fnvars import precompute_fn_values, precomputable_fn_vars
 
@@ -309,9 +427,39 @@ class Sweep:
                 if fn_err:
                     rf_batch.num_exotic[sorted(fn_err)] = True
             compiled = compile_rules_file(rf.rules, interner)
+            prep.append((rf, rf_batch, compiled))
+
+        # fused multi-rule-file dispatch: compatible files evaluate as
+        # packed executables; with rule_shards > 1 the packs shard
+        # across disjoint device groups (PackShardedEvaluator)
+        pack_on = (
+            self.pack_rules and os.environ.get("GUARD_TPU_PACK", "1") != "0"
+        )
+        packed_results: dict = {}
+        if pack_on:
+            items = [
+                (fi, c)
+                for fi, (_rf, rb, c) in enumerate(prep)
+                if rb is batch and pack_compatible(c) is None
+            ]
+            if self.rule_shards > 1 and len(items) >= 2:
+                packed_results = self._eval_pack_sharded(
+                    items, batch, after_dispatch
+                )
+            else:
+                packed_results = _evaluate_packs(
+                    items, batch, after_dispatch=after_dispatch
+                )
+        elif after_dispatch is not None:
+            after_dispatch()
+
+        for fi, (rf, rf_batch, compiled) in enumerate(prep):
             unsure = None
             host_docs = set()
-            if compiled.rules:
+            statuses = None
+            if fi in packed_results:
+                statuses, unsure, host_docs = packed_results[fi]
+            elif compiled.rules:
                 if self.rule_shards > 1:
                     from ..parallel.mesh import evaluate_bucketed
                     from ..parallel.rules import RuleShardedEvaluator
@@ -327,6 +475,7 @@ class Sweep:
                     statuses, unsure, host_docs = evaluator.evaluate_bucketed(
                         rf_batch
                     )
+            if statuses is not None:
                 for di in range(len(data_files)):
                     if di in host_docs:
                         continue

@@ -147,6 +147,11 @@ class Validate:
     # report rule-level statuses without per-clause detail, so
     # fail-heavy corpora stay device-bound instead of oracle-bound
     statuses_only: bool = False
+    # TPU backend: fuse compatible rule files into packed executables
+    # (ops/ir.pack_compiled — one device dispatch per (pack, bucket)
+    # instead of one per rule file); `--no-pack` restores the per-file
+    # dispatch path, e.g. to bisect a suspected packing divergence
+    pack_rules: bool = True
 
     # -- argument validation (validate.rs:205-232) --------------------
     def _validate_args(self) -> None:

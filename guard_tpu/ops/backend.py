@@ -33,6 +33,108 @@ from ..commands.report import rule_statuses_from_root, simplified_report_from_ro
 
 _STATUS = {PASS: Status.PASS, FAIL: Status.FAIL, SKIP: Status.SKIP}
 
+# rule-packing ceiling: packs close when their rule count would exceed
+# this (one pack executable traces every packed rule program, so the
+# cap bounds trace/compile time for pathologically huge registries;
+# the 250-file corpus' ~257 rules fit in ONE pack at the default)
+import os as _os
+
+PACK_MAX_RULES = int(_os.environ.get("GUARD_TPU_PACK_MAX_RULES", "512"))
+
+
+def dispatch_stats() -> dict:
+    """Snapshot of the run's device-dispatch observability counters
+    (parallel.mesh.DISPATCH_COUNTERS): `dispatches` = jitted evaluator
+    calls issued, `executables_compiled` = distinct (evaluator, bucket
+    shape) pairs those calls compiled. bench.py emits these and the CPU
+    bench-smoke pins a ceiling on the packed path's dispatch count."""
+    from ..parallel.mesh import DISPATCH_COUNTERS
+
+    return dict(DISPATCH_COUNTERS)
+
+
+def reset_dispatch_stats() -> None:
+    from ..parallel.mesh import reset_dispatch_counters
+
+    reset_dispatch_counters()
+
+
+def plan_packs(items, max_rules: int = None):
+    """Greedy pack planner over [(file_idx, CompiledRules)] pairs
+    already screened with ir.pack_compatible: packs fill in file order
+    and close when the next file would push the pack past `max_rules`.
+    File order is preserved so packed statuses slice back per file in
+    the caller's iteration order."""
+    max_rules = PACK_MAX_RULES if max_rules is None else max_rules
+    packs, cur, cur_rules = [], [], 0
+    for fi, c in items:
+        n = len(c.rules)
+        if cur and cur_rules + n > max_rules:
+            packs.append(cur)
+            cur, cur_rules = [], 0
+        cur.append((fi, c))
+        cur_rules += n
+    if cur:
+        packs.append(cur)
+    return packs
+
+
+def _evaluate_packs(items, batch, after_dispatch=None) -> dict:
+    """The fused multi-rule-file dispatch pipeline: pack the compatible
+    compiled files (plan_packs), then dispatch EVERY (pack, bucket
+    group) before collecting any — JAX dispatch is async, so host
+    columnarization of the next bucket/pack overlaps device execution
+    of the previous one. `after_dispatch` (the double-buffering hook:
+    commands/sweep.py encodes doc chunk k+1 in it while the device
+    executes chunk k) runs once everything is in flight, before the
+    first collect. Returns {file_idx: (statuses (D, R_f) int8, unsure
+    (D, R_f) bool, host_docs set)} sliced per file through the pack's
+    segment map; files left out of the result fall back to the
+    per-file path unchanged."""
+    import numpy as np
+
+    from .encoder import NODE_BUCKETS_EXTENDED, split_batch_by_size
+    from .ir import PackIncompatible, pack_compiled
+    from ..parallel.mesh import ShardedBatchEvaluator
+
+    results: dict = {}
+    if len(items) < 2:
+        if after_dispatch is not None:
+            after_dispatch()
+        return results
+    groups, oversize = split_batch_by_size(batch, NODE_BUCKETS_EXTENDED)
+    host_docs = {int(i) for i in oversize}
+    pending = []
+    for pack in plan_packs(items):
+        if len(pack) < 2:
+            continue  # a singleton pack gains nothing over per-file
+        try:
+            packed = pack_compiled([c for _, c in pack])
+        except PackIncompatible as e:
+            log.info("pack of %d files fell back to per-file: %s",
+                     len(pack), e)
+            continue
+        ev = ShardedBatchEvaluator(packed.compiled)
+        handles = [(idx, ev.dispatch(sub)) for sub, idx in groups]
+        pending.append((pack, packed, ev, handles))
+    if after_dispatch is not None:
+        after_dispatch()
+    for pack, packed, ev, handles in pending:
+        n_rules = len(packed.compiled.rules)
+        statuses = np.full((batch.n_docs, n_rules), SKIP, np.int8)
+        unsure = np.zeros((batch.n_docs, n_rules), bool)
+        for idx, handle in handles:
+            st, un = ev.collect(handle)
+            statuses[idx] = st
+            if un is not None:
+                unsure[idx] = un
+        for k, (fi, _c) in enumerate(pack):
+            seg = packed.segment(k)
+            results[fi] = (
+                statuses[:, seg], unsure[:, seg], set(host_docs),
+            )
+    return results
+
 # spawn-pool state: each worker parses the rule files once (initializer)
 # and never imports jax — oracle reruns are pure-Python CPU work
 _WORKER_RULES: dict = {}
@@ -191,12 +293,17 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
     junit_suites = {df.name: [] for df in data_files}
     host_docs = set()
 
-    for rule_file in rule_files:
-        # rule files with precomputable function lets (ops/fnvars.py)
-        # re-encode the batch with the per-doc function results BEFORE
-        # compile, so result strings are interned under the bit tables
-        from .fnvars import precompute_fn_values, precomputable_fn_vars
+    # lower every rule file UP-FRONT: the pack planner needs the whole
+    # registry before the first dispatch. Files with precomputable
+    # function lets (ops/fnvars.py) re-encode the batch with per-doc
+    # function results BEFORE compile (result strings must intern under
+    # the bit tables) — those files keep a per-file batch and are
+    # excluded from packing by ir.pack_compatible.
+    from .fnvars import precompute_fn_values, precomputable_fn_vars
+    from .ir import pack_compatible
 
+    prep = []
+    for rule_file in rule_files:
         rbatch = batch
         if precomputable_fn_vars(rule_file.rules):
             docs = _docs()
@@ -216,7 +323,29 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
             "%s: %d/%d rules lowered to device kernels (%d host-fallback)",
             rule_file.name, n_dev, n_dev + n_host, n_host,
         )
+        prep.append((rule_file, rbatch, compiled))
 
+    # fused multi-rule-file dispatch: compatible files (shared batch,
+    # no per-file fn re-encode) evaluate as packed executables, one
+    # device dispatch per (pack, bucket) instead of one per file
+    import os
+
+    pack_enabled = (
+        getattr(validate, "pack_rules", True)
+        and os.environ.get("GUARD_TPU_PACK", "1") != "0"
+    )
+    packed_results: dict = {}
+    if pack_enabled:
+        packed_results = _evaluate_packs(
+            [
+                (fi, c)
+                for fi, (_rf, rb, c) in enumerate(prep)
+                if rb is batch and pack_compatible(c) is None
+            ],
+            batch,
+        )
+
+    for fi, (rule_file, rbatch, compiled) in enumerate(prep):
         # native statuses oracle (native/oracle.cpp): the compiled-
         # engine prefilter. When rich reports aren't required it
         # answers host-rule/unsure/oversized-doc statuses at native
@@ -252,7 +381,11 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
             return merged
         statuses = None
         unsure = None
-        if compiled.rules:
+        if fi in packed_results:
+            # the packed segment slice is bit-identical to the
+            # per-file path (tests/test_rule_packing.py parity)
+            statuses, unsure, host_docs = packed_results[fi]
+        elif compiled.rules:
             evaluator = ShardedBatchEvaluator(compiled)
             statuses, unsure, host_docs = evaluator.evaluate_bucketed(rbatch)
 

@@ -2119,3 +2119,238 @@ def _assign_bit_slots(compiled: CompiledRules) -> None:
         or uses_interp[0]
     )
     return uses_fn[0]
+
+
+# ---------------------------------------------------------------------------
+# Rule-file packing: many compiled files -> ONE executable
+# ---------------------------------------------------------------------------
+class PackIncompatible(Exception):
+    """Raised when a CompiledRules cannot join a multi-file pack (it
+    needs a per-file re-encoded batch, or was compiled against a
+    different interner than the rest of the pack)."""
+
+
+def pack_compatible(compiled: CompiledRules) -> Optional[str]:
+    """None when `compiled` can join a pack, else the reason it
+    cannot. Function-variable files are the one semantic exclusion:
+    their batch is re-encoded per rule file (fn result subtrees +
+    fn_origin columns), so they cannot share the pack's one batch."""
+    if compiled.fn_vars:
+        return "precomputed function variables need a per-file batch"
+    if compiled.needs_fn_origin:
+        return "per-origin function results need a per-file batch"
+    if not compiled.rules:
+        return "no device-lowered rules"
+    return None
+
+
+@dataclass
+class PackedRules:
+    """One CompiledRules concatenating several rule files' lowered IRs
+    (pack_compiled), plus the per-file segment map: file i's rules
+    occupy packed indices [offsets[i], offsets[i] + sizes[i]). The
+    packed trace_signature doubles as the executable-cache key, so two
+    invocations packing the same file structures in the same order
+    reuse the jitted evaluator exactly like a single rule file does."""
+
+    compiled: CompiledRules
+    offsets: List[int]
+    sizes: List[int]
+
+    def segment(self, i: int) -> slice:
+        return slice(self.offsets[i], self.offsets[i] + self.sizes[i])
+
+
+def pack_compiled(parts: List[CompiledRules]) -> PackedRules:
+    """Concatenate the lowered IRs of `parts` into ONE CompiledRules
+    whose single vmap'd kernel evaluates a doc batch against every
+    packed rule at once (the fused multi-rule-file dispatch: one
+    compiled executable and one device dispatch per bucket for the
+    whole pack, instead of one per rule file).
+
+    Relocation is copy-on-write — the inputs stay valid for the
+    per-file path. Every slot namespace is remapped into the pack:
+    runtime-lits slots (deduped by literal string), bit-table slots
+    (each file's empty-string table collapses onto ONE shared slot —
+    the kernel reads `d.empty_slot` globally), has-child and folded-
+    chain specs (deduped by value: registry files share many
+    `Resources`-shaped columns), struct-literal slots (offset), and
+    CNamedRef rule indices (offset by the file's rule base, preserving
+    the compile-order invariant that referents precede referers).
+    Host rules stay per-file with the caller. `needs_*` flags OR."""
+    if not parts:
+        raise PackIncompatible("empty pack")
+    interner = parts[0].interner
+    for p in parts:
+        reason = pack_compatible(p)
+        if reason is not None:
+            raise PackIncompatible(reason)
+        if p.interner is not interner:
+            raise PackIncompatible("pack members must share one interner")
+    out = CompiledRules(
+        rules=[],
+        host_rules=[],
+        interner=interner,
+        str_empty_bits=np.array(
+            [len(s) == 0 for s in interner.strings], dtype=bool
+        ),
+        needs_struct_ids=any(p.needs_struct_ids for p in parts),
+        needs_unsure=any(p.needs_unsure for p in parts),
+        needs_str_rank=any(p.needs_str_rank for p in parts),
+        needs_pairwise=any(p.needs_pairwise for p in parts),
+    )
+    seen_lits: dict = {}
+    seen_kidc: dict = {}
+    seen_chain: dict = {}
+    offsets: List[int] = []
+    sizes: List[int] = []
+
+    def ensure_empty_slot() -> int:
+        if out.str_empty_slot < 0:
+            out.str_empty_slot = len(out.bit_tables)
+            out.bit_tables.append((out.str_empty_bits, "scalar"))
+        return out.str_empty_slot
+
+    for part in parts:
+        # -- per-part slot remaps (dedupe where specs are by-value) --
+        lits = {}
+        for old, name in enumerate(part.lit_names):
+            if name not in seen_lits:
+                seen_lits[name] = len(out.lit_names)
+                out.lit_names.append(name)
+            lits[old] = seen_lits[name]
+        bits = {}
+        for old, (table, target) in enumerate(part.bit_tables):
+            if old == part.str_empty_slot:
+                bits[old] = ensure_empty_slot()
+            else:
+                bits[old] = len(out.bit_tables)
+                out.bit_tables.append((table, target))
+        kidcs = {}
+        for old, spec in enumerate(part.kidc_tables):
+            if spec not in seen_kidc:
+                seen_kidc[spec] = len(out.kidc_tables)
+                out.kidc_tables.append(spec)
+            kidcs[old] = seen_kidc[spec]
+        chains = {}
+        for old, spec in enumerate(part.chain_tables):
+            if spec not in seen_chain:
+                seen_chain[spec] = len(out.chain_tables)
+                out.chain_tables.append(spec)
+            chains[old] = seen_chain[spec]
+        struct_base = len(out.struct_literals)
+        out.struct_literals.extend(part.struct_literals)
+        rule_base = len(out.rules)
+
+        def r_rhs(r: Optional[RhsSpec]) -> Optional[RhsSpec]:
+            if r is None:
+                return None
+            c = copy.copy(r)
+            if c.str_slot >= 0:
+                c.str_slot = lits[c.str_slot]
+            if c.bits_slot >= 0:
+                c.bits_slot = bits[c.bits_slot]
+            if c.lt_slot >= 0:
+                c.lt_slot = bits[c.lt_slot]
+            if c.le_slot >= 0:
+                c.le_slot = bits[c.le_slot]
+            if c.struct_slot >= 0:
+                c.struct_slot = struct_base + c.struct_slot
+            if c.items is not None:
+                c.items = [r_rhs(it) for it in c.items]
+            return c
+
+        def r_step(s: Step) -> Step:
+            if isinstance(s, StepFnVar):
+                # unreachable behind pack_compatible; kept as the
+                # exactness backstop should a new fn channel appear
+                raise PackIncompatible(
+                    "precomputed function variables are per-file"
+                )
+            if isinstance(s, StepKey):
+                c = copy.copy(s)
+                c.lit_slots = [lits[x] for x in s.lit_slots]
+                if c.kc_slot >= 0:
+                    c.kc_slot = kidcs[c.kc_slot]
+                return c
+            if isinstance(s, StepKeyChain):
+                c = copy.copy(s)
+                c.steps = [r_step(x) for x in s.steps]
+                c.chain_slot = chains[s.chain_slot]
+                return c
+            if isinstance(s, StepKeyInterpLit):
+                c = copy.copy(s)
+                c.lit_slots = [lits[x] for x in s.lit_slots]
+                c.kc_slots = [kidcs[x] for x in s.kc_slots]
+                return c
+            if isinstance(s, StepKeyInterpVar):
+                c = copy.copy(s)
+                c.var_steps = [r_step(x) for x in s.var_steps]
+                return c
+            if isinstance(s, StepIndex):
+                c = copy.copy(s)
+                if c.kc_slot >= 0:
+                    c.kc_slot = kidcs[c.kc_slot]
+                return c
+            if isinstance(s, StepFilter):
+                c = copy.copy(s)
+                c.conjunctions = [
+                    [r_node(n) for n in disj] for disj in s.conjunctions
+                ]
+                return c
+            if isinstance(s, StepKeysMatch):
+                c = copy.copy(s)
+                c.rhs = r_rhs(s.rhs)
+                return c
+            return s  # StepAllValues / StepAllIndices carry no slots
+
+        def r_node(n: CNode) -> CNode:
+            if isinstance(n, CClause):
+                c = copy.copy(n)
+                c.steps = [r_step(x) for x in n.steps]
+                c.rhs = r_rhs(n.rhs)
+                if n.rhs_query_steps is not None:
+                    c.rhs_query_steps = [
+                        r_step(x) for x in n.rhs_query_steps
+                    ]
+                return c
+            if isinstance(n, CCountClause):
+                c = copy.copy(n)
+                c.steps = [r_step(x) for x in n.steps]
+                return c
+            if isinstance(n, CBlockClause):
+                c = copy.copy(n)
+                c.query_steps = [r_step(x) for x in n.query_steps]
+                c.inner = [[r_node(x) for x in disj] for disj in n.inner]
+                return c
+            if isinstance(n, CWhenBlock):
+                c = copy.copy(n)
+                if n.conditions is not None:
+                    c.conditions = [
+                        [r_node(x) for x in disj] for disj in n.conditions
+                    ]
+                c.inner = [[r_node(x) for x in disj] for disj in n.inner]
+                return c
+            if isinstance(n, CNamedRef):
+                return CNamedRef(
+                    rule_indices=[i + rule_base for i in n.rule_indices],
+                    negation=n.negation,
+                )
+            return n
+
+        offsets.append(rule_base)
+        sizes.append(len(part.rules))
+        for r in part.rules:
+            nr = copy.copy(r)
+            if r.conditions is not None:
+                nr.conditions = [
+                    [r_node(n) for n in disj] for disj in r.conditions
+                ]
+            nr.conjunctions = [
+                [r_node(n) for n in disj] for disj in r.conjunctions
+            ]
+            out.rules.append(nr)
+    # struct-id compares ride the unsure channel (compile_rules_file
+    # applies the same implication)
+    out.needs_unsure = out.needs_unsure or out.needs_struct_ids
+    return PackedRules(compiled=out, offsets=offsets, sizes=sizes)

@@ -1944,6 +1944,53 @@ def build_doc_evaluator(compiled: CompiledRules, with_unsure: bool = False,
     return evaluate
 
 
+# Status.and_ as a priority order: FAIL dominates, PASS beats SKIP,
+# SKIP is the identity — so a segment's folded status is the max
+# priority over its rules (qresult.Status.and_ semantics).
+_STATUS_PRIO = np.array([1, 2, 0], dtype=np.int8)  # PASS, FAIL, SKIP
+_PRIO_STATUS = np.array([2, 0, 1], dtype=np.int8)  # -> SKIP, PASS, FAIL
+
+
+def segment_doc_status(statuses, seg_ids, n_segments: int):
+    """Segment-aware status reduction over a packed rule axis: fold
+    (..., R) rule statuses into (..., F) per-segment document statuses,
+    where seg_ids maps each packed rule index to its rule FILE
+    (ir.PackedRules segments). Reduction is Status.and_ (FAIL dominates,
+    PASS beats SKIP, SKIP is the identity), expressed as a segment-max
+    over a priority encoding so it stays one fused reduction per
+    segment. Accepts jnp arrays (trace-safe, used by packed summary
+    paths) or numpy (host-side, used by the backend and bench)."""
+    if isinstance(statuses, jnp.ndarray):
+        prio = jnp.asarray(_STATUS_PRIO)[statuses]
+        moved = jnp.moveaxis(prio, -1, 0)  # (R, ...)
+        mx = jax.ops.segment_max(
+            moved, jnp.asarray(seg_ids), num_segments=n_segments
+        )
+        # empty segments come back at the dtype minimum -> clip to SKIP
+        mx = jnp.clip(mx, 0, 2)
+        return jnp.moveaxis(jnp.asarray(_PRIO_STATUS)[mx], 0, -1)
+    statuses = np.asarray(statuses)
+    seg_ids = np.asarray(seg_ids)
+    prio = _STATUS_PRIO[statuses]
+    out = np.zeros(statuses.shape[:-1] + (n_segments,), np.int8)
+    np.maximum.at(
+        np.moveaxis(out, -1, 0), seg_ids, np.moveaxis(prio, -1, 0)
+    )
+    return _PRIO_STATUS[out]
+
+
+def segment_any(flags, seg_ids, n_segments: int):
+    """(..., R) bool -> (..., F) bool: does any rule in the segment set
+    its flag (e.g. the per-rule unsure bits routed per rule FILE)."""
+    flags = np.asarray(flags)
+    seg_ids = np.asarray(seg_ids)
+    out = np.zeros(flags.shape[:-1] + (n_segments,), bool)
+    np.logical_or.at(
+        np.moveaxis(out, -1, 0), seg_ids, np.moveaxis(flags, -1, 0)
+    )
+    return out
+
+
 class BatchEvaluator:
     """Jit-compiled (docs x rules) status evaluator. One instance per
     (compiled rule file); retracing happens only per node/edge bucket.

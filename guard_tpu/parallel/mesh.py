@@ -93,6 +93,22 @@ def pad_to_multiple(batch_arrays: Dict[str, np.ndarray], multiple: int) -> Tuple
 _SHARED_FNS: "OrderedDict[tuple, tuple]" = OrderedDict()
 _SHARED_FNS_MAX = 64
 
+# Dispatch/executable observability (read via ops.backend.dispatch_stats,
+# emitted by bench.py and asserted by the CPU bench-smoke): every
+# ShardedBatchEvaluator.dispatch counts one device dispatch, and the
+# first dispatch of a (jitted evaluator, bucket shape) pair counts one
+# compiled executable — jit compiles one XLA executable per input
+# shape, and node_kind's (D, N) shape determines the bucket. The packed
+# path's whole point is driving both counters down ~n_files-fold.
+DISPATCH_COUNTERS = {"dispatches": 0, "executables_compiled": 0}
+_COMPILED_SHAPES: set = set()
+
+
+def reset_dispatch_counters() -> None:
+    DISPATCH_COUNTERS["dispatches"] = 0
+    DISPATCH_COUNTERS["executables_compiled"] = 0
+    _COMPILED_SHAPES.clear()
+
 
 def _mesh_key(mesh: Mesh) -> tuple:
     # platform included: device ids are unique only per backend
@@ -244,23 +260,35 @@ class ShardedBatchEvaluator:
 
     def dispatch(self, batch: DocBatch):
         """Launch evaluation WITHOUT blocking (JAX dispatch is async):
-        returns (device_out, n_valid). Use to overlap work across
-        device sub-meshes (parallel/rules.py) before collecting."""
+        returns (device_out, n_valid). Use to overlap host work —
+        columnarizing the next bucket / encoding the next chunk — and
+        concurrent sub-mesh execution (parallel/rules.py) with device
+        execution, collecting deferred."""
         arrays, d = self._arrays(batch)
+        DISPATCH_COUNTERS["dispatches"] += 1
+        shape_key = (id(self._fn), arrays["node_kind"].shape)
+        if shape_key not in _COMPILED_SHAPES:
+            _COMPILED_SHAPES.add(shape_key)
+            DISPATCH_COUNTERS["executables_compiled"] += 1
         # numpy straight into the jitted call: in_shardings place the
         # arrays on this evaluator's mesh; jnp.asarray would commit them
         # to the default device first (wrong backend on TPU hosts when
         # the mesh is a CPU mesh).
         return self._fn(arrays, self._lits()), d
 
-    def __call__(self, batch: DocBatch) -> np.ndarray:
-        out, d = self.dispatch(batch)
+    def collect(self, handle):
+        """Block on a dispatch handle: (statuses (d, R) int8,
+        unsure (d, R) bool or None)."""
+        out, d = handle
         if self._with_unsure:
             statuses, unsure = out
-            self.last_unsure = np.asarray(unsure)[:d]
-            return np.asarray(statuses)[:d]
-        self.last_unsure = None
-        return np.asarray(out)[:d]
+            return np.asarray(statuses)[:d], np.asarray(unsure)[:d]
+        return np.asarray(out)[:d], None
+
+    def __call__(self, batch: DocBatch) -> np.ndarray:
+        statuses, unsure = self.collect(self.dispatch(batch))
+        self.last_unsure = unsure
+        return statuses
 
     def evaluate_bucketed(self, batch: DocBatch):
         return evaluate_bucketed(self, len(self.compiled.rules), batch)
@@ -298,8 +326,22 @@ def evaluate_bucketed(evaluator, n_rules: int, batch: DocBatch):
     groups, oversize = split_batch_by_size(batch, buckets)
     statuses = np.full((batch.n_docs, n_rules), SKIP, np.int8)
     unsure = np.zeros((batch.n_docs, n_rules), bool)
-    for sub, idx in groups:
-        statuses[idx] = evaluator(sub)  # retraces once per bucket shape
-        if evaluator.last_unsure is not None:
-            unsure[idx] = evaluator.last_unsure
+    if hasattr(evaluator, "dispatch") and hasattr(evaluator, "collect"):
+        # pipelined: dispatch EVERY bucket group before collecting any
+        # (JAX dispatch is async) — host columnarization of group k+1
+        # overlaps device execution of group k instead of serializing
+        # behind its collection
+        pending = [
+            (idx, evaluator.dispatch(sub)) for sub, idx in groups
+        ]
+        for idx, handle in pending:
+            st, un = evaluator.collect(handle)
+            statuses[idx] = st
+            if un is not None:
+                unsure[idx] = un
+    else:
+        for sub, idx in groups:
+            statuses[idx] = evaluator(sub)  # retraces once per bucket
+            if evaluator.last_unsure is not None:
+                unsure[idx] = evaluator.last_unsure
     return statuses, unsure, {int(i) for i in oversize}

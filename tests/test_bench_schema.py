@@ -1,0 +1,45 @@
+"""Bench-artifact hygiene (VERDICT r5 Weak #3): the committed bench
+artifact must contain every metric row the CURRENT bench driver emits,
+and tools/check_bench_schema.py must flag artifacts that don't."""
+
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+import bench  # noqa: E402
+import check_bench_schema  # noqa: E402
+
+
+def _newest_artifact():
+    candidates = sorted(REPO.glob("bench_all_*.json"))
+    assert candidates, "no committed bench_all_*.json artifact"
+    return candidates[-1]
+
+
+def test_committed_artifact_matches_current_driver():
+    problems = check_bench_schema.check(_newest_artifact())
+    assert problems == [], "\n".join(problems)
+
+
+def test_checker_flags_missing_metric(tmp_path):
+    src = _newest_artifact().read_text().splitlines()
+    victim = bench.expected_metrics()[0]
+    doctored = tmp_path / "bench_all_doctored.json"
+    doctored.write_text(
+        "\n".join(ln for ln in src if f'"{victim}"' not in ln) + "\n"
+    )
+    problems = check_bench_schema.check(doctored)
+    assert any(victim in p for p in problems)
+
+
+def test_expected_metrics_cover_fail_heavy_batch_rows():
+    metrics = bench.expected_metrics()
+    for tag in ("50pct", "allfail"):
+        for nd in bench.FAIL_HEAVY_BATCH_SIZES:
+            assert (
+                f"config6_fail_{tag}_docs{nd}_full_docs_per_sec" in metrics
+            )
+    assert "config5b_packed_templates_per_sec" in metrics

@@ -1,0 +1,261 @@
+"""Fused multi-rule-file dispatch (ops/ir.pack_compiled + the backend
+pack planner): the packed path must be BIT-IDENTICAL to the per-file
+path — statuses, unsure bits, reports and exit codes — while issuing
+an order of magnitude fewer device dispatches. The parity spans
+examples/rules/, a sampled slice of the registry corpus, and mixes
+that include host-fallback and function-variable rule files (which the
+planner must route back to the per-file path, not silently drop)."""
+
+import glob
+import io
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+import bench
+from guard_tpu.cli import run
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.core.values import from_plain
+from guard_tpu.ops.backend import (
+    _evaluate_packs,
+    dispatch_stats,
+    plan_packs,
+    reset_dispatch_stats,
+)
+from guard_tpu.ops.encoder import encode_batch
+from guard_tpu.ops.ir import (
+    PackIncompatible,
+    compile_rules_file,
+    pack_compatible,
+    pack_compiled,
+)
+from guard_tpu.ops.kernels import segment_any, segment_doc_status
+from guard_tpu.parallel.mesh import ShardedBatchEvaluator
+from guard_tpu.utils.io import Writer
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CORPUS = REPO / "corpus" / "rules"
+
+
+def _corpus_slice(n_files, n_docs=32):
+    """(docs, [RulesFile]) over the first n_files corpus rule files and
+    the union of their own test inputs."""
+    import yaml
+
+    paths = sorted(CORPUS.glob("*.guard"))[:n_files]
+    docs_plain = []
+    for p in paths:
+        spec = CORPUS / "tests" / f"{p.stem}_tests.yaml"
+        if spec.exists():
+            for case in yaml.safe_load(spec.read_text()) or []:
+                if isinstance(case, dict) and "input" in case:
+                    docs_plain.append(case["input"])
+    docs = [from_plain(d) for d in docs_plain][:n_docs]
+    rfs = [parse_rules_file(p.read_text(), p.name) for p in paths]
+    return docs, rfs
+
+
+def _example_rules():
+    out = []
+    for p in sorted(REPO.glob("examples/rules/*/*.guard")):
+        out.append(parse_rules_file(p.read_text(), p.name))
+    return out
+
+
+def _perfile_vs_packed(docs, rfs):
+    """Evaluate every packable file through both paths; assert
+    bit-identity of statuses AND unsure bits."""
+    batch, interner = encode_batch(docs)
+    compiled_files = [compile_rules_file(rf, interner) for rf in rfs]
+    items = [
+        (fi, c)
+        for fi, c in enumerate(compiled_files)
+        if pack_compatible(c) is None
+    ]
+    packed_results = _evaluate_packs(items, batch)
+    assert set(packed_results) == {fi for fi, _ in items}
+    for fi, c in items:
+        ev = ShardedBatchEvaluator(c)
+        st, un, hd = ev.evaluate_bucketed(batch)
+        pst, pun, phd = packed_results[fi]
+        assert np.array_equal(pst, st), f"statuses diverge for file {fi}"
+        assert np.array_equal(pun, un), f"unsure diverges for file {fi}"
+        assert phd == hd
+    return compiled_files, items
+
+
+def test_packed_parity_corpus_slice():
+    docs, rfs = _corpus_slice(24)
+    assert docs, "corpus test inputs missing"
+    _perfile_vs_packed(docs, rfs)
+
+
+def test_packed_parity_examples():
+    rng = np.random.default_rng(2)
+    docs = [from_plain(bench.make_template(rng, i)) for i in range(12)]
+    docs += [from_plain(bench.make_config_item(rng, i)) for i in range(6)]
+    rfs = _example_rules()
+    assert len(rfs) >= 5
+    compiled_files, items = _perfile_vs_packed(docs, rfs)
+    # the examples mix packable and unpackable (fn-var / host-only)
+    # files; the planner must not have dropped any packable one
+    assert len(items) >= 2
+
+
+def test_packed_parity_mixed_host_fallback():
+    """A pack whose neighbors include a host-fallback-only file and a
+    function-variable file: both must route to the per-file path while
+    the rest pack, and the end result must be identical."""
+    rng = np.random.default_rng(7)
+    docs = [from_plain(bench.make_template(rng, i)) for i in range(8)]
+    host_only = parse_rules_file(
+        "rule host_now { Resources.created == now() }", "host.guard"
+    )
+    fn_file = parse_rules_file(
+        "let upper = to_upper(Resources.*.Type)\n"
+        "rule named when Resources exists { %upper !empty }",
+        "fn.guard",
+    )
+    packable = [
+        parse_rules_file(bench.RULES, "a.guard"),
+        parse_rules_file(bench.ENCRYPTION_RULES, "b.guard"),
+    ]
+    batch, interner = encode_batch(docs)
+    compiled = [
+        compile_rules_file(rf, interner)
+        for rf in [packable[0], host_only, fn_file, packable[1]]
+    ]
+    assert compiled[1].host_rules, "now() should refuse lowering"
+    reasons = [pack_compatible(c) for c in compiled]
+    assert reasons[0] is None and reasons[3] is None
+    assert reasons[2] is not None, "fn-var file must be pack-excluded"
+    items = [
+        (fi, c) for fi, c in enumerate(compiled) if pack_compatible(c) is None
+    ]
+    packed_results = _evaluate_packs(items, batch)
+    for fi, c in items:
+        if fi not in packed_results:
+            continue
+        st, un, _hd = ShardedBatchEvaluator(c).evaluate_bucketed(batch)
+        assert np.array_equal(packed_results[fi][0], st)
+        assert np.array_equal(packed_results[fi][1], un)
+
+
+def test_pack_incompatible_raises():
+    rng = np.random.default_rng(9)
+    docs = [from_plain(bench.make_template(rng, i)) for i in range(4)]
+    batch, interner = encode_batch(docs)
+    fn_file = parse_rules_file(
+        "let upper = to_upper(Resources.*.Type)\n"
+        "rule named when Resources exists { %upper !empty }",
+        "fn.guard",
+    )
+    ok = compile_rules_file(parse_rules_file(bench.RULES, "a.guard"), interner)
+    bad = compile_rules_file(fn_file, interner)
+    with pytest.raises(PackIncompatible):
+        pack_compiled([ok, bad])
+
+
+def test_plan_packs_respects_rule_ceiling():
+    class _C:
+        def __init__(self, n):
+            self.rules = [None] * n
+
+    items = [(i, _C(3)) for i in range(10)]
+    packs = plan_packs(items, max_rules=9)
+    assert [len(p) for p in packs] == [3, 3, 3, 1]
+    # file order preserved within and across packs
+    assert [fi for p in packs for fi, _ in p] == list(range(10))
+
+
+def test_packed_dispatch_counters_under_ceiling():
+    """The acceptance counter: over a 24-file corpus slice the packed
+    path must issue >= 10x fewer dispatches than the per-file path and
+    stay under the pinned smoke ceiling."""
+    docs, rfs = _corpus_slice(24)
+    batch, interner = encode_batch(docs)
+    compiled_files = [compile_rules_file(rf, interner) for rf in rfs]
+    items = [
+        (fi, c)
+        for fi, c in enumerate(compiled_files)
+        if pack_compatible(c) is None
+    ]
+    assert len(items) >= 20
+    reset_dispatch_stats()
+    _evaluate_packs(items, batch)
+    packed = dispatch_stats()
+    reset_dispatch_stats()
+    for _, c in items:
+        ShardedBatchEvaluator(c).evaluate_bucketed(batch)
+    perfile = dispatch_stats()
+    assert packed["dispatches"] * 10 <= perfile["dispatches"]
+    assert packed["dispatches"] <= 8  # the CI pack-smoke ceiling
+
+
+def test_segment_doc_status_reduction():
+    PASS, FAIL, SKIP = 0, 1, 2
+    st = np.array(
+        [[PASS, SKIP, FAIL, PASS], [SKIP, SKIP, PASS, SKIP]], np.int8
+    )
+    seg = np.array([0, 0, 1, 1])
+    out = segment_doc_status(st, seg, 2)
+    assert out.tolist() == [[PASS, FAIL], [SKIP, PASS]]
+    any_fail = segment_any(st == FAIL, seg, 2)
+    assert any_fail.tolist() == [[False, True], [False, False]]
+    import jax.numpy as jnp
+
+    outj = segment_doc_status(jnp.asarray(st), seg, 2)
+    assert np.array_equal(np.asarray(outj), out)
+
+
+def test_validate_cli_packed_vs_unpacked_end_to_end(tmp_path):
+    """Exit codes + console output byte-identical with packing on and
+    off, over a doc mix with real failures."""
+    rng = np.random.default_rng(5)
+    for i in range(10):
+        (tmp_path / f"t{i}.json").write_text(
+            json.dumps(bench.make_template(rng, i))
+        )
+    rules = sorted(glob.glob(str(CORPUS / "*.guard")))[:8]
+
+    def run_cli(extra):
+        out, err = io.StringIO(), io.StringIO()
+        rc = run(
+            ["validate", "--backend", "tpu", "-r", *rules,
+             "-d", str(tmp_path)] + extra,
+            writer=Writer(out=out, err=err),
+        )
+        return rc, out.getvalue(), err.getvalue()
+
+    rc1, o1, e1 = run_cli([])
+    rc2, o2, e2 = run_cli(["--no-pack"])
+    assert (rc1, o1, e1) == (rc2, o2, e2)
+
+
+def test_sweep_cli_packed_vs_unpacked(tmp_path):
+    rng = np.random.default_rng(6)
+    data = tmp_path / "data"
+    data.mkdir()
+    for i in range(12):
+        (data / f"t{i}.json").write_text(
+            json.dumps(bench.make_template(rng, i))
+        )
+    rules = sorted(glob.glob(str(CORPUS / "*.guard")))[:6]
+
+    def run_sweep(extra, tag):
+        out, err = io.StringIO(), io.StringIO()
+        rc = run(
+            ["sweep", "-r", *rules, "-d", str(data),
+             "-M", str(tmp_path / f"m_{tag}.jsonl"), "-c", "5"] + extra,
+            writer=Writer(out=out, err=err),
+        )
+        return rc, out.getvalue()
+
+    rc1, o1 = run_sweep([], "packed")
+    rc2, o2 = run_sweep(["--no-pack"], "unpacked")
+    s1, s2 = json.loads(o1), json.loads(o2)
+    assert rc1 == rc2
+    for k in ("counts", "failed", "errors", "documents"):
+        assert s1[k] == s2[k], k

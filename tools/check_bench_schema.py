@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Bench-artifact schema gate: fail when a committed bench artifact is
+missing metric rows the CURRENT bench driver emits.
+
+VERDICT round 5 (Weak #3) caught `bench_all_r5.json` silently lacking
+the `config6_fail_*_python_rerun_docs_per_sec` rows — it was generated
+by an older bench.py and never regenerated, so BASELINE.md quoted
+ratios no committed artifact contained. This gate makes that drift
+loud: the artifact must contain every key `bench.expected_metrics()`
+lists, and every row must carry the driver-contract keys.
+
+Usage:
+    python tools/check_bench_schema.py [artifact.json ...]
+
+With no arguments, checks the newest `bench_all_*.json` in the repo
+root. Artifacts are JSONL (one metric object per line). Extra metrics
+in the artifact are fine (forward compatibility); missing expected
+metrics, malformed lines, or rows without the contract keys exit 1.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import bench  # noqa: E402  (repo root on sys.path above)
+
+CONTRACT_KEYS = ("metric", "value", "unit", "vs_baseline")
+
+
+def check(path: pathlib.Path) -> list:
+    problems = []
+    rows = {}
+    for ln, line in enumerate(path.read_text().splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            problems.append(f"{path}:{ln}: unparseable JSONL line ({e})")
+            continue
+        if not isinstance(obj, dict) or "metric" not in obj:
+            problems.append(f"{path}:{ln}: row without a `metric` key")
+            continue
+        for k in CONTRACT_KEYS:
+            if k not in obj:
+                problems.append(
+                    f"{path}:{ln}: metric {obj.get('metric')!r} missing "
+                    f"contract key {k!r}"
+                )
+        rows[obj["metric"]] = obj
+    for metric in bench.expected_metrics():
+        if metric not in rows:
+            problems.append(
+                f"{path}: missing metric {metric!r} (artifact predates "
+                "the current bench driver — regenerate it)"
+            )
+    return problems
+
+
+def main(argv: list) -> int:
+    if argv:
+        paths = [pathlib.Path(a) for a in argv]
+    else:
+        candidates = sorted(REPO.glob("bench_all_*.json"))
+        if not candidates:
+            print("no bench_all_*.json artifact found", file=sys.stderr)
+            return 1
+        paths = [candidates[-1]]
+    rc = 0
+    for path in paths:
+        if not path.exists():
+            print(f"{path}: does not exist", file=sys.stderr)
+            rc = 1
+            continue
+        problems = check(path)
+        if problems:
+            rc = 1
+            for p in problems:
+                print(p, file=sys.stderr)
+        else:
+            print(f"{path}: ok ({len(bench.expected_metrics())} expected "
+                  "metrics all present)")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
