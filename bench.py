@@ -710,6 +710,144 @@ def measure_corpus_packed(n_files: Optional[int] = None, n_docs: int = 2048,
     )
 
 
+def measure_rim(n_files: Optional[int] = None, n_docs: int = 2048,
+                reps: int = 3):
+    """Config 5b rim decomposition: with the kernel collapsed to one
+    packed dispatch (PR 1), where does the remaining host time go? Times
+    the two results-plane consumers over the SAME packed device output:
+
+      scalar — the per-(doc, rule) Python walk (pass A dict build +
+          per-doc report construction, GUARD_TPU_VECTOR_RIM=0);
+      vector — mask arithmetic over the device-reduced rim blocks +
+          bulk materialization (per-doc dicts only for mask-selected
+          docs, settled docs served from the per-unique-row cache).
+
+    Returns (vector_docs_per_sec, scalar_docs_per_sec, kernel_seconds,
+    rim_vector_seconds, rim_scalar_seconds, docs_materialized,
+    docs_settled) — docs/sec count each doc once per registry pass
+    (all files)."""
+    from guard_tpu.core.qresult import Status
+    from guard_tpu.ops import backend
+    from guard_tpu.ops.encoder import encode_batch
+    from guard_tpu.ops.ir import compile_rules_file, pack_compatible
+
+    docs, rfs, _paths = _load_corpus_workload(n_files, n_docs)
+    n_docs = len(docs)
+    batch, interner = encode_batch(docs)
+    compiled_files = [compile_rules_file(rf, interner) for rf in rfs]
+    items = [
+        (fi, c)
+        for fi, c in enumerate(compiled_files)
+        if pack_compatible(c) is None
+    ]
+    backend._evaluate_packs(items, batch)  # warm (trace + XLA compile)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        packed_results = backend._evaluate_packs(items, batch)
+    t_kernel = (time.perf_counter() - t0) / reps
+    by_fi = {fi: c for fi, c in items}
+
+    def scalar_rim():
+        for fi, (statuses, unsure, host_docs, _rim) in packed_results.items():
+            compiled = by_fi[fi]
+            for di in range(n_docs):
+                rule_statuses = {}
+                doc_status = Status.SKIP
+                if di not in host_docs:
+                    for ri, crule in enumerate(compiled.rules):
+                        st = backend._STATUS[int(statuses[di, ri])]
+                        prev = rule_statuses.get(crule.name)
+                        if prev is None or (
+                            prev == Status.SKIP and st != Status.SKIP
+                        ):
+                            rule_statuses[crule.name] = st
+                        elif st == Status.FAIL:
+                            rule_statuses[crule.name] = Status.FAIL
+                        doc_status = doc_status.and_(st)
+                report = {
+                    "name": f"d{di}",
+                    "metadata": {},
+                    "status": doc_status.value,
+                    "not_compliant": [
+                        n
+                        for n, s in sorted(rule_statuses.items())
+                        if s == Status.FAIL
+                    ],
+                    "not_applicable": sorted(
+                        n for n, s in rule_statuses.items()
+                        if s == Status.SKIP
+                    ),
+                    "compliant": sorted(
+                        n for n, s in rule_statuses.items()
+                        if s == Status.PASS
+                    ),
+                }
+                assert report
+
+    def vector_rim():
+        import numpy as np
+
+        materialized = settled = 0
+        for fi, (statuses, unsure, host_docs, rim) in packed_results.items():
+            compiled = by_fi[fi]
+            if rim is None:  # GUARD_TPU_VECTOR_RIM=0 run: host reduce
+                from guard_tpu.ops.ir import build_rim_spec
+                from guard_tpu.ops.kernels import rim_reduce
+
+                spec = build_rim_spec([compiled.rules])
+                blocks = rim_reduce(
+                    statuses, unsure, spec.group_ids, spec.file_ids,
+                    spec.last_ids, spec.n_groups, spec.n_files,
+                )
+                rim = (
+                    blocks[0], blocks[1], blocks[2][:, 0], blocks[3][:, 0],
+                    blocks[4][:, 0], blocks[5], spec.file_group_names[0],
+                )
+            name_st, name_un, _doc_st, any_fail, any_un = rim[:5]
+            names = rim[6]
+            host_mask = np.zeros(n_docs, bool)
+            for hd in host_docs:
+                host_mask[hd] = True
+            need_oracle, needs_statuses, materialize = backend.rim_masks(
+                any_fail, any_un, host_mask, bool(compiled.host_rules),
+                False, False,
+            )
+            row_cache = {}
+            for di in np.nonzero(materialize)[0]:
+                backend._materialize_row(
+                    name_st[di], name_un[di], names
+                )
+                materialized += 1
+            for di in np.nonzero(~materialize)[0]:
+                key = name_st[di].tobytes()
+                if key not in row_cache:
+                    row_cache[key] = backend._settled_template(
+                        name_st[di], names
+                    )
+                settled += 1
+        return materialized, settled
+
+    scalar_rim()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        scalar_rim()
+    t_scalar = (time.perf_counter() - t0) / reps
+    n_mat, n_settled = vector_rim()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        vector_rim()
+    t_vector = (time.perf_counter() - t0) / reps
+    return (
+        n_docs / t_vector,
+        n_docs / t_scalar,
+        t_kernel,
+        t_vector,
+        t_scalar,
+        n_mat,
+        n_settled,
+    )
+
+
 def pack_smoke(n_files: int = 40, n_docs: int = 48,
                dispatch_ceiling: int = 8) -> None:
     """CI bench-smoke (JAX_PLATFORMS=cpu, tiny corpus slice): asserts
@@ -749,6 +887,56 @@ def pack_smoke(n_files: int = 40, n_docs: int = 48,
         and np.array_equal(packed_results[fi][1], perfile_results[fi][1])
         for fi in packed_results
     )
+
+    # rim smoke (PR 2): the vectorized results plane must (a) be active
+    # on the packed path (device-reduced rim blocks present), (b) agree
+    # bit-for-bit with a host rim_reduce over the same statuses, and
+    # (c) select for materialization EXACTLY the (file, doc) pairs the
+    # raw status matrix justifies — a FAIL, an unsure flag, a host doc
+    # or host rules. Every all-PASS pair must settle in-array (zero
+    # per-rule dicts), and the smoke corpus must actually contain such
+    # pairs.
+    from guard_tpu.ops import backend as _backend
+    from guard_tpu.ops.ir import build_rim_spec
+    from guard_tpu.ops.kernels import rim_reduce
+
+    n_docs_b = batch.n_docs
+    rim_active = True
+    rim_parity = True
+    mask_exact = True
+    settled_pairs = 0
+    materialized_on_all_pass = 0
+    for fi, (st, un, host_docs, rim) in packed_results.items():
+        if rim is None:
+            rim_active = False
+            continue
+        c = next(c for f2, c in items if f2 == fi)
+        spec = build_rim_spec([c.rules])
+        host = rim_reduce(
+            st, un, spec.group_ids, spec.file_ids, spec.last_ids,
+            spec.n_groups, spec.n_files,
+        )
+        rim_parity = rim_parity and all(
+            np.array_equal(rim[b], blk)
+            for b, blk in enumerate(
+                (host[0], host[1], host[2][:, 0], host[3][:, 0],
+                 host[4][:, 0], host[5])
+            )
+        )
+        host_mask = np.zeros(n_docs_b, bool)
+        for hd in host_docs:
+            host_mask[hd] = True
+        _no, _ns, materialize = _backend.rim_masks(
+            rim[3], rim[4], host_mask, bool(c.host_rules),
+            False, False,
+        )
+        # independent ground truth from the RAW status matrix
+        bad = (st == 1).any(axis=1) | un.any(axis=1) | host_mask
+        if c.host_rules:
+            bad = bad | True
+        mask_exact = mask_exact and bool(np.array_equal(materialize, bad))
+        settled_pairs += int((~materialize).sum())
+        materialized_on_all_pass += int((materialize & ~bad).sum())
     record = {
         "metric": "pack_smoke",
         "files": len(items),
@@ -758,6 +946,11 @@ def pack_smoke(n_files: int = 40, n_docs: int = 48,
         "perfile_executables_compiled": perfile["executables_compiled"],
         "dispatch_ceiling": dispatch_ceiling,
         "parity": parity_ok,
+        "rim_vector_active": rim_active,
+        "rim_block_parity": rim_parity,
+        "rim_mask_exact": mask_exact,
+        "rim_settled_pairs": settled_pairs,
+        "rim_docs_materialized_on_all_pass": materialized_on_all_pass,
     }
     print(json.dumps(record), flush=True)
     ok = (
@@ -765,6 +958,11 @@ def pack_smoke(n_files: int = 40, n_docs: int = 48,
         and len(packed_results) == len(items)
         and packed["dispatches"] <= dispatch_ceiling
         and packed["dispatches"] * 10 <= perfile["dispatches"]
+        and rim_active
+        and rim_parity
+        and mask_exact
+        and settled_pairs > 0
+        and materialized_on_all_pass == 0
     )
     if not ok:
         raise SystemExit(1)
@@ -836,9 +1034,11 @@ def measure_fail_heavy(frac_fail: float, statuses_only: bool, n_docs: int = 1024
     raw_docs = [json.dumps(d) for d in docs_plain]
 
     vals = []
+    extra = {}
     for _ in range(3):
         t0 = time.perf_counter()
         statuses = np.asarray(ev(batch))
+        t_device = time.perf_counter() - t0
         n_fail_rerun = 0
         if not statuses_only:
             fail_rows = (statuses == 1).any(axis=1)
@@ -853,11 +1053,22 @@ def measure_fail_heavy(frac_fail: float, statuses_only: bool, n_docs: int = 1024
                             scope.reset_recorder().extract(), f"d{di}"
                         )
                     n_fail_rerun += 1
-        vals.append(n_docs / (time.perf_counter() - t0))
+        total = time.perf_counter() - t0
+        vals.append(n_docs / total)
+        # rim decomposition: device statuses vs the per-failing-doc
+        # host materialization (the rich rerun) — the counter mirrors
+        # backend.RIM_COUNTERS semantics (failing docs materialize,
+        # passing docs settle in-array)
+        extra = {
+            "docs_materialized": n_fail_rerun,
+            "docs_settled": n_docs - n_fail_rerun,
+            "device_seconds": round(t_device, 4),
+            "host_materialize_seconds": round(total - t_device, 4),
+        }
     if native is not None:
         native.close()
     vals.sort()
-    return vals[len(vals) // 2]
+    return vals[len(vals) // 2], extra
 
 
 def _measure_spread(med, fn1, fnk, k_inner: int, n_docs: int, reps: int = 3):
@@ -931,6 +1142,8 @@ def expected_metrics() -> list:
         "config5b_corpus_doc_rule_pairs_per_sec",
         "config5b_packed_templates_per_sec",
         "config5b_perfile_templates_per_sec",
+        "config5b_rim_vector_docs_per_sec",
+        "config5b_rim_scalar_docs_per_sec",
         "config5c_rule_sharded_templates_per_sec",
     ]
     for tag in ("50pct", "allfail"):
@@ -1032,6 +1245,39 @@ def main() -> None:
         },
     )
 
+    # config 5b rim decomposition: with the kernel fused to one
+    # dispatch, the remaining host time is the results-plane rim —
+    # these two rows time the scalar per-(doc, rule) walk vs the
+    # vectorized mask-arithmetic + bulk-materialization path over the
+    # SAME packed device output, and the packed row's extras above say
+    # how kernel vs rim time split per run
+    (
+        v_rim_vec, v_rim_scalar, t_kernel, t_rim_vec, t_rim_scalar,
+        n_mat, n_settled,
+    ) = measure_rim()
+    _emit(
+        "config5b_rim_vector_docs_per_sec",
+        v_rim_vec,
+        v_rim_vec / max(v_rim_scalar, 1e-9),
+        extra={
+            "docs_materialized": n_mat,
+            "docs_settled": n_settled,
+            "kernel_seconds_per_run": round(t_kernel, 4),
+            "rim_seconds_per_run": round(t_rim_vec, 4),
+            "vs_note": "vs_baseline here = speedup over the scalar rim on the same packed device output",
+        },
+    )
+    _emit(
+        "config5b_rim_scalar_docs_per_sec",
+        v_rim_scalar,
+        1.0,
+        extra={
+            "docs_materialized": n_mat + n_settled,
+            "docs_settled": 0,
+            "rim_seconds_per_run": round(t_rim_scalar, 4),
+        },
+    )
+
     # config 5c: rule-axis sharding with PACKS as the unit
     # (parallel/rules.PackShardedEvaluator) vs the serial per-file
     # loop on the same workload — the number now measures sharding,
@@ -1056,56 +1302,66 @@ def main() -> None:
     # oracle fail-rerun (rich reports per failing doc) vs the
     # --statuses-only escape hatch
     for frac, tag in ((0.5, "50pct"), (1.0, "allfail")):
-        full = measure_fail_heavy(frac, statuses_only=False)
-        lean = measure_fail_heavy(frac, statuses_only=True)
+        full, full_x = measure_fail_heavy(frac, statuses_only=False)
+        lean, lean_x = measure_fail_heavy(frac, statuses_only=True)
         # the round-2/3 verdicts' comparison flow: device statuses +
         # per-failing-doc PYTHON-oracle rerun (what the backend did
         # before the native records engine existed) — `full`'s
         # vs_baseline divides by it, so the improvement the native
         # rerun buys is read directly off the full row
-        pyflow = measure_fail_heavy(
+        pyflow, py_x = measure_fail_heavy(
             frac, statuses_only=False, force_python_rerun=True
         )
         _emit(
             f"config6_fail_{tag}_full_docs_per_sec",
             full,
             full / max(pyflow, 1e-9),
+            extra=full_x,
         )
         _emit(
             f"config6_fail_{tag}_python_rerun_docs_per_sec",
             pyflow,
             1.0,
+            extra=py_x,
         )
         _emit(
             f"config6_fail_{tag}_statuses_only_docs_per_sec",
             lean,
             lean / max(pyflow, 1e-9),
+            extra=lean_x,
         )
         # batch-size amortization rows (VERDICT r5 Weak #2): the
         # per-dispatch tunnel charge is fixed, so 8k/16k-doc batches
         # amortize it to ~12-24µs/doc and the >=5x native-vs-Python
         # rerun claim is read directly off the full/python_rerun ratio
         for nd in FAIL_HEAVY_BATCH_SIZES:
-            full_n = measure_fail_heavy(frac, statuses_only=False, n_docs=nd)
-            py_n = measure_fail_heavy(
+            full_n, full_nx = measure_fail_heavy(
+                frac, statuses_only=False, n_docs=nd
+            )
+            py_n, py_nx = measure_fail_heavy(
                 frac, statuses_only=False, n_docs=nd,
                 force_python_rerun=True,
             )
-            lean_n = measure_fail_heavy(frac, statuses_only=True, n_docs=nd)
+            lean_n, lean_nx = measure_fail_heavy(
+                frac, statuses_only=True, n_docs=nd
+            )
             _emit(
                 f"config6_fail_{tag}_docs{nd}_full_docs_per_sec",
                 full_n,
                 full_n / max(py_n, 1e-9),
+                extra=full_nx,
             )
             _emit(
                 f"config6_fail_{tag}_docs{nd}_python_rerun_docs_per_sec",
                 py_n,
                 1.0,
+                extra=py_nx,
             )
             _emit(
                 f"config6_fail_{tag}_docs{nd}_statuses_only_docs_per_sec",
                 lean_n,
                 lean_n / max(py_n, 1e-9),
+                extra=lean_nx,
             )
 
 

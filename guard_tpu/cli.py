@@ -68,6 +68,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="tpu backend: disable fused multi-rule-file dispatch "
         "(evaluate each rule file through its own executable)",
     )
+    v.add_argument(
+        "--no-vector-rim",
+        action="store_true",
+        help="tpu backend: disable the vectorized results plane "
+        "(per-doc scalar status walk instead of mask arithmetic + "
+        "bulk report materialization)",
+    )
 
     t = sub.add_parser("test", help="Test rules against expectations")
     t.add_argument("--rules-file", "-r", dest="rules", default=None)
@@ -115,6 +122,12 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="tpu backend: disable fused multi-rule-file dispatch "
         "(evaluate each rule file through its own executable)",
+    )
+    s.add_argument(
+        "--no-vector-rim",
+        action="store_true",
+        help="tpu backend: disable the vectorized results plane "
+        "(scalar per-doc chunk tallies)",
     )
 
     pt = sub.add_parser("parse-tree", help="Prints the parse tree for a rules file")
@@ -170,6 +183,7 @@ def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reade
                 backend=args.backend,
                 statuses_only=args.statuses_only,
                 pack_rules=not args.no_pack,
+                vector_rim=not args.no_vector_rim,
             )
             return cmd.execute(writer, reader)
         if args.command == "test":
@@ -195,6 +209,7 @@ def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reade
                 rule_shards=args.rule_shards,
                 last_modified=args.last_modified,
                 pack_rules=not args.no_pack,
+                vector_rim=not args.no_vector_rim,
             ).execute(writer, reader)
         if args.command == "parse-tree":
             return ParseTree(

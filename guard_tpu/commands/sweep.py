@@ -80,6 +80,9 @@ class Sweep:
     # .pack_compiled): one device dispatch per (pack, bucket) instead
     # of one per rule file; --no-pack restores per-file dispatch
     pack_rules: bool = True
+    # vectorized results plane (array chunk tallies, backend rim
+    # blocks); --no-vector-rim restores the scalar per-doc dict walk
+    vector_rim: bool = True
 
     def execute(self, writer: Writer, reader: Reader) -> int:
         if not self.rules:
@@ -234,10 +237,11 @@ class Sweep:
             encoded = None
 
         per_doc: List[Dict[str, Status]] = [dict() for _ in data_files]
+        vec_box: dict = {}
         if self.backend == "tpu":
             errors += self._eval_tpu(
                 data_files, rule_files, per_doc, writer, err_box,
-                encoded=encoded, after_dispatch=prefetch,
+                encoded=encoded, after_dispatch=prefetch, vec_box=vec_box,
             )
         else:
             errors += self._eval_oracle(
@@ -245,16 +249,23 @@ class Sweep:
             )
         errors += err_box[0]
 
-        for df, statuses in zip(data_files, per_doc):
-            if getattr(df, "_pv_failed", False):
-                continue  # unparseable doc: error counted, not tallied
-            doc_status = Status.SKIP
-            for st in statuses.values():
-                doc_status = doc_status.and_(st)
-            counts[doc_status.value.lower()] += 1
-            fails = sorted(n for n, s in statuses.items() if s == Status.FAIL)
-            if fails:
-                failed.append({"data": df.name, "rules": fails})
+        if vec_box.get("active"):
+            self._tally_vectorized(
+                data_files, vec_box, counts, failed
+            )
+        else:
+            for df, statuses in zip(data_files, per_doc):
+                if getattr(df, "_pv_failed", False):
+                    continue  # unparseable doc: error counted, not tallied
+                doc_status = Status.SKIP
+                for st in statuses.values():
+                    doc_status = doc_status.and_(st)
+                counts[doc_status.value.lower()] += 1
+                fails = sorted(
+                    n for n, s in statuses.items() if s == Status.FAIL
+                )
+                if fails:
+                    failed.append({"data": df.name, "rules": fails})
 
         return {
             "chunk": ci,
@@ -334,17 +345,22 @@ class Sweep:
         files split across `rule_shards` device groups, each group one
         packed executable on its own sub-mesh; all (group, bucket)
         work dispatches before anything collects. Returns the same
-        {file_idx: (statuses, unsure, host_docs)} map as
-        backend._evaluate_packs."""
+        {file_idx: (statuses, unsure, host_docs, rim)} map as
+        backend._evaluate_packs — with the vectorized rim on, each
+        shard reduces its statuses on device and the per-file rim
+        blocks come back assembled by PackShardedEvaluator.collect."""
         import numpy as np
 
+        from ..ops.backend import vector_rim_enabled
         from ..ops.encoder import NODE_BUCKETS_EXTENDED, split_batch_by_size
         from ..ops.ir import SKIP, PackIncompatible
         from ..parallel.rules import PackShardedEvaluator
 
+        with_rim = vector_rim_enabled() and self.vector_rim
         try:
             ev = PackShardedEvaluator(
-                [c for _, c in items], rule_shards=self.rule_shards
+                [c for _, c in items], rule_shards=self.rule_shards,
+                with_rim=with_rim,
             )
         except PackIncompatible:
             if after_dispatch is not None:
@@ -357,33 +373,63 @@ class Sweep:
             after_dispatch()
         statuses = np.full((batch.n_docs, ev.n_rules), SKIP, np.int8)
         unsure = np.zeros((batch.n_docs, ev.n_rules), bool)
+        spec = ev.rim_spec
+        rim = None
+        if with_rim:
+            rim = (
+                np.full((batch.n_docs, spec.n_groups), SKIP, np.int8),
+                np.zeros((batch.n_docs, spec.n_groups), bool),
+                np.full((batch.n_docs, spec.n_files), SKIP, np.int8),
+                np.zeros((batch.n_docs, spec.n_files), bool),
+                np.zeros((batch.n_docs, spec.n_files), bool),
+                np.full((batch.n_docs, spec.n_groups), SKIP, np.int8),
+            )
         for idx, handle in pending:
-            st, un = ev.collect(handle)
-            statuses[idx] = st
-            if un is not None:
-                unsure[idx] = un
+            collected = ev.collect(handle)
+            statuses[idx] = collected[0]
+            if collected[1] is not None:
+                unsure[idx] = collected[1]
+            if with_rim:
+                for b, block in enumerate(collected[2]):
+                    rim[b][idx] = block
         results = {}
         base = 0
-        for fi, c in items:
+        for k, (fi, c) in enumerate(items):
             r = len(c.rules)
+            rim_f = None
+            if with_rim:
+                gsl = spec.file_slice(k)
+                rim_f = (
+                    rim[0][:, gsl], rim[1][:, gsl], rim[2][:, k],
+                    rim[3][:, k], rim[4][:, k], rim[5][:, gsl],
+                    spec.file_group_names[k],
+                )
             results[fi] = (
                 statuses[:, base : base + r],
                 unsure[:, base : base + r],
                 set(host_docs),
+                rim_f,
             )
             base += r
         return results
 
     def _eval_tpu(self, data_files, rule_files, per_doc, writer, err_box,
-                  encoded=None, after_dispatch=None) -> int:
+                  encoded=None, after_dispatch=None, vec_box=None) -> int:
         import os
 
-        from ..ops.backend import _evaluate_packs, _honor_platform_env
+        import numpy as np
+
+        from ..ops.backend import (
+            _evaluate_packs,
+            _honor_platform_env,
+            vector_rim_enabled,
+        )
         from ..ops.encoder import encode_batch
         from ..ops.ir import (
             FAIL,
             PASS,
             SKIP,
+            build_rim_spec,
             compile_rules_file,
             pack_compatible,
         )
@@ -429,6 +475,16 @@ class Sweep:
             compiled = compile_rules_file(rf.rules, interner)
             prep.append((rf, rf_batch, compiled))
 
+        # vectorized rim (GUARD_TPU_VECTOR_RIM, --no-vector-rim): skip
+        # the O(docs x rules) per-doc dict fill entirely — keep
+        # per-file name_last blocks (the dict-overwrite semantics as an
+        # array) plus the oracle's writes per file, and let
+        # _tally_vectorized fold the chunk tallies as array math,
+        # replaying dicts only for docs an oracle actually touched
+        vec_on = (
+            vec_box is not None and vector_rim_enabled() and self.vector_rim
+        )
+
         # fused multi-rule-file dispatch: compatible files evaluate as
         # packed executables; with rule_shards > 1 the packs shard
         # across disjoint device groups (PackShardedEvaluator)
@@ -448,17 +504,21 @@ class Sweep:
                 )
             else:
                 packed_results = _evaluate_packs(
-                    items, batch, after_dispatch=after_dispatch
+                    items, batch, after_dispatch=after_dispatch,
+                    with_rim=vec_on,
                 )
         elif after_dispatch is not None:
             after_dispatch()
 
+        recs: list = []
+        D = len(data_files)
         for fi, (rf, rf_batch, compiled) in enumerate(prep):
             unsure = None
             host_docs = set()
             statuses = None
+            rim = None
             if fi in packed_results:
-                statuses, unsure, host_docs = packed_results[fi]
+                statuses, unsure, host_docs, rim = packed_results[fi]
             elif compiled.rules:
                 if self.rule_shards > 1:
                     from ..parallel.mesh import evaluate_bucketed
@@ -475,24 +535,38 @@ class Sweep:
                     statuses, unsure, host_docs = evaluator.evaluate_bucketed(
                         rf_batch
                     )
-            if statuses is not None:
-                for di in range(len(data_files)):
+            names: list = []
+            name_last = None
+            if statuses is not None and vec_on:
+                if rim is not None:
+                    name_last, names = rim[5], rim[6]
+                else:
+                    spec = build_rim_spec([compiled.rules])
+                    names = spec.file_group_names[0]
+                    name_last = statuses[:, spec.last_ids]
+            elif statuses is not None:
+                for di in range(D):
                     if di in host_docs:
                         continue
                     for ri, crule in enumerate(compiled.rules):
                         per_doc[di][crule.name] = _status[int(statuses[di, ri])]
+            # oracle writes land in a per-file dict list under the
+            # vectorized tally (the replay needs them file-ordered and
+            # separate from the device blocks); straight into per_doc
+            # on the scalar path
+            target = [dict() for _ in data_files] if vec_on else per_doc
             # oversize docs: the oracle evaluates EVERY rule for them,
             # so the host-rules pass below excludes them (no
             # double-evaluation / double-counted errors)
             if host_docs:
                 errors += self._eval_oracle(
-                    data_files, [rf], {"only_docs": host_docs}, per_doc,
+                    data_files, [rf], {"only_docs": host_docs}, target,
                     writer, err_box,
                 )
             # host fallback: unlowerable rules run on the oracle for
             # every other doc; unsure-flagged docs re-run all rules
             if compiled.host_rules:
-                rest = set(range(len(data_files))) - host_docs
+                rest = set(range(D)) - host_docs
                 if rest:
                     errors += self._eval_oracle(
                         data_files,
@@ -503,20 +577,90 @@ class Sweep:
                             },
                             "only_docs": rest,
                         },
-                        per_doc,
+                        target,
                         writer,
                         err_box,
                     )
             if unsure is not None:
                 oracle_docs = {
-                    di for di in range(len(data_files)) if bool(unsure[di].any())
+                    int(di) for di in np.nonzero(unsure.any(axis=1))[0]
                 }
                 if oracle_docs:
                     errors += self._eval_oracle(
                         data_files, [rf], {"only_docs": oracle_docs},
-                        per_doc, writer, err_box,
+                        target, writer, err_box,
                     )
+            if vec_on:
+                recs.append(
+                    (names, name_last, statuses is not None,
+                     set(host_docs), target)
+                )
+        if vec_box is not None:
+            vec_box["active"] = vec_on
+            vec_box["files"] = recs
         return errors
+
+    @staticmethod
+    def _tally_vectorized(data_files, vec_box, counts, failed) -> None:
+        """Chunk tallies from the per-file rim blocks: per-doc status =
+        the lattice fold over each rule name's WINNING value (dict
+        overwrite order: later files beat earlier ones, the last
+        same-name rule beats previous ones — exactly what the scalar
+        per_doc fill produced). Docs an oracle touched replay the dict
+        build (device names first, that file's oracle writes after, per
+        file in order); everything else folds as one numpy pass."""
+        import numpy as np
+
+        from ..ops.ir import FAIL
+
+        _st = {0: Status.PASS, 1: Status.FAIL, 2: Status.SKIP}
+        recs = vec_box["files"]
+        D = len(data_files)
+        replay = set()
+        for _names, _nl, _hasdev, host_docs_f, owrites_f in recs:
+            replay |= {int(i) for i in host_docs_f}
+            replay.update(di for di in range(D) if owrites_f[di])
+        # winning (file, group) per rule name for the clean-doc matrix
+        winner: Dict[str, tuple] = {}
+        for fp, (names, _nl, has_device, _hd, _ow) in enumerate(recs):
+            if has_device:
+                for g, n in enumerate(names):
+                    winner[n] = (fp, g)
+        wnames = list(winner)
+        doc_prio = None
+        M = None
+        if wnames:
+            M = np.stack(
+                [recs[fp][1][:, g] for fp, g in winner.values()], axis=1
+            )
+            # PASS=0,FAIL=1,SKIP=2 -> priority SKIP<PASS<FAIL
+            prio = np.array([1, 2, 0], np.int8)[M]
+            doc_prio = prio.max(axis=1)
+        for di, df in enumerate(data_files):
+            if getattr(df, "_pv_failed", False):
+                continue  # unparseable doc: error counted, not tallied
+            if di in replay:
+                d: Dict[str, Status] = {}
+                for names, name_last, has_device, host_docs_f, owrites_f in recs:
+                    if has_device and di not in host_docs_f:
+                        for g, n in enumerate(names):
+                            d[n] = _st[int(name_last[di, g])]
+                    d.update(owrites_f[di])
+                doc_status = Status.SKIP
+                for st in d.values():
+                    doc_status = doc_status.and_(st)
+                counts[doc_status.value.lower()] += 1
+                fails = sorted(n for n, s in d.items() if s == Status.FAIL)
+            else:
+                p = int(doc_prio[di]) if doc_prio is not None else 0
+                counts[("skip", "pass", "fail")[p]] += 1
+                fails = []
+                if p == 2:
+                    fails = sorted(
+                        wnames[c] for c in np.nonzero(M[di] == FAIL)[0]
+                    )
+            if fails:
+                failed.append({"data": df.name, "rules": fails})
 
     def _eval_oracle(self, data_files, rule_files, restrict, per_doc, writer,
                      err_box) -> int:

@@ -152,6 +152,10 @@ class Validate:
     # instead of one per rule file); `--no-pack` restores the per-file
     # dispatch path, e.g. to bisect a suspected packing divergence
     pack_rules: bool = True
+    # the vectorized results plane (device-side rim reductions + bulk
+    # report materialization, ops/backend.py); `--no-vector-rim` (or
+    # GUARD_TPU_VECTOR_RIM=0) restores the scalar per-(doc, rule) walk
+    vector_rim: bool = True
 
     # -- argument validation (validate.rs:205-232) --------------------
     def _validate_args(self) -> None:

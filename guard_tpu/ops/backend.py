@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import json
 import logging
+import os
+from collections import OrderedDict
 from typing import List
 
 log = logging.getLogger("guard_tpu.backend")
@@ -37,9 +39,63 @@ _STATUS = {PASS: Status.PASS, FAIL: Status.FAIL, SKIP: Status.SKIP}
 # this (one pack executable traces every packed rule program, so the
 # cap bounds trace/compile time for pathologically huge registries;
 # the 250-file corpus' ~257 rules fit in ONE pack at the default)
-import os as _os
+PACK_MAX_RULES = int(os.environ.get("GUARD_TPU_PACK_MAX_RULES", "512"))
 
-PACK_MAX_RULES = int(_os.environ.get("GUARD_TPU_PACK_MAX_RULES", "512"))
+
+def vector_rim_enabled() -> bool:
+    """The vectorized results plane (device-side rim reductions, numpy
+    mask arithmetic in pass A, bulk report materialization in pass B).
+    `GUARD_TPU_VECTOR_RIM=0` is the bit-parity escape hatch back to the
+    scalar per-(doc, rule) walk; read at call time so one process can
+    compare both (tests/test_vector_rim.py does)."""
+    return os.environ.get("GUARD_TPU_VECTOR_RIM", "1") != "0"
+
+
+# Rim observability, next to PR 1's dispatch counters
+# (parallel.mesh.DISPATCH_COUNTERS): `docs_materialized` counts (doc,
+# rule-file) pairs whose per-rule status dict was actually built —
+# failures, unsure-flagged, host-fallback, rich output — and
+# `docs_settled` those answered entirely in-array (report/console/JUnit
+# served from the shared per-unique-status-row cache). The scalar rim
+# materializes EVERY doc, so the all-PASS CI rim-smoke pins
+# docs_materialized == 0 only on the vectorized path.
+RIM_COUNTERS = {"docs_materialized": 0, "docs_settled": 0}
+
+
+def rim_stats() -> dict:
+    return dict(RIM_COUNTERS)
+
+
+def reset_rim_stats() -> None:
+    RIM_COUNTERS["docs_materialized"] = 0
+    RIM_COUNTERS["docs_settled"] = 0
+
+
+# pack_compiled output cache: the slot relocation is a pure function of
+# the member CompiledRules objects, so repeated evaluation of the same
+# registry (serve sessions, sweep chunks re-using lowered files, bench
+# reps) skips the IR rewrite. Keyed by member identity; values keep the
+# members alive so ids cannot be recycled under the cache.
+_PACK_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
+_PACK_CACHE_MAX = 8
+
+
+def _pack_cached(parts: list):
+    """pack_compiled(parts) with an LRU over member identities.
+    Returns (PackedRules, RimSpec)."""
+    from .ir import pack_compiled
+
+    key = tuple(id(c) for c in parts)
+    hit = _PACK_CACHE.get(key)
+    if hit is not None:
+        _PACK_CACHE.move_to_end(key)
+        return hit[1], hit[2]
+    packed = pack_compiled(parts)
+    spec = packed.rim_spec()
+    _PACK_CACHE[key] = (list(parts), packed, spec)
+    while len(_PACK_CACHE) > _PACK_CACHE_MAX:
+        _PACK_CACHE.popitem(last=False)
+    return packed, spec
 
 
 def dispatch_stats() -> dict:
@@ -79,7 +135,7 @@ def plan_packs(items, max_rules: int = None):
     return packs
 
 
-def _evaluate_packs(items, batch, after_dispatch=None) -> dict:
+def _evaluate_packs(items, batch, after_dispatch=None, with_rim=None) -> dict:
     """The fused multi-rule-file dispatch pipeline: pack the compatible
     compiled files (plan_packs), then dispatch EVERY (pack, bucket
     group) before collecting any — JAX dispatch is async, so host
@@ -88,13 +144,21 @@ def _evaluate_packs(items, batch, after_dispatch=None) -> dict:
     commands/sweep.py encodes doc chunk k+1 in it while the device
     executes chunk k) runs once everything is in flight, before the
     first collect. Returns {file_idx: (statuses (D, R_f) int8, unsure
-    (D, R_f) bool, host_docs set)} sliced per file through the pack's
-    segment map; files left out of the result fall back to the
-    per-file path unchanged."""
+    (D, R_f) bool, host_docs set, rim)} sliced per file through the
+    pack's segment map; files left out of the result fall back to the
+    per-file path unchanged.
+
+    `rim` is the file's slice of the device-reduced results plane —
+    (name_statuses (D, G_f), name_unsure (D, G_f), doc_status (D,),
+    any_fail (D,), any_unsure (D,), name_last (D, G_f), group names) —
+    or None when the vectorized rim is disabled (GUARD_TPU_VECTOR_RIM
+    =0): the reductions ride the same dispatch, so per-(pack, bucket)
+    only the blocks pass A actually consumes cross the device
+    boundary alongside the status matrix."""
     import numpy as np
 
     from .encoder import NODE_BUCKETS_EXTENDED, split_batch_by_size
-    from .ir import PackIncompatible, pack_compiled
+    from .ir import PackIncompatible
     from ..parallel.mesh import ShardedBatchEvaluator
 
     results: dict = {}
@@ -102,6 +166,8 @@ def _evaluate_packs(items, batch, after_dispatch=None) -> dict:
         if after_dispatch is not None:
             after_dispatch()
         return results
+    if with_rim is None:
+        with_rim = vector_rim_enabled()
     groups, oversize = split_batch_by_size(batch, NODE_BUCKETS_EXTENDED)
     host_docs = {int(i) for i in oversize}
     pending = []
@@ -109,29 +175,52 @@ def _evaluate_packs(items, batch, after_dispatch=None) -> dict:
         if len(pack) < 2:
             continue  # a singleton pack gains nothing over per-file
         try:
-            packed = pack_compiled([c for _, c in pack])
+            packed, spec = _pack_cached([c for _, c in pack])
         except PackIncompatible as e:
             log.info("pack of %d files fell back to per-file: %s",
                      len(pack), e)
             continue
-        ev = ShardedBatchEvaluator(packed.compiled)
+        ev = ShardedBatchEvaluator(
+            packed.compiled, rim_spec=spec if with_rim else None
+        )
         handles = [(idx, ev.dispatch(sub)) for sub, idx in groups]
-        pending.append((pack, packed, ev, handles))
+        pending.append((pack, packed, spec, ev, handles))
     if after_dispatch is not None:
         after_dispatch()
-    for pack, packed, ev, handles in pending:
+    for pack, packed, spec, ev, handles in pending:
         n_rules = len(packed.compiled.rules)
         statuses = np.full((batch.n_docs, n_rules), SKIP, np.int8)
         unsure = np.zeros((batch.n_docs, n_rules), bool)
+        rim = None
+        if with_rim:
+            rim = (
+                np.full((batch.n_docs, spec.n_groups), SKIP, np.int8),
+                np.zeros((batch.n_docs, spec.n_groups), bool),
+                np.full((batch.n_docs, spec.n_files), SKIP, np.int8),
+                np.zeros((batch.n_docs, spec.n_files), bool),
+                np.zeros((batch.n_docs, spec.n_files), bool),
+                np.full((batch.n_docs, spec.n_groups), SKIP, np.int8),
+            )
         for idx, handle in handles:
-            st, un = ev.collect(handle)
-            statuses[idx] = st
-            if un is not None:
-                unsure[idx] = un
+            collected = ev.collect(handle)
+            statuses[idx] = collected[0]
+            if collected[1] is not None:
+                unsure[idx] = collected[1]
+            if with_rim:
+                for b, block in enumerate(collected[2]):
+                    rim[b][idx] = block
         for k, (fi, _c) in enumerate(pack):
             seg = packed.segment(k)
+            rim_f = None
+            if with_rim:
+                gsl = spec.file_slice(k)
+                rim_f = (
+                    rim[0][:, gsl], rim[1][:, gsl], rim[2][:, k],
+                    rim[3][:, k], rim[4][:, k], rim[5][:, gsl],
+                    spec.file_group_names[k],
+                )
             results[fi] = (
-                statuses[:, seg], unsure[:, seg], set(host_docs),
+                statuses[:, seg], unsure[:, seg], set(host_docs), rim_f,
             )
     return results
 
@@ -150,7 +239,6 @@ from ..commands.validate import _looks_json  # noqa: E402
 
 
 def _oracle_pool_init(rule_texts) -> None:
-    import os
 
     os.environ["JAX_PLATFORMS"] = "cpu"
     global _WORKER_RULES
@@ -208,7 +296,6 @@ def _honor_platform_env() -> None:
     then); only `jax.config.update` before the first device query is.
     Mirror the env var programmatically so CLI subprocesses with
     JAX_PLATFORMS=cpu never touch the TPU plugin."""
-    import os
 
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         import jax
@@ -231,7 +318,6 @@ def _setup_compile_cache() -> None:
     global _cache_configured
     if _cache_configured:
         return
-    import os
 
     path = os.environ.get("GUARD_TPU_JAX_CACHE", "").strip()
     if path and path != "0":
@@ -243,6 +329,98 @@ def _setup_compile_cache() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     _cache_configured = True
+
+
+def rim_masks(any_fail, any_unsure, host_mask, has_host_rules: bool,
+              rich_mode: bool, statuses_only: bool,
+              show_rich: bool = False):
+    """Pass A as whole-corpus boolean arrays — the scalar per-doc
+    conditionals of the fail-rerun design expressed once as numpy mask
+    arithmetic over the rim blocks (kernels.rim_reduce):
+
+      need_oracle    — docs whose answer needs an oracle visit: host
+          rules present, kernel-flagged unsure, oversized/host docs,
+          rich output, or (unless --statuses-only) a device FAIL;
+      needs_statuses — the subset where statuses themselves are missing
+          (host rules / unsure / host docs): the native statuses
+          prefilter applies only there (or under --statuses-only);
+      materialize    — docs whose per-rule dict must be BUILT at all:
+          the oracle set plus device-FAIL docs (their report lists
+          failing names even in --statuses-only) plus everything when
+          the summary shows pass/skip rows (`show_rich`). Docs outside
+          this mask settle in-array: report/console/JUnit come from the
+          per-unique-status-row cache, no per-doc dict exists.
+    """
+    import numpy as np
+
+    base = bool(has_host_rules) or bool(rich_mode)
+    need_oracle = any_unsure | host_mask
+    if base:
+        need_oracle = need_oracle | np.True_
+    if not statuses_only:
+        need_oracle = need_oracle | any_fail
+    needs_statuses = any_unsure | host_mask
+    if has_host_rules:
+        needs_statuses = needs_statuses | np.True_
+    materialize = need_oracle | any_fail
+    if show_rich:
+        materialize = materialize | np.True_
+    return need_oracle, needs_statuses, materialize
+
+
+def _materialize_row(name_row, unsure_row, names):
+    """One doc's (rule_statuses dict, unsure_rules set) from its rim
+    row — same first-occurrence key order as the scalar per-rule walk
+    (the summary table prints declaration order)."""
+    rule_statuses = {}
+    unsure_rules = set()
+    for g, name in enumerate(names):
+        rule_statuses[name] = _STATUS[int(name_row[g])]
+        if unsure_row is not None and bool(unsure_row[g]):
+            unsure_rules.add(name)
+    return rule_statuses, unsure_rules
+
+
+def _settled_template(name_row, names):
+    """Everything shared by every doc with this status row — the
+    status-list report fields (the same construction the scalar pass B
+    performs per doc) plus the rule_statuses dict the console summary
+    reads. The bulk-materialization path builds this once per UNIQUE
+    row (an all-PASS corpus has exactly one) and per-doc reports are
+    thin dicts around the shared lists."""
+    rule_statuses, _ = _materialize_row(name_row, None, names)
+    vals = list(rule_statuses.values())
+    if Status.FAIL in vals:
+        status = Status.FAIL
+    elif Status.PASS in vals:
+        status = Status.PASS
+    else:
+        status = Status.SKIP
+    fields = {
+        "status": status.value,
+        "not_compliant": [
+            {
+                "Rule": {
+                    "name": n,
+                    "metadata": {},
+                    "messages": {
+                        "custom_message": None,
+                        "error_message": None,
+                    },
+                    "checks": [],
+                }
+            }
+            for n, s in sorted(rule_statuses.items())
+            if s == Status.FAIL
+        ],
+        "not_applicable": sorted(
+            n for n, s in rule_statuses.items() if s == Status.SKIP
+        ),
+        "compliant": sorted(
+            n for n, s in rule_statuses.items() if s == Status.PASS
+        ),
+    }
+    return fields, rule_statuses, status
 
 
 def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
@@ -328,12 +506,12 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
     # fused multi-rule-file dispatch: compatible files (shared batch,
     # no per-file fn re-encode) evaluate as packed executables, one
     # device dispatch per (pack, bucket) instead of one per file
-    import os
 
     pack_enabled = (
         getattr(validate, "pack_rules", True)
         and os.environ.get("GUARD_TPU_PACK", "1") != "0"
     )
+    rim_on = vector_rim_enabled() and getattr(validate, "vector_rim", True)
     packed_results: dict = {}
     if pack_enabled:
         packed_results = _evaluate_packs(
@@ -343,17 +521,22 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                 if rb is batch and pack_compatible(c) is None
             ],
             batch,
+            with_rim=rim_on,
         )
 
     for fi, (rule_file, rbatch, compiled) in enumerate(prep):
         # native statuses oracle (native/oracle.cpp): the compiled-
-        # engine prefilter. When rich reports aren't required it
+        # engine prefilter. When the full record tree isn't required it
         # answers host-rule/unsure/oversized-doc statuses at native
-        # speed, and pre-filters which failing docs actually need the
-        # rich Python rerun — the Python oracle runs only for those.
-        rich_mode = validate.structured or validate.verbose or validate.print_json
+        # speed, pre-filters which failing docs actually need the rich
+        # rerun, and serves structured (non-verbose) reports directly
+        # (eval_report is byte-equal to the Python oracle's
+        # simplified_report_from_root — the corpus differential pins
+        # it); only verbose/print-json need the Python record tree.
+        rich_tree = validate.verbose or validate.print_json
+        rich_mode = validate.structured or rich_tree
         native = None
-        if not rich_mode:
+        if not rich_tree:
             from .native_oracle import (
                 NativeEvalError,
                 NativeOracle,
@@ -381,103 +564,190 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
             return merged
         statuses = None
         unsure = None
+        rim = None
         if fi in packed_results:
             # the packed segment slice is bit-identical to the
             # per-file path (tests/test_rule_packing.py parity)
-            statuses, unsure, host_docs = packed_results[fi]
+            statuses, unsure, host_docs, rim = packed_results[fi]
         elif compiled.rules:
             evaluator = ShardedBatchEvaluator(compiled)
             statuses, unsure, host_docs = evaluator.evaluate_bucketed(rbatch)
 
-        # pass A: device statuses + which docs need the oracle
         statuses_only = getattr(validate, "statuses_only", False)
-        doc_infos = []
+
+        def _native_prefilter(data_file):
+            """The native statuses prefilter for one doc: (merged
+            statuses, overall) or None on decline. Shared by the scalar
+            walk and the vectorized pass A."""
+            raw = None
+            raw_ok = not validate.input_params and _looks_json(
+                data_file.content
+            )
+            if raw_ok:
+                try:
+                    raw = native.eval_raw_json(data_file.content)
+                except (NativeUnsupported, NativeEvalError):
+                    # e.g. flow-style YAML that sniffs as JSON, or a
+                    # decline — the loaded-PV wire is authoritative
+                    raw = None
+            if raw is None:
+                try:
+                    raw = native.eval_doc(data_file.path_value)
+                except (NativeUnsupported, NativeEvalError):
+                    raw = None
+            if raw is None:
+                return None
+            return (_merge_native(raw), _STATUS[overall_status(raw)])
+
+        doc_infos: dict = {}
         oracle_dis = []
         native_declines = 0
-        for di, data_file in enumerate(data_files):
-            rule_statuses = {}
-            unsure_rules = set()
-            doc_status = Status.SKIP
-            if statuses is not None and di not in host_docs:
-                for ri, crule in enumerate(compiled.rules):
-                    st = _STATUS[int(statuses[di, ri])]
-                    # same-name merge as the report layer
-                    # (report.rule_statuses_from_root): non-SKIP beats
-                    # SKIP, FAIL dominates
-                    prev = rule_statuses.get(crule.name)
-                    if prev is None or (
-                        prev == Status.SKIP and st != Status.SKIP
-                    ):
-                        rule_statuses[crule.name] = st
-                    elif st == Status.FAIL:
-                        rule_statuses[crule.name] = Status.FAIL
-                    doc_status = doc_status.and_(st)
-                    if unsure is not None and bool(unsure[di, ri]):
-                        unsure_rules.add(crule.name)
+        settled = None  # vectorized rim: (name_st, names, materialize mask)
+        if rim_on:
+            # pass A, vectorized: whole-corpus mask arithmetic over the
+            # rim blocks; per-doc dicts build ONLY for docs the masks
+            # select (failures, unsure, host-fallback, rich output)
+            import numpy as np
 
-            # host fallback for unlowerable rules + rich reporting:
-            # rerun the oracle when anything failed (unless
-            # --statuses-only), output needs detail, or the kernel
-            # flagged a shape it can't decide
-            need_oracle = (
-                bool(compiled.host_rules)
-                or bool(unsure_rules)
-                or di in host_docs
-                or validate.structured
-                or validate.verbose
-                or validate.print_json
-                or (
-                    not statuses_only
-                    and any(
-                        s == Status.FAIL for s in rule_statuses.values()
-                    )
+            D = len(data_files)
+            if statuses is not None and rim is None:
+                # per-file / fn-var path: same reductions, host-side
+                from .ir import build_rim_spec
+                from .kernels import rim_reduce
+
+                spec = build_rim_spec([compiled.rules])
+                blocks = rim_reduce(
+                    statuses, unsure, spec.group_ids, spec.file_ids,
+                    spec.last_ids, spec.n_groups, spec.n_files,
                 )
-            )
-            # native statuses can settle the doc only when statuses are
-            # what's missing (host rules / unsure / oversized docs, or
-            # statuses-only mode); a device-decided FAIL needing a rich
-            # report goes straight to the pass-B report path instead of
-            # paying a redundant statuses evaluation
-            needs_statuses = (
-                bool(compiled.host_rules)
-                or bool(unsure_rules)
-                or di in host_docs
-            )
-            native_statuses = None
-            if need_oracle and native is not None and (
-                needs_statuses or statuses_only
-            ):
-                raw = None
-                raw_ok = not validate.input_params and _looks_json(
-                    data_file.content
+                rim = (
+                    blocks[0], blocks[1], blocks[2][:, 0],
+                    blocks[3][:, 0], blocks[4][:, 0], blocks[5],
+                    spec.file_group_names[0],
                 )
-                if raw_ok:
-                    try:
-                        raw = native.eval_raw_json(data_file.content)
-                    except (NativeUnsupported, NativeEvalError):
-                        # e.g. flow-style YAML that sniffs as JSON, or a
-                        # decline — the loaded-PV wire is authoritative
-                        raw = None
-                if raw is None:
-                    try:
-                        raw = native.eval_doc(data_file.path_value)
-                    except (NativeUnsupported, NativeEvalError):
-                        raw = None
-                if raw is not None:
-                    native_statuses = (
-                        _merge_native(raw),
-                        _STATUS[overall_status(raw)],
+            if rim is not None:
+                name_st, name_un, _doc_st, any_fail, any_un = rim[:5]
+                names = rim[6]
+            else:
+                name_st = np.zeros((D, 0), np.int8)
+                name_un = None
+                any_fail = np.zeros(D, bool)
+                any_un = np.zeros(D, bool)
+                names = []
+            host_mask = np.zeros(D, bool)
+            for hd in host_docs:
+                if hd < D:
+                    host_mask[hd] = True
+            show_rich = bool(
+                {"pass", "skip", "all"} & set(validate.show_summary)
+            )
+            need_oracle_v, needs_statuses_v, materialize_v = rim_masks(
+                any_fail, any_un, host_mask, bool(compiled.host_rules),
+                rich_mode, statuses_only, show_rich,
+            )
+            prefilter_v = need_oracle_v & (
+                needs_statuses_v | bool(statuses_only)
+            )
+            for di in np.nonzero(materialize_v)[0]:
+                di = int(di)
+                data_file = data_files[di]
+                if statuses is not None and not host_mask[di]:
+                    rule_statuses, unsure_rules = _materialize_row(
+                        name_st[di], None if name_un is None else name_un[di],
+                        names,
                     )
-                    if statuses_only or native_statuses[1] != Status.FAIL:
-                        # statuses suffice: no Python rerun for this doc
-                        need_oracle = False
+                    doc_status = _STATUS[int(_doc_st[di])]
                 else:
-                    native_declines += 1
-            doc_infos.append(
-                (rule_statuses, unsure_rules, doc_status, native_statuses)
-            )
-            if need_oracle:
-                oracle_dis.append(di)
+                    rule_statuses, unsure_rules = {}, set()
+                    doc_status = Status.SKIP
+                RIM_COUNTERS["docs_materialized"] += 1
+                need_oracle = bool(need_oracle_v[di])
+                native_statuses = None
+                if need_oracle and native is not None and prefilter_v[di]:
+                    native_statuses = _native_prefilter(data_file)
+                    if native_statuses is not None:
+                        if statuses_only or native_statuses[1] != Status.FAIL:
+                            # statuses suffice: no rich rerun
+                            need_oracle = False
+                    else:
+                        native_declines += 1
+                doc_infos[di] = (
+                    rule_statuses, unsure_rules, doc_status, native_statuses
+                )
+                if need_oracle:
+                    oracle_dis.append(di)
+            n_settled = int(D - materialize_v.sum())
+            RIM_COUNTERS["docs_settled"] += n_settled
+            settled = (name_st, names)
+        else:
+            # pass A, scalar (GUARD_TPU_VECTOR_RIM=0 escape hatch):
+            # device statuses + which docs need the oracle, one
+            # (doc, rule) pair at a time
+            for di, data_file in enumerate(data_files):
+                rule_statuses = {}
+                unsure_rules = set()
+                doc_status = Status.SKIP
+                if statuses is not None and di not in host_docs:
+                    for ri, crule in enumerate(compiled.rules):
+                        st = _STATUS[int(statuses[di, ri])]
+                        # same-name merge as the report layer
+                        # (report.rule_statuses_from_root): non-SKIP
+                        # beats SKIP, FAIL dominates
+                        prev = rule_statuses.get(crule.name)
+                        if prev is None or (
+                            prev == Status.SKIP and st != Status.SKIP
+                        ):
+                            rule_statuses[crule.name] = st
+                        elif st == Status.FAIL:
+                            rule_statuses[crule.name] = Status.FAIL
+                        doc_status = doc_status.and_(st)
+                        if unsure is not None and bool(unsure[di, ri]):
+                            unsure_rules.add(crule.name)
+                RIM_COUNTERS["docs_materialized"] += 1
+
+                # host fallback for unlowerable rules + rich reporting:
+                # rerun the oracle when anything failed (unless
+                # --statuses-only), output needs detail, or the kernel
+                # flagged a shape it can't decide
+                need_oracle = (
+                    bool(compiled.host_rules)
+                    or bool(unsure_rules)
+                    or di in host_docs
+                    or rich_mode
+                    or (
+                        not statuses_only
+                        and any(
+                            s == Status.FAIL for s in rule_statuses.values()
+                        )
+                    )
+                )
+                # native statuses can settle the doc only when statuses
+                # are what's missing (host rules / unsure / oversized
+                # docs, or statuses-only mode); a device-decided FAIL
+                # needing a rich report goes straight to the pass-B
+                # report path instead of paying a redundant statuses
+                # evaluation
+                needs_statuses = (
+                    bool(compiled.host_rules)
+                    or bool(unsure_rules)
+                    or di in host_docs
+                )
+                native_statuses = None
+                if need_oracle and native is not None and (
+                    needs_statuses or statuses_only
+                ):
+                    native_statuses = _native_prefilter(data_file)
+                    if native_statuses is not None:
+                        if statuses_only or native_statuses[1] != Status.FAIL:
+                            # statuses suffice: no Python rerun
+                            need_oracle = False
+                    else:
+                        native_declines += 1
+                doc_infos[di] = (
+                    rule_statuses, unsure_rules, doc_status, native_statuses
+                )
+                if need_oracle:
+                    oracle_dis.append(di)
 
         # the oracle reruns are independent pure-Python work: fan them
         # over a process pool when there are enough to amortize spawn
@@ -490,7 +760,6 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
             and len(oracle_dis) >= _POOL_MIN_JOBS
             and not validate.input_params
         ):
-            import os
 
             workers = min(len(oracle_dis), os.cpu_count() or 1, 16)
             if workers > 1:
@@ -512,9 +781,39 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                     pooled_results = {}
 
         # pass B: emit per-doc output in order, using pooled results
-        # where available and the inline oracle otherwise
+        # where available and the inline oracle otherwise. Docs the
+        # vectorized pass A left un-materialized take the bulk path:
+        # report fields and summary dict come from the shared
+        # per-unique-status-row cache (one build per distinct row), and
+        # JUnit/structured accumulation is skipped entirely — settled
+        # docs only exist in non-structured runs.
         oracle_set = set(oracle_dis)
+        row_cache: dict = {}
         for di, data_file in enumerate(data_files):
+            if settled is not None and di not in doc_infos:
+                name_st, names = settled
+                key = name_st[di].tobytes()
+                cached = row_cache.get(key)
+                if cached is None:
+                    cached = row_cache[key] = _settled_template(
+                        name_st[di], names
+                    )
+                fields, rule_statuses, doc_status = cached
+                if doc_status == Status.FAIL:
+                    had_fail = True
+                if not validate.structured:
+                    report = {
+                        "name": data_file.name,
+                        "metadata": {},
+                        **fields,
+                    }
+                    console_chain(
+                        writer, data_file.name, data_file.content,
+                        data_file, rule_file.name,
+                        doc_status, rule_statuses, report,
+                        validate.show_summary, validate.output_format,
+                    )
+                continue
             (rule_statuses, unsure_rules, doc_status, native_statuses) = doc_infos[di]
             need_oracle = di in oracle_set
             if native_statuses is not None and not need_oracle:
@@ -559,12 +858,14 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
             if (
                 need_oracle
                 and native is not None
-                and not rich_mode
+                and not rich_tree
                 and di not in pooled_results
             ):
                 # rich reports from the native engine, byte-identical to
                 # simplified_report_from_root over the Python evaluator's
-                # tree (tests/test_native_oracle.py corpus differential)
+                # tree (tests/test_native_oracle.py corpus differential).
+                # Structured non-verbose output rides this path too:
+                # write_structured consumes the same report dicts.
                 native_result = None
                 raw_ok = not validate.input_params and _looks_json(
                     data_file.content

@@ -2160,6 +2160,88 @@ class PackedRules:
     def segment(self, i: int) -> slice:
         return slice(self.offsets[i], self.offsets[i] + self.sizes[i])
 
+    def rim_spec(self) -> "RimSpec":
+        return build_rim_spec(
+            [self.compiled.rules[self.segment(i)] for i in range(len(self.offsets))]
+        )
+
+
+def name_groups(rules: List[CRule]):
+    """Per-rule name-group ids over one file's lowered rules: rules
+    sharing a `rule_name` merge into one group, numbered in
+    first-occurrence order — the same key order the per-doc
+    `rule_statuses` dict build produces, so materialized dicts keep
+    the declaration order the summary table prints. Returns
+    ((R,) int32 group ids, group names)."""
+    ids = np.zeros(len(rules), np.int32)
+    names: List[str] = []
+    seen: dict = {}
+    for i, r in enumerate(rules):
+        g = seen.get(r.name)
+        if g is None:
+            g = seen[r.name] = len(names)
+            names.append(r.name)
+        ids[i] = g
+    return ids, names
+
+
+@dataclass
+class RimSpec:
+    """Index tables for the post-kernel rim reductions
+    (kernels.rim_reduce): one reduction over the (packed) rule axis
+    yields every file's per-name-group merged statuses, per-doc
+    overall status and any-fail / any-unsure bitmaps at once. Name
+    groups are numbered GLOBALLY across the files (file k's groups
+    occupy [group_offsets[k], group_offsets[k] + len(file_group_names
+    [k]))), so a file's blocks slice back out of the pack-wide arrays
+    by column range."""
+
+    group_ids: np.ndarray  # (R,) int32: rule -> global name group
+    file_ids: np.ndarray  # (R,) int32: rule -> file position
+    last_ids: np.ndarray  # (G,) int32: group -> LAST rule index in it
+    n_groups: int
+    n_files: int
+    group_offsets: List[int]
+    file_group_names: List[List[str]]
+
+    def file_slice(self, k: int) -> slice:
+        return slice(
+            self.group_offsets[k],
+            self.group_offsets[k] + len(self.file_group_names[k]),
+        )
+
+
+def build_rim_spec(file_rules: List[List[CRule]]) -> RimSpec:
+    """RimSpec over the concatenation of `file_rules` (one entry per
+    rule file, in pack segment order; pass a single-element list for
+    the per-file path)."""
+    gids: List[np.ndarray] = []
+    fids: List[np.ndarray] = []
+    offsets: List[int] = []
+    all_names: List[List[str]] = []
+    base = 0
+    for k, rules in enumerate(file_rules):
+        ids, names = name_groups(rules)
+        gids.append(ids + base)
+        fids.append(np.full(len(rules), k, np.int32))
+        offsets.append(base)
+        all_names.append(names)
+        base += len(names)
+    group_ids = np.concatenate(gids) if gids else np.zeros(0, np.int32)
+    last_ids = np.zeros(base, np.int32)
+    last_ids[group_ids] = np.arange(len(group_ids), dtype=np.int32)
+    return RimSpec(
+        group_ids=group_ids,
+        file_ids=(
+            np.concatenate(fids) if fids else np.zeros(0, np.int32)
+        ),
+        last_ids=last_ids,
+        n_groups=base,
+        n_files=len(file_rules),
+        group_offsets=offsets,
+        file_group_names=all_names,
+    )
+
 
 def pack_compiled(parts: List[CompiledRules]) -> PackedRules:
     """Concatenate the lowered IRs of `parts` into ONE CompiledRules

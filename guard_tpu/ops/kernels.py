@@ -56,6 +56,18 @@ GATHER_ALWAYS_ON_CPU = (
     os.environ.get("GUARD_TPU_GATHER_ON_CPU", "1") != "0"
 )
 
+# ... with a small-bucket floor: below this node count CPU runs keep
+# the one-hot formulation after all. The round-5 CPU tuning was
+# measured at the 64-node bucket and above; at the trimmed 16-node
+# bucket the registry corpus actually lands in, the gather arm's
+# per-op lax.sort overhead dominates and the packed 257-rule program
+# runs 4.6x SLOWER than one-hot (0.67s vs 0.14s per 2048-doc run,
+# measured on this host for PR 2). 32 keeps the tuned behavior for
+# every bucket the round-5 bake-off covered.
+GATHER_CPU_MIN_NODES = int(
+    os.environ.get("GUARD_TPU_GATHER_CPU_MIN_NODES", "32")
+)
+
 
 def _use_gather(n: int, platform: Optional[str] = None) -> bool:
     """Trace-time formulation choice for an n-node bucket. `platform`
@@ -64,7 +76,7 @@ def _use_gather(n: int, platform: Optional[str] = None) -> bool:
     under explicit placement); falls back to jax.default_backend()."""
     if n >= GATHER_MIN_NODES:
         return True
-    if not GATHER_ALWAYS_ON_CPU:
+    if not GATHER_ALWAYS_ON_CPU or n < GATHER_CPU_MIN_NODES:
         return False
     if platform is None:
         platform = jax.default_backend()
@@ -1981,7 +1993,16 @@ def segment_doc_status(statuses, seg_ids, n_segments: int):
 
 def segment_any(flags, seg_ids, n_segments: int):
     """(..., R) bool -> (..., F) bool: does any rule in the segment set
-    its flag (e.g. the per-rule unsure bits routed per rule FILE)."""
+    its flag (e.g. the per-rule unsure bits routed per rule FILE).
+    Accepts jnp arrays (trace-safe, used by the device-side rim
+    reductions) or numpy (host-side)."""
+    if isinstance(flags, jnp.ndarray):
+        moved = jnp.moveaxis(flags.astype(jnp.int8), -1, 0)  # (R, ...)
+        mx = jax.ops.segment_max(
+            moved, jnp.asarray(seg_ids), num_segments=n_segments
+        )
+        # empty segments come back at the dtype minimum -> False
+        return jnp.moveaxis(mx > 0, 0, -1)
     flags = np.asarray(flags)
     seg_ids = np.asarray(seg_ids)
     out = np.zeros(flags.shape[:-1] + (n_segments,), bool)
@@ -1989,6 +2010,50 @@ def segment_any(flags, seg_ids, n_segments: int):
         np.moveaxis(out, -1, 0), seg_ids, np.moveaxis(flags, -1, 0)
     )
     return out
+
+
+def rim_reduce(statuses, unsure, group_ids, file_ids, last_ids,
+               n_groups: int, n_files: int):
+    """The post-kernel rim reductions over a (packed) rule axis, in one
+    place so the device (jnp, fused into the collect) and the host
+    (numpy, per-file fallback paths) produce identical blocks:
+
+      name_statuses (D, G) int8  — per name-group merged status (FAIL
+          dominates, PASS beats SKIP, SKIP identity — the same-name
+          merge the report layer applies, rule_statuses_from_root);
+      name_unsure   (D, G) bool  — any rule in the group unsure;
+      doc_status    (D, F) int8  — per-file overall doc status
+          (Status.and_ over the file's rules);
+      any_fail      (D, F) bool  — any rule in the file FAILed;
+      any_unsure    (D, F) bool  — any rule in the file unsure;
+      name_last     (D, G) int8  — the group's LAST rule's status (the
+          dict-overwrite semantics the sweep tally reproduces).
+
+    `group_ids` maps each rule index to its name group (ir.RimSpec —
+    globally numbered across a pack so one reduction serves every
+    packed file), `file_ids` to its rule file."""
+    name_statuses = segment_doc_status(statuses, group_ids, n_groups)
+    doc_status = segment_doc_status(statuses, file_ids, n_files)
+    fails = statuses == FAIL
+    any_fail = segment_any(fails, file_ids, n_files)
+    if isinstance(statuses, jnp.ndarray):
+        name_last = jnp.take(statuses, jnp.asarray(last_ids), axis=-1)
+    else:
+        name_last = np.asarray(statuses)[..., np.asarray(last_ids)]
+    if unsure is None:
+        if isinstance(statuses, jnp.ndarray):
+            name_unsure = jnp.zeros(name_statuses.shape, bool)
+            any_unsure = jnp.zeros(any_fail.shape, bool)
+        else:
+            name_unsure = np.zeros(name_statuses.shape, bool)
+            any_unsure = np.zeros(any_fail.shape, bool)
+    else:
+        name_unsure = segment_any(unsure, group_ids, n_groups)
+        any_unsure = segment_any(unsure, file_ids, n_files)
+    return (
+        name_statuses, name_unsure, doc_status, any_fail, any_unsure,
+        name_last,
+    )
 
 
 class BatchEvaluator:

@@ -28,6 +28,7 @@ from __future__ import annotations
 import copy
 import dataclasses
 from collections import OrderedDict
+from functools import partial
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -179,6 +180,7 @@ def _shared_evaluator_fns(compiled: CompiledRules, mesh: Mesh):
         # sweeps GATHER_MIN_NODES): bake them into the cache key
         kernels.GATHER_MIN_NODES,
         kernels.GATHER_ALWAYS_ON_CPU,
+        kernels.GATHER_CPU_MIN_NODES,
     )
     hit = _SHARED_FNS.get(key)
     if hit is not None:
@@ -237,16 +239,48 @@ def _shared_evaluator_fns(compiled: CompiledRules, mesh: Mesh):
     return fn, summary_fn
 
 
+@partial(jax.jit, static_argnums=(5, 6))
+def _rim_device(statuses, unsure, group_ids, file_ids, last_ids,
+                n_groups: int, n_files: int):
+    """Device-side rim reductions (kernels.rim_reduce) fused behind the
+    evaluator dispatch: segment-max folds over the rule axis, purely
+    local per doc, so the doc sharding of `statuses` carries through
+    and only the reduced (D, G)/(D, F) blocks ever cross to the host.
+    group/file index tables are runtime inputs — one executable per
+    (bucket shape, n_groups, n_files) serves every pack with that
+    shape."""
+    from ..ops.kernels import rim_reduce
+
+    return rim_reduce(
+        jnp.asarray(statuses),
+        None if unsure is None else jnp.asarray(unsure),
+        jnp.asarray(group_ids), jnp.asarray(file_ids),
+        jnp.asarray(last_ids), n_groups, n_files,
+    )
+
+
 class ShardedBatchEvaluator:
     """DP-sharded (docs x rules) status evaluator over a device mesh.
     When the rule file compares against query RHS, `last_unsure` holds
-    the (D, R) bool matrix of results to route to the CPU oracle."""
+    the (D, R) bool matrix of results to route to the CPU oracle.
 
-    def __init__(self, compiled: CompiledRules, mesh: Optional[Mesh] = None):
+    `rim_spec` (ir.RimSpec) switches dispatch/collect into the
+    vectorized-rim protocol: the post-kernel status reductions —
+    per-name-group merged statuses, per-doc overall status, any-fail /
+    any-unsure bitmaps (kernels.rim_reduce) — run ON DEVICE right
+    behind the evaluator dispatch, and `collect` returns them as a
+    third element. On accelerators this shrinks the per-collect
+    transfer from the (D, R) status matrix to the (D, G)/(D, F) blocks
+    the backend's mask arithmetic actually consumes. Without rim_spec
+    the two-element protocol is unchanged."""
+
+    def __init__(self, compiled: CompiledRules, mesh: Optional[Mesh] = None,
+                 rim_spec=None):
         self.compiled = compiled
         self.mesh = mesh if mesh is not None else default_mesh()
         self._with_unsure = compiled.needs_unsure
         self._fn, self._summary_fn = _shared_evaluator_fns(compiled, self.mesh)
+        self.rim_spec = rim_spec
         self.last_unsure = None
 
     def _arrays(self, batch: DocBatch):
@@ -274,19 +308,38 @@ class ShardedBatchEvaluator:
         # arrays on this evaluator's mesh; jnp.asarray would commit them
         # to the default device first (wrong backend on TPU hosts when
         # the mesh is a CPU mesh).
-        return self._fn(arrays, self._lits()), d
+        out = self._fn(arrays, self._lits())
+        rim = None
+        if self.rim_spec is not None:
+            statuses = out[0] if self._with_unsure else out
+            unsure = out[1] if self._with_unsure else None
+            rim = _rim_device(
+                statuses, unsure,
+                self.rim_spec.group_ids, self.rim_spec.file_ids,
+                self.rim_spec.last_ids,
+                self.rim_spec.n_groups, self.rim_spec.n_files,
+            )
+        return out, d, rim
 
     def collect(self, handle):
         """Block on a dispatch handle: (statuses (d, R) int8,
-        unsure (d, R) bool or None)."""
-        out, d = handle
+        unsure (d, R) bool or None) — plus the rim blocks as a third
+        element (each trimmed to d docs) when this evaluator carries a
+        rim_spec."""
+        out, d, rim_dev = handle
         if self._with_unsure:
             statuses, unsure = out
-            return np.asarray(statuses)[:d], np.asarray(unsure)[:d]
-        return np.asarray(out)[:d], None
+            st, un = np.asarray(statuses)[:d], np.asarray(unsure)[:d]
+        else:
+            st, un = np.asarray(out)[:d], None
+        if self.rim_spec is None:
+            return st, un
+        rim = tuple(np.asarray(b)[:d] for b in rim_dev)
+        return st, un, rim
 
     def __call__(self, batch: DocBatch) -> np.ndarray:
-        statuses, unsure = self.collect(self.dispatch(batch))
+        collected = self.collect(self.dispatch(batch))
+        statuses, unsure = collected[0], collected[1]
         self.last_unsure = unsure
         return statuses
 

@@ -285,8 +285,9 @@ class PackShardedEvaluator:
         compiled_files: List[CompiledRules],
         rule_shards: int = 2,
         devices: Optional[Sequence] = None,
+        with_rim: bool = False,
     ):
-        from ..ops.ir import pack_compiled
+        from ..ops.ir import build_rim_spec, pack_compiled
 
         if not compiled_files:
             raise ValueError("no compiled rule files to shard")
@@ -298,6 +299,14 @@ class PackShardedEvaluator:
         self.groups = partition_packs(self.files, rule_shards)
         col_base = np.cumsum([0] + [len(c.rules) for c in self.files])
         self.n_rules = int(col_base[-1])
+        # vectorized-rim protocol: each shard reduces its pack's
+        # statuses on device (mesh.ShardedBatchEvaluator rim_spec) and
+        # collect assembles the per-file blocks into GLOBAL arrays in
+        # input file order (self.rim_spec indexes them)
+        self.rim_spec = (
+            build_rim_spec([c.rules for c in self.files]) if with_rim
+            else None
+        )
         splits = np.array_split(np.arange(len(devices)), len(self.groups))
         self.shards: List[Tuple[ShardedBatchEvaluator, np.ndarray]] = []
         for g, dev_idx in zip(self.groups, splits):
@@ -306,28 +315,66 @@ class PackShardedEvaluator:
                 [np.arange(col_base[i], col_base[i + 1]) for i in g]
             )
             mesh = Mesh(np.array([devices[i] for i in dev_idx]), ("docs",))
+            shard_spec = (
+                build_rim_spec([self.files[i].rules for i in g])
+                if with_rim else None
+            )
             self.shards.append(
-                (ShardedBatchEvaluator(packed.compiled, mesh), cols)
+                (
+                    ShardedBatchEvaluator(
+                        packed.compiled, mesh, rim_spec=shard_spec
+                    ),
+                    cols,
+                    list(g),
+                )
             )
         self._with_unsure = any(f.needs_unsure for f in self.files)
         self.last_unsure: Optional[np.ndarray] = None
 
     def dispatch(self, batch: DocBatch):
         """All pack groups dispatch before any collects."""
-        return [(ev, cols, ev.dispatch(batch)) for ev, cols in self.shards]
+        return [
+            (ev, cols, g, ev.dispatch(batch)) for ev, cols, g in self.shards
+        ]
 
     def collect(self, pending):
-        d0 = pending[0][2][1]
+        from ..ops.ir import SKIP
+
+        d0 = pending[0][3][1]
         statuses = np.empty((d0, self.n_rules), np.int8)
         unsure = np.zeros((d0, self.n_rules), bool)
-        for ev, cols, handle in pending:
-            st, un = ev.collect(handle)
+        spec = self.rim_spec
+        rim = None
+        if spec is not None:
+            rim = (
+                np.full((d0, spec.n_groups), SKIP, np.int8),
+                np.zeros((d0, spec.n_groups), bool),
+                np.full((d0, spec.n_files), SKIP, np.int8),
+                np.zeros((d0, spec.n_files), bool),
+                np.zeros((d0, spec.n_files), bool),
+                np.full((d0, spec.n_groups), SKIP, np.int8),
+            )
+        for ev, cols, g, handle in pending:
+            collected = ev.collect(handle)
+            st, un = collected[0], collected[1]
             statuses[:, cols] = st
             if un is not None:
                 unsure[:, cols] = un
-        return statuses, (unsure if self._with_unsure else None)
+            if spec is not None:
+                shard_rim = collected[2]
+                sspec = ev.rim_spec
+                for k, fi in enumerate(g):
+                    gsl, ssl = spec.file_slice(fi), sspec.file_slice(k)
+                    for b in (0, 1, 5):  # name-group-axis blocks
+                        rim[b][:, gsl] = shard_rim[b][:, ssl]
+                    for b in (2, 3, 4):  # file-axis blocks
+                        rim[b][:, fi] = shard_rim[b][:, k]
+        if spec is None:
+            return statuses, (unsure if self._with_unsure else None)
+        return statuses, (unsure if self._with_unsure else None), rim
 
     def __call__(self, batch: DocBatch) -> np.ndarray:
-        statuses, unsure = self.collect(self.dispatch(batch))
+        collected = self.collect(self.dispatch(batch))
+        statuses, unsure = collected[0], collected[1]
         self.last_unsure = unsure
         return statuses

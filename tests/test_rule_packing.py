@@ -79,7 +79,7 @@ def _perfile_vs_packed(docs, rfs):
     for fi, c in items:
         ev = ShardedBatchEvaluator(c)
         st, un, hd = ev.evaluate_bucketed(batch)
-        pst, pun, phd = packed_results[fi]
+        pst, pun, phd = packed_results[fi][:3]
         assert np.array_equal(pst, st), f"statuses diverge for file {fi}"
         assert np.array_equal(pun, un), f"unsure diverges for file {fi}"
         assert phd == hd

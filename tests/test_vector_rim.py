@@ -1,0 +1,332 @@
+"""Bit-parity suite for the vectorized results plane (PR 2).
+
+`validate --backend tpu` and `sweep` output under the vectorized rim
+(GUARD_TPU_VECTOR_RIM=1, the default) must be byte-identical to the
+scalar per-(doc, rule) walk (GUARD_TPU_VECTOR_RIM=0) over mixed
+corpora: fail-heavy docs, unsure-flagged docs (variable key
+interpolation over non-strings), host-fallback rules (now()), fn-var
+files (per-file re-encoded batches), packed and per-file dispatch —
+asserting identical console output, structured reports, exit codes and
+JUnit XML. Plus unit coverage for the rim reduction lattice and the
+pass-A mask plane."""
+
+import json
+
+import numpy as np
+import pytest
+
+from guard_tpu.cli import run
+from guard_tpu.utils.io import Reader, Writer
+
+# fail-heavy device-lowerable rules (same-name rules merge; `sse`
+# fails on unencrypted buckets)
+RULES_MAIN = (
+    "let b = Resources.*[ Type == 'AWS::S3::Bucket' ]\n"
+    "rule sse when %b !empty { %b.Properties.Enc == true }\n"
+    "rule named { Resources.* { Type exists } }\n"
+)
+
+# now() is a documented host-only construct: the whole file falls back
+# to the CPU oracle (ir.HOST_ONLY_CONSTRUCTS)
+RULES_HOST = (
+    "let t = now()\n"
+    "rule fresh { Resources exists }\n"
+)
+
+# variable key interpolation: non-string values in %names flag the doc
+# unsure (kernels.StepKeyInterpVar), routing it to the oracle
+RULES_UNSURE = (
+    "let names = Selection.targets\n"
+    "rule sel { Resources.%names exists }\n"
+)
+
+# precomputable function let: the file re-encodes its batch per file
+# (ops/fnvars.py) and is excluded from packing by ir.pack_compatible
+RULES_FN = (
+    "let up = to_upper(Meta.name)\n"
+    "rule upper when Meta.name exists { %up == 'WIDGET' }\n"
+)
+
+
+def _mk_corpus(tmp_path, with_extra_rules=True):
+    rdir = tmp_path / "rules"
+    rdir.mkdir(exist_ok=True)
+    (rdir / "main.guard").write_text(RULES_MAIN)
+    if with_extra_rules:
+        (rdir / "host.guard").write_text(RULES_HOST)
+        (rdir / "unsure.guard").write_text(RULES_UNSURE)
+        (rdir / "fnvar.guard").write_text(RULES_FN)
+    data = tmp_path / "data"
+    data.mkdir(exist_ok=True)
+    for i in range(10):
+        doc = {
+            "Resources": {
+                "b": {
+                    "Type": "AWS::S3::Bucket",
+                    # docs 0, 3, 6, 9 fail `sse`
+                    "Properties": {"Enc": (i % 3) != 0},
+                }
+            },
+            "Meta": {"name": "widget" if i % 2 else "gadget"},
+            # docs 0, 4, 8 carry a non-string selection target: the
+            # unsure flag routes them to the oracle
+            "Selection": {"targets": [3] if i % 4 == 0 else ["b"]},
+        }
+        (data / f"t{i:03d}.json").write_text(json.dumps(doc))
+    return rdir, data
+
+
+def _validate(rule_args, data, extra=()):
+    w = Writer.buffered()
+    rc = run(
+        ["validate", *rule_args, "-d", str(data), "--backend", "tpu",
+         *extra],
+        writer=w,
+        reader=Reader(),
+    )
+    return rc, w.out.getvalue(), w.err.getvalue()
+
+
+def _both(monkeypatch, fn):
+    monkeypatch.setenv("GUARD_TPU_VECTOR_RIM", "1")
+    vec = fn()
+    monkeypatch.setenv("GUARD_TPU_VECTOR_RIM", "0")
+    scalar = fn()
+    return vec, scalar
+
+
+MODES = [
+    [],
+    ["--show-summary", "all"],
+    ["--statuses-only"],
+    ["-o", "yaml"],
+    ["--structured", "-o", "json", "--show-summary", "none"],
+    ["--structured", "-o", "junit", "--show-summary", "none"],
+]
+
+
+@pytest.mark.parametrize("mode", MODES, ids=lambda m: "_".join(m) or "default")
+def test_validate_parity_mixed_corpus(tmp_path, monkeypatch, mode):
+    """Mixed rules (fail-heavy + host-fallback + unsure + fn-var) over
+    a mixed corpus: every output mode byte-identical across the rim
+    paths, including JUnit and structured reports."""
+    rdir, data = _mk_corpus(tmp_path)
+    rule_args = ["-r", *(str(rf) for rf in sorted(rdir.glob("*.guard")))]
+    vec, scalar = _both(
+        monkeypatch, lambda: _validate(rule_args, data, mode)
+    )
+    assert vec == scalar
+
+
+@pytest.mark.parametrize("pack", ["1", "0"], ids=["packed", "perfile"])
+def test_validate_parity_pack_and_perfile(tmp_path, monkeypatch, pack):
+    """Parity holds on both dispatch paths: packed executables (the
+    device-side rim reductions) and per-file dispatch (host-side
+    rim_reduce fallback)."""
+    rdir, data = _mk_corpus(tmp_path)
+    monkeypatch.setenv("GUARD_TPU_PACK", pack)
+    rule_args = ["-r", *(str(rf) for rf in sorted(rdir.glob("*.guard")))]
+    vec, scalar = _both(monkeypatch, lambda: _validate(rule_args, data))
+    assert vec == scalar
+    assert vec[0] != 0  # the corpus contains genuine failures
+
+
+def test_sweep_parity(tmp_path, monkeypatch):
+    """Sweep chunk tallies (counts, failed list, exit code) identical
+    across the rim paths — including the dict-overwrite semantics for
+    same-name rules across files and oracle-touched docs."""
+    rdir, data = _mk_corpus(tmp_path)
+
+    def go(tag):
+        w = Writer.buffered()
+        rule_args = ["-r", *(str(rf) for rf in sorted(rdir.glob("*.guard")))]
+        rc = run(
+            ["sweep", *rule_args, "-d", str(data),
+             "--manifest", str(tmp_path / f"m{tag}.jsonl"),
+             "--chunk-size", "4"],
+            writer=w,
+            reader=Reader(),
+        )
+        summary = json.loads(w.out.getvalue().strip().splitlines()[-1])
+        summary.pop("manifest")
+        return rc, summary, w.err.getvalue()
+
+    monkeypatch.setenv("GUARD_TPU_VECTOR_RIM", "1")
+    vec = go("vec")
+    monkeypatch.setenv("GUARD_TPU_VECTOR_RIM", "0")
+    scalar = go("sca")
+    assert vec == scalar
+
+
+def test_all_pass_corpus_settles_in_array(tmp_path, monkeypatch):
+    """The rim counters: an all-PASS corpus under the vectorized rim
+    materializes ZERO per-rule dicts — every doc settles through the
+    per-unique-status-row cache — while the scalar rim materializes
+    every one."""
+    from guard_tpu.ops import backend
+
+    rdir = tmp_path / "rules"
+    rdir.mkdir()
+    (rdir / "a.guard").write_text("rule a { Resources exists }\n")
+    (rdir / "b.guard").write_text("rule b { Resources.*.Type exists }\n")
+    data = tmp_path / "data"
+    data.mkdir()
+    for i in range(6):
+        (data / f"t{i}.json").write_text(
+            json.dumps({"Resources": {"x": {"Type": "T"}}})
+        )
+
+    monkeypatch.setenv("GUARD_TPU_VECTOR_RIM", "1")
+    backend.reset_rim_stats()
+    rc, out, _ = _validate(
+        ["-r", str(rdir / "a.guard"), str(rdir / "b.guard")], data
+    )
+    assert rc == 0
+    stats = backend.rim_stats()
+    assert stats["docs_materialized"] == 0
+    assert stats["docs_settled"] == 12  # 6 docs x 2 rule files
+
+    monkeypatch.setenv("GUARD_TPU_VECTOR_RIM", "0")
+    backend.reset_rim_stats()
+    rc2, out2, _ = _validate(
+        ["-r", str(rdir / "a.guard"), str(rdir / "b.guard")], data
+    )
+    assert (rc2, out2) == (rc, out)
+    stats = backend.rim_stats()
+    assert stats["docs_materialized"] == 12
+    assert stats["docs_settled"] == 0
+
+
+def test_rim_reduce_lattice():
+    """The numpy rim reduction implements the report layer's status
+    lattice exactly: FAIL dominates, PASS beats SKIP, SKIP identity —
+    per name group and per file — plus the any-fail/any-unsure bitmaps
+    and the last-rule-wins block."""
+    from guard_tpu.ops.ir import FAIL, PASS, SKIP
+    from guard_tpu.ops.kernels import rim_reduce
+
+    # two files: file 0 has rules [a, a, b], file 1 has [c]
+    statuses = np.array(
+        [
+            [PASS, SKIP, SKIP, PASS],   # a: PASS (non-SKIP beats SKIP)
+            [SKIP, FAIL, PASS, SKIP],   # a: FAIL (FAIL dominates)
+            [SKIP, SKIP, SKIP, FAIL],
+        ],
+        np.int8,
+    )
+    unsure = np.zeros((3, 4), bool)
+    unsure[2, 1] = True
+    group_ids = np.array([0, 0, 1, 2], np.int32)
+    file_ids = np.array([0, 0, 0, 1], np.int32)
+    last_ids = np.array([1, 2, 3], np.int32)
+    name_st, name_un, doc_st, any_fail, any_un, name_last = rim_reduce(
+        statuses, unsure, group_ids, file_ids, last_ids, 3, 2
+    )
+    assert name_st.tolist() == [
+        [PASS, SKIP, PASS], [FAIL, PASS, SKIP], [SKIP, SKIP, FAIL]
+    ]
+    assert name_un.tolist() == [
+        [False, False, False], [False, False, False], [True, False, False]
+    ]
+    assert doc_st.tolist() == [[PASS, PASS], [FAIL, SKIP], [SKIP, FAIL]]
+    assert any_fail.tolist() == [
+        [False, False], [True, False], [False, True]
+    ]
+    assert any_un.tolist() == [
+        [False, False], [False, False], [True, False]
+    ]
+    # last-rule-wins (the sweep's dict-overwrite semantics): group 0's
+    # last rule is index 1
+    assert name_last[:, 0].tolist() == [SKIP, FAIL, SKIP]
+
+
+def test_rim_masks_plane():
+    """Pass-A mask arithmetic: need_oracle / needs_statuses /
+    materialize reproduce the scalar conditionals."""
+    from guard_tpu.ops.backend import rim_masks
+
+    any_fail = np.array([True, False, False, False])
+    any_un = np.array([False, True, False, False])
+    host = np.array([False, False, True, False])
+    no, ns, mat = rim_masks(
+        any_fail, any_un, host, has_host_rules=False, rich_mode=False,
+        statuses_only=False,
+    )
+    assert no.tolist() == [True, True, True, False]
+    assert ns.tolist() == [False, True, True, False]
+    assert mat.tolist() == [True, True, True, False]
+    # statuses-only: FAIL alone no longer needs the oracle, but its
+    # report still lists failing names -> it must materialize
+    no, ns, mat = rim_masks(
+        any_fail, any_un, host, has_host_rules=False, rich_mode=False,
+        statuses_only=True,
+    )
+    assert no.tolist() == [False, True, True, False]
+    assert mat.tolist() == [True, True, True, False]
+    # host rules / rich output force everything
+    no, ns, mat = rim_masks(
+        any_fail, any_un, host, has_host_rules=True, rich_mode=False,
+        statuses_only=False,
+    )
+    assert bool(np.all(no)) and bool(np.all(ns)) and bool(np.all(mat))
+    no, ns, mat = rim_masks(
+        any_fail, any_un, host, has_host_rules=False, rich_mode=True,
+        statuses_only=False,
+    )
+    assert bool(np.all(no)) and bool(np.all(mat))
+    # show-summary pass/skip rows materialize everything without
+    # touching the oracle masks
+    no, ns, mat = rim_masks(
+        any_fail, any_un, host, has_host_rules=False, rich_mode=False,
+        statuses_only=False, show_rich=True,
+    )
+    assert no.tolist() == [True, True, True, False]
+    assert bool(np.all(mat))
+
+
+def test_device_rim_blocks_match_host(tmp_path):
+    """The device-side rim reduction (mesh._rim_device behind the
+    packed dispatch) returns the same blocks as a host rim_reduce over
+    the collected status matrix."""
+    from guard_tpu.core.parser import parse_rules_file
+    from guard_tpu.core.values import from_plain
+    from guard_tpu.ops.backend import _evaluate_packs
+    from guard_tpu.ops.encoder import encode_batch
+    from guard_tpu.ops.ir import (
+        build_rim_spec,
+        compile_rules_file,
+        pack_compatible,
+    )
+    from guard_tpu.ops.kernels import rim_reduce
+
+    docs = [
+        from_plain({"Resources": {"b": {"Type": "AWS::S3::Bucket",
+                                        "Properties": {"Enc": i % 2 == 0}}}})
+        for i in range(5)
+    ]
+    rfs = [
+        parse_rules_file(RULES_MAIN, "main.guard"),
+        parse_rules_file("rule t { Resources.b.Type == /S3/ }\n", "t.guard"),
+    ]
+    batch, interner = encode_batch(docs)
+    items = [
+        (fi, compile_rules_file(rf, interner)) for fi, rf in enumerate(rfs)
+    ]
+    items = [(fi, c) for fi, c in items if pack_compatible(c) is None]
+    assert len(items) == 2
+    results = _evaluate_packs(items, batch)
+    for fi, c in items:
+        st, un, _hd, rim = results[fi]
+        assert rim is not None
+        spec = build_rim_spec([c.rules])
+        host = rim_reduce(
+            st, un, spec.group_ids, spec.file_ids, spec.last_ids,
+            spec.n_groups, spec.n_files,
+        )
+        np.testing.assert_array_equal(rim[0], host[0])
+        np.testing.assert_array_equal(rim[1], host[1])
+        np.testing.assert_array_equal(rim[2], host[2][:, 0])
+        np.testing.assert_array_equal(rim[3], host[3][:, 0])
+        np.testing.assert_array_equal(rim[4], host[4][:, 0])
+        np.testing.assert_array_equal(rim[5], host[5])
+        assert rim[6] == spec.file_group_names[0]

@@ -31,6 +31,34 @@ import bench  # noqa: E402  (repo root on sys.path above)
 
 CONTRACT_KEYS = ("metric", "value", "unit", "vs_baseline")
 
+# per-metric REQUIRED extra keys (PR 2 rim decomposition): the rim rows
+# must say how many docs materialized vs settled and how the run time
+# split between kernel and host rim, and every config6 fail-heavy row
+# must carry its device/host decomposition — so the "where is the next
+# bottleneck" question is answerable from the committed artifact alone
+METRIC_REQUIRED_KEYS = {
+    "config5b_packed_templates_per_sec": (
+        "dispatches_per_run", "executables_compiled",
+    ),
+    "config5b_rim_vector_docs_per_sec": (
+        "docs_materialized", "docs_settled", "kernel_seconds_per_run",
+        "rim_seconds_per_run",
+    ),
+    "config5b_rim_scalar_docs_per_sec": (
+        "docs_materialized", "rim_seconds_per_run",
+    ),
+}
+
+
+def _required_keys(metric: str):
+    keys = METRIC_REQUIRED_KEYS.get(metric, ())
+    if metric.startswith("config6_fail_"):
+        keys = keys + (
+            "docs_materialized", "docs_settled", "device_seconds",
+            "host_materialize_seconds",
+        )
+    return keys
+
 
 def check(path: pathlib.Path) -> list:
     problems = []
@@ -47,7 +75,7 @@ def check(path: pathlib.Path) -> list:
         if not isinstance(obj, dict) or "metric" not in obj:
             problems.append(f"{path}:{ln}: row without a `metric` key")
             continue
-        for k in CONTRACT_KEYS:
+        for k in CONTRACT_KEYS + _required_keys(obj["metric"]):
             if k not in obj:
                 problems.append(
                     f"{path}:{ln}: metric {obj.get('metric')!r} missing "
