@@ -75,6 +75,14 @@ def build_parser() -> argparse.ArgumentParser:
         "(per-doc scalar status walk instead of mask arithmetic + "
         "bulk report materialization)",
     )
+    v.add_argument(
+        "--ingest-workers",
+        type=int,
+        default=None,
+        help="tpu backend: worker processes for the parallel host "
+        "read/parse/encode plane (default auto; 0 = serial bit-parity "
+        "escape hatch; overrides GUARD_TPU_INGEST_WORKERS)",
+    )
 
     t = sub.add_parser("test", help="Test rules against expectations")
     t.add_argument("--rules-file", "-r", dest="rules", default=None)
@@ -128,6 +136,15 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="tpu backend: disable the vectorized results plane "
         "(scalar per-doc chunk tallies)",
+    )
+    s.add_argument(
+        "--ingest-workers",
+        type=int,
+        default=None,
+        help="tpu backend: worker processes for the parallel host "
+        "read/parse/encode plane feeding the chunk pipeline (default "
+        "auto; 0 = serial bit-parity escape hatch; overrides "
+        "GUARD_TPU_INGEST_WORKERS)",
     )
 
     pt = sub.add_parser("parse-tree", help="Prints the parse tree for a rules file")
@@ -184,6 +201,7 @@ def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reade
                 statuses_only=args.statuses_only,
                 pack_rules=not args.no_pack,
                 vector_rim=not args.no_vector_rim,
+                ingest_workers=args.ingest_workers,
             )
             return cmd.execute(writer, reader)
         if args.command == "test":
@@ -210,6 +228,7 @@ def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reade
                 last_modified=args.last_modified,
                 pack_rules=not args.no_pack,
                 vector_rim=not args.no_vector_rim,
+                ingest_workers=args.ingest_workers,
             ).execute(writer, reader)
         if args.command == "parse-tree":
             return ParseTree(

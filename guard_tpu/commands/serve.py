@@ -10,6 +10,20 @@ process-boundary equivalent: spawn ONCE, then stream newline-delimited
 JSON requests over stdin and read one JSON response line per request —
 warm interpreter, warm JAX, warm compile caches across calls.
 
+Persistent sessions also reuse the PREPARED evaluation pipeline across
+requests: rule payloads seen before are served from a parsed-RuleFile
+cache (keyed by the exact rule texts), so a session alternating over a
+stable registry skips re-parsing per request — and, downstream, the
+trace/executable caches (`parallel/mesh._shared_evaluator_fns`, the
+backend pack cache) key off those same reused objects, so the tpu
+backend re-dispatches without re-lowering. Data documents flow through
+the same chunk-encode entrypoint as the sweep ingest plane
+(`ops.encoder.encode_chunk_texts` / the native batch loader), so serve
+benefits from the host-plane work without a worker pool (payloads
+arrive in-memory; there is nothing to read from disk). A rules payload
+that fails to parse always takes the uncached path, so per-request
+parse errors reproduce byte-identically.
+
 Protocol (one line in, one line out):
 
   request:  {"rules": [..], "data": [..]}          (payload contract,
@@ -26,14 +40,58 @@ request produces a response with code 5 and keeps the session alive.
 from __future__ import annotations
 
 import json
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
+from ..core.errors import ParseError
+from ..core.parser import parse_rules_file
 from ..utils.io import Reader, Writer
+
+#: parsed-rules cache ceiling per session (rule registries are few and
+#: stable in practice; the bound only guards a hostile request stream)
+_RULES_CACHE_MAX = 8
 
 
 @dataclass
 class Serve:
     stdio: bool = True
+    # parsed RuleFile lists keyed by the exact rules-text tuple;
+    # instance-scoped so sessions never share stale registries
+    _rules_cache: "OrderedDict[tuple, list]" = field(
+        default_factory=OrderedDict, repr=False
+    )
+    cache_hits: int = 0
+
+    def _prepared_rules(self, rules_strs):
+        """Parsed RuleFile list for this request's rule texts, reused
+        across requests. Returns None when any text fails to parse —
+        the request then takes the ordinary payload path so the parse
+        error output reproduces exactly, and nothing is cached."""
+        from .validate import RuleFile
+
+        key = tuple(rules_strs)
+        hit = self._rules_cache.get(key)
+        if hit is not None:
+            self._rules_cache.move_to_end(key)
+            self.cache_hits += 1
+            return hit
+        rule_files = []
+        for i, content in enumerate(rules_strs):
+            name = f"RULES_STDIN[{i + 1}]"
+            try:
+                rf = parse_rules_file(content, name)
+            except ParseError:
+                return None
+            if rf is not None:
+                rule_files.append(
+                    RuleFile(
+                        name=name, full_name=name, content=content, rules=rf
+                    )
+                )
+        self._rules_cache[key] = rule_files
+        while len(self._rules_cache) > _RULES_CACHE_MAX:
+            self._rules_cache.popitem(last=False)
+        return rule_files
 
     def execute(self, writer: Writer, reader: Reader) -> int:
         from .validate import Validate
@@ -47,12 +105,16 @@ class Serve:
                 req = json.loads(line)
                 if not isinstance(req, dict):
                     raise ValueError("request must be a JSON object")
+                rules_strs = req.get("rules", [])
                 payload = json.dumps(
                     {
-                        "rules": req.get("rules", []),
+                        "rules": rules_strs,
                         "data": req.get("data", []),
                     }
                 )
+                prepared = None
+                if all(isinstance(r, str) for r in rules_strs):
+                    prepared = self._prepared_rules(rules_strs)
                 out_fmt = req.get("output_format", "sarif")
                 structured = out_fmt in ("sarif", "json", "yaml", "junit")
                 cmd = Validate(
@@ -62,6 +124,7 @@ class Serve:
                     show_summary=["none"] if structured else ["fail"],
                     verbose=bool(req.get("verbose", False)),
                     backend=req.get("backend", "auto"),
+                    prepared_rules=prepared,
                 )
                 buf = Writer.buffered()
                 code = cmd.execute(buf, Reader.from_string(payload))

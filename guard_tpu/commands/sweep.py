@@ -83,6 +83,12 @@ class Sweep:
     # vectorized results plane (array chunk tallies, backend rim
     # blocks); --no-vector-rim restores the scalar per-doc dict walk
     vector_rim: bool = True
+    # ingest worker processes for the parallel host read/parse/encode
+    # plane (parallel/ingest.py). None = auto (GUARD_TPU_INGEST_WORKERS
+    # env, else cpu_count - 1 capped at 4); 0 = the serial bit-parity
+    # escape hatch (the old single-chunk double buffer); 1 = pipelined
+    # control flow with inline encode
+    ingest_workers: Optional[int] = None
 
     def execute(self, writer: Writer, reader: Reader) -> int:
         if not self.rules:
@@ -117,37 +123,55 @@ class Sweep:
                 continue
             todo.append((ci, sig, chunk))
 
-        # double-buffered encode/execute (tpu backend): while the
-        # device executes chunk k's dispatched packs, the host reads
-        # and columnarizes chunk k+1 (the `prefetch` callback fires
-        # between dispatch and collect — JAX dispatch is async, so the
-        # encode genuinely overlaps device execution instead of
-        # serializing behind each chunk's collection)
-        prepared: Dict[int, tuple] = {}
+        # three-stage ingest/dispatch/consume pipeline (tpu backend,
+        # parallel/ingest.py): worker processes read+parse+encode
+        # chunks into a bounded prefetch queue, the main thread
+        # dispatches chunk k's packs and then materializes chunk k-1's
+        # tallies while the device executes k. workers=0
+        # (GUARD_TPU_INGEST_WORKERS=0 / --ingest-workers 0) is the
+        # bit-parity escape hatch back to the old single-chunk double
+        # buffer below.
+        workers = 0
+        if self.backend == "tpu" and todo:
+            from ..parallel.ingest import resolve_ingest_workers
 
-        def _prepare(j: int) -> None:
-            if self.backend != "tpu" or j >= len(todo):
-                return
-            ci2, _sig2, chunk2 = todo[j]
-            if ci2 in prepared:
-                return
-            err_box2 = [0]
-            dfs = self._read_chunk(chunk2, writer, err_box2)
-            enc = self._encode_chunk(dfs, writer, err_box2)
-            prepared[ci2] = (dfs, enc, err_box2[0])
+            workers = resolve_ingest_workers(self.ingest_workers)
+        if workers >= 1:
+            evaluated = self._run_pipeline(
+                todo, rule_files, done, manifest_path, writer, workers
+            )
+        else:
+            # double-buffered encode/execute (tpu backend): while the
+            # device executes chunk k's dispatched packs, the host
+            # reads and columnarizes chunk k+1 (the `prefetch` callback
+            # fires between dispatch and collect — JAX dispatch is
+            # async, so the encode genuinely overlaps device execution
+            # instead of serializing behind each chunk's collection)
+            prepared: Dict[int, tuple] = {}
 
-        with manifest_path.open("a") as mf:
-            for j, (ci, sig, chunk) in enumerate(todo):
-                _prepare(j)
-                rec = self._evaluate_chunk(
-                    ci, sig, chunk, rule_files, writer,
-                    prepared=prepared.pop(ci, None),
-                    prefetch=(lambda j=j: _prepare(j + 1)),
-                )
-                done[ci] = rec
-                mf.write(json.dumps(rec) + "\n")
-                mf.flush()
-                evaluated += 1
+            def _prepare(j: int) -> None:
+                if self.backend != "tpu" or j >= len(todo):
+                    return
+                ci2, _sig2, chunk2 = todo[j]
+                if ci2 in prepared:
+                    return
+                err_box2 = [0]
+                dfs = self._read_chunk(chunk2, writer, err_box2)
+                enc = self._encode_chunk(dfs, writer, err_box2)
+                prepared[ci2] = (dfs, enc, err_box2[0])
+
+            with manifest_path.open("a") as mf:
+                for j, (ci, sig, chunk) in enumerate(todo):
+                    _prepare(j)
+                    rec = self._evaluate_chunk(
+                        ci, sig, chunk, rule_files, writer,
+                        prepared=prepared.pop(ci, None),
+                        prefetch=(lambda j=j: _prepare(j + 1)),
+                    )
+                    done[ci] = rec
+                    mf.write(json.dumps(rec) + "\n")
+                    mf.flush()
+                    evaluated += 1
 
         totals = {k: 0 for k in _STATUS_NAMES}
         failed: List[dict] = []
@@ -195,6 +219,164 @@ class Sweep:
                     RuleFile(name=f.name, full_name=str(f), content=content, rules=rf)
                 )
         return rule_files, errors
+
+    # -- the three-stage pipeline (ingest workers >= 1) ---------------
+    def _run_pipeline(self, todo, rule_files, done, manifest_path,
+                      writer, workers) -> int:
+        """Stage driver: (1) ingest — worker processes (or inline when
+        workers == 1 / spawn fails) read+parse+encode chunks into a
+        bounded prefetch queue; (2) packed device dispatch; (3) rim/
+        report consumption — chunk k-1's tallies materialize while the
+        device executes chunk k and the workers encode k+1..k+depth.
+        Emission is ordered: manifest records, tallies and stderr keep
+        the serial path's exact byte order (ingest messages surface at
+        dequeue, which sits between dispatch(k) and collect(k) just
+        like the old prefetch hook)."""
+        from ..parallel.ingest import _chunk_job, pipeline_depth, shared_pool
+        from ..parallel.mesh import PIPELINE_COUNTERS
+
+        depth = pipeline_depth()
+        pool = None
+        if workers >= 2 and len(todo) > 1:
+            # process-global pool: spawn cost amortizes across sweep
+            # invocations (serve sessions, chunked drivers, bench
+            # reps); shared_pool degrades to None — inline ingest —
+            # when spawn fails
+            pool = shared_pool(workers)
+        queue: list = []  # [(j, AsyncResult)], at most `depth` deep
+        nxt = [0]
+
+        def _top_up() -> None:
+            # backpressure: never more than `depth` encoded chunks
+            # ahead of the dispatch stage, so peak queued-chunk memory
+            # is bounded by depth x chunk columns
+            if pool is None:
+                return
+            while nxt[0] < len(todo) and len(queue) < depth:
+                j2 = nxt[0]
+                ci2, _sig2, chunk2 = todo[j2]
+                queue.append((j2, pool.submit(
+                    _chunk_job, (ci2, [str(p) for p in chunk2])
+                )))
+                nxt[0] += 1
+                PIPELINE_COUNTERS["max_inflight_chunks"] = max(
+                    PIPELINE_COUNTERS["max_inflight_chunks"], len(queue)
+                )
+
+        evaluated = 0
+        inflight = None
+        # NOTE: the pool is process-global (parallel/ingest.shared_pool)
+        # and deliberately not closed on exit: spawn cost amortizes
+        # across invocations, workers are daemonic, and any abandoned
+        # in-flight jobs drain harmlessly
+        with manifest_path.open("a") as mf:
+            _top_up()
+            for j, (ci, sig, chunk) in enumerate(todo):
+                data_files, encoded, pre_err = self._take_ingest(
+                    j, chunk, queue, pool, writer,
+                    busy=inflight is not None,
+                )
+                _top_up()
+                err_box = [pre_err]
+                state = self._dispatch_tpu(
+                    data_files, rule_files, writer, err_box,
+                    encoded=encoded, vec_box={},
+                )
+                if inflight is not None:
+                    ci_prev, rec = self._finish_chunk(inflight, writer)
+                    done[ci_prev] = rec
+                    mf.write(json.dumps(rec) + "\n")
+                    mf.flush()
+                    evaluated += 1
+                inflight = (ci, sig, chunk, data_files, state, err_box)
+            if inflight is not None:
+                ci_prev, rec = self._finish_chunk(inflight, writer)
+                done[ci_prev] = rec
+                mf.write(json.dumps(rec) + "\n")
+                mf.flush()
+                evaluated += 1
+        return evaluated
+
+    def _take_ingest(self, j, chunk, queue, pool, writer, busy):
+        """Dequeue chunk j's worker-encoded payload, or read+encode it
+        inline (workers == 1, spawn failure, or a failed worker job).
+        Returns (data_files, (batch, interner), error_count); the
+        chunk's read/encode stderr is emitted here — the same stream
+        position the serial path's prefetch hook used."""
+        import logging
+        import time
+
+        from ..parallel.mesh import PIPELINE_COUNTERS
+
+        if pool is not None and queue and queue[0][0] == j:
+            _jj, handle = queue.pop(0)
+            t0 = time.perf_counter()
+            try:
+                _ci, res = handle.get()
+            except Exception as e:  # worker died: degrade, don't fail
+                logging.getLogger("guard_tpu.ingest").warning(
+                    "ingest worker failed (%s); encoding chunk inline", e
+                )
+                res = None
+            PIPELINE_COUNTERS["ingest_stall_seconds"] += (
+                time.perf_counter() - t0
+            )
+            if res is not None:
+                from ..ops.encoder import Interner, batch_from_payload
+
+                PIPELINE_COUNTERS["chunks_prefetched"] += 1
+                if busy:
+                    # this chunk's encode ran in a worker while the
+                    # previous chunk's device work was still in flight
+                    PIPELINE_COUNTERS["encode_dispatch_overlap"] += 1
+                PIPELINE_COUNTERS["read_parse_seconds"] += res["read_seconds"]
+                PIPELINE_COUNTERS["encode_seconds"] += res["encode_seconds"]
+                data_files = [
+                    DataFile(name=n, content=c, _pv=None)
+                    for n, c in zip(res["names"], res["contents"])
+                ]
+                for i in res["pv_failed"]:
+                    data_files[i]._pv_failed = True
+                for m in res["messages"]:
+                    writer.writeln_err(m)
+                encoded = (
+                    batch_from_payload(res["payload"]),
+                    Interner.from_strings(res["strings"]),
+                )
+                return data_files, encoded, res["errors"]
+        err_box = [0]
+        t0 = time.perf_counter()
+        data_files = self._read_chunk(chunk, writer, err_box)
+        t_read = time.perf_counter() - t0
+        encoded = self._encode_chunk(data_files, writer, err_box)
+        PIPELINE_COUNTERS["read_parse_seconds"] += t_read
+        PIPELINE_COUNTERS["encode_seconds"] += (
+            time.perf_counter() - t0 - t_read
+        )
+        return data_files, encoded, err_box[0]
+
+    def _finish_chunk(self, inflight, writer):
+        """Stage 3 for one chunk: collect the dispatched device work,
+        run oracle fallbacks, fold the tallies and build the manifest
+        record — while the NEXT chunk's device work executes."""
+        ci, sig, chunk, data_files, state, err_box = inflight
+        counts = {k: 0 for k in _STATUS_NAMES}
+        failed: List[dict] = []
+        per_doc: List[Dict[str, Status]] = [dict() for _ in data_files]
+        errors = self._collect_tpu(state, per_doc, writer, err_box)
+        errors += err_box[0]
+        self._tally_chunk(
+            data_files, per_doc, state.get("vec_box") or {}, counts, failed
+        )
+        return ci, {
+            "chunk": ci,
+            "sig": sig,
+            "files": len(chunk),
+            "first": chunk[0].name if chunk else None,
+            "counts": counts,
+            "failed": failed,
+            "errors": errors,
+        }
 
     # -- one chunk ----------------------------------------------------
     def _read_chunk(
@@ -249,6 +431,23 @@ class Sweep:
             )
         errors += err_box[0]
 
+        self._tally_chunk(data_files, per_doc, vec_box, counts, failed)
+
+        return {
+            "chunk": ci,
+            "sig": sig,
+            "files": len(chunk),
+            "first": chunk[0].name if chunk else None,
+            "counts": counts,
+            "failed": failed,
+            "errors": errors,
+        }
+
+    def _tally_chunk(self, data_files, per_doc, vec_box, counts,
+                     failed) -> None:
+        """Stage-3 tally for one chunk: the vectorized fold over the
+        rim blocks when active, the scalar per-doc walk otherwise.
+        Shared by the serial path and the pipeline's consumer stage."""
         if vec_box.get("active"):
             self._tally_vectorized(
                 data_files, vec_box, counts, failed
@@ -266,16 +465,6 @@ class Sweep:
                 )
                 if fails:
                     failed.append({"data": df.name, "rules": fails})
-
-        return {
-            "chunk": ci,
-            "sig": sig,
-            "files": len(chunk),
-            "first": chunk[0].name if chunk else None,
-            "counts": counts,
-            "failed": failed,
-            "errors": errors,
-        }
 
     @staticmethod
     def _pv(df, writer, err_box):
@@ -340,37 +529,43 @@ class Sweep:
             )
         return batch, interner
 
-    def _eval_pack_sharded(self, items, batch, after_dispatch):
-        """Rule-axis parallelism with PACKS as the unit: the packable
-        files split across `rule_shards` device groups, each group one
-        packed executable on its own sub-mesh; all (group, bucket)
-        work dispatches before anything collects. Returns the same
-        {file_idx: (statuses, unsure, host_docs, rim)} map as
-        backend._evaluate_packs — with the vectorized rim on, each
-        shard reduces its statuses on device and the per-file rim
-        blocks come back assembled by PackShardedEvaluator.collect."""
-        import numpy as np
-
-        from ..ops.backend import vector_rim_enabled
+    def _dispatch_pack_sharded(self, items, batch, with_rim):
+        """Dispatch half of rule-axis parallelism with PACKS as the
+        unit: the packable files split across `rule_shards` device
+        groups, each group one packed executable on its own sub-mesh;
+        all (group, bucket) work dispatches before anything collects.
+        Returns in-flight state for _collect_pack_sharded (None when
+        the files cannot pack — collect yields {} and the per-file
+        path takes over)."""
         from ..ops.encoder import NODE_BUCKETS_EXTENDED, split_batch_by_size
-        from ..ops.ir import SKIP, PackIncompatible
+        from ..ops.ir import PackIncompatible
         from ..parallel.rules import PackShardedEvaluator
 
-        with_rim = vector_rim_enabled() and self.vector_rim
         try:
             ev = PackShardedEvaluator(
                 [c for _, c in items], rule_shards=self.rule_shards,
                 with_rim=with_rim,
             )
         except PackIncompatible:
-            if after_dispatch is not None:
-                after_dispatch()
-            return {}
+            return None
         groups, oversize = split_batch_by_size(batch, NODE_BUCKETS_EXTENDED)
         host_docs = {int(i) for i in oversize}
         pending = [(idx, ev.dispatch(sub)) for sub, idx in groups]
-        if after_dispatch is not None:
-            after_dispatch()
+        return (ev, items, batch, pending, host_docs, with_rim)
+
+    def _collect_pack_sharded(self, st) -> dict:
+        """Collect half: assemble the same {file_idx: (statuses,
+        unsure, host_docs, rim)} map as backend.collect_packs — with
+        the vectorized rim on, each shard reduced its statuses on
+        device and the per-file rim blocks come back assembled by
+        PackShardedEvaluator.collect."""
+        import numpy as np
+
+        from ..ops.ir import SKIP
+
+        if st is None:
+            return {}
+        ev, items, batch, pending, host_docs, with_rim = st
         statuses = np.full((batch.n_docs, ev.n_rules), SKIP, np.int8)
         unsure = np.zeros((batch.n_docs, ev.n_rules), bool)
         spec = ev.rim_spec
@@ -415,42 +610,47 @@ class Sweep:
 
     def _eval_tpu(self, data_files, rule_files, per_doc, writer, err_box,
                   encoded=None, after_dispatch=None, vec_box=None) -> int:
+        """The fused dispatch+collect flow: `after_dispatch` (the
+        serial path's double-buffering hook) fires with the packed
+        device work in flight, exactly between the two halves."""
+        state = self._dispatch_tpu(
+            data_files, rule_files, writer, err_box, encoded=encoded,
+            vec_box=vec_box,
+        )
+        if after_dispatch is not None:
+            after_dispatch()
+        return self._collect_tpu(state, per_doc, writer, err_box)
+
+    def _dispatch_tpu(self, data_files, rule_files, writer, err_box,
+                      encoded=None, vec_box=None) -> dict:
+        """Stage 2, dispatch half: lower the registry and dispatch the
+        packed executables, returning with the device work IN FLIGHT.
+        The split from _collect_tpu is what lets the pipeline
+        materialize chunk k-1's tallies (and the ingest workers encode
+        chunk k+1) while the device executes chunk k."""
         import os
 
-        import numpy as np
-
         from ..ops.backend import (
-            _evaluate_packs,
             _honor_platform_env,
+            dispatch_packs,
             vector_rim_enabled,
         )
         from ..ops.encoder import encode_batch
-        from ..ops.ir import (
-            FAIL,
-            PASS,
-            SKIP,
-            build_rim_spec,
-            compile_rules_file,
-            pack_compatible,
-        )
-        from ..parallel.mesh import ShardedBatchEvaluator
+        from ..ops.ir import compile_rules_file, pack_compatible
 
         # JAX_PLATFORMS=cpu in the env is not reliably honored by
         # plugin discovery (a wedged TPU tunnel hangs device init);
         # mirror it programmatically before the first device query
         _honor_platform_env()
 
-        _status = {PASS: Status.PASS, FAIL: Status.FAIL, SKIP: Status.SKIP}
+        state = {"vec_box": vec_box, "data_files": data_files}
         if not data_files:
-            if after_dispatch is not None:
-                after_dispatch()
-            return 0
+            return state
         if encoded is not None:
             batch, interner = encoded
         else:
             batch, interner = self._encode_chunk(data_files, writer, err_box)
 
-        errors = 0
         # lower every rule file up-front (pack planning needs the full
         # registry before the first dispatch)
         prep = []
@@ -491,7 +691,10 @@ class Sweep:
         pack_on = (
             self.pack_rules and os.environ.get("GUARD_TPU_PACK", "1") != "0"
         )
-        packed_results: dict = {}
+        state.update(
+            batch=batch, prep=prep, vec_on=vec_on,
+            pack_pending=None, sharded=None,
+        )
         if pack_on:
             items = [
                 (fi, c)
@@ -499,16 +702,39 @@ class Sweep:
                 if rb is batch and pack_compatible(c) is None
             ]
             if self.rule_shards > 1 and len(items) >= 2:
-                packed_results = self._eval_pack_sharded(
-                    items, batch, after_dispatch
+                state["sharded"] = self._dispatch_pack_sharded(
+                    items, batch, vec_on
                 )
             else:
-                packed_results = _evaluate_packs(
-                    items, batch, after_dispatch=after_dispatch,
-                    with_rim=vec_on,
+                state["pack_pending"] = dispatch_packs(
+                    items, batch, with_rim=vec_on
                 )
-        elif after_dispatch is not None:
-            after_dispatch()
+        return state
+
+    def _collect_tpu(self, state, per_doc, writer, err_box) -> int:
+        """Stage 3, collect half: block on the dispatched packs, run
+        the oracle fallbacks and fill per_doc / the vec_box recs."""
+        import numpy as np
+
+        from ..ops.backend import collect_packs
+        from ..ops.ir import FAIL, PASS, SKIP, build_rim_spec
+        from ..parallel.mesh import ShardedBatchEvaluator
+
+        data_files = state["data_files"]
+        if not data_files:
+            return 0
+        _status = {PASS: Status.PASS, FAIL: Status.FAIL, SKIP: Status.SKIP}
+        vec_box = state["vec_box"]
+        vec_on = state["vec_on"]
+        batch = state["batch"]
+        prep = state["prep"]
+        errors = 0
+        if state["sharded"] is not None:
+            packed_results = self._collect_pack_sharded(state["sharded"])
+        elif state["pack_pending"] is not None:
+            packed_results = collect_packs(state["pack_pending"], batch)
+        else:
+            packed_results = {}
 
         recs: list = []
         D = len(data_files)

@@ -156,6 +156,15 @@ class Validate:
     # report materialization, ops/backend.py); `--no-vector-rim` (or
     # GUARD_TPU_VECTOR_RIM=0) restores the scalar per-(doc, rule) walk
     vector_rim: bool = True
+    # TPU backend: ingest worker processes for the parallel host
+    # read/parse/encode plane (parallel/ingest.py). None = auto
+    # (GUARD_TPU_INGEST_WORKERS, else cpu_count - 1 capped at 4);
+    # 0 = the serial bit-parity escape hatch; 1 = pipelined control
+    # flow with inline encode
+    ingest_workers: Optional[int] = None
+    # serve sessions: pre-parsed RuleFile list reused across requests
+    # (commands/serve.py) — skips re-parse/re-lowering per request
+    prepared_rules: Optional[List["RuleFile"]] = None
 
     # -- argument validation (validate.rs:205-232) --------------------
     def _validate_args(self) -> None:
@@ -366,19 +375,25 @@ class Validate:
             ]
             rule_files = []
             errors = 0
-            for i, content in enumerate(rules_strs):
-                name = f"RULES_STDIN[{i + 1}]"
-                try:
-                    rf = parse_rules_file(content, name)
-                except ParseError as e:
-                    writer.writeln_err(f"Parse Error on ruleset file {name}")
-                    writer.writeln_err(str(e))
-                    errors += 1
-                    continue
-                if rf is not None:
-                    rule_files.append(
-                        RuleFile(name=name, full_name=name, content=content, rules=rf)
-                    )
+            if self.prepared_rules is not None:
+                # serve sessions: the rules were parsed once when the
+                # session first saw them (all clean — parse errors
+                # always take the uncached path so stderr reproduces)
+                rule_files = list(self.prepared_rules)
+            else:
+                for i, content in enumerate(rules_strs):
+                    name = f"RULES_STDIN[{i + 1}]"
+                    try:
+                        rf = parse_rules_file(content, name)
+                    except ParseError as e:
+                        writer.writeln_err(f"Parse Error on ruleset file {name}")
+                        writer.writeln_err(str(e))
+                        errors += 1
+                        continue
+                    if rf is not None:
+                        rule_files.append(
+                            RuleFile(name=name, full_name=name, content=content, rules=rf)
+                        )
         else:
             try:
                 data_files = self._load_data_files(reader, writer)

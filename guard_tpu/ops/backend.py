@@ -115,6 +115,23 @@ def reset_dispatch_stats() -> None:
     reset_dispatch_counters()
 
 
+def pipeline_stats() -> dict:
+    """Snapshot of the ingest-pipeline counters
+    (parallel.mesh.PIPELINE_COUNTERS): worker-prefetched chunks,
+    encode/dispatch overlap events, the queued-chunk high-water mark
+    and the stage timing accumulators bench.py's ingest decomposition
+    rows divide into per-run numbers."""
+    from ..parallel.mesh import PIPELINE_COUNTERS
+
+    return dict(PIPELINE_COUNTERS)
+
+
+def reset_pipeline_stats() -> None:
+    from ..parallel.mesh import reset_pipeline_counters
+
+    reset_pipeline_counters()
+
+
 def plan_packs(items, max_rules: int = None):
     """Greedy pack planner over [(file_idx, CompiledRules)] pairs
     already screened with ir.pack_compatible: packs fill in file order
@@ -135,39 +152,33 @@ def plan_packs(items, max_rules: int = None):
     return packs
 
 
-def _evaluate_packs(items, batch, after_dispatch=None, with_rim=None) -> dict:
-    """The fused multi-rule-file dispatch pipeline: pack the compatible
-    compiled files (plan_packs), then dispatch EVERY (pack, bucket
-    group) before collecting any — JAX dispatch is async, so host
-    columnarization of the next bucket/pack overlaps device execution
-    of the previous one. `after_dispatch` (the double-buffering hook:
-    commands/sweep.py encodes doc chunk k+1 in it while the device
-    executes chunk k) runs once everything is in flight, before the
-    first collect. Returns {file_idx: (statuses (D, R_f) int8, unsure
-    (D, R_f) bool, host_docs set, rim)} sliced per file through the
-    pack's segment map; files left out of the result fall back to the
-    per-file path unchanged.
+class PackPending:
+    """In-flight state between `dispatch_packs` and `collect_packs` —
+    the decoupling the three-stage sweep pipeline needs: chunk k's
+    packs stay dispatched (device executing) while the host emits
+    chunk k-1's reports and the ingest workers encode chunk k+1."""
 
-    `rim` is the file's slice of the device-reduced results plane —
-    (name_statuses (D, G_f), name_unsure (D, G_f), doc_status (D,),
-    any_fail (D,), any_unsure (D,), name_last (D, G_f), group names) —
-    or None when the vectorized rim is disabled (GUARD_TPU_VECTOR_RIM
-    =0): the reductions ride the same dispatch, so per-(pack, bucket)
-    only the blocks pass A actually consumes cross the device
-    boundary alongside the status matrix."""
-    import numpy as np
+    __slots__ = ("pending", "host_docs", "with_rim")
 
+    def __init__(self, pending, host_docs, with_rim):
+        self.pending = pending
+        self.host_docs = host_docs
+        self.with_rim = with_rim
+
+
+def dispatch_packs(items, batch, with_rim=None) -> PackPending:
+    """Dispatch half of the fused multi-rule-file pipeline: pack the
+    compatible compiled files (plan_packs) and dispatch EVERY (pack,
+    bucket group) WITHOUT collecting — JAX dispatch is async, so the
+    returned PackPending represents genuinely in-flight device work."""
     from .encoder import NODE_BUCKETS_EXTENDED, split_batch_by_size
     from .ir import PackIncompatible
     from ..parallel.mesh import ShardedBatchEvaluator
 
-    results: dict = {}
-    if len(items) < 2:
-        if after_dispatch is not None:
-            after_dispatch()
-        return results
     if with_rim is None:
         with_rim = vector_rim_enabled()
+    if len(items) < 2:
+        return PackPending([], set(), with_rim)
     groups, oversize = split_batch_by_size(batch, NODE_BUCKETS_EXTENDED)
     host_docs = {int(i) for i in oversize}
     pending = []
@@ -185,9 +196,29 @@ def _evaluate_packs(items, batch, after_dispatch=None, with_rim=None) -> dict:
         )
         handles = [(idx, ev.dispatch(sub)) for sub, idx in groups]
         pending.append((pack, packed, spec, ev, handles))
-    if after_dispatch is not None:
-        after_dispatch()
-    for pack, packed, spec, ev, handles in pending:
+    return PackPending(pending, host_docs, with_rim)
+
+
+def collect_packs(pp: PackPending, batch) -> dict:
+    """Collect half: block on the PackPending handles and slice results
+    back per file. Returns {file_idx: (statuses (D, R_f) int8, unsure
+    (D, R_f) bool, host_docs set, rim)} through the pack's segment map;
+    files left out of the result fall back to the per-file path
+    unchanged.
+
+    `rim` is the file's slice of the device-reduced results plane —
+    (name_statuses (D, G_f), name_unsure (D, G_f), doc_status (D,),
+    any_fail (D,), any_unsure (D,), name_last (D, G_f), group names) —
+    or None when the vectorized rim is disabled (GUARD_TPU_VECTOR_RIM
+    =0): the reductions ride the same dispatch, so per-(pack, bucket)
+    only the blocks pass A actually consumes cross the device
+    boundary alongside the status matrix."""
+    import numpy as np
+
+    results: dict = {}
+    with_rim = pp.with_rim
+    host_docs = pp.host_docs
+    for pack, packed, spec, ev, handles in pp.pending:
         n_rules = len(packed.compiled.rules)
         statuses = np.full((batch.n_docs, n_rules), SKIP, np.int8)
         unsure = np.zeros((batch.n_docs, n_rules), bool)
@@ -223,6 +254,20 @@ def _evaluate_packs(items, batch, after_dispatch=None, with_rim=None) -> dict:
                 statuses[:, seg], unsure[:, seg], set(host_docs), rim_f,
             )
     return results
+
+
+def _evaluate_packs(items, batch, after_dispatch=None, with_rim=None) -> dict:
+    """dispatch_packs + collect_packs fused: every (pack, bucket group)
+    dispatches before anything collects, so host columnarization of the
+    next bucket/pack overlaps device execution of the previous one.
+    `after_dispatch` (the legacy double-buffering hook: commands/
+    sweep.py's serial path encodes doc chunk k+1 in it while the device
+    executes chunk k) runs once everything is in flight, before the
+    first collect."""
+    pp = dispatch_packs(items, batch, with_rim)
+    if after_dispatch is not None:
+        after_dispatch()
+    return collect_packs(pp, batch)
 
 # spawn-pool state: each worker parses the rule files once (initializer)
 # and never imports jax — oracle reruns are pure-Python CPU work
@@ -449,7 +494,34 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
         return [df.path_value for df in data_files]
 
     batch = interner = None
-    if all(_looks_json(df.content) for df in data_files):
+    # parallel ingest plane (parallel/ingest.py): with workers >= 2 the
+    # document list splits into contiguous shards, each encoded in an
+    # ingest worker process with a private interner, merged through an
+    # id remap — statuses and reports are invariant under intern-id
+    # labels, so output stays byte-identical to the serial encode.
+    # Payload/stdin sessions and --input-parameters merges keep the
+    # inline path (merged trees exist only in this process).
+    from ..parallel.ingest import resolve_ingest_workers
+
+    ingest_workers = resolve_ingest_workers(
+        getattr(validate, "ingest_workers", None)
+    )
+    if (
+        ingest_workers >= 2
+        and len(data_files) >= 2
+        and not validate.payload
+        and not validate.input_params
+    ):
+        from ..parallel.ingest import parallel_encode_documents
+
+        enc = parallel_encode_documents(
+            [df.name for df in data_files],
+            [df.content for df in data_files],
+            ingest_workers,
+        )
+        if enc is not None:
+            batch, interner = enc
+    if batch is None and all(_looks_json(df.content) for df in data_files):
         # JSON corpus: the native C++ data loader (native/encoder.cpp)
         from .native_encoder import encode_json_batch_native, native_available
 

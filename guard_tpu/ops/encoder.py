@@ -113,6 +113,15 @@ class Interner:
     def __len__(self) -> int:
         return len(self._strings)
 
+    @classmethod
+    def from_strings(cls, strings: List[str]) -> "Interner":
+        """Rebuild an interner from its string table (the picklable
+        wire form ingest workers ship across the process boundary)."""
+        it = cls()
+        it._strings = list(strings)
+        it._ids = {s: i for i, s in enumerate(it._strings)}
+        return it
+
     def regex_match_bits(self, pattern: str) -> np.ndarray:
         """(S,) bool: does `pattern` match each interned string —
         host-precomputed so the TPU kernel only gathers."""
@@ -807,3 +816,148 @@ def encode_batch(docs: List[PV], interner: Optional[Interner] = None,
         if enc.fn_origin_miss:
             batch.num_exotic[i] = True
     return batch, interner
+
+
+# -- ingest-plane transport (parallel/ingest.py) ----------------------
+# The worker pool ships encoded chunks across the process boundary as
+# plain dicts of numpy arrays: cheap to pickle, and the derived columns
+# travel along so the receiving process never re-runs the __post_init__
+# derivation.
+
+_PAYLOAD_ARRAYS = (
+    "node_kind", "node_parent", "scalar_id", "num_hi", "num_lo",
+    "child_count", "edge_parent", "edge_child", "edge_key_id",
+    "edge_index", "edge_valid", "node_key_id", "node_index",
+    "node_parent_kind", "num_exotic", "fn_origin",
+)
+
+
+def batch_payload(batch: DocBatch) -> dict:
+    """Picklable wire form of a DocBatch (derived columns included)."""
+    out = {k: getattr(batch, k) for k in _PAYLOAD_ARRAYS}
+    out["n_docs"] = batch.n_docs
+    out["n_nodes"] = batch.n_nodes
+    out["n_edges"] = batch.n_edges
+    return out
+
+
+def batch_from_payload(payload: dict) -> DocBatch:
+    return DocBatch(**payload)
+
+
+def remap_interned_ids(batch: DocBatch, remap: np.ndarray) -> None:
+    """Relabel a shard batch's intern ids in place through `remap`
+    (shard-local id -> merged id). Only non-negative entries are ids;
+    the sentinel namespaces (-1/-2, and the reserved fn ids — never
+    present at encode time) pass through untouched."""
+    if len(remap) == 0:
+        return
+    for attr in ("scalar_id", "edge_key_id", "node_key_id"):
+        col = getattr(batch, attr)
+        if col.size:
+            safe = np.clip(col, 0, len(remap) - 1)
+            col[...] = np.where(col >= 0, remap[safe], col)
+
+
+_CONCAT_FILL = {
+    "node_kind": -1, "node_parent": -1, "scalar_id": -1, "num_hi": 0,
+    "num_lo": 0, "child_count": 0, "edge_parent": 0, "edge_child": 0,
+    "edge_key_id": -2, "edge_index": -2, "edge_valid": False,
+    "node_key_id": -2, "node_index": -2, "node_parent_kind": -1,
+}
+
+
+def concat_batches(parts: List[DocBatch]) -> DocBatch:
+    """Concatenate shard batches along the doc axis, padding node/edge
+    axes to the widest shard with the same suffix fills encode_batch
+    uses — so the result is shape- and content-equivalent to encoding
+    the union serially (modulo intern-id labels, which the caller has
+    already remapped into one namespace)."""
+    assert parts, "concat_batches needs at least one shard"
+    assert all(p.fn_origin is None for p in parts), (
+        "fn results are encoded after the shard merge, never inside it"
+    )
+    n_nodes = max(p.n_nodes for p in parts)
+    n_edges = max(p.n_edges for p in parts)
+
+    def padcat(attr: str, width: int) -> np.ndarray:
+        fill = _CONCAT_FILL[attr]
+        cols = []
+        for p in parts:
+            col = getattr(p, attr)
+            if col.shape[1] < width:
+                pad = np.full(
+                    (col.shape[0], width - col.shape[1]), fill,
+                    dtype=col.dtype,
+                )
+                col = np.concatenate([col, pad], axis=1)
+            cols.append(col)
+        return np.concatenate(cols, axis=0)
+
+    node_attrs = (
+        "node_kind", "node_parent", "scalar_id", "num_hi", "num_lo",
+        "child_count", "node_key_id", "node_index", "node_parent_kind",
+    )
+    edge_attrs = (
+        "edge_parent", "edge_child", "edge_key_id", "edge_index",
+        "edge_valid",
+    )
+    fields = {a: padcat(a, n_nodes) for a in node_attrs}
+    fields.update({a: padcat(a, n_edges) for a in edge_attrs})
+    return DocBatch(
+        n_docs=sum(p.n_docs for p in parts),
+        n_nodes=n_nodes,
+        n_edges=n_edges,
+        num_exotic=np.concatenate([p.num_exotic for p in parts]),
+        **fields,
+    )
+
+
+def encode_chunk_texts(names: List[str], contents: List[str]):
+    """Worker-safe chunk encode entrypoint — the sweep's chunk-encode
+    semantics as a pure function over raw texts, shared by the serial
+    path, the ingest workers and the serve session so the three can
+    never drift: the native C++ JSON encoder when the whole chunk
+    sniffs as JSON (an invalid doc is marked, substituted with a `null`
+    stand-in and the rest retried), the Python loader otherwise (a
+    parse failure marks the doc and encodes a null stand-in).
+
+    Returns (batch, interner, pv_failed_indices, messages, errors,
+    pvs): `pvs` is the per-doc Python document list when the Python
+    path ran (callers in the same process can cache them for oracle
+    fallbacks) and None on the native path.
+    """
+    from .native_encoder import encode_json_batch_resilient
+
+    pv_failed: set = set()
+    messages: List[str] = []
+    errors = 0
+    batch = interner = pvs = None
+    if all(c.lstrip()[:1] in ("{", "[") for c in contents):
+        batch, interner, failed, msgs = encode_json_batch_resilient(
+            contents, names
+        )
+        pv_failed |= failed
+        messages += msgs
+        errors += len(failed)
+    if batch is None:
+        from ..core.errors import GuardError
+        from ..core.loader import load_document
+        from ..core.values import PV, Path as VPath
+
+        pvs = []
+        for i, content in enumerate(contents):
+            if i in pv_failed:
+                pvs.append(None)  # already marked by the native retry
+                continue
+            try:
+                pvs.append(load_document(content, names[i]))
+            except GuardError as e:
+                pv_failed.add(i)
+                messages.append(f"skipping {names[i]}: {e}")
+                errors += 1
+                pvs.append(None)
+        batch, interner = encode_batch(
+            [pv if pv is not None else PV.null(VPath.root()) for pv in pvs]
+        )
+    return batch, interner, sorted(pv_failed), messages, errors, pvs

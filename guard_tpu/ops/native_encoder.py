@@ -75,6 +75,34 @@ def native_available() -> bool:
     return _load() is not None
 
 
+def encode_json_batch_resilient(contents: List[str], names: List[str]):
+    """`encode_json_batch_native` with per-document error isolation:
+    an invalid document must not push the whole chunk off the native
+    encoder, so it is reported, replaced by a `null` stand-in and the
+    remainder retried (the sweep chunk contract; callers exclude the
+    marked docs from tallies). Returns (batch, interner,
+    failed_indices, messages) — (None, None, failed, msgs) when the
+    shared library is unavailable or errors, in which case the caller
+    falls back to the Python loader with the marks kept."""
+    failed: set = set()
+    msgs: List[str] = []
+    if not native_available():
+        return None, None, failed, msgs
+    work = list(contents)
+    for _ in range(len(work) + 1):
+        try:
+            batch, interner, err = encode_json_batch_native(work)
+        except RuntimeError:
+            return None, None, failed, msgs
+        if err is None:
+            return batch, interner, failed, msgs
+        if err not in failed:
+            failed.add(err)
+            msgs.append(f"skipping {names[err]}: invalid JSON")
+        work[err] = "null"
+    return None, None, failed, msgs
+
+
 def encode_json_batch_native(
     docs: List[str],
 ) -> Tuple[DocBatch, Interner, Optional[int]]:

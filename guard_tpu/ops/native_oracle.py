@@ -21,6 +21,7 @@ Falls back transparently when the shared library hasn't been built
 from __future__ import annotations
 
 import ctypes
+import threading
 from typing import List, Optional
 
 from ..core.ast_serde import (
@@ -33,6 +34,9 @@ from ..core.ast_serde import (
 from ..core.exprs import RulesFile
 from ..core.values import PV
 from ._native_lib import build, load_lib
+
+#: stand-in lock for close() on partially-constructed instances
+_NULL_LOCK = threading.Lock()
 
 _SO_NAME = "libguard_oracle.so"
 _BUILD_SCRIPT = "build_oracle.sh"
@@ -109,11 +113,18 @@ def _consume_err(lib, err: ctypes.c_char_p) -> str:
 class NativeOracle:
     """One compiled rule file; evaluates per-doc statuses natively.
 
-    NOT thread-safe: the engine's regex cache and pcre2 match data are
-    per-handle and unsynchronized — use one NativeOracle per thread.
-    """
+    Thread-safe via a per-thread handle pool: the engine's regex cache
+    and pcre2 match data are per-handle and unsynchronized, so sharing
+    ONE handle across threads was a documented footgun — instead each
+    thread lazily compiles its own handle from the serialized AST (the
+    constructor compiles the calling thread's eagerly, preserving the
+    compile-failure-raises contract). A pipelined consumer stage can
+    therefore hammer one NativeOracle from several threads
+    (tests/test_native_oracle.py pins it)."""
 
     def __init__(self, rules_file: RulesFile):
+        import threading
+
         lib = _load()
         if lib is None:
             raise NativeUnsupported(
@@ -122,19 +133,47 @@ class NativeOracle:
         self._lib = lib
         self.n_rules = len(rules_file.guard_rules)
         try:
-            ast_json = rules_file_to_json(rules_file).encode("utf-8")
+            self._ast_json = rules_file_to_json(rules_file).encode("utf-8")
         except (Unserializable, RecursionError) as e:
             raise NativeUnsupported(str(e))
+        self._pool_lock = threading.Lock()
+        self._handles: dict = {}  # thread ident -> engine handle
+        self._closed = False
+        self._handle_for_thread()  # compile now: constructor must raise
+
+    def _compile_handle(self):
         err = ctypes.c_char_p()
-        self._handle = lib.guard_oracle_compile(ast_json, ctypes.byref(err))
-        if not self._handle:
-            msg = _consume_err(lib, err)
-            raise NativeUnsupported(msg)
+        handle = self._lib.guard_oracle_compile(
+            self._ast_json, ctypes.byref(err)
+        )
+        if not handle:
+            raise NativeUnsupported(_consume_err(self._lib, err))
+        return handle
+
+    def _handle_for_thread(self):
+        """The calling thread's private engine handle (compiled on
+        first use). Raises NativeUnsupported after close()."""
+        import threading
+
+        if self._closed:
+            raise NativeUnsupported("oracle handle closed")
+        tid = threading.get_ident()
+        handle = self._handles.get(tid)
+        if handle is None:
+            handle = self._compile_handle()
+            with self._pool_lock:
+                if self._closed:  # closed during our compile: lost race
+                    self._lib.guard_oracle_free(handle)
+                    raise NativeUnsupported("oracle handle closed")
+                self._handles[tid] = handle
+        return handle
 
     def close(self) -> None:
-        if getattr(self, "_handle", None):
-            self._lib.guard_oracle_free(self._handle)
-            self._handle = None
+        with getattr(self, "_pool_lock", None) or _NULL_LOCK:
+            self._closed = True
+            for handle in getattr(self, "_handles", {}).values():
+                self._lib.guard_oracle_free(handle)
+            self._handles = {}
 
     def __del__(self):  # pragma: no cover - interpreter teardown order
         try:
@@ -157,15 +196,14 @@ class NativeOracle:
         the Python evaluator's (differential suite pins the serde
         encoding), so simplified_report_from_root / rule_statuses_from_root
         consume it unchanged."""
-        if not self._handle:
-            raise NativeUnsupported("oracle handle closed")
+        handle = self._handle_for_thread()
         try:
             wire = doc_to_json(doc).encode("utf-8")
         except (Unserializable, RecursionError) as e:
             raise NativeUnsupported(str(e))
         err = ctypes.c_char_p()
         ptr = self._lib.guard_oracle_eval_records(
-            self._handle, wire, data_file_name.encode("utf-8"), ctypes.byref(err)
+            handle, wire, data_file_name.encode("utf-8"), ctypes.byref(err)
         )
         if not ptr:
             msg = _consume_err(self._lib, err)
@@ -186,8 +224,7 @@ class NativeOracle:
         records only (the fail-rerun fast path). Byte-equal to
         simplified_report_from_root over the Python evaluator's tree
         (differential suite)."""
-        if not self._handle:
-            raise NativeUnsupported("oracle handle closed")
+        self._handle_for_thread()
         try:
             wire = doc_to_compact(doc, locs=True).encode("utf-8")
         except (Unserializable, RecursionError) as e:
@@ -199,8 +236,7 @@ class NativeOracle:
     def eval_report_raw(self, content: str, data_file_name: str):
         """eval_report straight from raw JSON text — no Python-side
         load or serialization; source marks match the loader's."""
-        if not self._handle:
-            raise NativeUnsupported("oracle handle closed")
+        self._handle_for_thread()
         return self._report_call(
             self._lib.guard_oracle_eval_report_raw,
             content.encode("utf-8"),
@@ -214,7 +250,8 @@ class NativeOracle:
 
         err = ctypes.c_char_p()
         ptr = entry(
-            self._handle, wire, data_file_name.encode("utf-8"), ctypes.byref(err)
+            self._handle_for_thread(), wire, data_file_name.encode("utf-8"),
+            ctypes.byref(err),
         )
         if not ptr:
             msg = _consume_err(self._lib, err)
@@ -239,12 +276,11 @@ class NativeOracle:
         return self.eval_wire(content.encode("utf-8"), raw=True)
 
     def eval_wire(self, wire: bytes, raw: bool = False) -> List[int]:
-        if not self._handle:
-            raise NativeUnsupported("oracle handle closed")
+        handle = self._handle_for_thread()
         err = ctypes.c_char_p()
         buf = (ctypes.c_int32 * max(self.n_rules, 1))()
         entry = self._lib.guard_oracle_eval_raw if raw else self._lib.guard_oracle_eval
-        n = entry(self._handle, wire, buf, len(buf), ctypes.byref(err))
+        n = entry(handle, wire, buf, len(buf), ctypes.byref(err))
         if n < 0:
             msg = _consume_err(self._lib, err)
             if msg.startswith("unsupported:"):

@@ -1,0 +1,335 @@
+"""Parallel host ingest plane: multi-worker read/parse/encode.
+
+After PR 1 (fused packed dispatch) and PR 2 (device-side rim
+reductions) the (docs x rules) device program is no longer the wall —
+the host is, and its read+parse+encode slice ran on ONE Python thread,
+interleaved between dispatch and collect (`commands/sweep.py`'s old
+single-chunk double buffer). This module turns ingest into stage 1 of
+a three-stage pipeline:
+
+  1. **ingest workers** (this module): a spawn-based process pool where
+     each worker reads, sniffs, parses and columnarizes one chunk into
+     its own `(DocBatch, Interner)` — chunks already carry per-chunk
+     interners, so no cross-worker id merge is needed, only picklable
+     transport of the numpy columns (`ops.encoder.batch_payload`);
+  2. **packed device dispatch** (`ops.backend.dispatch_packs`), fed
+     from a bounded prefetch queue (depth >= 2, backpressure via
+     `IngestPool` so queued-chunk memory stays bounded);
+  3. **rim/report consumption** (`commands/sweep._finish_chunk`):
+     collected status blocks materialize while the NEXT chunk is
+     already dispatched, with ordered emission so console/structured
+     output and exit codes stay byte-identical to the serial path.
+
+Workers never import jax (spawn, not fork: nothing inherits the
+initialized runtime). `GUARD_TPU_INGEST_WORKERS=0` (or
+`--ingest-workers 0`) is the bit-parity escape hatch back to the old
+serial double buffer, the same pattern as `--no-pack` /
+`--no-vector-rim`; workers=1 keeps the pipelined control flow but
+encodes inline; spawn failure degrades to inline encoding with a
+logged warning, never an error.
+
+`validate --backend tpu` reuses the same pool for one-shot batches:
+the document list splits into contiguous shards, each worker encodes
+its shard with a private interner, and the shards merge through an id
+remap (`ops.encoder.remap_interned_ids` + `concat_batches`) — statuses
+and reports are invariant under intern-id relabeling, so output stays
+byte-identical to the serial encode.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import List, Optional, Tuple
+
+log = logging.getLogger("guard_tpu.ingest")
+
+#: bounded prefetch depth: at most this many encoded chunks may exist
+#: ahead of the dispatch stage (backpressure bounds peak host memory at
+#: depth x chunk columns). Override with GUARD_TPU_INGEST_DEPTH.
+DEFAULT_DEPTH = 2
+
+#: auto worker ceiling: ingest rarely scales past a few processes
+#: before the dispatch stage is the bottleneck again
+DEFAULT_MAX_WORKERS = 4
+
+
+def pipeline_depth() -> int:
+    raw = os.environ.get("GUARD_TPU_INGEST_DEPTH", "").strip()
+    try:
+        depth = int(raw) if raw else DEFAULT_DEPTH
+    except ValueError:
+        depth = DEFAULT_DEPTH
+    return max(2, depth)
+
+
+def resolve_ingest_workers(flag: Optional[int] = None) -> int:
+    """Worker count for the ingest plane: the CLI flag wins, then
+    `GUARD_TPU_INGEST_WORKERS`, then auto (cpu_count - 1, capped at
+    DEFAULT_MAX_WORKERS — one core stays with the dispatch/rim
+    stages). 0 = the serial bit-parity escape hatch; 1 = pipelined
+    control flow with inline encode (no processes)."""
+    if flag is None:
+        env = os.environ.get("GUARD_TPU_INGEST_WORKERS", "").strip()
+        if env:
+            try:
+                flag = int(env)
+            except ValueError:
+                flag = None
+    if flag is None:
+        flag = min((os.cpu_count() or 1) - 1, DEFAULT_MAX_WORKERS)
+    return max(0, int(flag))
+
+
+def _worker_init() -> None:
+    # defensive: workers never import jax, but if a transitive import
+    # ever does, it must not touch a TPU tunnel
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def read_paths(paths: List[str]) -> Tuple[list, list, list, int]:
+    """Read chunk files; unreadable ones are skipped with one error
+    each (the sweep's `_read_chunk` contract, message-identical)."""
+    names, contents, msgs, errors = [], [], [], 0
+    for p in paths:
+        try:
+            with open(p, "r") as f:
+                contents.append(f.read())
+        except OSError as e:
+            msgs.append(f"skipping {p}: {e}")
+            errors += 1
+            continue
+        names.append(os.path.basename(p))
+    return names, contents, msgs, errors
+
+
+def _chunk_job(args):
+    """Worker body for one sweep chunk: read + sniff + parse +
+    columnarize, returning a picklable payload (numpy columns via
+    batch_payload, interner strings, error marks/messages and the
+    stage timings the bench decomposition rows report)."""
+    ci, paths = args
+    from ..ops.encoder import batch_payload, encode_chunk_texts
+
+    t0 = time.perf_counter()
+    names, contents, read_msgs, read_errs = read_paths(paths)
+    t_read = time.perf_counter() - t0
+    batch, interner, pv_failed, enc_msgs, enc_errs, _pvs = (
+        encode_chunk_texts(names, contents)
+    )
+    t_enc = time.perf_counter() - t0 - t_read
+    return ci, {
+        "names": names,
+        "contents": contents,
+        "payload": batch_payload(batch),
+        "strings": interner.strings,
+        "pv_failed": pv_failed,
+        "messages": read_msgs + enc_msgs,
+        "errors": read_errs + enc_errs,
+        "read_seconds": t_read,
+        "encode_seconds": t_enc,
+    }
+
+
+def _validate_shard_job(args):
+    """Worker body for one validate shard: encode a contiguous slice
+    of the document list with a private interner. Mirrors the serial
+    batch-build decision flow of `ops.backend.tpu_validate`: the native
+    C++ JSON encoder when the whole corpus sniffed as JSON (decided in
+    the parent so every shard agrees with the serial path), the Python
+    loader otherwise — and a Python-loader parse failure reports the
+    first failing document instead of encoding (the serial path raises
+    there with the same message)."""
+    names, contents, use_native = args
+    from ..ops.encoder import batch_payload, encode_batch
+
+    if use_native:
+        from ..ops.native_encoder import (
+            encode_json_batch_native,
+            native_available,
+        )
+
+        if native_available():
+            try:
+                batch, interner, err = encode_json_batch_native(contents)
+                if err is None:
+                    return ("ok", batch_payload(batch), interner.strings)
+            except RuntimeError:
+                pass
+    from ..core.errors import GuardError
+    from ..core.loader import load_document
+
+    pvs = []
+    for i, content in enumerate(contents):
+        try:
+            pvs.append(load_document(content, names[i]))
+        except GuardError as e:
+            return ("parse_error", i, str(e))
+    batch, interner = encode_batch(pvs)
+    return ("ok", batch_payload(batch), interner.strings)
+
+
+def _spawn_pool(workers: int):
+    """Isolated so tests can force a spawn failure."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    return ctx.Pool(processes=workers, initializer=_worker_init)
+
+
+def _ping_job(x):
+    return x
+
+
+def _spawn_probe_timeout() -> float:
+    raw = os.environ.get("GUARD_TPU_INGEST_SPAWN_TIMEOUT", "").strip()
+    try:
+        return float(raw) if raw else 60.0
+    except ValueError:
+        return 60.0
+
+
+class IngestPool:
+    """A spawn pool with graceful degradation: construction failure
+    sets `.available` False (callers fall back to inline ingest — the
+    pipeline must never turn a pool problem into a result problem).
+
+    Construction PROBES the pool with a bounded ping: under an
+    embedder whose unguarded __main__ cannot re-execute under spawn,
+    workers die during bootstrap and the Pool respawns them forever —
+    an unprobed first .get() would hang, not raise. The ping turns
+    that failure mode into a clean degradation within
+    GUARD_TPU_INGEST_SPAWN_TIMEOUT (default 60s)."""
+
+    def __init__(self, workers: int):
+        self.workers = workers
+        self.error: Optional[str] = None
+        try:
+            self._pool = _spawn_pool(workers)
+        except Exception as e:  # any bootstrap failure degrades, ever
+            self._pool = None
+            self.error = str(e)
+            return
+        try:
+            assert self._pool.apply_async(
+                _ping_job, (1,)
+            ).get(timeout=_spawn_probe_timeout()) == 1
+        except Exception as e:
+            self.error = f"spawn probe failed: {e!r}"
+            try:
+                self._pool.terminate()
+                self._pool.join()
+            except Exception:
+                pass
+            self._pool = None
+
+    @property
+    def available(self) -> bool:
+        return self._pool is not None
+
+    def submit(self, fn, args):
+        return self._pool.apply_async(fn, (args,))
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.terminate()
+            self._pool.join()
+            self._pool = None
+
+
+# process-global pool reuse: spawning workers costs ~a second of
+# interpreter+import per process, which would otherwise be charged to
+# EVERY sweep/validate invocation (serve sessions, bench reps, chunked
+# drivers). Pools are stateless (pure-function jobs), so one healthy
+# pool per worker count serves the whole process; failures are NOT
+# cached, so a transient spawn problem heals on the next invocation.
+_POOL_CACHE: dict = {}
+
+
+def shared_pool(workers: int) -> Optional[IngestPool]:
+    """A cached healthy IngestPool for `workers`, or None when spawn
+    fails (caller degrades to inline ingest). Callers must NOT close
+    the returned pool; `close_shared_pools` / interpreter exit does
+    (workers are daemonic)."""
+    pool = _POOL_CACHE.get(workers)
+    if pool is not None and pool.available:
+        return pool
+    _POOL_CACHE.pop(workers, None)
+    pool = IngestPool(workers)
+    if not pool.available:
+        log.warning(
+            "ingest worker pool unavailable (%s); "
+            "falling back to inline ingest", pool.error,
+        )
+        return None
+    _POOL_CACHE[workers] = pool
+    return pool
+
+
+def close_shared_pools() -> None:
+    for pool in list(_POOL_CACHE.values()):
+        pool.close()
+    _POOL_CACHE.clear()
+
+
+def parallel_encode_documents(names: List[str], contents: List[str],
+                              workers: int):
+    """Validate's one-shot batch encode over an ingest worker pool.
+
+    Returns (DocBatch, Interner) or None when the pool is unavailable
+    (caller falls back to the serial encode). A document that fails the
+    Python loader raises GuardError with the FIRST failing document's
+    message in document order — the serial path's error contract.
+    """
+    from ..commands.validate import _looks_json
+    from ..core.errors import GuardError
+    from ..ops.encoder import (
+        Interner,
+        batch_from_payload,
+        concat_batches,
+        remap_interned_ids,
+    )
+
+    n = len(contents)
+    workers = min(workers, n)
+    if workers < 2:
+        return None
+    use_native = all(_looks_json(c) for c in contents)
+    pool = shared_pool(workers)
+    if pool is None:
+        return None
+    bounds = [(n * k) // workers for k in range(workers + 1)]
+    shards = [
+        (names[lo:hi], contents[lo:hi], use_native)
+        for lo, hi in zip(bounds, bounds[1:])
+        if hi > lo
+    ]
+    try:
+        results = [
+            h.get() for h in
+            [pool.submit(_validate_shard_job, s) for s in shards]
+        ]
+    except Exception as e:
+        log.warning(
+            "ingest workers failed (%s); encoding serially", e
+        )
+        return None
+    for res in results:
+        if res[0] == "parse_error":
+            # shards are contiguous and in document order, so the
+            # earliest shard's first failure is the global first —
+            # the serial path's error message, byte for byte
+            raise GuardError(res[2])
+    merged = Interner()
+    import numpy as np
+
+    parts = []
+    for res in results:
+        batch = batch_from_payload(res[1])
+        remap = np.array(
+            [merged.intern(s) for s in res[2]], dtype=np.int32
+        )
+        remap_interned_ids(batch, remap)
+        parts.append(batch)
+    return concat_batches(parts), merged

@@ -111,6 +111,42 @@ def reset_dispatch_counters() -> None:
     _COMPILED_SHAPES.clear()
 
 
+# Ingest-pipeline observability, next to the dispatch counters above
+# and the rim counters (ops.backend.RIM_COUNTERS): stage-level truth
+# about the three-stage sweep pipeline (parallel/ingest.py).
+#   chunks_prefetched       — chunk payloads produced by ingest WORKERS
+#                             (inline encodes don't count);
+#   encode_dispatch_overlap — worker payloads dequeued while a previous
+#                             chunk's device work was still in flight,
+#                             i.e. encodes that genuinely overlapped
+#                             dispatch (the CI ingest-smoke pins > 0);
+#   max_inflight_chunks     — high-water mark of queued encoded chunks
+#                             (bounded by the configured pipeline
+#                             depth: backpressure proof);
+#   ingest_stall_seconds    — consumer time blocked waiting on the
+#                             ingest queue (the pipeline_stall bench
+#                             decomposition row);
+#   read_parse_seconds /    — cumulative stage-1 timings as measured
+#   encode_seconds            inside the workers (or inline).
+PIPELINE_COUNTERS = {
+    "chunks_prefetched": 0,
+    "encode_dispatch_overlap": 0,
+    "max_inflight_chunks": 0,
+    "ingest_stall_seconds": 0.0,
+    "read_parse_seconds": 0.0,
+    "encode_seconds": 0.0,
+}
+
+
+def reset_pipeline_counters() -> None:
+    PIPELINE_COUNTERS["chunks_prefetched"] = 0
+    PIPELINE_COUNTERS["encode_dispatch_overlap"] = 0
+    PIPELINE_COUNTERS["max_inflight_chunks"] = 0
+    PIPELINE_COUNTERS["ingest_stall_seconds"] = 0.0
+    PIPELINE_COUNTERS["read_parse_seconds"] = 0.0
+    PIPELINE_COUNTERS["encode_seconds"] = 0.0
+
+
 def _mesh_key(mesh: Mesh) -> tuple:
     # platform included: device ids are unique only per backend
     # (CpuDevice 0 and TpuDevice 0 coexist), and an explicit CPU mesh

@@ -43,3 +43,43 @@ def test_expected_metrics_cover_fail_heavy_batch_rows():
                 f"config6_fail_{tag}_docs{nd}_full_docs_per_sec" in metrics
             )
     assert "config5b_packed_templates_per_sec" in metrics
+
+
+def test_expected_metrics_cover_ingest_rows():
+    """PR 3: the ingest-plane decomposition rows (workers=1 vs 2, for
+    the registry and fail-heavy corpora) are part of the driver
+    contract and gated by the schema checker."""
+    metrics = bench.expected_metrics()
+    for m in (
+        "config5b_ingest_workers1_templates_per_sec",
+        "config5b_ingest_workers2_templates_per_sec",
+        "config6_ingest_workers1_docs_per_sec",
+        "config6_ingest_workers2_docs_per_sec",
+    ):
+        assert m in metrics
+
+
+def test_checker_requires_ingest_decomposition_keys(tmp_path):
+    """An ingest row missing its decomposition extras fails the gate."""
+    row = {
+        "metric": "config5b_ingest_workers2_templates_per_sec",
+        "value": 1.0,
+        "unit": "templates/sec",
+        "vs_baseline": 1.0,
+        "workers": 2,
+        # read_parse/encode/pipeline_stall keys intentionally missing
+    }
+    src = _newest_artifact().read_text().splitlines()
+    doctored = tmp_path / "bench_all_doctored_ingest.json"
+    doctored.write_text(
+        "\n".join(
+            ln for ln in src
+            if '"config5b_ingest_workers2_templates_per_sec"' not in ln
+        )
+        + "\n"
+        + __import__("json").dumps(row)
+        + "\n"
+    )
+    problems = check_bench_schema.check(doctored)
+    assert any("read_parse_seconds_per_run" in p for p in problems)
+    assert any("pipeline_stall_seconds_per_run" in p for p in problems)

@@ -699,3 +699,62 @@ def test_case_converter_key_fallback():
         "rule r when Resources exists { Resources.a.instance_type == 't2' }",
         docs,
     )
+
+
+def test_native_oracle_thread_safety_two_thread_hammer():
+    """The per-thread handle pool (PR 3): ONE shared NativeOracle
+    hammered from two threads must produce exactly the serial results
+    — the former one-handle design shared an unsynchronized regex
+    cache/pcre2 match data across threads (a documented footgun, now
+    fixed for the pipelined consumer stage)."""
+    import threading
+
+    rf = parse_rules_file(
+        "rule named { Resources.*.Name == /^prod-[a-z0-9-]+$/ }\n"
+        "rule sized { Resources.*.Size <= 100 }\n",
+        "mt.guard",
+    )
+    docs = [
+        from_plain(
+            {
+                "Resources": {
+                    "r": {
+                        "Name": f"prod-app-{i}" if i % 3 else f"DEV_{i}",
+                        "Size": (i * 7) % 160,
+                    }
+                }
+            }
+        )
+        for i in range(40)
+    ]
+    native = NativeOracle(rf)
+    try:
+        expected = [native.eval_doc(d) for d in docs]
+        results = {0: [], 1: []}
+        errors = []
+
+        def hammer(slot):
+            try:
+                for _ in range(5):
+                    out = [native.eval_doc(d) for d in docs]
+                    results[slot].append(out)
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=hammer, args=(s,)) for s in (0, 1)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        for slot in (0, 1):
+            assert len(results[slot]) == 5
+            for out in results[slot]:
+                assert out == expected
+    finally:
+        native.close()
+    # closed oracles refuse cleanly from any thread
+    with pytest.raises(NativeUnsupported):
+        native.eval_doc(docs[0])

@@ -1,0 +1,282 @@
+"""Bit-parity suite for the parallel host ingest plane (PR 3).
+
+`sweep` and `validate --backend tpu` output under the three-stage
+pipeline (ingest workers -> packed dispatch -> rim/report consumption,
+parallel/ingest.py) must be byte-identical to the serial path
+(`--ingest-workers 0`, the old single-chunk double buffer) for every
+worker count, over the mixed corpus of the vector-rim suite —
+fail-heavy docs, unsure-flagged docs, host-fallback rules, fn-var
+files — on both the packed and per-file dispatch paths, across
+console, structured JSON, YAML and JUnit output. Plus: graceful
+serial fallback when worker spawn fails, bounded prefetch (the queue
+high-water mark never exceeds the configured depth), and
+deterministic file ordering."""
+
+import json
+
+import pytest
+
+from guard_tpu.cli import run
+from guard_tpu.ops.backend import pipeline_stats, reset_pipeline_stats
+from guard_tpu.utils.io import Reader, Writer
+
+RULES_MAIN = (
+    "let b = Resources.*[ Type == 'AWS::S3::Bucket' ]\n"
+    "rule sse when %b !empty { %b.Properties.Enc == true }\n"
+    "rule named { Resources.* { Type exists } }\n"
+)
+RULES_HOST = "let t = now()\nrule fresh { Resources exists }\n"
+RULES_UNSURE = (
+    "let names = Selection.targets\n"
+    "rule sel { Resources.%names exists }\n"
+)
+RULES_FN = (
+    "let up = to_upper(Meta.name)\n"
+    "rule upper when Meta.name exists { %up == 'WIDGET' }\n"
+)
+
+
+def _mk_corpus(tmp_path, n_docs=10):
+    rdir = tmp_path / "rules"
+    rdir.mkdir(exist_ok=True)
+    (rdir / "main.guard").write_text(RULES_MAIN)
+    (rdir / "host.guard").write_text(RULES_HOST)
+    (rdir / "unsure.guard").write_text(RULES_UNSURE)
+    (rdir / "fnvar.guard").write_text(RULES_FN)
+    data = tmp_path / "data"
+    data.mkdir(exist_ok=True)
+    for i in range(n_docs):
+        doc = {
+            "Resources": {
+                "b": {
+                    "Type": "AWS::S3::Bucket",
+                    "Properties": {"Enc": (i % 3) != 0},
+                }
+            },
+            "Meta": {"name": "widget" if i % 2 else "gadget"},
+            "Selection": {"targets": [3] if i % 4 == 0 else ["b"]},
+        }
+        (data / f"t{i:03d}.json").write_text(json.dumps(doc))
+    return rdir, data
+
+
+def _rule_args(rdir):
+    return ["-r", *(str(rf) for rf in sorted(rdir.glob("*.guard")))]
+
+
+def _sweep(tmp_path, rdir, data, workers, tag, chunk=3):
+    w = Writer.buffered()
+    rc = run(
+        ["sweep", *_rule_args(rdir), "-d", str(data),
+         "-M", str(tmp_path / f"m-{tag}.jsonl"), "-c", str(chunk),
+         "--ingest-workers", str(workers)],
+        writer=w,
+        reader=Reader(),
+    )
+    summary = json.loads(w.out.getvalue().strip().splitlines()[-1])
+    summary.pop("manifest")
+    return rc, summary, w.err.getvalue()
+
+
+def _validate(rdir, data, workers, extra=()):
+    w = Writer.buffered()
+    rc = run(
+        ["validate", *_rule_args(rdir), "-d", str(data),
+         "--backend", "tpu", "--ingest-workers", str(workers), *extra],
+        writer=w,
+        reader=Reader(),
+    )
+    return rc, w.out.getvalue(), w.err.getvalue()
+
+
+def test_sweep_parity_across_worker_counts(tmp_path):
+    """workers 0/1/2/4 over the mixed corpus: identical counts, failed
+    lists (deterministic file ordering), error tallies, exit codes and
+    stderr bytes — and the pipelined runs genuinely prefetch."""
+    rdir, data = _mk_corpus(tmp_path)
+    base = _sweep(tmp_path, rdir, data, 0, "w0")
+    for w in (1, 2, 4):
+        reset_pipeline_stats()
+        got = _sweep(tmp_path, rdir, data, w, f"w{w}")
+        assert got == base, f"workers={w} diverged"
+        stats = pipeline_stats()
+        if w >= 2:
+            assert stats["chunks_prefetched"] > 0
+            assert stats["encode_dispatch_overlap"] > 0
+    assert base[0] != 0  # the corpus contains genuine failures
+    # file ordering inside the failed list is deterministic
+    names = [f["data"] for f in base[1]["failed"]]
+    assert names == sorted(names)
+
+
+@pytest.mark.parametrize("pack", ["1", "0"], ids=["packed", "perfile"])
+def test_sweep_parity_pack_modes(tmp_path, monkeypatch, pack):
+    """Parity holds on both dispatch paths: packed executables and
+    per-file dispatch."""
+    rdir, data = _mk_corpus(tmp_path)
+    monkeypatch.setenv("GUARD_TPU_PACK", pack)
+    base = _sweep(tmp_path, rdir, data, 0, f"p{pack}-w0")
+    got = _sweep(tmp_path, rdir, data, 2, f"p{pack}-w2")
+    assert got == base
+
+
+def test_sweep_bounded_prefetch_queue(tmp_path, monkeypatch):
+    """Backpressure: the queued-chunk high-water mark never exceeds
+    the configured pipeline depth."""
+    rdir, data = _mk_corpus(tmp_path, n_docs=12)
+    monkeypatch.setenv("GUARD_TPU_INGEST_DEPTH", "2")
+    reset_pipeline_stats()
+    _sweep(tmp_path, rdir, data, 2, "depth", chunk=2)  # 6 chunks
+    stats = pipeline_stats()
+    assert 0 < stats["max_inflight_chunks"] <= 2
+
+
+VALIDATE_MODES = [
+    [],
+    ["-o", "yaml"],
+    ["--structured", "-o", "json", "--show-summary", "none"],
+    ["--structured", "-o", "junit", "--show-summary", "none"],
+]
+
+
+@pytest.mark.parametrize(
+    "mode", VALIDATE_MODES, ids=lambda m: "_".join(m) or "console"
+)
+def test_validate_parity_workers(tmp_path, mode):
+    """validate's sharded parallel encode (contiguous shards, private
+    interners, id-remap merge): console/YAML/structured/JUnit bytes and
+    exit codes identical to the serial encode."""
+    rdir, data = _mk_corpus(tmp_path)
+    base = _validate(rdir, data, 0, mode)
+    got = _validate(rdir, data, 2, mode)
+    assert got == base
+    assert base[0] != 0
+
+
+def test_validate_parity_more_worker_counts(tmp_path):
+    rdir, data = _mk_corpus(tmp_path)
+    base = _validate(rdir, data, 0)
+    for w in (1, 4):
+        assert _validate(rdir, data, w) == base
+
+
+def test_spawn_failure_falls_back_serially(tmp_path, monkeypatch):
+    """A failing worker spawn degrades to inline ingest with identical
+    output — never an error."""
+    import guard_tpu.parallel.ingest as ingest
+
+    rdir, data = _mk_corpus(tmp_path)
+    base_sweep = _sweep(tmp_path, rdir, data, 0, "sf-w0")
+    base_val = _validate(rdir, data, 0)
+
+    def boom(workers):
+        raise OSError("spawn blocked for test")
+
+    ingest.close_shared_pools()  # a cached healthy pool would bypass
+    monkeypatch.setattr(ingest, "_spawn_pool", boom)
+    assert _sweep(tmp_path, rdir, data, 4, "sf-w4") == base_sweep
+    assert _validate(rdir, data, 4) == base_val
+
+
+def test_sweep_parity_unreadable_and_invalid_docs(tmp_path):
+    """Worker-read chunks reproduce the serial error accounting: an
+    invalid JSON doc is skipped with one error (native-encoder retry)
+    on every worker count."""
+    rdir, data = _mk_corpus(tmp_path, n_docs=7)
+    (data / "zbad.json").write_text('{"Resources": {')  # truncated
+    base = _sweep(tmp_path, rdir, data, 0, "err-w0")
+    assert base[1]["errors"] >= 1
+    for w in (1, 2):
+        assert _sweep(tmp_path, rdir, data, w, f"err-w{w}") == base
+
+
+def test_resolve_ingest_workers_env_and_flag(monkeypatch):
+    from guard_tpu.parallel.ingest import resolve_ingest_workers
+
+    monkeypatch.delenv("GUARD_TPU_INGEST_WORKERS", raising=False)
+    assert resolve_ingest_workers(3) == 3
+    assert resolve_ingest_workers(0) == 0
+    monkeypatch.setenv("GUARD_TPU_INGEST_WORKERS", "2")
+    assert resolve_ingest_workers(None) == 2
+    assert resolve_ingest_workers(5) == 5  # explicit flag wins
+    monkeypatch.setenv("GUARD_TPU_INGEST_WORKERS", "0")
+    assert resolve_ingest_workers(None) == 0
+    monkeypatch.delenv("GUARD_TPU_INGEST_WORKERS", raising=False)
+    import os
+
+    auto = resolve_ingest_workers(None)
+    assert 0 <= auto <= min((os.cpu_count() or 1) - 1, 4) or auto == 0
+
+
+def test_batch_payload_roundtrip():
+    """The picklable wire form reconstructs an equivalent DocBatch
+    without re-deriving columns."""
+    import numpy as np
+
+    from guard_tpu.core.values import from_plain
+    from guard_tpu.ops.encoder import (
+        batch_from_payload,
+        batch_payload,
+        encode_batch,
+    )
+
+    docs = [from_plain({"a": [1, 2, {"b": "x"}]}), from_plain({"c": None})]
+    batch, _ = encode_batch(docs)
+    clone = batch_from_payload(batch_payload(batch))
+    for attr in (
+        "node_kind", "node_parent", "scalar_id", "num_hi", "num_lo",
+        "child_count", "edge_parent", "edge_child", "edge_key_id",
+        "edge_index", "edge_valid", "node_key_id", "node_index",
+        "node_parent_kind", "num_exotic",
+    ):
+        np.testing.assert_array_equal(
+            getattr(batch, attr), getattr(clone, attr), err_msg=attr
+        )
+    assert (clone.n_docs, clone.n_nodes, clone.n_edges) == (
+        batch.n_docs, batch.n_nodes, batch.n_edges
+    )
+
+
+def test_shard_merge_matches_serial_encode():
+    """Encoding two shards with private interners and merging through
+    remap+concat yields the same statuses as one serial encode."""
+    import numpy as np
+
+    from guard_tpu.core.parser import parse_rules_file
+    from guard_tpu.core.values import from_plain
+    from guard_tpu.ops.encoder import (
+        Interner,
+        concat_batches,
+        encode_batch,
+        remap_interned_ids,
+    )
+    from guard_tpu.ops.ir import compile_rules_file
+    from guard_tpu.parallel.mesh import ShardedBatchEvaluator
+
+    docs = [
+        from_plain({"Resources": {"r": {"Type": t, "Size": s}}})
+        for t, s in [("A", 1), ("B", 200), ("A", 50), ("C", 7)]
+    ]
+    rf = parse_rules_file(
+        "rule small { Resources.*.Size <= 100 }\n"
+        "rule typed { Resources.*.Type IN ['A', 'B'] }\n",
+        "m.guard",
+    )
+    # serial
+    batch_s, interner_s = encode_batch(docs)
+    ev_s = ShardedBatchEvaluator(compile_rules_file(rf, interner_s))
+    st_s, _, _ = ev_s.evaluate_bucketed(batch_s)
+    # sharded: 2 + 2 with private interners, merged
+    merged = Interner()
+    parts = []
+    for shard in (docs[:2], docs[2:]):
+        b, it = encode_batch(shard)
+        remap = np.array(
+            [merged.intern(s) for s in it.strings], dtype=np.int32
+        )
+        remap_interned_ids(b, remap)
+        parts.append(b)
+    batch_m = concat_batches(parts)
+    ev_m = ShardedBatchEvaluator(compile_rules_file(rf, merged))
+    st_m, _, _ = ev_m.evaluate_bucketed(batch_m)
+    np.testing.assert_array_equal(st_s, st_m)

@@ -76,3 +76,44 @@ def test_serve_empty_line_ends_session():
     )
     assert rc == 0
     assert w.out.getvalue().strip() == ""
+
+
+def test_serve_reuses_prepared_rules_across_requests(monkeypatch):
+    """Persistent sessions reuse the prepared pipeline: the second
+    request with the same rules is served from the parsed-RuleFile
+    cache (no re-parse), with byte-identical output — and a rules
+    payload that fails to parse always takes the uncached path so the
+    parse-error output reproduces every time."""
+    import guard_tpu.commands.serve as serve_mod
+    from guard_tpu.commands.serve import Serve
+    from guard_tpu.utils.io import Reader, Writer
+
+    calls = [0]
+    real_parse = serve_mod.parse_rules_file
+
+    def counting_parse(content, name):
+        calls[0] += 1
+        return real_parse(content, name)
+
+    monkeypatch.setattr(serve_mod, "parse_rules_file", counting_parse)
+
+    rules = ["rule ok { a exists }", "rule sized { a <= 3 }"]
+    req = json.dumps({"rules": rules, "data": ['{"a": 1}']})
+    req2 = json.dumps({"rules": rules, "data": ['{"a": 9}']})
+    bad = json.dumps({"rules": ["rule broken {{{"], "data": ['{"a": 1}']})
+    srv = Serve(stdio=True)
+    w = Writer.buffered()
+    rc = srv.execute(
+        w, Reader.from_string("\n".join([req, req2, req, bad, bad]) + "\n")
+    )
+    assert rc == 0
+    resps = [json.loads(l) for l in w.out.getvalue().splitlines() if l.strip()]
+    assert [r["code"] for r in resps] == [0, 19, 0, 5, 5]
+    # 2 parses for the first request's two rule files; requests 2 and 3
+    # hit the cache; the broken payload parses (and fails) both times
+    # in serve plus once per request inside validate's payload path
+    assert srv.cache_hits == 2
+    assert calls[0] == 4  # 2 (first request) + 1 + 1 (broken, uncached)
+    # identical requests produce identical bytes (cache is transparent)
+    assert resps[0]["output"] == resps[2]["output"]
+    assert resps[3] == resps[4]

@@ -49,10 +49,21 @@ METRIC_REQUIRED_KEYS = {
     ),
 }
 
+# PR 3 ingest decomposition: every *_ingest_workers* row must say how
+# the host plane's time split (file read vs parse/encode vs consumer
+# stall) and how many workers fed the pipeline — the "is ingest the
+# bottleneck" question must be answerable from the artifact alone
+INGEST_REQUIRED_KEYS = (
+    "workers", "read_parse_seconds_per_run", "encode_seconds_per_run",
+    "pipeline_stall_seconds_per_run",
+)
+
 
 def _required_keys(metric: str):
     keys = METRIC_REQUIRED_KEYS.get(metric, ())
-    if metric.startswith("config6_fail_"):
+    if "_ingest_workers" in metric:
+        keys = keys + INGEST_REQUIRED_KEYS
+    elif metric.startswith("config6_fail_"):
         keys = keys + (
             "docs_materialized", "docs_settled", "device_seconds",
             "host_materialize_seconds",
