@@ -28,6 +28,7 @@ time is the K-loop minus the 1-loop wall time over (K - 1).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 from typing import Optional
@@ -1017,6 +1018,223 @@ def ingest_smoke(n_docs: int = 64, chunk_size: int = 16) -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def measure_quarantine(n_docs: int = 1024, chunk_size: int = 256,
+                       reps: int = 3, n_poison: int = 8):
+    """The failure plane's overhead contract: the always-on quarantine
+    plumbing (structured error records threaded through every chunk)
+    must cost <= 5% on a CLEAN corpus vs the historical fail-fast
+    semantics (`--max-doc-failures 0`), and a DEGRADED run — poisoned
+    docs plus an injected device-dispatch fault — must finish at a
+    quantified fraction of clean throughput instead of aborting.
+    Returns (clean_docs_per_sec, clean_extra, degraded_docs_per_sec,
+    degraded_extra)."""
+    import gc
+    import pathlib
+    import shutil
+    import tempfile
+
+    from guard_tpu.commands.sweep import Sweep
+    from guard_tpu.utils import faults
+    from guard_tpu.utils.io import Reader, Writer
+
+    tmp = tempfile.mkdtemp(prefix="guard_quarantine_")
+    try:
+        docdir, rules = _write_ingest_corpus(tmp, "registry", n_docs)
+
+        def timed(tag: str, max_df, expect_rc=None) -> float:
+            cmd = Sweep(
+                rules=[rules],
+                data=[docdir],
+                manifest=str(pathlib.Path(tmp) / f"m-{tag}.jsonl"),
+                chunk_size=chunk_size,
+                backend="tpu",
+                ingest_workers=0,
+                max_doc_failures=max_df,
+            )
+            rc = cmd.execute(Writer.buffered(), Reader.from_string(""))
+            if expect_rc is not None and rc != expect_rc:
+                raise SystemExit(
+                    f"quarantine bench: {tag} exited {rc}, "
+                    f"expected {expect_rc}"
+                )
+            return rc
+
+        def one(tag: str, max_df) -> float:
+            # a full collection lands inside every OTHER ~1s run
+            # otherwise (gen-2 threshold ≈ two runs' allocations),
+            # phase-locking a bimodal ~0.5s cost onto whichever config
+            # the interleave order parks on the collecting phase —
+            # collect outside the clock so runs time only sweep work
+            gc.collect()
+            t0 = time.perf_counter()
+            timed(tag, max_df)
+            return time.perf_counter() - t0
+
+        # fail-fast vs clean-quarantine reps INTERLEAVE with the pair
+        # order SWAPPED each rep, and the best-of-reps time is kept
+        # per config. The two configs run identical work (the flag
+        # only changes the exit branch), so the overhead ratio is
+        # dominated by host noise — slow drift and contention spikes
+        # an order of magnitude larger than the effect — unless rep
+        # pairs share a clock window, neither config is parked on a
+        # fixed position in it, and the minimum filters the spikes.
+        one("failfast-warm", 0)  # compile outside the clock
+        t_failfast: list = []
+        t_clean: list = []
+        for r in range(reps):
+            pair = [("failfast", 0, t_failfast), ("clean", None, t_clean)]
+            if r % 2:
+                pair.reverse()
+            for tag, max_df, acc in pair:
+                acc.append(one(f"{tag}-r{r}", max_df))
+        v_failfast = n_docs / min(t_failfast)
+        v_clean = n_docs / min(t_clean)
+        clean_extra = {
+            "workers": 0,
+            "quarantined_docs": 0,
+            "overhead_vs_failfast": round(
+                v_failfast / max(v_clean, 1e-9), 4
+            ),
+        }
+
+        # degraded: poison a slice of the corpus and inject one device
+        # dispatch failure per run — the sweep must complete, at a cost
+        paths = sorted(pathlib.Path(docdir).glob("*.json"))
+        step = max(1, len(paths) // max(n_poison, 1))
+        poisoned = paths[::step][:n_poison]
+        for p in poisoned:
+            p.write_text("{poisoned for quarantine bench")
+        old_fault = os.environ.get("GUARD_TPU_FAULT")
+        os.environ["GUARD_TPU_FAULT"] = "dispatch:nth=1"
+        try:
+            faults.reset_faults()
+            timed("degraded-warm", None)
+            faults.reset_faults()
+            t_degraded: list = []
+            for r in range(reps):
+                # flip the env (and poke the lazy parser) to reset the
+                # nth= fired-once state per rep WITHOUT clearing the
+                # fault counters
+                os.environ["GUARD_TPU_FAULT"] = ""
+                faults.fault_active("dispatch")
+                os.environ["GUARD_TPU_FAULT"] = "dispatch:nth=1"
+                t_degraded.append(one(f"degraded-r{r}", None))
+            v_degraded = n_docs / min(t_degraded)
+            stats = faults.fault_stats()
+        finally:
+            if old_fault is None:
+                os.environ.pop("GUARD_TPU_FAULT", None)
+            else:
+                os.environ["GUARD_TPU_FAULT"] = old_fault
+            faults.reset_faults()
+        degraded_extra = {
+            "workers": 0,
+            "poisoned_docs": len(poisoned),
+            "quarantined_docs": stats["quarantined_docs"] // reps,
+            "retries": stats["retries"],
+            "dispatch_fallbacks": stats["dispatch_fallbacks"],
+        }
+        return v_clean, clean_extra, v_degraded, degraded_extra
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def chaos_smoke(n_docs: int = 48, chunk_size: int = 12) -> None:
+    """CI chaos-smoke (JAX_PLATFORMS=cpu): a registry-scale sweep with
+    an injected ingest-worker crash AND a device-dispatch fault AND one
+    parse-poisoned document must FINISH — counts/failed for the
+    unaffected docs identical to the clean run, a quarantine record
+    naming the poisoned file, nonzero retry/quarantine/fallback
+    counters — and `--max-doc-failures 0` must turn the same run into
+    a hard error. Prints one JSON line; SystemExit(1) on violation."""
+    import json as _json
+    import pathlib
+    import shutil
+    import tempfile
+
+    from guard_tpu.commands.sweep import Sweep
+    from guard_tpu.parallel import ingest as _ingest
+    from guard_tpu.utils import faults
+    from guard_tpu.utils.io import Reader, Writer
+
+    tmp = tempfile.mkdtemp(prefix="guard_chaos_smoke_")
+    os.environ["GUARD_TPU_RETRY_BACKOFF"] = "0"
+    try:
+        docdir, rules = _write_ingest_corpus(tmp, "registry", n_docs)
+
+        def run_sweep(tag: str, max_df=None):
+            w = Writer.buffered()
+            cmd = Sweep(
+                rules=[rules],
+                data=[docdir],
+                manifest=str(pathlib.Path(tmp) / f"m-{tag}.jsonl"),
+                chunk_size=chunk_size,
+                backend="tpu",
+                ingest_workers=2,
+                max_doc_failures=max_df,
+            )
+            rc = cmd.execute(w, Reader.from_string(""))
+            summary = _json.loads(
+                w.out.getvalue().strip().splitlines()[-1]
+            )
+            return rc, summary
+
+        clean_rc, clean = run_sweep("clean")
+
+        # the victim sorts last: chunks holding the clean docs carry
+        # identical work in both runs
+        (pathlib.Path(docdir) / "zpoison.json").write_text(
+            "{poisoned for chaos smoke"
+        )
+        os.environ["GUARD_TPU_FAULT"] = (
+            "worker_crash:nth=1,dispatch:nth=1"
+        )
+        _ingest.close_shared_pools()  # spawn workers under the fault env
+        faults.reset_faults()
+        chaos_rc, chaos = run_sweep("chaos")
+        stats = faults.fault_stats()
+
+        faults.reset_faults()
+        _ingest.close_shared_pools()
+        failfast_rc, _ = run_sweep("failfast", max_df=0)
+        os.environ.pop("GUARD_TPU_FAULT", None)
+        faults.reset_faults()
+        _ingest.close_shared_pools()
+
+        quarantined = chaos.get("quarantined", [])
+        parity = (
+            chaos["counts"] == clean["counts"]
+            and chaos["failed"] == clean["failed"]
+            and chaos["documents"] == clean["documents"] + 1
+            and chaos_rc == clean_rc
+        )
+        record = {
+            "metric": "chaos_smoke",
+            "docs": n_docs,
+            "parity": parity,
+            "quarantined": [q["file"] for q in quarantined],
+            "retries": stats["retries"],
+            "worker_restarts": stats["worker_restarts"],
+            "quarantined_docs": stats["quarantined_docs"],
+            "dispatch_fallbacks": stats["dispatch_fallbacks"],
+            "failfast_exit": failfast_rc,
+        }
+        print(_json.dumps(record), flush=True)
+        ok = (
+            parity
+            and [q["file"] for q in quarantined] == ["zpoison.json"]
+            and quarantined[0]["stage"] == "parse"
+            and stats["retries"] > 0
+            and stats["quarantined_docs"] > 0
+            and stats["dispatch_fallbacks"] > 0
+            and failfast_rc == 5
+        )
+        if not ok:
+            raise SystemExit(1)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def pack_smoke(n_files: int = 40, n_docs: int = 48,
                dispatch_ceiling: int = 8) -> None:
     """CI bench-smoke (JAX_PLATFORMS=cpu, tiny corpus slice): asserts
@@ -1317,6 +1535,8 @@ def expected_metrics() -> list:
         "config5b_ingest_workers2_templates_per_sec",
         "config6_ingest_workers1_docs_per_sec",
         "config6_ingest_workers2_docs_per_sec",
+        "config5b_quarantine_clean_templates_per_sec",
+        "config5b_quarantine_degraded_templates_per_sec",
         "config5c_rule_sharded_templates_per_sec",
     ]
     for tag in ("50pct", "allfail"):
@@ -1346,6 +1566,15 @@ def main() -> None:
 
         _honor_platform_env()
         ingest_smoke()
+        return
+    if "--chaos-smoke" in sys.argv:
+        # CI smoke for the failure plane: injected worker crash +
+        # device-dispatch fault + one poisoned doc must degrade, not
+        # abort, with clean-doc parity and nonzero recovery counters
+        from guard_tpu.ops.backend import _honor_platform_env
+
+        _honor_platform_env()
+        chaos_smoke()
         return
     if not _probe_tpu_responsive():
         import jax as _jax
@@ -1504,6 +1733,28 @@ def main() -> None:
         v_ing2f,
         v_ing2f / max(v_ing1f, 1e-9),
         extra=x_ing2f,
+    )
+
+    # config 5b failure plane: the quarantine plumbing's overhead on a
+    # clean registry sweep (contract: <= 5% vs `--max-doc-failures 0`
+    # fail-fast) and the throughput of a DEGRADED run — poisoned docs
+    # plus an injected device-dispatch fault — that completes instead
+    # of aborting
+    v_qc, x_qc, v_qd, x_qd = measure_quarantine()
+    _emit(
+        "config5b_quarantine_clean_templates_per_sec",
+        v_qc,
+        1.0,
+        extra=x_qc,
+    )
+    _emit(
+        "config5b_quarantine_degraded_templates_per_sec",
+        v_qd,
+        v_qd / max(v_qc, 1e-9),
+        extra={
+            **x_qd,
+            "vs_note": "vs_baseline here = degraded-run throughput over the clean quarantine run on the same corpus (poisoned docs + injected dispatch fault)",
+        },
     )
 
     # config 5c: rule-axis sharding with PACKS as the unit

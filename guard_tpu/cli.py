@@ -83,6 +83,16 @@ def build_parser() -> argparse.ArgumentParser:
         "read/parse/encode plane (default auto; 0 = serial bit-parity "
         "escape hatch; overrides GUARD_TPU_INGEST_WORKERS)",
     )
+    v.add_argument(
+        "--max-doc-failures",
+        type=int,
+        default=None,
+        help="tpu backend: quarantine documents that fail to "
+        "read/parse/encode instead of aborting; exit ERROR only when "
+        "more than this many docs were quarantined (0 = quarantine "
+        "records but any failing doc still fails the run; omit the "
+        "flag for the historical abort-on-first-failure behavior)",
+    )
 
     t = sub.add_parser("test", help="Test rules against expectations")
     t.add_argument("--rules-file", "-r", dest="rules", default=None)
@@ -146,6 +156,16 @@ def build_parser() -> argparse.ArgumentParser:
         "auto; 0 = serial bit-parity escape hatch; overrides "
         "GUARD_TPU_INGEST_WORKERS)",
     )
+    s.add_argument(
+        "--max-doc-failures",
+        type=int,
+        default=None,
+        help="exit ERROR when more than this many documents were "
+        "quarantined (failed read/parse/encode). Default: unlimited — "
+        "quarantined docs are recorded but never fail the run by "
+        "themselves; 0 restores the historical any-doc-error-is-fatal "
+        "exit code",
+    )
 
     pt = sub.add_parser("parse-tree", help="Prints the parse tree for a rules file")
     pt.add_argument("--rules", "-r", default=None)
@@ -202,6 +222,7 @@ def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reade
                 pack_rules=not args.no_pack,
                 vector_rim=not args.no_vector_rim,
                 ingest_workers=args.ingest_workers,
+                max_doc_failures=args.max_doc_failures,
             )
             return cmd.execute(writer, reader)
         if args.command == "test":
@@ -229,6 +250,7 @@ def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reade
                 pack_rules=not args.no_pack,
                 vector_rim=not args.no_vector_rim,
                 ingest_workers=args.ingest_workers,
+                max_doc_failures=args.max_doc_failures,
             ).execute(writer, reader)
         if args.command == "parse-tree":
             return ParseTree(

@@ -33,23 +33,46 @@ Protocol (one line in, one line out):
   response: {"code": <exit code 0|19|5>, "output": "<stdout text>",
              "error": "<stderr text>"}
 
-An empty line or EOF ends the session with exit code 0; a malformed
-request produces a response with code 5 and keeps the session alive.
+An empty line or EOF ends the session with exit code 0. Request
+isolation (the failure plane's serve leg): a malformed or poisoned
+request produces a structured error response — code 5 plus an
+`error_class` naming the exception type — and keeps the session
+alive; `GUARD_TPU_SERVE_TIMEOUT=<seconds>` bounds each request
+(a timed-out request answers `error_class: "RequestTimeout"` and the
+session keeps serving; the wedged worker thread is abandoned, not
+joined — a stuck device call cannot be cancelled, only orphaned).
 """
 
 from __future__ import annotations
 
 import json
+import os
 from collections import OrderedDict
 from dataclasses import dataclass, field
+from typing import Optional
 
 from ..core.errors import ParseError
 from ..core.parser import parse_rules_file
 from ..utils.io import Reader, Writer
 
+
+def _serve_timeout() -> float:
+    """Per-request bound in seconds (GUARD_TPU_SERVE_TIMEOUT); 0 or
+    unset = unbounded direct call (zero overhead)."""
+    raw = os.environ.get("GUARD_TPU_SERVE_TIMEOUT", "").strip()
+    try:
+        return float(raw) if raw else 0.0
+    except ValueError:
+        return 0.0
+
 #: parsed-rules cache ceiling per session (rule registries are few and
 #: stable in practice; the bound only guards a hostile request stream)
 _RULES_CACHE_MAX = 8
+
+
+class RequestTimeout(Exception):
+    """One request exceeded GUARD_TPU_SERVE_TIMEOUT; the session
+    answers with a structured error and keeps serving."""
 
 
 @dataclass
@@ -61,6 +84,9 @@ class Serve:
         default_factory=OrderedDict, repr=False
     )
     cache_hits: int = 0
+    # lazily created single-worker executor for bounded requests
+    # (GUARD_TPU_SERVE_TIMEOUT); abandoned + recreated after a timeout
+    _executor: Optional[object] = field(default=None, repr=False)
 
     def _prepared_rules(self, rules_strs):
         """Parsed RuleFile list for this request's rule texts, reused
@@ -92,6 +118,32 @@ class Serve:
         while len(self._rules_cache) > _RULES_CACHE_MAX:
             self._rules_cache.popitem(last=False)
         return rule_files
+
+    def _run_bounded(self, cmd, buf, payload):
+        """Run one request under GUARD_TPU_SERVE_TIMEOUT. The
+        single-worker executor is created lazily and reused across
+        requests; on timeout it is abandoned (its thread may still be
+        wedged in a device call) and a fresh one serves the next
+        request."""
+        timeout = _serve_timeout()
+        if timeout <= 0:
+            return cmd.execute(buf, Reader.from_string(payload))
+        from concurrent.futures import TimeoutError as FutTimeout
+        from concurrent.futures import ThreadPoolExecutor
+
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(max_workers=1)
+        fut = self._executor.submit(
+            cmd.execute, buf, Reader.from_string(payload)
+        )
+        try:
+            return fut.result(timeout=timeout)
+        except FutTimeout:
+            ex, self._executor = self._executor, None
+            ex.shutdown(wait=False)
+            raise RequestTimeout(
+                f"request timed out after {timeout:g}s"
+            )
 
     def execute(self, writer: Writer, reader: Reader) -> int:
         from .validate import Validate
@@ -127,14 +179,19 @@ class Serve:
                     prepared_rules=prepared,
                 )
                 buf = Writer.buffered()
-                code = cmd.execute(buf, Reader.from_string(payload))
+                code = self._run_bounded(cmd, buf, payload)
                 resp = {
                     "code": code,
                     "output": buf.out.getvalue(),
                     "error": buf.err.getvalue(),
                 }
-            except Exception as e:  # malformed request: keep serving
-                resp = {"code": 5, "output": "", "error": str(e)}
+            except Exception as e:  # poisoned request: keep serving
+                resp = {
+                    "code": 5,
+                    "output": "",
+                    "error": str(e),
+                    "error_class": type(e).__name__,
+                }
             writer.writeln(json.dumps(resp))
             writer.flush()
         return 0

@@ -28,6 +28,12 @@ from ..core.loader import load_document
 from ..core.parser import parse_rules_file
 from ..core.qresult import Status
 from ..core.scopes import RootScope
+from ..utils.faults import (
+    FAULT_COUNTERS,
+    bounded_call,
+    maybe_fail,
+    quarantine_record,
+)
 from ..utils.io import Reader, Writer
 from .files import DATA_FILE_EXTENSIONS, RULE_FILE_EXTENSIONS, gather
 from .validate import (
@@ -39,6 +45,37 @@ from .validate import (
 )
 
 _STATUS_NAMES = ("pass", "fail", "skip")
+
+#: pool-crash recovery gives up restarting after this many crashes in
+#: one run and stays inline (a persistently dying pool would otherwise
+#: pay spawn cost on every remaining chunk)
+_MAX_POOL_RESTARTS = 3
+
+
+def _chunk_timeout() -> float:
+    """Bound on one worker chunk job (GUARD_TPU_INGEST_CHUNK_TIMEOUT,
+    seconds): a worker killed mid-job loses the job and an unbounded
+    .get() would hang the sweep forever — the bound turns a hung pool
+    into the same recovery path as a crashed one."""
+    import os
+
+    raw = os.environ.get("GUARD_TPU_INGEST_CHUNK_TIMEOUT", "").strip()
+    try:
+        return float(raw) if raw else 300.0
+    except ValueError:
+        return 300.0
+
+
+def _retry_backoff() -> float:
+    """Base of the pool-restart exponential backoff in seconds
+    (GUARD_TPU_RETRY_BACKOFF; tests set 0 for speed)."""
+    import os
+
+    raw = os.environ.get("GUARD_TPU_RETRY_BACKOFF", "").strip()
+    try:
+        return float(raw) if raw else 0.05
+    except ValueError:
+        return 0.05
 
 
 def _chunk_signature(paths: List[Path]) -> str:
@@ -89,6 +126,12 @@ class Sweep:
     # escape hatch (the old single-chunk double buffer); 1 = pipelined
     # control flow with inline encode
     ingest_workers: Optional[int] = None
+    # document-quarantine threshold: a doc whose read/parse/encode
+    # fails is quarantined (structured record in manifest + summary,
+    # rest of the chunk proceeds) and the run exits ERROR only when
+    # the quarantine count exceeds this. None = unlimited (quarantine
+    # never fails the run by itself); 0 = today's fail-fast behavior
+    max_doc_failures: Optional[int] = None
 
     def execute(self, writer: Writer, reader: Reader) -> int:
         if not self.rules:
@@ -155,10 +198,10 @@ class Sweep:
                 ci2, _sig2, chunk2 = todo[j]
                 if ci2 in prepared:
                     return
-                err_box2 = [0]
+                err_box2 = [0, []]
                 dfs = self._read_chunk(chunk2, writer, err_box2)
                 enc = self._encode_chunk(dfs, writer, err_box2)
-                prepared[ci2] = (dfs, enc, err_box2[0])
+                prepared[ci2] = (dfs, enc, err_box2)
 
             with manifest_path.open("a") as mf:
                 for j, (ci, sig, chunk) in enumerate(todo):
@@ -175,6 +218,7 @@ class Sweep:
 
         totals = {k: 0 for k in _STATUS_NAMES}
         failed: List[dict] = []
+        quarantined: List[dict] = []
         errors = parse_errors
         for ci in range(len(chunks)):
             rec = done.get(ci)
@@ -183,6 +227,7 @@ class Sweep:
             for k in _STATUS_NAMES:
                 totals[k] += rec["counts"].get(k, 0)
             failed.extend(rec.get("failed", []))
+            quarantined.extend(rec.get("quarantined", []))
             errors += rec.get("errors", 0)
         summary = {
             "chunks": len(chunks),
@@ -194,8 +239,22 @@ class Sweep:
             "errors": errors,
             "manifest": str(manifest_path),
         }
+        if quarantined:
+            # keyed only when present so clean-run summaries stay
+            # byte-identical to the pre-failure-plane output
+            summary["quarantined"] = quarantined
         writer.writeln(json.dumps(summary))
-        if errors:
+        # exit-code semantics: quarantined documents are PARTIAL
+        # failure — ERROR only past --max-doc-failures (default
+        # unlimited; 0 restores the historical any-doc-error-is-fatal
+        # behavior). Errors that are not doc quarantines (rule parse
+        # errors, oracle evaluation errors) stay fatal.
+        doc_failures = len(quarantined)
+        hard_errors = max(0, errors - doc_failures)
+        if hard_errors:
+            return ERROR_STATUS_CODE
+        limit = self.max_doc_failures
+        if limit is not None and limit >= 0 and doc_failures > limit:
             return ERROR_STATUS_CODE
         if totals["fail"]:
             return FAILURE_STATUS_CODE
@@ -236,13 +295,17 @@ class Sweep:
         from ..parallel.mesh import PIPELINE_COUNTERS
 
         depth = pipeline_depth()
-        pool = None
+        # pool and restart state live in boxes so the crash-recovery
+        # path in _take_ingest can restart (or retire) the pool
+        # mid-run without re-threading the driver loop
+        pool_box = [None]
+        restarts = [0]
         if workers >= 2 and len(todo) > 1:
             # process-global pool: spawn cost amortizes across sweep
             # invocations (serve sessions, chunked drivers, bench
             # reps); shared_pool degrades to None — inline ingest —
             # when spawn fails
-            pool = shared_pool(workers)
+            pool_box[0] = shared_pool(workers)
         queue: list = []  # [(j, AsyncResult)], at most `depth` deep
         nxt = [0]
 
@@ -250,12 +313,12 @@ class Sweep:
             # backpressure: never more than `depth` encoded chunks
             # ahead of the dispatch stage, so peak queued-chunk memory
             # is bounded by depth x chunk columns
-            if pool is None:
+            if pool_box[0] is None:
                 return
             while nxt[0] < len(todo) and len(queue) < depth:
                 j2 = nxt[0]
                 ci2, _sig2, chunk2 = todo[j2]
-                queue.append((j2, pool.submit(
+                queue.append((j2, pool_box[0].submit(
                     _chunk_job, (ci2, [str(p) for p in chunk2])
                 )))
                 nxt[0] += 1
@@ -272,12 +335,13 @@ class Sweep:
         with manifest_path.open("a") as mf:
             _top_up()
             for j, (ci, sig, chunk) in enumerate(todo):
-                data_files, encoded, pre_err = self._take_ingest(
-                    j, chunk, queue, pool, writer,
+                data_files, encoded, pre_err, pre_recs = self._take_ingest(
+                    j, chunk, queue, pool_box, writer,
                     busy=inflight is not None,
+                    workers=workers, nxt=nxt, restarts=restarts,
                 )
                 _top_up()
-                err_box = [pre_err]
+                err_box = [pre_err, pre_recs]
                 state = self._dispatch_tpu(
                     data_files, rule_files, writer, err_box,
                     encoded=encoded, vec_box={},
@@ -297,27 +361,38 @@ class Sweep:
                 evaluated += 1
         return evaluated
 
-    def _take_ingest(self, j, chunk, queue, pool, writer, busy):
+    def _take_ingest(self, j, chunk, queue, pool_box, writer, busy,
+                     workers=0, nxt=None, restarts=None):
         """Dequeue chunk j's worker-encoded payload, or read+encode it
         inline (workers == 1, spawn failure, or a failed worker job).
-        Returns (data_files, (batch, interner), error_count); the
-        chunk's read/encode stderr is emitted here — the same stream
-        position the serial path's prefetch hook used."""
-        import logging
+        Returns (data_files, (batch, interner), error_count,
+        quarantine_records); the chunk's read/encode stderr is emitted
+        here — the same stream position the serial path's prefetch
+        hook used.
+
+        A dead or hung worker (bounded by
+        GUARD_TPU_INGEST_CHUNK_TIMEOUT) triggers the recovery ladder:
+        the chunk retries INLINE immediately (the retry — no result is
+        ever lost), queued jobs on the dead pool are re-planned, and
+        the pool restarts with bounded exponential backoff
+        (GUARD_TPU_RETRY_BACKOFF base, _MAX_POOL_RESTARTS cap; past
+        the cap the rest of the run stays inline)."""
         import time
 
         from ..parallel.mesh import PIPELINE_COUNTERS
 
+        pool = pool_box[0]
         if pool is not None and queue and queue[0][0] == j:
             _jj, handle = queue.pop(0)
             t0 = time.perf_counter()
             try:
-                _ci, res = handle.get()
-            except Exception as e:  # worker died: degrade, don't fail
-                logging.getLogger("guard_tpu.ingest").warning(
-                    "ingest worker failed (%s); encoding chunk inline", e
-                )
+                maybe_fail("worker_crash")
+                _ci, res = handle.get(timeout=_chunk_timeout())
+            except Exception as e:  # worker died: recover, don't fail
                 res = None
+                self._recover_ingest(
+                    e, queue, pool_box, workers, nxt, restarts
+                )
             PIPELINE_COUNTERS["ingest_stall_seconds"] += (
                 time.perf_counter() - t0
             )
@@ -343,8 +418,9 @@ class Sweep:
                     batch_from_payload(res["payload"]),
                     Interner.from_strings(res["strings"]),
                 )
-                return data_files, encoded, res["errors"]
-        err_box = [0]
+                return (data_files, encoded, res["errors"],
+                        list(res.get("quarantined", ())))
+        err_box = [0, []]
         t0 = time.perf_counter()
         data_files = self._read_chunk(chunk, writer, err_box)
         t_read = time.perf_counter() - t0
@@ -353,7 +429,47 @@ class Sweep:
         PIPELINE_COUNTERS["encode_seconds"] += (
             time.perf_counter() - t0 - t_read
         )
-        return data_files, encoded, err_box[0]
+        return data_files, encoded, err_box[0], err_box[1]
+
+    def _recover_ingest(self, exc, queue, pool_box, workers, nxt,
+                        restarts) -> None:
+        """Ingest-worker crash recovery: log, count the inline retry,
+        re-plan every chunk queued on the dead pool, and restart the
+        pool (bounded exponential backoff, capped restarts)."""
+        import logging
+        import time
+
+        from ..parallel.ingest import restart_shared_pool
+
+        log = logging.getLogger("guard_tpu.ingest")
+        log.warning(
+            "ingest worker failed (%s); retrying chunk inline", exc
+        )
+        FAULT_COUNTERS["retries"] += 1
+        if queue:
+            # jobs queued on the dead pool are lost — rewind the
+            # submit cursor so _top_up re-plans them on the new pool
+            if nxt is not None:
+                nxt[0] = queue[0][0]
+            queue.clear()
+        if restarts is None:
+            pool_box[0] = None
+            return
+        restarts[0] += 1
+        if restarts[0] > _MAX_POOL_RESTARTS:
+            log.warning(
+                "ingest pool crashed %d times; staying inline for the "
+                "rest of the run", restarts[0] - 1,
+            )
+            pool_box[0] = None
+            return
+        backoff = min(
+            _retry_backoff() * (2 ** (restarts[0] - 1)), 2.0
+        )
+        if backoff > 0:
+            time.sleep(backoff)
+        FAULT_COUNTERS["worker_restarts"] += 1
+        pool_box[0] = restart_shared_pool(workers)
 
     def _finish_chunk(self, inflight, writer):
         """Stage 3 for one chunk: collect the dispatched device work,
@@ -368,7 +484,7 @@ class Sweep:
         self._tally_chunk(
             data_files, per_doc, state.get("vec_box") or {}, counts, failed
         )
-        return ci, {
+        rec = {
             "chunk": ci,
             "sig": sig,
             "files": len(chunk),
@@ -377,6 +493,10 @@ class Sweep:
             "failed": failed,
             "errors": errors,
         }
+        if err_box[1]:
+            rec["quarantined"] = err_box[1]
+            FAULT_COUNTERS["quarantined_docs"] += len(err_box[1])
+        return ci, rec
 
     # -- one chunk ----------------------------------------------------
     def _read_chunk(
@@ -391,13 +511,15 @@ class Sweep:
         data_files: List[DataFile] = []
         for p in chunk:
             try:
+                maybe_fail("read", key=p.name)
                 content = p.read_text()
                 data_files.append(
                     DataFile(name=p.name, content=content, _pv=None)
                 )
-            except OSError as e:
+            except Exception as e:
                 writer.writeln_err(f"skipping {p}: {e}")
                 err_box[0] += 1
+                err_box[1].append(quarantine_record(p.name, "read", e))
         return data_files
 
     def _evaluate_chunk(
@@ -407,13 +529,14 @@ class Sweep:
         counts = {k: 0 for k in _STATUS_NAMES}
         failed: List[dict] = []
         errors = 0
-        err_box = [0]
+        err_box = [0, []]
 
         if prepared is not None:
             # read + encoded by the pipeline's prefetch (overlapped
             # with the previous chunk's device execution)
-            data_files, encoded, pre_err = prepared
-            err_box[0] += pre_err
+            data_files, encoded, pre_box = prepared
+            err_box[0] += pre_box[0]
+            err_box[1].extend(pre_box[1])
         else:
             data_files = self._read_chunk(chunk, writer, err_box)
             encoded = None
@@ -433,7 +556,7 @@ class Sweep:
 
         self._tally_chunk(data_files, per_doc, vec_box, counts, failed)
 
-        return {
+        rec = {
             "chunk": ci,
             "sig": sig,
             "files": len(chunk),
@@ -442,6 +565,10 @@ class Sweep:
             "failed": failed,
             "errors": errors,
         }
+        if err_box[1]:
+            rec["quarantined"] = err_box[1]
+            FAULT_COUNTERS["quarantined_docs"] += len(err_box[1])
+        return rec
 
     def _tally_chunk(self, data_files, per_doc, vec_box, counts,
                      failed) -> None:
@@ -479,6 +606,9 @@ class Sweep:
                 df._pv_failed = True
                 writer.writeln_err(f"skipping {df.name}: {e}")
                 err_box[0] += 1
+                err_box[1].append(
+                    quarantine_record(df.name, "parse", e)
+                )
         return df._pv
 
     def _padded_pvs(self, data_files, writer, err_box):
@@ -493,40 +623,33 @@ class Sweep:
         ]
 
     def _encode_chunk(self, data_files, writer, err_box):
-        """Columnarize one chunk: the native C++ JSON encoder when the
-        whole chunk sniffs as JSON, the Python encoder otherwise.
-        Returns (batch, interner)."""
-        from ..ops.encoder import encode_batch
-        from ..ops.native_encoder import encode_json_batch_native, native_available
+        """Columnarize one chunk via the shared chunk-encode
+        entrypoint (ops.encoder.encode_chunk_texts — also the ingest
+        workers' body, so the serial and worker paths cannot drift):
+        the native C++ JSON encoder when the whole chunk sniffs as
+        JSON (an invalid doc is marked + quarantined, substituted with
+        a null stand-in and the rest retried), the Python encoder
+        otherwise. Returns (batch, interner)."""
+        from ..ops.encoder import encode_chunk_texts
 
-        batch = interner = None
-        if native_available() and all(
-            df.content.lstrip()[:1] in ("{", "[") for df in data_files
-        ):
-            # an invalid doc must not push the whole chunk off the
-            # native encoder: mark it, substitute a null stand-in,
-            # and retry with the rest
-            contents = [df.content for df in data_files]
-            for _ in range(len(data_files) + 1):
-                try:
-                    batch, interner, err = encode_json_batch_native(contents)
-                except RuntimeError:
-                    batch = interner = None
-                    break
-                if err is None:
-                    break
-                bad = data_files[err]
-                if not getattr(bad, "_pv_failed", False):
-                    bad._pv_failed = True
-                    writer.writeln_err(f"skipping {bad.name}: invalid JSON")
-                    err_box[0] += 1
-                contents[err] = "null"
-                batch = interner = None
-        if batch is None:
-            # Python fallback (non-JSON corpora or no native lib)
-            batch, interner = encode_batch(
-                self._padded_pvs(data_files, writer, err_box)
+        batch, interner, pv_failed, messages, errors, recs, pvs = (
+            encode_chunk_texts(
+                [df.name for df in data_files],
+                [df.content for df in data_files],
             )
+        )
+        for i in pv_failed:
+            data_files[i]._pv_failed = True
+        if pvs is not None:
+            # the Python path already built the documents — cache them
+            # on the DataFiles so oracle fallbacks don't re-parse
+            for df, pv in zip(data_files, pvs):
+                if pv is not None and df._pv is None:
+                    df._pv = pv
+        for m in messages:
+            writer.writeln_err(m)
+        err_box[0] += errors
+        err_box[1].extend(recs)
         return batch, interner
 
     def _dispatch_pack_sharded(self, items, batch, with_rim):
@@ -550,7 +673,24 @@ class Sweep:
             return None
         groups, oversize = split_batch_by_size(batch, NODE_BUCKETS_EXTENDED)
         host_docs = {int(i) for i in oversize}
-        pending = [(idx, ev.dispatch(sub)) for sub, idx in groups]
+        pending = []
+        for sub, idx in groups:
+            try:
+                maybe_fail("dispatch")
+                pending.append((idx, ev.dispatch(sub)))
+            except Exception as e:
+                # one bucket's dispatch failure degrades just those
+                # docs to the host oracle; the rest stay on device
+                import logging
+
+                logging.getLogger("guard_tpu.sweep").warning(
+                    "sharded pack dispatch failed for a %d-doc bucket "
+                    "(%s); docs fall back to the host oracle",
+                    len(idx), e,
+                )
+                FAULT_COUNTERS["dispatch_fallbacks"] += 1
+                FAULT_COUNTERS["oracle_fallbacks"] += 1
+                host_docs.update(int(i) for i in idx)
         return (ev, items, batch, pending, host_docs, with_rim)
 
     def _collect_pack_sharded(self, st) -> dict:
@@ -580,7 +720,21 @@ class Sweep:
                 np.full((batch.n_docs, spec.n_groups), SKIP, np.int8),
             )
         for idx, handle in pending:
-            collected = ev.collect(handle)
+            try:
+                maybe_fail("collect")
+                collected = bounded_call(ev.collect, handle)
+            except Exception as e:
+                import logging
+
+                logging.getLogger("guard_tpu.sweep").warning(
+                    "sharded pack collect failed for a %d-doc bucket "
+                    "(%s); docs fall back to the host oracle",
+                    len(idx), e,
+                )
+                FAULT_COUNTERS["dispatch_fallbacks"] += 1
+                FAULT_COUNTERS["oracle_fallbacks"] += 1
+                host_docs = set(host_docs) | {int(i) for i in idx}
+                continue
             statuses[idx] = collected[0]
             if collected[1] is not None:
                 unsure[idx] = collected[1]
@@ -701,14 +855,27 @@ class Sweep:
                 for fi, (_rf, rb, c) in enumerate(prep)
                 if rb is batch and pack_compatible(c) is None
             ]
-            if self.rule_shards > 1 and len(items) >= 2:
-                state["sharded"] = self._dispatch_pack_sharded(
-                    items, batch, vec_on
+            try:
+                if self.rule_shards > 1 and len(items) >= 2:
+                    state["sharded"] = self._dispatch_pack_sharded(
+                        items, batch, vec_on
+                    )
+                else:
+                    state["pack_pending"] = dispatch_packs(
+                        items, batch, with_rim=vec_on
+                    )
+            except Exception as e:
+                # a packed-plane failure is never fatal: the per-file
+                # dispatch path below evaluates every file unchanged
+                import logging
+
+                logging.getLogger("guard_tpu.sweep").warning(
+                    "packed dispatch plane failed (%s); "
+                    "falling back to per-file dispatch", e,
                 )
-            else:
-                state["pack_pending"] = dispatch_packs(
-                    items, batch, with_rim=vec_on
-                )
+                FAULT_COUNTERS["dispatch_fallbacks"] += 1
+                state["sharded"] = None
+                state["pack_pending"] = None
         return state
 
     def _collect_tpu(self, state, per_doc, writer, err_box) -> int:
@@ -729,11 +896,25 @@ class Sweep:
         batch = state["batch"]
         prep = state["prep"]
         errors = 0
-        if state["sharded"] is not None:
-            packed_results = self._collect_pack_sharded(state["sharded"])
-        elif state["pack_pending"] is not None:
-            packed_results = collect_packs(state["pack_pending"], batch)
-        else:
+        try:
+            if state["sharded"] is not None:
+                packed_results = self._collect_pack_sharded(
+                    state["sharded"]
+                )
+            elif state["pack_pending"] is not None:
+                packed_results = collect_packs(state["pack_pending"], batch)
+            else:
+                packed_results = {}
+        except Exception as e:
+            # collect-side catastrophe: fall the whole chunk back to
+            # the per-file dispatch path (rung 2 of the ladder)
+            import logging
+
+            logging.getLogger("guard_tpu.sweep").warning(
+                "packed collect plane failed (%s); "
+                "falling back to per-file dispatch", e,
+            )
+            FAULT_COUNTERS["dispatch_fallbacks"] += 1
             packed_results = {}
 
         recs: list = []
@@ -903,6 +1084,7 @@ class Sweep:
                 if pv is None:
                     continue
                 try:
+                    maybe_fail("oracle", key=df.name)
                     scope = RootScope(rf.rules, pv)
                     eval_rules_file(rf.rules, scope, df.name)
                 except GuardError as e:

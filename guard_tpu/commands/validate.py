@@ -165,6 +165,13 @@ class Validate:
     # serve sessions: pre-parsed RuleFile list reused across requests
     # (commands/serve.py) — skips re-parse/re-lowering per request
     prepared_rules: Optional[List["RuleFile"]] = None
+    # TPU backend: document-quarantine threshold (the failure plane,
+    # utils/faults.py). None = historical behavior (a failing document
+    # aborts the run); an integer N enables quarantine — failing docs
+    # are excluded with structured error records and the run exits
+    # ERROR only when more than N docs were quarantined (0 = quarantine
+    # on, but any quarantined doc still fails the run)
+    max_doc_failures: Optional[int] = None
 
     # -- argument validation (validate.rs:205-232) --------------------
     def _validate_args(self) -> None:

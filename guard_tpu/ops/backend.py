@@ -28,6 +28,14 @@ from ..core.errors import GuardError
 from ..core.evaluator import eval_rules_file
 from ..core.qresult import Status
 from ..core.scopes import RootScope
+from ..utils.faults import (
+    FAULT_COUNTERS,
+    bounded_call,
+    fault_stats,
+    maybe_fail,
+    quarantine_record,
+    reset_fault_counters,
+)
 from ..utils.io import Writer
 from .encoder import encode_batch
 from .ir import FAIL, PASS, SKIP, compile_rules_file
@@ -132,6 +140,13 @@ def reset_pipeline_stats() -> None:
     reset_pipeline_counters()
 
 
+def reset_fault_stats() -> None:
+    """Reset the failure-plane counters (utils.faults.FAULT_COUNTERS);
+    `fault_stats` is re-exported above them for symmetry with the
+    dispatch/pipeline/rim accessors."""
+    reset_fault_counters()
+
+
 def plan_packs(items, max_rules: int = None):
     """Greedy pack planner over [(file_idx, CompiledRules)] pairs
     already screened with ir.pack_compatible: packs fill in file order
@@ -194,7 +209,21 @@ def dispatch_packs(items, batch, with_rim=None) -> PackPending:
         ev = ShardedBatchEvaluator(
             packed.compiled, rim_spec=spec if with_rim else None
         )
-        handles = [(idx, ev.dispatch(sub)) for sub, idx in groups]
+        # a failed bucket dispatch keeps its sub-batch (handle None) so
+        # collect_packs can walk the degradation ladder: per-file
+        # dispatch for just that bucket, then the host oracle
+        handles = []
+        for sub, idx in groups:
+            try:
+                maybe_fail("dispatch")
+                handles.append((idx, sub, ev.dispatch(sub)))
+            except Exception as e:
+                log.warning(
+                    "packed dispatch failed for a %d-doc bucket (%s); "
+                    "will retry per-file at collect", len(idx), e,
+                )
+                FAULT_COUNTERS["dispatch_fallbacks"] += 1
+                handles.append((idx, sub, None))
         pending.append((pack, packed, spec, ev, handles))
     return PackPending(pending, host_docs, with_rim)
 
@@ -215,6 +244,8 @@ def collect_packs(pp: PackPending, batch) -> dict:
     boundary alongside the status matrix."""
     import numpy as np
 
+    from ..parallel.mesh import ShardedBatchEvaluator
+
     results: dict = {}
     with_rim = pp.with_rim
     host_docs = pp.host_docs
@@ -222,6 +253,8 @@ def collect_packs(pp: PackPending, batch) -> dict:
         n_rules = len(packed.compiled.rules)
         statuses = np.full((batch.n_docs, n_rules), SKIP, np.int8)
         unsure = np.zeros((batch.n_docs, n_rules), bool)
+        host_extra: dict = {}
+        recovered = []  # bucket idx arrays that lost their rim blocks
         rim = None
         if with_rim:
             rim = (
@@ -232,14 +265,66 @@ def collect_packs(pp: PackPending, batch) -> dict:
                 np.zeros((batch.n_docs, spec.n_files), bool),
                 np.full((batch.n_docs, spec.n_groups), SKIP, np.int8),
             )
-        for idx, handle in handles:
-            collected = ev.collect(handle)
-            statuses[idx] = collected[0]
-            if collected[1] is not None:
-                unsure[idx] = collected[1]
+        for idx, sub, handle in handles:
+            if handle is not None:
+                try:
+                    maybe_fail("collect")
+                    collected = bounded_call(ev.collect, handle)
+                except Exception as e:
+                    log.warning(
+                        "packed collect failed for a %d-doc bucket "
+                        "(%s); retrying per-file", len(idx), e,
+                    )
+                    FAULT_COUNTERS["dispatch_fallbacks"] += 1
+                    handle = None
+                else:
+                    statuses[idx] = collected[0]
+                    if collected[1] is not None:
+                        unsure[idx] = collected[1]
+                    if with_rim:
+                        for b, block in enumerate(collected[2]):
+                            rim[b][idx] = block
+                    continue
+            # degradation rung 2: per-file dispatch for just this
+            # bucket; a file that still fails lands on the host oracle
+            # (rung 3) for these docs only
+            for k, (fi, c) in enumerate(pack):
+                seg = packed.segment(k)
+                try:
+                    ev2 = ShardedBatchEvaluator(c)
+                    st, un = bounded_call(
+                        lambda: ev2.collect(ev2.dispatch(sub))
+                    )[:2]
+                except Exception as e:
+                    log.warning(
+                        "per-file retry failed for file %d (%s); "
+                        "%d docs fall back to the host oracle",
+                        fi, e, len(idx),
+                    )
+                    FAULT_COUNTERS["oracle_fallbacks"] += 1
+                    host_extra.setdefault(fi, set()).update(
+                        int(i) for i in idx
+                    )
+                    continue
+                cols = np.arange(seg.start, seg.stop)
+                statuses[np.ix_(idx, cols)] = st
+                if un is not None:
+                    unsure[np.ix_(idx, cols)] = un
             if with_rim:
-                for b, block in enumerate(collected[2]):
-                    rim[b][idx] = block
+                recovered.append(idx)
+        if with_rim and recovered:
+            # recompute the lost rim blocks host-side from the
+            # recovered status rows (same reduction the device ran)
+            from .kernels import rim_reduce
+
+            for idx in recovered:
+                blocks = rim_reduce(
+                    statuses[idx], unsure[idx],
+                    spec.group_ids, spec.file_ids, spec.last_ids,
+                    spec.n_groups, spec.n_files,
+                )
+                for b, block in enumerate(blocks):
+                    rim[b][idx] = np.asarray(block)
         for k, (fi, _c) in enumerate(pack):
             seg = packed.segment(k)
             rim_f = None
@@ -251,7 +336,8 @@ def collect_packs(pp: PackPending, batch) -> dict:
                     spec.file_group_names[k],
                 )
             results[fi] = (
-                statuses[:, seg], unsure[:, seg], set(host_docs), rim_f,
+                statuses[:, seg], unsure[:, seg],
+                set(host_docs) | host_extra.get(fi, set()), rim_f,
             )
     return results
 
@@ -485,15 +571,46 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
     if not data_files or not rule_files:
         return SUCCESS_STATUS_CODE
 
+    # failure plane: with --max-doc-failures set, a doc that fails to
+    # parse/encode is QUARANTINED — structured error record, `null`
+    # stand-in in the batch, excluded from every report pass — instead
+    # of aborting the whole run. `quarantined` maps doc index -> record.
+    quarantined: dict = {}
+    max_df = getattr(validate, "max_doc_failures", None)
+    q_mode = max_df is not None and not validate.input_params
+
     # Python document trees build LAZILY (DataFile.path_value): on
     # all-JSON corpora the native encoder, device kernels and native
     # oracle run entirely from raw content, and the eager per-doc tree
     # build (~40% of all-lowered sweep time, measured round 3) is paid
     # only by the docs something actually walks.
     def _docs():
+        if quarantined:
+            from ..core.values import PV
+            from ..core.values import Path as VPath
+
+            return [
+                PV.null(VPath.root()) if di in quarantined else df.path_value
+                for di, df in enumerate(data_files)
+            ]
         return [df.path_value for df in data_files]
 
     batch = interner = None
+    if q_mode:
+        from .encoder import encode_chunk_texts
+
+        (batch, interner, q_order, q_msgs, _q_err, q_records,
+         q_pvs) = encode_chunk_texts(
+            [df.name for df in data_files],
+            [df.content for df in data_files],
+        )
+        quarantined = dict(zip(q_order, q_records))
+        for m in q_msgs:
+            writer.writeln_err(m)
+        if q_pvs is not None:
+            for df, pv in zip(data_files, q_pvs):
+                if pv is not None and getattr(df, "_pv", None) is None:
+                    df._pv = pv
     # parallel ingest plane (parallel/ingest.py): with workers >= 2 the
     # document list splits into contiguous shards, each encoded in an
     # ingest worker process with a private interner, merged through an
@@ -507,7 +624,8 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
         getattr(validate, "ingest_workers", None)
     )
     if (
-        ingest_workers >= 2
+        batch is None
+        and ingest_workers >= 2
         and len(data_files) >= 2
         and not validate.payload
         and not validate.input_params
@@ -540,7 +658,11 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
     errors = 0
     had_fail = False
     all_reports: List[dict] = []
-    junit_suites = {df.name: [] for df in data_files}
+    junit_suites = {
+        df.name: []
+        for di, df in enumerate(data_files)
+        if di not in quarantined
+    }
     host_docs = set()
 
     # lower every rule file UP-FRONT: the pack planner needs the whole
@@ -717,6 +839,11 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                 any_fail, any_un, host_mask, bool(compiled.host_rules),
                 rich_mode, statuses_only, show_rich,
             )
+            if quarantined:
+                qmask = np.zeros(D, bool)
+                qmask[list(quarantined)] = True
+                need_oracle_v &= ~qmask
+                materialize_v &= ~qmask
             prefilter_v = need_oracle_v & (
                 needs_statuses_v | bool(statuses_only)
             )
@@ -756,6 +883,8 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
             # device statuses + which docs need the oracle, one
             # (doc, rule) pair at a time
             for di, data_file in enumerate(data_files):
+                if di in quarantined:
+                    continue
                 rule_statuses = {}
                 unsure_rules = set()
                 doc_status = Status.SKIP
@@ -862,6 +991,8 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
         oracle_set = set(oracle_dis)
         row_cache: dict = {}
         for di, data_file in enumerate(data_files):
+            if di in quarantined:
+                continue
             if settled is not None and di not in doc_infos:
                 name_st, names = settled
                 key = name_st[di].tobytes()
@@ -987,6 +1118,7 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                     }
                 else:
                     try:
+                        maybe_fail("oracle", key=data_file.name)
                         scope = RootScope(rule_file.rules, data_file.path_value)
                         oracle_status = eval_rules_file(
                             rule_file.rules, scope, data_file.name
@@ -1049,6 +1181,11 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
 
     if errors > 0:
         return ERROR_STATUS_CODE
+    if quarantined:
+        FAULT_COUNTERS["quarantined_docs"] += len(quarantined)
+        # negative limit = unlimited quarantine (degrade, never error)
+        if max_df is not None and 0 <= max_df < len(quarantined):
+            return ERROR_STATUS_CODE
     if had_fail:
         return FAILURE_STATUS_CODE
     return SUCCESS_STATUS_CODE

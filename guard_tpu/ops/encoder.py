@@ -923,16 +923,35 @@ def encode_chunk_texts(names: List[str], contents: List[str]):
     parse failure marks the doc and encodes a null stand-in).
 
     Returns (batch, interner, pv_failed_indices, messages, errors,
-    pvs): `pvs` is the per-doc Python document list when the Python
-    path ran (callers in the same process can cache them for oracle
-    fallbacks) and None on the native path.
+    quarantined, pvs): `quarantined` holds one structured error record
+    per failed index (same order as pv_failed_indices) for the failure
+    plane's manifest/report outputs; `pvs` is the per-doc Python
+    document list when the Python path ran (callers in the same
+    process can cache them for oracle fallbacks) and None on the
+    native path.
     """
+    from ..utils.faults import fault_active, maybe_fail, quarantine_record
     from .native_encoder import encode_json_batch_resilient
 
     pv_failed: set = set()
     messages: List[str] = []
+    recs: dict = {}
     errors = 0
     batch = interner = pvs = None
+    if fault_active("parse") or fault_active("encode"):
+        contents = list(contents)
+        for i, name in enumerate(names):
+            for stage in ("parse", "encode"):
+                if i in pv_failed:
+                    continue
+                try:
+                    maybe_fail(stage, key=name)
+                except Exception as e:
+                    pv_failed.add(i)
+                    messages.append(f"skipping {name}: {e}")
+                    recs[i] = quarantine_record(name, stage, e)
+                    errors += 1
+                    contents[i] = "null"  # neutral stand-in downstream
     if all(c.lstrip()[:1] in ("{", "[") for c in contents):
         batch, interner, failed, msgs = encode_json_batch_resilient(
             contents, names
@@ -940,6 +959,11 @@ def encode_chunk_texts(names: List[str], contents: List[str]):
         pv_failed |= failed
         messages += msgs
         errors += len(failed)
+        for i in failed:
+            recs[i] = {
+                "file": names[i], "stage": "parse",
+                "error": "ParseError", "message": "invalid JSON",
+            }
     if batch is None:
         from ..core.errors import GuardError
         from ..core.loader import load_document
@@ -955,9 +979,12 @@ def encode_chunk_texts(names: List[str], contents: List[str]):
             except GuardError as e:
                 pv_failed.add(i)
                 messages.append(f"skipping {names[i]}: {e}")
+                recs[i] = quarantine_record(names[i], "parse", e)
                 errors += 1
                 pvs.append(None)
         batch, interner = encode_batch(
             [pv if pv is not None else PV.null(VPath.root()) for pv in pvs]
         )
-    return batch, interner, sorted(pv_failed), messages, errors, pvs
+    order = sorted(pv_failed)
+    return (batch, interner, order, messages, errors,
+            [recs[i] for i in order], pvs)

@@ -88,20 +88,26 @@ def _worker_init() -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
 
 
-def read_paths(paths: List[str]) -> Tuple[list, list, list, int]:
+def read_paths(paths: List[str]) -> Tuple[list, list, list, int, list]:
     """Read chunk files; unreadable ones are skipped with one error
-    each (the sweep's `_read_chunk` contract, message-identical)."""
-    names, contents, msgs, errors = [], [], [], 0
+    each (the sweep's `_read_chunk` contract, message-identical) and
+    a structured quarantine record for the failure plane."""
+    from ..utils.faults import maybe_fail, quarantine_record
+
+    names, contents, msgs, errors, recs = [], [], [], 0, []
     for p in paths:
+        base = os.path.basename(p)
         try:
+            maybe_fail("read", key=base)
             with open(p, "r") as f:
                 contents.append(f.read())
-        except OSError as e:
+        except Exception as e:
             msgs.append(f"skipping {p}: {e}")
             errors += 1
+            recs.append(quarantine_record(base, "read", e))
             continue
-        names.append(os.path.basename(p))
-    return names, contents, msgs, errors
+        names.append(base)
+    return names, contents, msgs, errors, recs
 
 
 def _chunk_job(args):
@@ -113,9 +119,9 @@ def _chunk_job(args):
     from ..ops.encoder import batch_payload, encode_chunk_texts
 
     t0 = time.perf_counter()
-    names, contents, read_msgs, read_errs = read_paths(paths)
+    names, contents, read_msgs, read_errs, read_recs = read_paths(paths)
     t_read = time.perf_counter() - t0
-    batch, interner, pv_failed, enc_msgs, enc_errs, _pvs = (
+    batch, interner, pv_failed, enc_msgs, enc_errs, enc_recs, _pvs = (
         encode_chunk_texts(names, contents)
     )
     t_enc = time.perf_counter() - t0 - t_read
@@ -127,6 +133,7 @@ def _chunk_job(args):
         "pv_failed": pv_failed,
         "messages": read_msgs + enc_msgs,
         "errors": read_errs + enc_errs,
+        "quarantined": read_recs + enc_recs,
         "read_seconds": t_read,
         "encode_seconds": t_enc,
     }
@@ -242,22 +249,30 @@ class IngestPool:
 # interpreter+import per process, which would otherwise be charged to
 # EVERY sweep/validate invocation (serve sessions, bench reps, chunked
 # drivers). Pools are stateless (pure-function jobs), so one healthy
-# pool per worker count serves the whole process; failures are NOT
-# cached, so a transient spawn problem heals on the next invocation.
+# pool per worker count serves the whole process. Spawn FAILURES are
+# cached too (_SPAWN_FAILED): the probe ping costs up to
+# GUARD_TPU_INGEST_SPAWN_TIMEOUT, so degraded mode pays it at most
+# once per process and warns exactly once; `restart_shared_pool`
+# clears the mark for deliberate recovery restarts.
 _POOL_CACHE: dict = {}
+_SPAWN_FAILED: dict = {}
 
 
 def shared_pool(workers: int) -> Optional[IngestPool]:
     """A cached healthy IngestPool for `workers`, or None when spawn
-    fails (caller degrades to inline ingest). Callers must NOT close
-    the returned pool; `close_shared_pools` / interpreter exit does
-    (workers are daemonic)."""
+    fails (caller degrades to inline ingest; the failure is cached so
+    repeat invocations skip the spawn probe and its warning). Callers
+    must NOT close the returned pool; `close_shared_pools` /
+    interpreter exit does (workers are daemonic)."""
     pool = _POOL_CACHE.get(workers)
     if pool is not None and pool.available:
         return pool
+    if workers in _SPAWN_FAILED:
+        return None
     _POOL_CACHE.pop(workers, None)
     pool = IngestPool(workers)
     if not pool.available:
+        _SPAWN_FAILED[workers] = pool.error
         log.warning(
             "ingest worker pool unavailable (%s); "
             "falling back to inline ingest", pool.error,
@@ -267,10 +282,23 @@ def shared_pool(workers: int) -> Optional[IngestPool]:
     return pool
 
 
+def restart_shared_pool(workers: int) -> Optional[IngestPool]:
+    """Tear down the cached pool for `workers` (crashed worker
+    recovery) and spawn a fresh one; a previously cached spawn failure
+    is retried, not trusted — a restart is an explicit recovery
+    action, unlike the hot-path probe skip."""
+    pool = _POOL_CACHE.pop(workers, None)
+    if pool is not None:
+        pool.close()
+    _SPAWN_FAILED.pop(workers, None)
+    return shared_pool(workers)
+
+
 def close_shared_pools() -> None:
     for pool in list(_POOL_CACHE.values()):
         pool.close()
     _POOL_CACHE.clear()
+    _SPAWN_FAILED.clear()
 
 
 def parallel_encode_documents(names: List[str], contents: List[str],

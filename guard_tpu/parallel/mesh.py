@@ -411,26 +411,58 @@ def evaluate_bucketed(evaluator, n_rules: int, batch: DocBatch):
     )
     from ..ops.ir import SKIP
 
+    import logging
+
+    from ..utils.faults import FAULT_COUNTERS, bounded_call, maybe_fail
+
+    log = logging.getLogger("guard_tpu.mesh")
     buckets = NODE_BUCKETS_EXTENDED
     groups, oversize = split_batch_by_size(batch, buckets)
     statuses = np.full((batch.n_docs, n_rules), SKIP, np.int8)
     unsure = np.zeros((batch.n_docs, n_rules), bool)
+    host_extra: set = set()
+
+    def _bucket_to_host(stage, exc, idx):
+        # one bucket's device failure degrades that bucket to the host
+        # oracle; every other bucket's results are untouched
+        log.warning(
+            "device %s failed for a %d-doc bucket (%s); "
+            "falling back to the host oracle", stage, len(idx), exc,
+        )
+        FAULT_COUNTERS["dispatch_fallbacks"] += 1
+        FAULT_COUNTERS["oracle_fallbacks"] += 1
+        host_extra.update(int(i) for i in idx)
+
     if hasattr(evaluator, "dispatch") and hasattr(evaluator, "collect"):
         # pipelined: dispatch EVERY bucket group before collecting any
         # (JAX dispatch is async) — host columnarization of group k+1
         # overlaps device execution of group k instead of serializing
         # behind its collection
-        pending = [
-            (idx, evaluator.dispatch(sub)) for sub, idx in groups
-        ]
+        pending = []
+        for sub, idx in groups:
+            try:
+                maybe_fail("dispatch")
+                pending.append((idx, evaluator.dispatch(sub)))
+            except Exception as e:
+                _bucket_to_host("dispatch", e, idx)
         for idx, handle in pending:
-            st, un = evaluator.collect(handle)
+            try:
+                maybe_fail("collect")
+                st, un = bounded_call(evaluator.collect, handle)
+            except Exception as e:
+                _bucket_to_host("collect", e, idx)
+                continue
             statuses[idx] = st
             if un is not None:
                 unsure[idx] = un
     else:
         for sub, idx in groups:
-            statuses[idx] = evaluator(sub)  # retraces once per bucket
+            try:
+                maybe_fail("dispatch")
+                statuses[idx] = bounded_call(evaluator, sub)
+            except Exception as e:
+                _bucket_to_host("dispatch", e, idx)
+                continue
             if evaluator.last_unsure is not None:
                 unsure[idx] = evaluator.last_unsure
-    return statuses, unsure, {int(i) for i in oversize}
+    return statuses, unsure, {int(i) for i in oversize} | host_extra

@@ -227,7 +227,12 @@ class RuleShardedEvaluator:
     def dispatch(self, batch: DocBatch):
         """Dispatch EVERY rule-group shard before any collection (on
         hardware the groups then execute concurrently on their
-        disjoint sub-meshes)."""
+        disjoint sub-meshes). Carries the `dispatch` fault-injection
+        point so the sweep's bucket-isolation ladder is exercisable on
+        the rule-sharded path too."""
+        from ..utils.faults import maybe_fail
+
+        maybe_fail("dispatch")
         return [(ev, idx, ev.dispatch(batch)) for ev, idx in self.shards]
 
     def collect(self, pending):
@@ -332,7 +337,11 @@ class PackShardedEvaluator:
         self.last_unsure: Optional[np.ndarray] = None
 
     def dispatch(self, batch: DocBatch):
-        """All pack groups dispatch before any collects."""
+        """All pack groups dispatch before any collects (with the
+        `dispatch` fault-injection point, as on the unsharded path)."""
+        from ..utils.faults import maybe_fail
+
+        maybe_fail("dispatch")
         return [
             (ev, cols, g, ev.dispatch(batch)) for ev, cols, g in self.shards
         ]

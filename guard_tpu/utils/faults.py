@@ -1,0 +1,233 @@
+"""Deterministic fault injection for the sweep/serve failure plane.
+
+The pipeline's degradation paths (document quarantine, ingest-worker
+restart, packed-dispatch -> per-file -> host-oracle fallback) are only
+trustworthy if they can be exercised on demand, reproducibly, in CI.
+This module provides named injection points driven by the
+`GUARD_TPU_FAULT` environment variable — no wall-clock, no global RNG,
+so a failing chaos run replays bit-for-bit.
+
+Grammar (comma-separated clauses)::
+
+    GUARD_TPU_FAULT=<point>:<spec>[,<point>:<spec>...]
+
+where `<point>` is one of POINTS and `<spec>` is one of:
+
+    nth=K            fire on the Kth eligible call in this process
+                     (1-based; fires exactly once per process)
+    glob=PATTERN     fire whenever the call's key (usually a file
+                     name) fnmatches PATTERN (stateless; every match)
+    rate=R[:seed=S]  fire pseudo-randomly at rate R in [0,1], keyed by
+                     sha256(seed, point, call index, key) — the same
+                     env string over the same call sequence fires the
+                     same calls, independent of host or wall-clock
+
+Every firing increments `FAULT_COUNTERS["injected_<point>"]`; the
+recovery machinery increments the remaining counters (retries,
+worker_restarts, quarantined_docs, dispatch_fallbacks,
+oracle_fallbacks) so every degradation is observable next to the
+existing dispatch/pipeline/rim counters.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import hashlib
+import os
+from typing import Optional
+
+from ..core.errors import GuardError
+
+#: named injection points, in pipeline order
+POINTS = (
+    "read", "parse", "encode", "worker_crash",
+    "dispatch", "collect", "oracle",
+)
+
+#: observability beside DISPATCH_COUNTERS / PIPELINE_COUNTERS /
+#: RIM_COUNTERS: injected_* count fault firings, the rest count the
+#: recovery actions the failure plane took
+FAULT_COUNTERS = {
+    **{f"injected_{p}": 0 for p in POINTS},
+    "retries": 0,
+    "worker_restarts": 0,
+    "quarantined_docs": 0,
+    "dispatch_fallbacks": 0,
+    "oracle_fallbacks": 0,
+}
+
+
+class InjectedFault(GuardError):
+    """Raised at an active injection point; flows through the same
+    recovery paths as a real failure of that stage."""
+
+
+# parsed per env-string: re-parse lazily whenever GUARD_TPU_FAULT
+# changes so tests can flip it via monkeypatch without a reset hook
+_STATE = {"env": None, "specs": {}, "calls": {}, "fired": set()}
+
+
+def _parse(env: str) -> dict:
+    specs: dict = {}
+    for clause in env.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        parts = clause.split(":")
+        point = parts[0].strip()
+        if point not in POINTS:
+            raise GuardError(
+                f"GUARD_TPU_FAULT: unknown injection point {point!r} "
+                f"(expected one of {', '.join(POINTS)})"
+            )
+        spec: dict = {}
+        for kv in parts[1:]:
+            if "=" not in kv:
+                raise GuardError(
+                    f"GUARD_TPU_FAULT: malformed spec {kv!r} in "
+                    f"{clause!r} (expected key=value)"
+                )
+            k, v = kv.split("=", 1)
+            k = k.strip()
+            if k == "nth":
+                try:
+                    spec["nth"] = int(v)
+                except ValueError:
+                    raise GuardError(
+                        f"GUARD_TPU_FAULT: nth must be an integer, "
+                        f"got {v!r}"
+                    )
+            elif k == "glob":
+                spec["glob"] = v
+            elif k == "rate":
+                try:
+                    spec["rate"] = float(v)
+                except ValueError:
+                    raise GuardError(
+                        f"GUARD_TPU_FAULT: rate must be a float, "
+                        f"got {v!r}"
+                    )
+            elif k == "seed":
+                spec["seed"] = v
+            else:
+                raise GuardError(
+                    f"GUARD_TPU_FAULT: unknown spec key {k!r} in "
+                    f"{clause!r}"
+                )
+        if not any(k in spec for k in ("nth", "glob", "rate")):
+            raise GuardError(
+                f"GUARD_TPU_FAULT: clause {clause!r} needs one of "
+                "nth=/glob=/rate="
+            )
+        specs[point] = spec
+    return specs
+
+
+def _specs() -> dict:
+    env = os.environ.get("GUARD_TPU_FAULT", "")
+    if env != _STATE["env"]:
+        _STATE["env"] = env
+        _STATE["specs"] = _parse(env) if env.strip() else {}
+        _STATE["calls"] = {}
+        _STATE["fired"] = set()
+    return _STATE["specs"]
+
+
+def fault_active(point: str) -> bool:
+    """True when GUARD_TPU_FAULT names `point` (cheap pre-check so
+    hot paths skip the per-call bookkeeping entirely when clean)."""
+    return point in _specs()
+
+
+def should_fire(point: str, key: Optional[str] = None) -> bool:
+    spec = _specs().get(point)
+    if spec is None:
+        return False
+    calls = _STATE["calls"]
+    calls[point] = calls.get(point, 0) + 1
+    if "glob" in spec:
+        return key is not None and fnmatch.fnmatch(key, spec["glob"])
+    if "nth" in spec:
+        if point in _STATE["fired"]:
+            return False
+        if calls[point] == spec["nth"]:
+            _STATE["fired"].add(point)
+            return True
+        return False
+    # seeded rate: deterministic hash of (seed, point, call idx, key)
+    seed = spec.get("seed", "0")
+    h = hashlib.sha256(
+        f"{seed}:{point}:{calls[point]}:{key or ''}".encode()
+    ).digest()
+    return int.from_bytes(h[:8], "big") / 2.0**64 < spec["rate"]
+
+
+def maybe_fail(point: str, key: Optional[str] = None) -> None:
+    """Raise InjectedFault when `point` is active and its spec fires
+    for this call. No-op (and counter-free) otherwise."""
+    if should_fire(point, key):
+        FAULT_COUNTERS[f"injected_{point}"] += 1
+        suffix = f" ({key})" if key else ""
+        raise InjectedFault(f"injected {point} fault{suffix}")
+
+
+def fault_stats() -> dict:
+    return dict(FAULT_COUNTERS)
+
+
+def reset_fault_counters() -> None:
+    for k in FAULT_COUNTERS:
+        FAULT_COUNTERS[k] = 0
+
+
+def reset_faults() -> None:
+    """Counters AND call/fired state (tests: fresh nth= sequencing)."""
+    reset_fault_counters()
+    _STATE["env"] = None
+    _STATE["specs"] = {}
+    _STATE["calls"] = {}
+    _STATE["fired"] = set()
+
+
+def quarantine_record(file: str, stage: str, exc: BaseException) -> dict:
+    """The structured error record carried through manifest/report
+    outputs for a quarantined document."""
+    return {
+        "file": file,
+        "stage": stage,
+        "error": type(exc).__name__,
+        "message": str(exc),
+    }
+
+
+def dispatch_timeout() -> float:
+    """Per-dispatch/collect timeout in seconds (0 = unbounded)."""
+    raw = os.environ.get("GUARD_TPU_DISPATCH_TIMEOUT", "").strip()
+    try:
+        return float(raw) if raw else 0.0
+    except ValueError:
+        return 0.0
+
+
+def bounded_call(fn, *args):
+    """Run `fn(*args)` under the configured dispatch timeout. With no
+    timeout configured this is a direct call (zero overhead on the
+    clean path). On timeout the worker thread is abandoned (daemonic;
+    a wedged device call cannot be cancelled, only orphaned) and a
+    GuardError is raised so the caller's degradation ladder engages."""
+    t = dispatch_timeout()
+    if t <= 0:
+        return fn(*args)
+    from concurrent.futures import ThreadPoolExecutor, TimeoutError
+
+    ex = ThreadPoolExecutor(max_workers=1)
+    try:
+        fut = ex.submit(fn, *args)
+        try:
+            return fut.result(timeout=t)
+        except TimeoutError:
+            raise GuardError(
+                f"device call timed out after {t:g}s"
+            )
+    finally:
+        ex.shutdown(wait=False)

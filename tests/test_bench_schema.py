@@ -83,3 +83,40 @@ def test_checker_requires_ingest_decomposition_keys(tmp_path):
     problems = check_bench_schema.check(doctored)
     assert any("read_parse_seconds_per_run" in p for p in problems)
     assert any("pipeline_stall_seconds_per_run" in p for p in problems)
+
+
+def test_expected_metrics_cover_quarantine_rows():
+    """PR 5: the failure-plane overhead rows (clean quarantine cost vs
+    fail-fast, degraded-run throughput) are part of the driver
+    contract and gated by the schema checker."""
+    metrics = bench.expected_metrics()
+    assert "config5b_quarantine_clean_templates_per_sec" in metrics
+    assert "config5b_quarantine_degraded_templates_per_sec" in metrics
+
+
+def test_checker_requires_quarantine_keys(tmp_path):
+    """A degraded-run row missing its recovery counters fails the
+    gate."""
+    row = {
+        "metric": "config5b_quarantine_degraded_templates_per_sec",
+        "value": 1.0,
+        "unit": "templates/sec",
+        "vs_baseline": 1.0,
+        "poisoned_docs": 8,
+        # quarantined_docs / retries / dispatch_fallbacks missing
+    }
+    src = _newest_artifact().read_text().splitlines()
+    doctored = tmp_path / "bench_all_doctored_quarantine.json"
+    doctored.write_text(
+        "\n".join(
+            ln for ln in src
+            if '"config5b_quarantine_degraded_templates_per_sec"'
+            not in ln
+        )
+        + "\n"
+        + __import__("json").dumps(row)
+        + "\n"
+    )
+    problems = check_bench_schema.check(doctored)
+    assert any("quarantined_docs" in p for p in problems)
+    assert any("dispatch_fallbacks" in p for p in problems)

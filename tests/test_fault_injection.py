@@ -1,0 +1,381 @@
+"""Failure-plane suite: deterministic fault injection
+(guard_tpu/utils/faults.py) driving document quarantine, ingest-worker
+recovery, the packed-dispatch -> per-file -> host-oracle degradation
+ladder, serve request isolation, and the `--max-doc-failures` exit
+contract. Every degraded run must keep the UNAFFECTED documents
+byte-identical to a clean run — a fault may cost throughput, never
+correctness."""
+
+import json
+
+import pytest
+
+from guard_tpu.cli import run
+from guard_tpu.core.errors import GuardError
+from guard_tpu.parallel import ingest
+from guard_tpu.utils import faults
+from guard_tpu.utils.io import Reader, Writer
+
+RULES = (
+    "let b = Resources.*[ Type == 'AWS::S3::Bucket' ]\n"
+    "rule sse when %b !empty { %b.Properties.Enc == true }\n"
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    """Every test starts with no active faults, fresh counters and no
+    cached worker pools (worker-side injection needs the env var set
+    BEFORE the pool spawns), and instant retry backoff."""
+    monkeypatch.delenv("GUARD_TPU_FAULT", raising=False)
+    monkeypatch.setenv("GUARD_TPU_RETRY_BACKOFF", "0")
+    faults.reset_faults()
+    ingest.close_shared_pools()
+    yield
+    ingest.close_shared_pools()
+    faults.reset_faults()
+
+
+def _mk_corpus(tmp_path, n=6, fail=(2,), poison=False):
+    rules = tmp_path / "rules.guard"
+    rules.write_text(RULES)
+    data = tmp_path / "data"
+    data.mkdir(exist_ok=True)
+    for i in range(n):
+        doc = {
+            "Resources": {
+                "b": {
+                    "Type": "AWS::S3::Bucket",
+                    "Properties": {"Enc": i not in fail},
+                }
+            }
+        }
+        (data / f"t{i:02d}.json").write_text(json.dumps(doc))
+    if poison:
+        # sorts LAST so chunks holding the clean docs are unchanged
+        (data / "zpoison.json").write_text("{not valid json")
+    return rules, data
+
+
+def _sweep(tmp_path, rules, data, *extra, tag="m", workers=0, chunk=3):
+    w = Writer.buffered()
+    rc = run(
+        ["sweep", "-r", str(rules), "-d", str(data),
+         "-M", str(tmp_path / f"{tag}.jsonl"), "-c", str(chunk),
+         "--backend", "tpu", "--ingest-workers", str(workers), *extra],
+        writer=w, reader=Reader(),
+    )
+    summary = json.loads(w.out.getvalue().strip().splitlines()[-1])
+    summary.pop("manifest")
+    return rc, summary
+
+
+def _validate(rules, data, *extra):
+    w = Writer.buffered()
+    rc = run(
+        ["validate", "-r", str(rules), "-d", str(data),
+         "--backend", "tpu", *extra],
+        writer=w, reader=Reader(),
+    )
+    return rc, w.out.getvalue(), w.err.getvalue()
+
+
+# ---------------------------------------------------------------- specs
+
+
+def test_fault_spec_parsing():
+    assert faults._parse("read:nth=3") == {"read": {"nth": 3}}
+    assert faults._parse("parse:glob=bad*,dispatch:nth=1") == {
+        "parse": {"glob": "bad*"}, "dispatch": {"nth": 1},
+    }
+    assert faults._parse("oracle:rate=0.5:seed=s7") == {
+        "oracle": {"rate": 0.5, "seed": "s7"},
+    }
+    with pytest.raises(GuardError):
+        faults._parse("bogus_point:nth=1")
+    with pytest.raises(GuardError):
+        faults._parse("read:nth")  # not key=value
+    with pytest.raises(GuardError):
+        faults._parse("read:nth=x")
+    with pytest.raises(GuardError):
+        faults._parse("read:seed=1")  # needs nth/glob/rate
+
+
+def test_nth_spec_fires_exactly_once(monkeypatch):
+    monkeypatch.setenv("GUARD_TPU_FAULT", "dispatch:nth=2")
+    faults.reset_faults()
+    fired = [faults.should_fire("dispatch") for _ in range(5)]
+    assert fired == [False, True, False, False, False]
+    assert not faults.should_fire("collect")
+
+
+def test_rate_spec_is_deterministic(monkeypatch):
+    monkeypatch.setenv("GUARD_TPU_FAULT", "read:rate=0.4:seed=s1")
+
+    def pattern():
+        faults.reset_faults()
+        return [
+            faults.should_fire("read", key=f"doc{i}.json")
+            for i in range(40)
+        ]
+
+    a, b = pattern(), pattern()
+    assert a == b  # no wall-clock, no global RNG
+    assert any(a) and not all(a)
+
+
+def test_maybe_fail_counts_and_raises(monkeypatch):
+    monkeypatch.setenv("GUARD_TPU_FAULT", "read:glob=bad*")
+    faults.reset_faults()
+    faults.maybe_fail("read", key="fine.json")  # no-op
+    with pytest.raises(faults.InjectedFault):
+        faults.maybe_fail("read", key="bad.json")
+    assert faults.fault_stats()["injected_read"] == 1
+
+
+# ---------------------------------------------- doc-stage quarantine
+
+
+@pytest.mark.parametrize("workers", [0, 1, 2])
+@pytest.mark.parametrize("stage", ["read", "parse", "encode"])
+def test_doc_fault_quarantines_only_that_doc(
+    tmp_path, monkeypatch, stage, workers
+):
+    """An injected read/parse/encode failure on one doc quarantines
+    exactly that doc — counts, failed list and exit code for the rest
+    of the corpus match a clean run without it."""
+    rules, data = _mk_corpus(tmp_path)
+    base_rc, base = _sweep(tmp_path, rules, data, tag=f"{stage}-base")
+    # the victim sorts last: the chunks holding the clean docs are
+    # byte-for-byte the same work in both runs
+    (data / "zvictim.json").write_text(
+        json.dumps({"Resources": {"b": {
+            "Type": "AWS::S3::Bucket", "Properties": {"Enc": True}}}})
+    )
+    monkeypatch.setenv("GUARD_TPU_FAULT", f"{stage}:glob=zvictim*")
+    faults.reset_faults()
+    rc, summary = _sweep(
+        tmp_path, rules, data, tag=f"{stage}-w{workers}", workers=workers
+    )
+    q = summary.pop("quarantined")
+    assert [r["file"] for r in q] == ["zvictim.json"]
+    assert q[0]["stage"] == stage
+    assert q[0]["error"] == "InjectedFault"
+    assert summary["counts"] == base["counts"]
+    assert summary["failed"] == base["failed"]
+    assert summary["documents"] == base["documents"] + 1
+    assert rc == base_rc
+
+
+def test_clean_run_summary_has_no_quarantine_key(tmp_path):
+    rules, data = _mk_corpus(tmp_path)
+    _rc, summary = _sweep(tmp_path, rules, data, tag="clean")
+    assert "quarantined" not in summary
+
+
+def test_max_doc_failures_exit_contract(tmp_path):
+    """Default: doc failures degrade, never error. 0 restores
+    fail-fast. N errors only above N quarantines; negative =
+    unlimited."""
+    rules, data = _mk_corpus(tmp_path, fail=(), poison=True)
+    rc, summary = _sweep(tmp_path, rules, data, tag="dflt")
+    assert rc == 0  # clean docs all pass; poison only quarantined
+    assert [r["file"] for r in summary["quarantined"]] == ["zpoison.json"]
+    rc0, _ = _sweep(tmp_path, rules, data, "--max-doc-failures", "0",
+                    tag="df0")
+    assert rc0 == 5
+    rc1, _ = _sweep(tmp_path, rules, data, "--max-doc-failures", "1",
+                    tag="df1")
+    assert rc1 == 0
+    rcn, _ = _sweep(tmp_path, rules, data, "--max-doc-failures", "-1",
+                    tag="dfn")
+    assert rcn == 0
+
+
+def test_max_doc_failures_zero_without_faults_is_bit_exact(tmp_path):
+    """`--max-doc-failures 0` over a clean corpus reproduces the
+    default run exactly — the failure plane is free when unused."""
+    rules, data = _mk_corpus(tmp_path)
+    rc_a, sum_a = _sweep(tmp_path, rules, data, tag="pa")
+    rc_b, sum_b = _sweep(tmp_path, rules, data, "--max-doc-failures",
+                         "0", tag="pb")
+    assert (rc_a, sum_a) == (rc_b, sum_b)
+
+
+# ----------------------------------------------- worker crash recovery
+
+
+def test_worker_crash_retries_chunk_and_restarts_pool(
+    tmp_path, monkeypatch
+):
+    rules, data = _mk_corpus(tmp_path)
+    base = _sweep(tmp_path, rules, data, tag="wc-base", workers=2)
+    monkeypatch.setenv("GUARD_TPU_FAULT", "worker_crash:nth=1")
+    ingest.close_shared_pools()
+    faults.reset_faults()
+    got = _sweep(tmp_path, rules, data, tag="wc-fault", workers=2)
+    assert got == base  # the retried chunk reproduces exactly
+    stats = faults.fault_stats()
+    assert stats["injected_worker_crash"] == 1
+    assert stats["retries"] >= 1
+    assert stats["worker_restarts"] >= 1
+
+
+# ------------------------------------------- dispatch/collect ladder
+
+
+@pytest.mark.parametrize("pack", ["1", "0"], ids=["packed", "perfile"])
+@pytest.mark.parametrize("point", ["dispatch", "collect"])
+def test_device_fault_falls_back_to_host(
+    tmp_path, monkeypatch, point, pack
+):
+    """A device dispatch/collect failure for one bucket degrades to
+    the host oracle for just those docs — same counts, failed list and
+    exit code as the clean run."""
+    rules, data = _mk_corpus(tmp_path)
+    monkeypatch.setenv("GUARD_TPU_PACK", pack)
+    base = _sweep(tmp_path, rules, data, tag=f"{point}{pack}-base")
+    monkeypatch.setenv("GUARD_TPU_FAULT", f"{point}:nth=1")
+    faults.reset_faults()
+    got = _sweep(tmp_path, rules, data, tag=f"{point}{pack}-fault")
+    assert got == base
+    assert faults.fault_stats()["dispatch_fallbacks"] >= 1
+
+
+def test_oracle_fault_is_a_hard_error(tmp_path, monkeypatch):
+    """The host oracle is the LAST rung: a failure there surfaces as a
+    real evaluation error (nonzero exit), not silent data loss."""
+    rules, data = _mk_corpus(tmp_path)
+    monkeypatch.setenv("GUARD_TPU_FAULT", "oracle:nth=1")
+    faults.reset_faults()
+    w = Writer.buffered()
+    rc = run(
+        ["sweep", "-r", str(rules), "-d", str(data),
+         "-M", str(tmp_path / "orc.jsonl"), "-c", "3",
+         "--backend", "cpu"],
+        writer=w, reader=Reader(),
+    )
+    summary = json.loads(w.out.getvalue().strip().splitlines()[-1])
+    assert rc == 5
+    assert summary["errors"] >= 1
+    assert faults.fault_stats()["injected_oracle"] == 1
+
+
+# --------------------------------------------- validate quarantine
+
+
+def test_validate_default_still_fails_fast_on_poison(tmp_path):
+    rules, data = _mk_corpus(tmp_path, fail=(), poison=True)
+    rc, _out, _err = _validate(rules, data)
+    assert rc == 5
+
+
+@pytest.mark.parametrize(
+    "mode",
+    [
+        [],
+        ["-o", "yaml"],
+        ["--structured", "-o", "json", "--show-summary", "none"],
+        ["--structured", "-o", "junit", "--show-summary", "none"],
+    ],
+    ids=["console", "yaml", "json", "junit"],
+)
+def test_validate_quarantine_completes_and_excludes_doc(tmp_path, mode):
+    rules, data = _mk_corpus(tmp_path, poison=True)
+    rc, out, err = _validate(rules, data, "--max-doc-failures", "-1",
+                             *mode)
+    assert rc == 19  # t02 genuinely fails; poison only degrades
+    assert "skipping zpoison.json" in err
+    assert "zpoison" not in out
+    rc0, _out, _err = _validate(rules, data, "--max-doc-failures", "0",
+                                *mode)
+    assert rc0 == 5
+
+
+def test_validate_quarantine_clean_corpus_matches_default(tmp_path):
+    """With no failing docs the quarantine encode path must reproduce
+    the default batch-build chain byte-for-byte."""
+    rules, data = _mk_corpus(tmp_path)
+    base = _validate(rules, data, "--structured", "-o", "json",
+                     "--show-summary", "none")
+    got = _validate(rules, data, "--max-doc-failures", "5",
+                    "--structured", "-o", "json", "--show-summary",
+                    "none")
+    assert got == base
+
+
+# ----------------------------------------------- serve isolation
+
+
+def test_serve_timeout_answers_and_keeps_serving(monkeypatch):
+    import time
+
+    from guard_tpu.commands import validate as validate_mod
+
+    real_execute = validate_mod.Validate.execute
+
+    def slow_execute(self, writer, reader):
+        if self.verbose:  # the request marks itself slow
+            time.sleep(1.0)
+            return 0
+        return real_execute(self, writer, reader)
+
+    monkeypatch.setattr(validate_mod.Validate, "execute", slow_execute)
+    monkeypatch.setenv("GUARD_TPU_SERVE_TIMEOUT", "0.2")
+    w = Writer.buffered()
+    reqs = [
+        json.dumps({"rules": ["rule ok { a exists }"],
+                    "data": ['{"a": 1}'], "verbose": True}),
+        json.dumps({"rules": ["rule ok { a exists }"],
+                    "data": ['{"a": 1}']}),
+    ]
+    rc = run(["serve", "--stdio"], writer=w,
+             reader=Reader.from_string("\n".join(reqs) + "\n"))
+    assert rc == 0
+    resps = [json.loads(l) for l in w.out.getvalue().splitlines()
+             if l.strip()]
+    assert resps[0]["code"] == 5
+    assert resps[0]["error_class"] == "RequestTimeout"
+    assert "0.2" in resps[0]["error"]
+    assert resps[1]["code"] == 0  # the session outlives the timeout
+
+
+def test_serve_error_response_names_exception_class():
+    w = Writer.buffered()
+    rc = run(["serve", "--stdio"], writer=w,
+             reader=Reader.from_string("[1, 2, 3]\n\n"))
+    assert rc == 0
+    resp = json.loads(w.out.getvalue().splitlines()[0])
+    assert resp["code"] == 5
+    assert resp["error_class"] == "ValueError"
+
+
+# ------------------------------------------ spawn-probe failure cache
+
+
+def test_spawn_probe_failure_cached_once(tmp_path, monkeypatch, caplog):
+    """A failed worker spawn is probed AT MOST once per process: later
+    sweeps skip the probe (and its ping timeout) and warn exactly
+    once. restart_shared_pool clears the mark."""
+    calls = []
+
+    def boom(workers):
+        calls.append(workers)
+        raise OSError("spawn blocked for test")
+
+    ingest.close_shared_pools()
+    monkeypatch.setattr(ingest, "_spawn_pool", boom)
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger=ingest.log.name):
+        assert ingest.shared_pool(2) is None
+        assert ingest.shared_pool(2) is None
+        assert ingest.shared_pool(2) is None
+    assert len(calls) == 1  # probe paid once, failure cached
+    warns = [r for r in caplog.records
+             if "spawn blocked for test" in r.getMessage()]
+    assert len(warns) == 1  # warned exactly once
+    # deliberate recovery clears the mark and probes again
+    assert ingest.restart_shared_pool(2) is None
+    assert len(calls) == 2
+    ingest.close_shared_pools()

@@ -174,8 +174,12 @@ def test_spawn_failure_falls_back_serially(tmp_path, monkeypatch):
 
     ingest.close_shared_pools()  # a cached healthy pool would bypass
     monkeypatch.setattr(ingest, "_spawn_pool", boom)
-    assert _sweep(tmp_path, rdir, data, 4, "sf-w4") == base_sweep
-    assert _validate(rdir, data, 4) == base_val
+    try:
+        assert _sweep(tmp_path, rdir, data, 4, "sf-w4") == base_sweep
+        assert _validate(rdir, data, 4) == base_val
+    finally:
+        # clear the cached spawn failure so later tests spawn again
+        ingest.close_shared_pools()
 
 
 def test_sweep_parity_unreadable_and_invalid_docs(tmp_path):

@@ -185,10 +185,12 @@ rule named_prod when %n >= 1 { some %upper == /PROD/ }
     assert results["cpu"][1] == {"pass": 2, "fail": 1, "skip": 0}
 
 
-def test_sweep_invalid_json_doc_stays_native_and_counts_error(tmp_path):
-    """One truncated JSON doc must not stall the chunk: it is skipped
-    with one error while the remaining documents still evaluate (on
-    the native encoder when available)."""
+def test_sweep_invalid_json_doc_quarantines_and_counts_error(tmp_path):
+    """One truncated JSON doc must not stall the chunk: it is
+    quarantined with one error while the remaining documents still
+    evaluate (on the native encoder when available). By default doc
+    failures degrade the run (exit stays green); `--max-doc-failures 0`
+    restores the historical fail-fast exit."""
     rules = tmp_path / "r.guard"
     rules.write_text("rule ok { Resources exists }\n")
     data = tmp_path / "data"
@@ -206,4 +208,17 @@ def test_sweep_invalid_json_doc_stays_native_and_counts_error(tmp_path):
     assert summary["errors"] == 1
     assert summary["counts"]["pass"] == 5
     assert summary["counts"]["fail"] == 0
-    assert rc == 5  # error exit dominates
+    # the failure plane: doc skips surface as quarantine records, not
+    # a hard-error exit
+    assert [q["file"] for q in summary["quarantined"]] == ["bad.json"]
+    assert summary["quarantined"][0]["stage"] == "parse"
+    assert rc == 0
+
+    w = Writer.buffered()
+    rc = run(
+        ["sweep", "-r", str(rules), "-d", str(data),
+         "-M", str(tmp_path / "m0.jsonl"), "-c", "16",
+         "--max-doc-failures", "0"],
+        writer=w, reader=Reader(),
+    )
+    assert rc == 5  # fail-fast semantics restored on request

@@ -47,6 +47,17 @@ METRIC_REQUIRED_KEYS = {
     "config5b_rim_scalar_docs_per_sec": (
         "docs_materialized", "rim_seconds_per_run",
     ),
+    # PR 5 failure plane: the clean row must quantify the always-on
+    # quarantine plumbing's cost against fail-fast semantics, and the
+    # degraded row must carry the recovery counters so "what did the
+    # chaos run actually survive" is answerable from the artifact
+    "config5b_quarantine_clean_templates_per_sec": (
+        "quarantined_docs", "overhead_vs_failfast",
+    ),
+    "config5b_quarantine_degraded_templates_per_sec": (
+        "poisoned_docs", "quarantined_docs", "retries",
+        "dispatch_fallbacks",
+    ),
 }
 
 # PR 3 ingest decomposition: every *_ingest_workers* row must say how
