@@ -249,6 +249,16 @@ def _probe_tpu_responsive(timeout_s: float = 45.0) -> bool:
         return False
 
 
+def _reset_stats() -> None:
+    """One switch for every observability plane (dispatch / pipeline /
+    rim / fault counters + the telemetry registry) at each measured
+    entry point — stale counters from a previous measure_* otherwise
+    bleed into per-run extras."""
+    from guard_tpu.ops.backend import reset_all_stats
+
+    reset_all_stats()
+
+
 def _cpu_oracle_docs_per_sec(rule_files, docs, n_cpu: int, isolate_errors: bool = False) -> float:
     """Shared CPU-oracle denominator: evaluate each of `rule_files`
     (a RulesFile or a list of them) over the first n_cpu docs through
@@ -325,6 +335,7 @@ def measure(rules_text: str, docs, min_rules: int, n_cpu: int = 256):
     from guard_tpu.ops.ir import compile_rules_file
     from guard_tpu.ops.kernels import build_doc_evaluator
 
+    _reset_stats()
     n_docs = len(docs)
     rf = parse_rules_file(rules_text, "bench.guard")
     batch, interner = encode_batch(docs)
@@ -413,6 +424,7 @@ def measure_corpus():
     from guard_tpu.ops.ir import compile_rules_file
     from guard_tpu.ops.kernels import build_doc_evaluator
 
+    _reset_stats()
     corpus = pathlib.Path(__file__).parent / "corpus" / "rules"
     rule_files = sorted(corpus.glob("*.guard"))
     assert len(rule_files) >= 200, "vendored corpus missing"
@@ -546,6 +558,7 @@ def measure_rule_sharded(
     from guard_tpu.parallel.mesh import ShardedBatchEvaluator
     from guard_tpu.parallel.rules import PackShardedEvaluator
 
+    _reset_stats()
     rng = np.random.default_rng(13)
     docs = [from_plain(make_template(rng, i)) for i in range(n_docs)]
     # a registry-shaped workload: many small rule files (names
@@ -643,6 +656,7 @@ def measure_corpus_packed(n_files: Optional[int] = None, n_docs: int = 2048,
     from guard_tpu.ops.ir import compile_rules_file, pack_compatible
     from guard_tpu.parallel.mesh import ShardedBatchEvaluator
 
+    _reset_stats()
     docs, rfs, _paths = _load_corpus_workload(n_files, n_docs)
     n_docs = len(docs)
     batch, interner = encode_batch(docs)
@@ -732,6 +746,7 @@ def measure_rim(n_files: Optional[int] = None, n_docs: int = 2048,
     from guard_tpu.ops.encoder import encode_batch
     from guard_tpu.ops.ir import compile_rules_file, pack_compatible
 
+    _reset_stats()
     docs, rfs, _paths = _load_corpus_workload(n_files, n_docs)
     n_docs = len(docs)
     batch, interner = encode_batch(docs)
@@ -849,6 +864,70 @@ def measure_rim(n_files: Optional[int] = None, n_docs: int = 2048,
     )
 
 
+def measure_telemetry(n_files: Optional[int] = None, n_docs: int = 2048,
+                      reps: int = 3):
+    """Telemetry overhead contract: spans disabled must cost nothing
+    but their single branch (the off row should match the plain
+    config5b_packed row), and the on/off pair bounds what ENABLED
+    tracing charges the production packed dispatch + vector rim path.
+    Off/on reps interleave with the pair order swapped each rep and
+    best-of-reps kept, like measure_quarantine — the effect is smaller
+    than host noise otherwise. Returns (off_docs_per_sec,
+    on_docs_per_sec, spans_recorded_per_run)."""
+    import gc
+
+    from guard_tpu.ops import backend
+    from guard_tpu.ops.encoder import encode_batch
+    from guard_tpu.ops.ir import compile_rules_file, pack_compatible
+    from guard_tpu.utils import telemetry
+
+    _reset_stats()
+    docs, rfs, _paths = _load_corpus_workload(n_files, n_docs)
+    n_docs = len(docs)
+    batch, interner = encode_batch(docs)
+    compiled_files = [compile_rules_file(rf, interner) for rf in rfs]
+    items = [
+        (fi, c)
+        for fi, c in enumerate(compiled_files)
+        if pack_compatible(c) is None
+    ]
+    backend._evaluate_packs(items, batch)  # warm (trace + XLA compile)
+
+    def one(enabled: bool) -> float:
+        gc.collect()
+        if enabled:
+            telemetry.enable()
+            telemetry.reset_trace()
+        t0 = time.perf_counter()
+        backend._evaluate_packs(items, batch)
+        dt = time.perf_counter() - t0
+        if enabled:
+            telemetry.disable()
+        return dt
+
+    t_off: list = []
+    t_on: list = []
+    spans_recorded = 0
+    for r in range(reps):
+        pair = [(False, t_off), (True, t_on)]
+        if r % 2:
+            pair.reverse()
+        for enabled, acc in pair:
+            acc.append(one(enabled))
+    # span count from one final enabled run (trace_events holds the
+    # last reset_trace window; metadata rows carry no "ph": "X")
+    one(True)
+    spans_recorded = sum(
+        1 for e in telemetry.trace_events() if e.get("ph") == "X"
+    )
+    telemetry.reset_trace()
+    return (
+        n_docs / min(t_off),
+        n_docs / min(t_on),
+        spans_recorded,
+    )
+
+
 def _write_ingest_corpus(tmp: str, corpus: str, n_docs: int):
     """Materialize a sweep workload on disk (the ingest plane reads
     real files): returns (doc_dir, rules_path). `registry` = the
@@ -904,9 +983,11 @@ def measure_ingest(workers: int, corpus: str = "registry",
     import tempfile
 
     from guard_tpu.commands.sweep import Sweep
-    from guard_tpu.ops.backend import pipeline_stats, reset_pipeline_stats
+    from guard_tpu.ops.backend import pipeline_stats
+    from guard_tpu.utils import telemetry
     from guard_tpu.utils.io import Reader, Writer
 
+    _reset_stats()
     tmp = tempfile.mkdtemp(prefix=f"guard_ingest_{corpus}_")
     try:
         docdir, rules = _write_ingest_corpus(tmp, corpus, n_docs)
@@ -923,21 +1004,30 @@ def measure_ingest(workers: int, corpus: str = "registry",
             return cmd.execute(Writer.buffered(), Reader.from_string(""))
 
         run_once("warm")  # trace + XLA compile outside the timed reps
-        reset_pipeline_stats()
+        # stage-second accounting now comes from the telemetry
+        # registry's span roll-ups (worker spans ship back with each
+        # chunk payload), not the hand-rolled PIPELINE_COUNTERS
+        # seconds — tracing stays on across the timed reps, so these
+        # rows also charge the enabled-span overhead honestly
+        _reset_stats()
+        telemetry.enable()
+        telemetry.reset_trace()
         t0 = time.perf_counter()
         for r in range(reps):
             run_once(f"r{r}")
         elapsed = time.perf_counter() - t0
+        stage = telemetry.REGISTRY.stage_seconds()
+        telemetry.disable()
         stats = pipeline_stats()
         n_chunks = (n_docs + chunk_size - 1) // chunk_size
         extra = {
             "workers": workers,
             "chunks_per_run": n_chunks,
             "read_parse_seconds_per_run": round(
-                stats["read_parse_seconds"] / reps, 4
+                stage.get("read_parse", 0.0) / reps, 4
             ),
             "encode_seconds_per_run": round(
-                stats["encode_seconds"] / reps, 4
+                stage.get("encode", 0.0) / reps, 4
             ),
             "pipeline_stall_seconds_per_run": round(
                 stats["ingest_stall_seconds"] / reps, 4
@@ -1037,6 +1127,7 @@ def measure_quarantine(n_docs: int = 1024, chunk_size: int = 256,
     from guard_tpu.utils import faults
     from guard_tpu.utils.io import Reader, Writer
 
+    _reset_stats()
     tmp = tempfile.mkdtemp(prefix="guard_quarantine_")
     try:
         docdir, rules = _write_ingest_corpus(tmp, "registry", n_docs)
@@ -1191,8 +1282,22 @@ def chaos_smoke(n_docs: int = 48, chunk_size: int = 12) -> None:
         )
         _ingest.close_shared_pools()  # spawn workers under the fault env
         faults.reset_faults()
+        # the chaos run is traced: every parent-side fault/recovery
+        # counter increment must land as a fault.* instant event
+        # (EventedCounters), so the failure story is a trace artifact
+        from guard_tpu.utils import telemetry
+
+        telemetry.enable()
+        telemetry.reset_trace()
         chaos_rc, chaos = run_sweep("chaos")
         stats = faults.fault_stats()
+        fault_events = sorted({
+            e["name"]
+            for e in telemetry.trace_events()
+            if e.get("ph") == "i"
+        })
+        telemetry.disable()
+        telemetry.reset_trace()
 
         faults.reset_faults()
         _ingest.close_shared_pools()
@@ -1218,6 +1323,7 @@ def chaos_smoke(n_docs: int = 48, chunk_size: int = 12) -> None:
             "quarantined_docs": stats["quarantined_docs"],
             "dispatch_fallbacks": stats["dispatch_fallbacks"],
             "failfast_exit": failfast_rc,
+            "trace_fault_events": fault_events,
         }
         print(_json.dumps(record), flush=True)
         ok = (
@@ -1228,6 +1334,11 @@ def chaos_smoke(n_docs: int = 48, chunk_size: int = 12) -> None:
             and stats["quarantined_docs"] > 0
             and stats["dispatch_fallbacks"] > 0
             and failfast_rc == 5
+            and {
+                "fault.retries",
+                "fault.quarantined_docs",
+                "fault.dispatch_fallbacks",
+            }.issubset(fault_events)
         )
         if not ok:
             raise SystemExit(1)
@@ -1355,6 +1466,148 @@ def pack_smoke(n_files: int = 40, n_docs: int = 48,
         raise SystemExit(1)
 
 
+def trace_smoke(n_docs: int = 160, chunk_size: int = 16,
+                overlap_docs: int = 2560, overlap_chunk: int = 256) -> None:
+    """CI trace-smoke (JAX_PLATFORMS=cpu), two traced sweeps through
+    the real CLI export flags (--trace-out/--metrics-out, workers=2):
+
+      registry — the 250-file corpus must leave a well-formed trace
+          with >= 1 span per pipeline stage, an exit code identical to
+          an untraced warm run, and a metrics snapshot passing
+          tools/check_metrics_schema.py with all four counter groups;
+      overlap — the fail-heavy corpus (one small rule file, so the
+          parent's per-chunk prep is ~ms instead of the registry's
+          250-file lower_compile) must show a genuine wall-clock
+          interval overlap between an ingest-worker-lane span and a
+          dispatch/collect-lane span — the pipelined ingest drawn in
+          lanes instead of inferred from the overlap counter.
+
+    With `--keep-trace FILE` the overlap trace is copied out of the
+    tmp dir (the committed example under docs/). Prints one JSON line;
+    SystemExit(1) on violation."""
+    import json as _json
+    import pathlib
+    import shutil
+    import tempfile
+
+    from guard_tpu.cli import run as cli_run
+    from guard_tpu.utils.io import Reader, Writer
+
+    sys.path.insert(0, str(pathlib.Path(__file__).parent / "tools"))
+    from check_metrics_schema import EXPECTED_GROUPS, check_snapshot
+
+    tmp = tempfile.mkdtemp(prefix="guard_trace_smoke_")
+    try:
+        def run(corpus: str, tag: str, nd: int, cs: int,
+                flags: tuple = ()):
+            docdir, rules = _write_ingest_corpus(
+                str(pathlib.Path(tmp) / corpus), corpus, nd
+            )
+            return cli_run(
+                [
+                    "sweep", "--rules", rules, "--data", docdir,
+                    "--manifest", str(pathlib.Path(tmp) / f"m-{tag}.jsonl"),
+                    "--chunk-size", str(cs),
+                    "--ingest-workers", "2", *flags,
+                ],
+                writer=Writer.buffered(),
+                reader=Reader.from_string(""),
+            )
+
+        def load(tpath: str):
+            events = _json.loads(pathlib.Path(tpath).read_text())[
+                "traceEvents"
+            ]
+            lanes = {
+                e["tid"]: e["args"]["name"]
+                for e in events
+                if e.get("ph") == "M" and e["name"] == "thread_name"
+            }
+            return [e for e in events if e.get("ph") == "X"], lanes
+
+        # registry pass: stage coverage + snapshot schema. The warm
+        # run first — cold XLA compile stretches the first dispatches
+        # to seconds — and as the exit-code comparator: the export
+        # flags must not change the outcome (the registry corpus
+        # legitimately exits 5; 8 rules error on foreign inputs)
+        tpath = str(pathlib.Path(tmp) / "trace.json")
+        mpath = str(pathlib.Path(tmp) / "metrics.json")
+        warm_rc = run("registry", "warm", n_docs, chunk_size)
+        rc = run(
+            "registry", "traced", n_docs, chunk_size,
+            ("--trace-out", tpath, "--metrics-out", mpath),
+        )
+        spans, lanes = load(tpath)
+        by_name: dict = {}
+        for e in spans:
+            by_name.setdefault(e["name"], []).append(e)
+        required = (
+            "rule_parse", "read_parse", "encode", "lower_compile",
+            "dispatch", "collect", "rim_reduce", "report",
+        )
+        missing = [n for n in required if not by_name.get(n)]
+        snapshot = _json.loads(pathlib.Path(mpath).read_text())
+        problems = check_snapshot(snapshot, require_groups=EXPECTED_GROUPS)
+
+        # overlap pass: worker encode spans must intersect dispatch/
+        # collect spans on the wall-clock timeline
+        opath = str(pathlib.Path(tmp) / "trace_overlap.json")
+        run("failheavy", "ov-warm", overlap_docs, overlap_chunk)
+        ov_rc = run(
+            "failheavy", "ov-traced", overlap_docs, overlap_chunk,
+            ("--trace-out", opath),
+        )
+        ospans, olanes = load(opath)
+
+        def _iv(e):
+            return e["ts"], e["ts"] + e["dur"]
+
+        wspans = [
+            e for e in ospans
+            if olanes.get(e["tid"], "").startswith("worker-")
+        ]
+        dspans = [
+            e for e in ospans
+            if olanes.get(e["tid"]) in ("dispatch", "collect")
+        ]
+        overlapping = sum(
+            1
+            for w in wspans
+            for d in dspans
+            if max(_iv(w)[0], _iv(d)[0]) < min(_iv(w)[1], _iv(d)[1])
+        )
+        record = {
+            "metric": "trace_smoke",
+            "docs": n_docs,
+            "exit_code": rc,
+            "warm_exit_code": warm_rc,
+            "spans_total": len(spans),
+            "missing_stages": missing,
+            "metrics_schema_problems": problems,
+            "overlap_exit_code": ov_rc,
+            "worker_lanes": sorted(
+                {olanes.get(e["tid"]) for e in wspans}
+            ),
+            "overlapping_span_pairs": overlapping,
+        }
+        print(_json.dumps(record), flush=True)
+        if "--keep-trace" in sys.argv:
+            shutil.copy(
+                opath, sys.argv[sys.argv.index("--keep-trace") + 1]
+            )
+        ok = (
+            rc == warm_rc
+            and not missing
+            and not problems
+            and len(wspans) > 0
+            and overlapping > 0
+        )
+        if not ok:
+            raise SystemExit(1)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def measure_fail_heavy(frac_fail: float, statuses_only: bool, n_docs: int = 1024,
                        force_python_rerun: bool = False):
     """End-to-end docs/sec through the backend decision flow on a
@@ -1372,6 +1625,7 @@ def measure_fail_heavy(frac_fail: float, statuses_only: bool, n_docs: int = 1024
     from guard_tpu.ops.ir import compile_rules_file
     from guard_tpu.ops.kernels import BatchEvaluator
 
+    _reset_stats()
     rng = np.random.default_rng(11)
     rf = parse_rules_file(RULES, "fh.guard")
     docs_plain = []
@@ -1531,6 +1785,8 @@ def expected_metrics() -> list:
         "config5b_perfile_templates_per_sec",
         "config5b_rim_vector_docs_per_sec",
         "config5b_rim_scalar_docs_per_sec",
+        "config5b_telemetry_off_templates_per_sec",
+        "config5b_telemetry_on_templates_per_sec",
         "config5b_ingest_workers1_templates_per_sec",
         "config5b_ingest_workers2_templates_per_sec",
         "config6_ingest_workers1_docs_per_sec",
@@ -1566,6 +1822,15 @@ def main() -> None:
 
         _honor_platform_env()
         ingest_smoke()
+        return
+    if "--trace-smoke" in sys.argv:
+        # CI smoke for the telemetry plane: the CLI export flags must
+        # yield a complete per-stage trace with visible worker/device
+        # overlap and a schema-valid metrics snapshot
+        from guard_tpu.ops.backend import _honor_platform_env
+
+        _honor_platform_env()
+        trace_smoke()
         return
     if "--chaos-smoke" in sys.argv:
         # CI smoke for the failure plane: injected worker crash +
@@ -1685,6 +1950,29 @@ def main() -> None:
             "docs_materialized": n_mat + n_settled,
             "docs_settled": 0,
             "rim_seconds_per_run": round(t_rim_scalar, 4),
+        },
+    )
+
+    # config 5b telemetry overhead: the span plane's cost on the same
+    # packed registry dispatch, tracing off vs on (off must match the
+    # packed row above — disabled spans are one branch; the pair
+    # bounds what an always-traced production run would pay)
+    v_toff, v_ton, n_spans = measure_telemetry()
+    _emit(
+        "config5b_telemetry_off_templates_per_sec",
+        v_toff,
+        1.0,
+        extra={"telemetry": "disabled"},
+    )
+    _emit(
+        "config5b_telemetry_on_templates_per_sec",
+        v_ton,
+        v_ton / max(v_toff, 1e-9),
+        extra={
+            "telemetry": "enabled",
+            "overhead_vs_off": round(v_toff / max(v_ton, 1e-9), 4),
+            "spans_recorded_per_run": n_spans,
+            "vs_note": "vs_baseline here = enabled-tracing throughput over disabled-tracing on the same packed registry dispatch",
         },
     )
 

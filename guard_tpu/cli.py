@@ -24,6 +24,27 @@ VERSION = "0.1.0"
 PROG = "guard-tpu"
 
 
+def _add_telemetry_flags(sp: argparse.ArgumentParser) -> None:
+    """The telemetry export face (utils/telemetry.py), shared by
+    validate / sweep / serve. Either flag enables span tracing for the
+    run; with neither, spans stay a single disabled branch."""
+    sp.add_argument(
+        "--trace-out",
+        default=None,
+        metavar="FILE",
+        help="write a Chrome trace_event JSON profile of this run "
+        "(open in Perfetto or chrome://tracing): one lane per pipeline "
+        "stage plus per-ingest-worker lanes",
+    )
+    sp.add_argument(
+        "--metrics-out",
+        default=None,
+        metavar="FILE",
+        help="write a schema-versioned JSON metrics snapshot (all "
+        "counter groups, histograms and span roll-ups)",
+    )
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog=PROG,
@@ -93,6 +114,7 @@ def build_parser() -> argparse.ArgumentParser:
         "records but any failing doc still fails the run; omit the "
         "flag for the historical abort-on-first-failure behavior)",
     )
+    _add_telemetry_flags(v)
 
     t = sub.add_parser("test", help="Test rules against expectations")
     t.add_argument("--rules-file", "-r", dest="rules", default=None)
@@ -166,6 +188,7 @@ def build_parser() -> argparse.ArgumentParser:
         "themselves; 0 restores the historical any-doc-error-is-fatal "
         "exit code",
     )
+    _add_telemetry_flags(s)
 
     pt = sub.add_parser("parse-tree", help="Prints the parse tree for a rules file")
     pt.add_argument("--rules", "-r", default=None)
@@ -189,6 +212,7 @@ def build_parser() -> argparse.ArgumentParser:
     # the transport must be chosen explicitly; stdio is the only one
     # today, so `serve` without it is an error, not a silent default
     sv.add_argument("--stdio", action="store_true")
+    _add_telemetry_flags(sv)
 
     return p
 
@@ -203,6 +227,30 @@ def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reade
         parser.print_help()
         return 0
 
+    # telemetry export face: either flag turns span tracing on for the
+    # whole invocation; exports happen in `finally` so a code-5 run
+    # still leaves its profile behind for diagnosis
+    trace_out = getattr(args, "trace_out", None)
+    metrics_out = getattr(args, "metrics_out", None)
+    if trace_out or metrics_out:
+        from .utils import telemetry
+
+        telemetry.enable()
+        telemetry.reset_trace()
+    try:
+        return _dispatch(args, writer, reader)
+    finally:
+        if trace_out or metrics_out:
+            from .utils import telemetry
+
+            if trace_out:
+                telemetry.write_trace(trace_out)
+            if metrics_out:
+                telemetry.write_metrics(metrics_out)
+            telemetry.disable()
+
+
+def _dispatch(args, writer: Writer, reader: Reader) -> int:
     try:
         if args.command == "validate":
             cmd = Validate(

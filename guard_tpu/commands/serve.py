@@ -33,6 +33,13 @@ Protocol (one line in, one line out):
   response: {"code": <exit code 0|19|5>, "output": "<stdout text>",
              "error": "<stderr text>"}
 
+A `{"metrics": true}` request returns the live telemetry snapshot
+instead: `{"code": 0, "metrics": {...}}` — the same schema-versioned
+document `--metrics-out` writes (utils.telemetry), reflecting the
+previous validate request's counters (each validate request starts
+with one `backend.reset_all_stats()` switch) plus the persistent
+per-request latency histogram (`serve_request_seconds`, p50/p99).
+
 An empty line or EOF ends the session with exit code 0. Request
 isolation (the failure plane's serve leg): a malformed or poisoned
 request produces a structured error response — code 5 plus an
@@ -53,6 +60,7 @@ from typing import Optional
 
 from ..core.errors import ParseError
 from ..core.parser import parse_rules_file
+from ..utils import telemetry
 from ..utils.io import Reader, Writer
 
 
@@ -102,18 +110,20 @@ class Serve:
             self.cache_hits += 1
             return hit
         rule_files = []
-        for i, content in enumerate(rules_strs):
-            name = f"RULES_STDIN[{i + 1}]"
-            try:
-                rf = parse_rules_file(content, name)
-            except ParseError:
-                return None
-            if rf is not None:
-                rule_files.append(
-                    RuleFile(
-                        name=name, full_name=name, content=content, rules=rf
+        with telemetry.span("rule_parse", {"files": len(rules_strs)}):
+            for i, content in enumerate(rules_strs):
+                name = f"RULES_STDIN[{i + 1}]"
+                try:
+                    rf = parse_rules_file(content, name)
+                except ParseError:
+                    return None
+                if rf is not None:
+                    rule_files.append(
+                        RuleFile(
+                            name=name, full_name=name, content=content,
+                            rules=rf
+                        )
                     )
-                )
         self._rules_cache[key] = rule_files
         while len(self._rules_cache) > _RULES_CACHE_MAX:
             self._rules_cache.popitem(last=False)
@@ -146,6 +156,9 @@ class Serve:
             )
 
     def execute(self, writer: Writer, reader: Reader) -> int:
+        import time
+
+        from ..ops.backend import reset_all_stats
         from .validate import Validate
 
         stream = reader.stream()
@@ -153,45 +166,67 @@ class Serve:
             line = line.strip()
             if not line:
                 break
+            t0 = time.perf_counter()
+            sp = telemetry.span_begin("serve_request")
             try:
                 req = json.loads(line)
                 if not isinstance(req, dict):
                     raise ValueError("request must be a JSON object")
-                rules_strs = req.get("rules", [])
-                payload = json.dumps(
-                    {
-                        "rules": rules_strs,
-                        "data": req.get("data", []),
+                if req.get("metrics"):
+                    # live observability face: the same snapshot
+                    # --metrics-out writes, reflecting the PREVIOUS
+                    # validate request (counters reset at the start of
+                    # each one, not after — so they stay inspectable)
+                    sp.set("kind", "metrics")
+                    resp = {"code": 0, "metrics": telemetry.metrics_snapshot()}
+                else:
+                    # one reset switch per request: a poisoned or
+                    # timed-out request must not bleed counters into
+                    # the next one (persistent latency histograms and
+                    # the session trace survive by design)
+                    reset_all_stats()
+                    rules_strs = req.get("rules", [])
+                    payload = json.dumps(
+                        {
+                            "rules": rules_strs,
+                            "data": req.get("data", []),
+                        }
+                    )
+                    prepared = None
+                    if all(isinstance(r, str) for r in rules_strs):
+                        prepared = self._prepared_rules(rules_strs)
+                    out_fmt = req.get("output_format", "sarif")
+                    structured = out_fmt in ("sarif", "json", "yaml", "junit")
+                    cmd = Validate(
+                        payload=True,
+                        structured=structured,
+                        output_format=out_fmt,
+                        show_summary=["none"] if structured else ["fail"],
+                        verbose=bool(req.get("verbose", False)),
+                        backend=req.get("backend", "auto"),
+                        prepared_rules=prepared,
+                    )
+                    buf = Writer.buffered()
+                    code = self._run_bounded(cmd, buf, payload)
+                    resp = {
+                        "code": code,
+                        "output": buf.out.getvalue(),
+                        "error": buf.err.getvalue(),
                     }
-                )
-                prepared = None
-                if all(isinstance(r, str) for r in rules_strs):
-                    prepared = self._prepared_rules(rules_strs)
-                out_fmt = req.get("output_format", "sarif")
-                structured = out_fmt in ("sarif", "json", "yaml", "junit")
-                cmd = Validate(
-                    payload=True,
-                    structured=structured,
-                    output_format=out_fmt,
-                    show_summary=["none"] if structured else ["fail"],
-                    verbose=bool(req.get("verbose", False)),
-                    backend=req.get("backend", "auto"),
-                    prepared_rules=prepared,
-                )
-                buf = Writer.buffered()
-                code = self._run_bounded(cmd, buf, payload)
-                resp = {
-                    "code": code,
-                    "output": buf.out.getvalue(),
-                    "error": buf.err.getvalue(),
-                }
             except Exception as e:  # poisoned request: keep serving
+                sp.set("error_class", type(e).__name__)
                 resp = {
                     "code": 5,
                     "output": "",
                     "error": str(e),
                     "error_class": type(e).__name__,
                 }
+            telemetry.span_end(sp)
+            # per-request latency distribution (p50/p99): persistent,
+            # so between-request resets never erase the session story
+            telemetry.REGISTRY.histogram(
+                "serve_request_seconds", persistent=True
+            ).observe(time.perf_counter() - t0)
             writer.writeln(json.dumps(resp))
             writer.flush()
         return 0

@@ -35,6 +35,8 @@ from ..utils.faults import (
     quarantine_record,
 )
 from ..utils.io import Reader, Writer
+from ..utils.telemetry import ingest_worker_spans as _ingest_worker_spans
+from ..utils.telemetry import span as _span
 from .files import DATA_FILE_EXTENSIONS, RULE_FILE_EXTENSIONS, gather
 from .validate import (
     ERROR_STATUS_CODE,
@@ -216,34 +218,35 @@ class Sweep:
                     mf.flush()
                     evaluated += 1
 
-        totals = {k: 0 for k in _STATUS_NAMES}
-        failed: List[dict] = []
-        quarantined: List[dict] = []
-        errors = parse_errors
-        for ci in range(len(chunks)):
-            rec = done.get(ci)
-            if rec is None:
-                continue
-            for k in _STATUS_NAMES:
-                totals[k] += rec["counts"].get(k, 0)
-            failed.extend(rec.get("failed", []))
-            quarantined.extend(rec.get("quarantined", []))
-            errors += rec.get("errors", 0)
-        summary = {
-            "chunks": len(chunks),
-            "evaluated": evaluated,
-            "resumed": skipped,
-            "documents": len(paths),
-            "counts": totals,
-            "failed": failed,
-            "errors": errors,
-            "manifest": str(manifest_path),
-        }
-        if quarantined:
-            # keyed only when present so clean-run summaries stay
-            # byte-identical to the pre-failure-plane output
-            summary["quarantined"] = quarantined
-        writer.writeln(json.dumps(summary))
+        with _span("report", {"chunks": len(chunks)}):
+            totals = {k: 0 for k in _STATUS_NAMES}
+            failed: List[dict] = []
+            quarantined: List[dict] = []
+            errors = parse_errors
+            for ci in range(len(chunks)):
+                rec = done.get(ci)
+                if rec is None:
+                    continue
+                for k in _STATUS_NAMES:
+                    totals[k] += rec["counts"].get(k, 0)
+                failed.extend(rec.get("failed", []))
+                quarantined.extend(rec.get("quarantined", []))
+                errors += rec.get("errors", 0)
+            summary = {
+                "chunks": len(chunks),
+                "evaluated": evaluated,
+                "resumed": skipped,
+                "documents": len(paths),
+                "counts": totals,
+                "failed": failed,
+                "errors": errors,
+                "manifest": str(manifest_path),
+            }
+            if quarantined:
+                # keyed only when present so clean-run summaries stay
+                # byte-identical to the pre-failure-plane output
+                summary["quarantined"] = quarantined
+            writer.writeln(json.dumps(summary))
         # exit-code semantics: quarantined documents are PARTIAL
         # failure — ERROR only past --max-doc-failures (default
         # unlimited; 0 restores the historical any-doc-error-is-fatal
@@ -261,6 +264,10 @@ class Sweep:
         return SUCCESS_STATUS_CODE
 
     def _parse_rules(self, writer: Writer):
+        with _span("rule_parse"):
+            return self._parse_rules_inner(writer)
+
+    def _parse_rules_inner(self, writer: Writer):
         rule_files: List[RuleFile] = []
         errors = 0
         for f in gather(self.rules, RULE_FILE_EXTENSIONS, self.last_modified):
@@ -406,6 +413,7 @@ class Sweep:
                     PIPELINE_COUNTERS["encode_dispatch_overlap"] += 1
                 PIPELINE_COUNTERS["read_parse_seconds"] += res["read_seconds"]
                 PIPELINE_COUNTERS["encode_seconds"] += res["encode_seconds"]
+                _ingest_worker_spans(res.get("spans"), chunk=j)
                 data_files = [
                     DataFile(name=n, content=c, _pv=None)
                     for n, c in zip(res["names"], res["contents"])
@@ -509,17 +517,18 @@ class Sweep:
         the eager build was ~40% of end-to-end sweep wall time on
         all-lowered JSON corpora."""
         data_files: List[DataFile] = []
-        for p in chunk:
-            try:
-                maybe_fail("read", key=p.name)
-                content = p.read_text()
-                data_files.append(
-                    DataFile(name=p.name, content=content, _pv=None)
-                )
-            except Exception as e:
-                writer.writeln_err(f"skipping {p}: {e}")
-                err_box[0] += 1
-                err_box[1].append(quarantine_record(p.name, "read", e))
+        with _span("read_parse", {"files": len(chunk)}):
+            for p in chunk:
+                try:
+                    maybe_fail("read", key=p.name)
+                    content = p.read_text()
+                    data_files.append(
+                        DataFile(name=p.name, content=content, _pv=None)
+                    )
+                except Exception as e:
+                    writer.writeln_err(f"skipping {p}: {e}")
+                    err_box[0] += 1
+                    err_box[1].append(quarantine_record(p.name, "read", e))
         return data_files
 
     def _evaluate_chunk(
@@ -575,23 +584,24 @@ class Sweep:
         """Stage-3 tally for one chunk: the vectorized fold over the
         rim blocks when active, the scalar per-doc walk otherwise.
         Shared by the serial path and the pipeline's consumer stage."""
-        if vec_box.get("active"):
-            self._tally_vectorized(
-                data_files, vec_box, counts, failed
-            )
-        else:
-            for df, statuses in zip(data_files, per_doc):
-                if getattr(df, "_pv_failed", False):
-                    continue  # unparseable doc: error counted, not tallied
-                doc_status = Status.SKIP
-                for st in statuses.values():
-                    doc_status = doc_status.and_(st)
-                counts[doc_status.value.lower()] += 1
-                fails = sorted(
-                    n for n, s in statuses.items() if s == Status.FAIL
+        with _span("rim_reduce", {"docs": len(data_files)}):
+            if vec_box.get("active"):
+                self._tally_vectorized(
+                    data_files, vec_box, counts, failed
                 )
-                if fails:
-                    failed.append({"data": df.name, "rules": fails})
+            else:
+                for df, statuses in zip(data_files, per_doc):
+                    if getattr(df, "_pv_failed", False):
+                        continue  # unparseable doc: error counted, not tallied
+                    doc_status = Status.SKIP
+                    for st in statuses.values():
+                        doc_status = doc_status.and_(st)
+                    counts[doc_status.value.lower()] += 1
+                    fails = sorted(
+                        n for n, s in statuses.items() if s == Status.FAIL
+                    )
+                    if fails:
+                        failed.append({"data": df.name, "rules": fails})
 
     @staticmethod
     def _pv(df, writer, err_box):
@@ -808,26 +818,32 @@ class Sweep:
         # lower every rule file up-front (pack planning needs the full
         # registry before the first dispatch)
         prep = []
-        for rf in rule_files:
-            from ..ops.fnvars import precompute_fn_values, precomputable_fn_vars
-
-            rf_batch = batch
-            if precomputable_fn_vars(rf.rules):
-                # precomputed function lets: re-encode with per-doc
-                # results before compile (ops/fnvars.py) — this path
-                # genuinely needs the Python documents
-                pvs = self._padded_pvs(data_files, writer, err_box)
-                fn_vars, fn_vals, fn_err = precompute_fn_values(rf.rules, pvs)
-                rf_batch, _ = encode_batch(
-                    pvs,
-                    interner,
-                    fn_values=fn_vals,
-                    fn_var_order=fn_vars,
+        with _span("lower_compile", {"files": len(rule_files)}):
+            for rf in rule_files:
+                from ..ops.fnvars import (
+                    precompute_fn_values,
+                    precomputable_fn_vars,
                 )
-                if fn_err:
-                    rf_batch.num_exotic[sorted(fn_err)] = True
-            compiled = compile_rules_file(rf.rules, interner)
-            prep.append((rf, rf_batch, compiled))
+
+                rf_batch = batch
+                if precomputable_fn_vars(rf.rules):
+                    # precomputed function lets: re-encode with per-doc
+                    # results before compile (ops/fnvars.py) — this path
+                    # genuinely needs the Python documents
+                    pvs = self._padded_pvs(data_files, writer, err_box)
+                    fn_vars, fn_vals, fn_err = precompute_fn_values(
+                        rf.rules, pvs
+                    )
+                    rf_batch, _ = encode_batch(
+                        pvs,
+                        interner,
+                        fn_values=fn_vals,
+                        fn_var_order=fn_vars,
+                    )
+                    if fn_err:
+                        rf_batch.num_exotic[sorted(fn_err)] = True
+                compiled = compile_rules_file(rf.rules, interner)
+                prep.append((rf, rf_batch, compiled))
 
         # vectorized rim (GUARD_TPU_VECTOR_RIM, --no-vector-rim): skip
         # the O(docs x rules) per-doc dict fill entirely — keep
@@ -857,9 +873,12 @@ class Sweep:
             ]
             try:
                 if self.rule_shards > 1 and len(items) >= 2:
-                    state["sharded"] = self._dispatch_pack_sharded(
-                        items, batch, vec_on
-                    )
+                    with _span(
+                        "dispatch", {"files": len(items), "mode": "sharded"}
+                    ):
+                        state["sharded"] = self._dispatch_pack_sharded(
+                            items, batch, vec_on
+                        )
                 else:
                     state["pack_pending"] = dispatch_packs(
                         items, batch, with_rim=vec_on
@@ -898,9 +917,10 @@ class Sweep:
         errors = 0
         try:
             if state["sharded"] is not None:
-                packed_results = self._collect_pack_sharded(
-                    state["sharded"]
-                )
+                with _span("collect", {"mode": "sharded"}):
+                    packed_results = self._collect_pack_sharded(
+                        state["sharded"]
+                    )
             elif state["pack_pending"] is not None:
                 packed_results = collect_packs(state["pack_pending"], batch)
             else:
@@ -934,14 +954,16 @@ class Sweep:
                     ev = RuleShardedEvaluator(
                         compiled, rule_shards=self.rule_shards
                     )
-                    statuses, unsure, host_docs = evaluate_bucketed(
-                        ev, len(compiled.rules), rf_batch
-                    )
+                    with _span("dispatch", {"mode": "per_file", "file": fi}):
+                        statuses, unsure, host_docs = evaluate_bucketed(
+                            ev, len(compiled.rules), rf_batch
+                        )
                 else:
                     evaluator = ShardedBatchEvaluator(compiled)
-                    statuses, unsure, host_docs = evaluator.evaluate_bucketed(
-                        rf_batch
-                    )
+                    with _span("dispatch", {"mode": "per_file", "file": fi}):
+                        statuses, unsure, host_docs = (
+                            evaluator.evaluate_bucketed(rf_batch)
+                        )
             names: list = []
             name_last = None
             if statuses is not None and vec_on:
@@ -1076,24 +1098,28 @@ class Sweep:
         only_docs = restrict.get("only_docs") if restrict else None
         only_rules = restrict.get("only_rules") if restrict else None
         errors = 0
-        for rf in rule_files:
-            for di, df in enumerate(data_files):
-                if only_docs is not None and di not in only_docs:
-                    continue
-                pv = self._pv(df, writer, err_box)
-                if pv is None:
-                    continue
-                try:
-                    maybe_fail("oracle", key=df.name)
-                    scope = RootScope(rf.rules, pv)
-                    eval_rules_file(rf.rules, scope, df.name)
-                except GuardError as e:
-                    writer.writeln_err(f"{df.name} vs {rf.name}: {e}")
-                    errors += 1
-                    continue
-                statuses = rule_statuses_from_root(scope.reset_recorder().extract())
-                for rn, st in statuses.items():
-                    if only_rules is not None and rn not in only_rules:
+        with _span("oracle", {"docs": len(only_docs) if only_docs is not None
+                              else len(data_files)}):
+            for rf in rule_files:
+                for di, df in enumerate(data_files):
+                    if only_docs is not None and di not in only_docs:
                         continue
-                    per_doc[di][rn] = st
+                    pv = self._pv(df, writer, err_box)
+                    if pv is None:
+                        continue
+                    try:
+                        maybe_fail("oracle", key=df.name)
+                        scope = RootScope(rf.rules, pv)
+                        eval_rules_file(rf.rules, scope, df.name)
+                    except GuardError as e:
+                        writer.writeln_err(f"{df.name} vs {rf.name}: {e}")
+                        errors += 1
+                        continue
+                    statuses = rule_statuses_from_root(
+                        scope.reset_recorder().extract()
+                    )
+                    for rn, st in statuses.items():
+                        if only_rules is not None and rn not in only_rules:
+                            continue
+                        per_doc[di][rn] = st
         return errors

@@ -38,6 +38,7 @@ from ..core.qresult import Status
 from ..core.scopes import RootScope
 from ..core.values import PV
 from ..utils.io import Reader, Writer
+from ..utils.telemetry import span as _span
 from .files import DATA_FILE_EXTENSIONS, RULE_FILE_EXTENSIONS, gather
 from .report import (
     rule_statuses_from_root,
@@ -212,6 +213,11 @@ class Validate:
 
     # -- input loading ------------------------------------------------
     def _load_data_files(self, reader: Reader, writer: Writer) -> List[DataFile]:
+        with _span("read_parse"):
+            return self._load_data_files_inner(reader, writer)
+
+    def _load_data_files_inner(self, reader: Reader,
+                               writer: Writer) -> List[DataFile]:
         data_files: List[DataFile] = []
         if self.payload:
             rules, data = load_payload(reader.read())
@@ -258,18 +264,22 @@ class Validate:
             sources = []
             for f in gather(self.rules, RULE_FILE_EXTENSIONS, self.last_modified):
                 sources.append((f.name, f.read_text(), str(f)))
-        for name, content, full in sources:
-            try:
-                rf = parse_rules_file(content, name)
-            except ParseError as e:
-                # per-file error isolation (validate.rs:406-434)
-                writer.writeln_err(f"Parse Error on ruleset file {name}")
-                writer.writeln_err(str(e))
-                errors += 1
-                continue
-            if rf is None:
-                continue
-            rule_files.append(RuleFile(name=name, full_name=full, content=content, rules=rf))
+        with _span("rule_parse", {"files": len(sources)}):
+            for name, content, full in sources:
+                try:
+                    rf = parse_rules_file(content, name)
+                except ParseError as e:
+                    # per-file error isolation (validate.rs:406-434)
+                    writer.writeln_err(f"Parse Error on ruleset file {name}")
+                    writer.writeln_err(str(e))
+                    errors += 1
+                    continue
+                if rf is None:
+                    continue
+                rule_files.append(
+                    RuleFile(name=name, full_name=full, content=content,
+                             rules=rf)
+                )
         return rule_files, errors
 
     def _merged_input_params(self) -> Optional[PV]:

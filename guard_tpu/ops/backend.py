@@ -37,6 +37,10 @@ from ..utils.faults import (
     reset_fault_counters,
 )
 from ..utils.io import Writer
+from ..utils.telemetry import REGISTRY as _TELEMETRY
+from ..utils.telemetry import span as _span
+from ..utils.telemetry import span_begin as _span_begin
+from ..utils.telemetry import span_end as _span_end
 from .encoder import encode_batch
 from .ir import FAIL, PASS, SKIP, compile_rules_file
 from ..commands.report import rule_statuses_from_root, simplified_report_from_root
@@ -67,16 +71,17 @@ def vector_rim_enabled() -> bool:
 # served from the shared per-unique-status-row cache). The scalar rim
 # materializes EVERY doc, so the all-PASS CI rim-smoke pins
 # docs_materialized == 0 only on the vectorized path.
-RIM_COUNTERS = {"docs_materialized": 0, "docs_settled": 0}
+RIM_COUNTERS = _TELEMETRY.counter_group(
+    "rim", {"docs_materialized": 0, "docs_settled": 0}
+)
 
 
 def rim_stats() -> dict:
-    return dict(RIM_COUNTERS)
+    return _TELEMETRY.group_stats("rim")
 
 
 def reset_rim_stats() -> None:
-    RIM_COUNTERS["docs_materialized"] = 0
-    RIM_COUNTERS["docs_settled"] = 0
+    _TELEMETRY.reset_group("rim")
 
 
 # pack_compiled output cache: the slot relocation is a pure function of
@@ -98,8 +103,9 @@ def _pack_cached(parts: list):
     if hit is not None:
         _PACK_CACHE.move_to_end(key)
         return hit[1], hit[2]
-    packed = pack_compiled(parts)
-    spec = packed.rim_spec()
+    with _span("pack_compile", {"files": len(parts)}):
+        packed = pack_compiled(parts)
+        spec = packed.rim_spec()
     _PACK_CACHE[key] = (list(parts), packed, spec)
     while len(_PACK_CACHE) > _PACK_CACHE_MAX:
         _PACK_CACHE.popitem(last=False)
@@ -112,15 +118,15 @@ def dispatch_stats() -> dict:
     calls issued, `executables_compiled` = distinct (evaluator, bucket
     shape) pairs those calls compiled. bench.py emits these and the CPU
     bench-smoke pins a ceiling on the packed path's dispatch count."""
-    from ..parallel.mesh import DISPATCH_COUNTERS
+    from ..parallel import mesh  # noqa: F401  registers the group
 
-    return dict(DISPATCH_COUNTERS)
+    return _TELEMETRY.group_stats("dispatch")
 
 
 def reset_dispatch_stats() -> None:
-    from ..parallel.mesh import reset_dispatch_counters
+    from ..parallel import mesh  # noqa: F401  registers the group
 
-    reset_dispatch_counters()
+    _TELEMETRY.reset_group("dispatch")
 
 
 def pipeline_stats() -> dict:
@@ -129,15 +135,15 @@ def pipeline_stats() -> dict:
     encode/dispatch overlap events, the queued-chunk high-water mark
     and the stage timing accumulators bench.py's ingest decomposition
     rows divide into per-run numbers."""
-    from ..parallel.mesh import PIPELINE_COUNTERS
+    from ..parallel import mesh  # noqa: F401  registers the group
 
-    return dict(PIPELINE_COUNTERS)
+    return _TELEMETRY.group_stats("pipeline")
 
 
 def reset_pipeline_stats() -> None:
-    from ..parallel.mesh import reset_pipeline_counters
+    from ..parallel import mesh  # noqa: F401  registers the group
 
-    reset_pipeline_counters()
+    _TELEMETRY.reset_group("pipeline")
 
 
 def reset_fault_stats() -> None:
@@ -145,6 +151,21 @@ def reset_fault_stats() -> None:
     `fault_stats` is re-exported above them for symmetry with the
     dispatch/pipeline/rim accessors."""
     reset_fault_counters()
+
+
+def reset_all_stats() -> None:
+    """Reset EVERY observability plane atomically: dispatch, pipeline,
+    rim and fault counter groups plus the telemetry gauges, stage
+    histograms and span roll-ups — one switch instead of four reset
+    calls each entry point had to remember. Used by serve between
+    requests and by every bench measure_* entry point. Persistent
+    histograms (serve request latency) and the trace buffer survive:
+    the former accumulate across requests by design, the latter is an
+    artifact log, not a stat. Deliberately does NOT import
+    parallel.mesh (and with it jax): a group that was never registered
+    was never incremented, so there is nothing to reset — which keeps
+    this safe to call from jax-free serve sessions."""
+    _TELEMETRY.reset()
 
 
 def plan_packs(items, max_rules: int = None):
@@ -194,6 +215,15 @@ def dispatch_packs(items, batch, with_rim=None) -> PackPending:
         with_rim = vector_rim_enabled()
     if len(items) < 2:
         return PackPending([], set(), with_rim)
+    with _span("dispatch", {"files": len(items)}):
+        return _dispatch_packs_inner(items, batch, with_rim)
+
+
+def _dispatch_packs_inner(items, batch, with_rim) -> PackPending:
+    from .encoder import NODE_BUCKETS_EXTENDED, split_batch_by_size
+    from .ir import PackIncompatible
+    from ..parallel.mesh import ShardedBatchEvaluator
+
     groups, oversize = split_batch_by_size(batch, NODE_BUCKETS_EXTENDED)
     host_docs = {int(i) for i in oversize}
     pending = []
@@ -242,6 +272,13 @@ def collect_packs(pp: PackPending, batch) -> dict:
     =0): the reductions ride the same dispatch, so per-(pack, bucket)
     only the blocks pass A actually consumes cross the device
     boundary alongside the status matrix."""
+    if not pp.pending:
+        return {}
+    with _span("collect", {"packs": len(pp.pending)}):
+        return _collect_packs_inner(pp, batch)
+
+
+def _collect_packs_inner(pp: PackPending, batch) -> dict:
     import numpy as np
 
     from ..parallel.mesh import ShardedBatchEvaluator
@@ -639,21 +676,28 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
         )
         if enc is not None:
             batch, interner = enc
-    if batch is None and all(_looks_json(df.content) for df in data_files):
-        # JSON corpus: the native C++ data loader (native/encoder.cpp)
-        from .native_encoder import encode_json_batch_native, native_available
-
-        if native_available():
-            try:
-                batch, interner, err = encode_json_batch_native(
-                    [df.content for df in data_files]
-                )
-                if err is not None:
-                    batch = interner = None
-            except RuntimeError:
-                pass
     if batch is None:
-        batch, interner = encode_batch(_docs())
+        # inline (non-worker) encode: one span covers whichever encoder
+        # wins; the parallel path above records per-worker spans instead
+        with _span("encode", {"docs": len(data_files)}):
+            if all(_looks_json(df.content) for df in data_files):
+                # JSON corpus: native C++ data loader (native/encoder.cpp)
+                from .native_encoder import (
+                    encode_json_batch_native,
+                    native_available,
+                )
+
+                if native_available():
+                    try:
+                        batch, interner, err = encode_json_batch_native(
+                            [df.content for df in data_files]
+                        )
+                        if err is not None:
+                            batch = interner = None
+                    except RuntimeError:
+                        pass
+            if batch is None:
+                batch, interner = encode_batch(_docs())
 
     errors = 0
     had_fail = False
@@ -675,27 +719,28 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
     from .ir import pack_compatible
 
     prep = []
-    for rule_file in rule_files:
-        rbatch = batch
-        if precomputable_fn_vars(rule_file.rules):
-            docs = _docs()
-            fn_vars, fn_vals, fn_err = precompute_fn_values(
-                rule_file.rules, docs
+    with _span("lower_compile", {"files": len(rule_files)}):
+        for rule_file in rule_files:
+            rbatch = batch
+            if precomputable_fn_vars(rule_file.rules):
+                docs = _docs()
+                fn_vars, fn_vals, fn_err = precompute_fn_values(
+                    rule_file.rules, docs
+                )
+                rbatch, _ = encode_batch(
+                    docs, interner, fn_values=fn_vals, fn_var_order=fn_vars
+                )
+                if fn_err:
+                    # a function raised on these docs: route them to the
+                    # oracle, which reproduces the error path
+                    rbatch.num_exotic[sorted(fn_err)] = True
+            compiled = compile_rules_file(rule_file.rules, interner)
+            n_dev, n_host = len(compiled.rules), len(compiled.host_rules)
+            log.info(
+                "%s: %d/%d rules lowered to device kernels (%d host-fallback)",
+                rule_file.name, n_dev, n_dev + n_host, n_host,
             )
-            rbatch, _ = encode_batch(
-                docs, interner, fn_values=fn_vals, fn_var_order=fn_vars
-            )
-            if fn_err:
-                # a function raised on these docs: route them to the
-                # oracle, which reproduces the error path
-                rbatch.num_exotic[sorted(fn_err)] = True
-        compiled = compile_rules_file(rule_file.rules, interner)
-        n_dev, n_host = len(compiled.rules), len(compiled.host_rules)
-        log.info(
-            "%s: %d/%d rules lowered to device kernels (%d host-fallback)",
-            rule_file.name, n_dev, n_dev + n_host, n_host,
-        )
-        prep.append((rule_file, rbatch, compiled))
+            prep.append((rule_file, rbatch, compiled))
 
     # fused multi-rule-file dispatch: compatible files (shared batch,
     # no per-file fn re-encode) evaluate as packed executables, one
@@ -765,7 +810,10 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
             statuses, unsure, host_docs, rim = packed_results[fi]
         elif compiled.rules:
             evaluator = ShardedBatchEvaluator(compiled)
-            statuses, unsure, host_docs = evaluator.evaluate_bucketed(rbatch)
+            with _span("dispatch", {"mode": "per_file", "file": fi}):
+                statuses, unsure, host_docs = (
+                    evaluator.evaluate_bucketed(rbatch)
+                )
 
         statuses_only = getattr(validate, "statuses_only", False)
 
@@ -797,6 +845,11 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
         oracle_dis = []
         native_declines = 0
         settled = None  # vectorized rim: (name_st, names, materialize mask)
+        _sp_rim = _span_begin(
+            "rim_reduce",
+            {"docs": len(data_files), "file": fi,
+             "mode": "vector" if rim_on else "scalar"},
+        )
         if rim_on:
             # pass A, vectorized: whole-corpus mask arithmetic over the
             # rim blocks; per-doc dicts build ONLY for docs the masks
@@ -949,6 +1002,7 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                 )
                 if need_oracle:
                     oracle_dis.append(di)
+        _span_end(_sp_rim)
 
         # the oracle reruns are independent pure-Python work: fan them
         # over a process pool when there are enough to amortize spawn
@@ -969,9 +1023,12 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                     for di in oracle_dis
                 ]
                 try:
-                    pooled_results = _run_oracle_jobs(
-                        0, rule_file, jobs, workers
-                    )
+                    with _span(
+                        "oracle", {"jobs": len(jobs), "workers": workers}
+                    ):
+                        pooled_results = _run_oracle_jobs(
+                            0, rule_file, jobs, workers
+                        )
                 except Exception as e:  # pool bootstrap can fail when
                     # an embedder's unguarded __main__ re-executes
                     # under spawn — the inline path is always safe
@@ -990,6 +1047,9 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
         # docs only exist in non-structured runs.
         oracle_set = set(oracle_dis)
         row_cache: dict = {}
+        _sp_report = _span_begin(
+            "report", {"docs": len(data_files), "file": fi}
+        )
         for di, data_file in enumerate(data_files):
             if di in quarantined:
                 continue
@@ -1167,6 +1227,7 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                     doc_status, rule_statuses, report, validate.show_summary,
                     validate.output_format,
                 )
+        _span_end(_sp_report)
 
         if native is not None:
             native.close()

@@ -44,6 +44,7 @@ from ..core.values import (
     PV,
     compiled_regex,
 )
+from ..utils.telemetry import span as _span
 
 
 _BIAS32 = 1 << 31
@@ -930,6 +931,11 @@ def encode_chunk_texts(names: List[str], contents: List[str]):
     process can cache them for oracle fallbacks) and None on the
     native path.
     """
+    with _span("encode", {"docs": len(names)}):
+        return _encode_chunk_texts_inner(names, contents)
+
+
+def _encode_chunk_texts_inner(names: List[str], contents: List[str]):
     from ..utils.faults import fault_active, maybe_fail, quarantine_record
     from .native_encoder import encode_json_batch_resilient
 

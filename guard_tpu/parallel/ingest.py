@@ -117,8 +117,10 @@ def _chunk_job(args):
     stage timings the bench decomposition rows report)."""
     ci, paths = args
     from ..ops.encoder import batch_payload, encode_chunk_texts
+    from ..utils.telemetry import worker_spans
 
     t0 = time.perf_counter()
+    w0 = time.time()
     names, contents, read_msgs, read_errs, read_recs = read_paths(paths)
     t_read = time.perf_counter() - t0
     batch, interner, pv_failed, enc_msgs, enc_errs, enc_recs, _pvs = (
@@ -136,6 +138,12 @@ def _chunk_job(args):
         "quarantined": read_recs + enc_recs,
         "read_seconds": t_read,
         "encode_seconds": t_enc,
+        # wall-anchored span records for the parent's trace: dropped
+        # there when tracing is off (building them is a few dicts)
+        "spans": worker_spans([
+            ("read_parse", w0, t_read),
+            ("encode", w0 + t_read, t_enc),
+        ]),
     }
 
 
@@ -150,6 +158,15 @@ def _validate_shard_job(args):
     there with the same message)."""
     names, contents, use_native = args
     from ..ops.encoder import batch_payload, encode_batch
+    from ..utils.telemetry import worker_spans
+
+    t0 = time.perf_counter()
+    w0 = time.time()
+
+    def _spans():
+        return worker_spans([
+            ("encode", w0, time.perf_counter() - t0),
+        ])
 
     if use_native:
         from ..ops.native_encoder import (
@@ -161,7 +178,8 @@ def _validate_shard_job(args):
             try:
                 batch, interner, err = encode_json_batch_native(contents)
                 if err is None:
-                    return ("ok", batch_payload(batch), interner.strings)
+                    return ("ok", batch_payload(batch),
+                            interner.strings, _spans())
             except RuntimeError:
                 pass
     from ..core.errors import GuardError
@@ -174,7 +192,7 @@ def _validate_shard_job(args):
         except GuardError as e:
             return ("parse_error", i, str(e))
     batch, interner = encode_batch(pvs)
-    return ("ok", batch_payload(batch), interner.strings)
+    return ("ok", batch_payload(batch), interner.strings, _spans())
 
 
 def _spawn_pool(workers: int):
@@ -349,11 +367,14 @@ def parallel_encode_documents(names: List[str], contents: List[str],
             # earliest shard's first failure is the global first —
             # the serial path's error message, byte for byte
             raise GuardError(res[2])
+    from ..utils.telemetry import ingest_worker_spans
+
     merged = Interner()
     import numpy as np
 
     parts = []
     for res in results:
+        ingest_worker_spans(res[3] if len(res) > 3 else None)
         batch = batch_from_payload(res[1])
         remap = np.array(
             [merged.intern(s) for s in res[2]], dtype=np.int32

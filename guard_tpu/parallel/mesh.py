@@ -39,6 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.encoder import DocBatch
 from ..ops.ir import CompiledRules, trace_signature
 from ..ops.kernels import build_doc_evaluator
+from ..utils.telemetry import REGISTRY as _TELEMETRY
 
 DOC_AXIS = "docs"
 DCN_AXIS = "dcn"  # cross-slice / cross-host axis
@@ -101,14 +102,21 @@ _SHARED_FNS_MAX = 64
 # compiled executable — jit compiles one XLA executable per input
 # shape, and node_kind's (D, N) shape determines the bucket. The packed
 # path's whole point is driving both counters down ~n_files-fold.
-DISPATCH_COUNTERS = {"dispatches": 0, "executables_compiled": 0}
 _COMPILED_SHAPES: set = set()
+
+# absorbed into the central telemetry registry (utils/telemetry.py):
+# this dict stays the mutation surface (the dispatch sites below
+# increment it directly, bit-compatibly), the registry owns
+# read/reset/snapshot behind ops.backend.dispatch_stats()
+DISPATCH_COUNTERS = _TELEMETRY.counter_group(
+    "dispatch",
+    {"dispatches": 0, "executables_compiled": 0},
+    extra_reset=_COMPILED_SHAPES.clear,
+)
 
 
 def reset_dispatch_counters() -> None:
-    DISPATCH_COUNTERS["dispatches"] = 0
-    DISPATCH_COUNTERS["executables_compiled"] = 0
-    _COMPILED_SHAPES.clear()
+    _TELEMETRY.reset_group("dispatch")
 
 
 # Ingest-pipeline observability, next to the dispatch counters above
@@ -128,23 +136,18 @@ def reset_dispatch_counters() -> None:
 #                             decomposition row);
 #   read_parse_seconds /    — cumulative stage-1 timings as measured
 #   encode_seconds            inside the workers (or inline).
-PIPELINE_COUNTERS = {
+PIPELINE_COUNTERS = _TELEMETRY.counter_group("pipeline", {
     "chunks_prefetched": 0,
     "encode_dispatch_overlap": 0,
     "max_inflight_chunks": 0,
     "ingest_stall_seconds": 0.0,
     "read_parse_seconds": 0.0,
     "encode_seconds": 0.0,
-}
+})
 
 
 def reset_pipeline_counters() -> None:
-    PIPELINE_COUNTERS["chunks_prefetched"] = 0
-    PIPELINE_COUNTERS["encode_dispatch_overlap"] = 0
-    PIPELINE_COUNTERS["max_inflight_chunks"] = 0
-    PIPELINE_COUNTERS["ingest_stall_seconds"] = 0.0
-    PIPELINE_COUNTERS["read_parse_seconds"] = 0.0
-    PIPELINE_COUNTERS["encode_seconds"] = 0.0
+    _TELEMETRY.reset_group("pipeline")
 
 
 def _mesh_key(mesh: Mesh) -> tuple:

@@ -43,6 +43,7 @@ from ..ops.ir import (
     StepKeyInterpVar,
     compile_rules_file,
 )
+from ..utils.telemetry import span as _span
 from .mesh import Mesh, ShardedBatchEvaluator
 
 
@@ -315,7 +316,10 @@ class PackShardedEvaluator:
         splits = np.array_split(np.arange(len(devices)), len(self.groups))
         self.shards: List[Tuple[ShardedBatchEvaluator, np.ndarray]] = []
         for g, dev_idx in zip(self.groups, splits):
-            packed = pack_compiled([self.files[i] for i in g])
+            # per-group pack compile is the sharded path's lowering
+            # cost (backend._pack_cached never sees these packs)
+            with _span("pack_compile", {"files": len(g)}):
+                packed = pack_compiled([self.files[i] for i in g])
             cols = np.concatenate(
                 [np.arange(col_base[i], col_base[i + 1]) for i in g]
             )

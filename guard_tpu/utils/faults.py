@@ -37,6 +37,8 @@ import os
 from typing import Optional
 
 from ..core.errors import GuardError
+from .telemetry import REGISTRY as _TELEMETRY
+from .telemetry import EventedCounters
 
 #: named injection points, in pipeline order
 POINTS = (
@@ -46,15 +48,19 @@ POINTS = (
 
 #: observability beside DISPATCH_COUNTERS / PIPELINE_COUNTERS /
 #: RIM_COUNTERS: injected_* count fault firings, the rest count the
-#: recovery actions the failure plane took
-FAULT_COUNTERS = {
+#: recovery actions the failure plane took. Registered with the
+#: central telemetry registry as group "fault"; EventedCounters turns
+#: every increment into an instant trace event when tracing is on, so
+#: quarantine / pool restarts / ladder fallbacks appear in --trace-out
+#: with zero per-site changes.
+FAULT_COUNTERS = _TELEMETRY.counter_group("fault", EventedCounters("fault", {
     **{f"injected_{p}": 0 for p in POINTS},
     "retries": 0,
     "worker_restarts": 0,
     "quarantined_docs": 0,
     "dispatch_fallbacks": 0,
     "oracle_fallbacks": 0,
-}
+}))
 
 
 class InjectedFault(GuardError):
@@ -176,8 +182,7 @@ def fault_stats() -> dict:
 
 
 def reset_fault_counters() -> None:
-    for k in FAULT_COUNTERS:
-        FAULT_COUNTERS[k] = 0
+    _TELEMETRY.reset_group("fault")
 
 
 def reset_faults() -> None:

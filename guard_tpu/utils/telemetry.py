@@ -1,0 +1,604 @@
+"""Unified telemetry plane: the central metrics registry + span tracer.
+
+Before this module the engine had four independent global counter
+dicts (`parallel.mesh.DISPATCH_COUNTERS` / `PIPELINE_COUNTERS`,
+`ops.backend.RIM_COUNTERS`, `utils.faults.FAULT_COUNTERS`) and all
+wall-clock attribution lived in ad-hoc `perf_counter` arithmetic
+inside bench.py — there was no way to see, for a production run, where
+time went across the three-stage pipeline or which degradation-ladder
+rungs fired. This module is the one roof over all of it:
+
+**MetricsRegistry** (`REGISTRY`, process-global) — counters, gauges
+and per-stage duration histograms with fixed log2 buckets. The four
+existing counter dicts are ABSORBED, not replaced: each owning module
+registers its dict as a named counter group (`counter_group`), the
+dict object itself stays the mutation surface (every existing `+= 1`
+site and direct import keeps working, bit-compatibly), and the
+registry becomes the read/reset/snapshot authority behind the
+`*_stats()` / `reset_*` facades in `ops.backend`.
+
+**Spans** — `span(name, attrs)` is a nestable context manager
+instrumenting every pipeline stage (rule parse, lowering/pack-compile,
+read/parse, encode, dispatch, collect, rim reduce, report
+materialization, oracle fallback, serve requests). Disabled spans cost
+ONE branch and allocate nothing (`span()` returns a shared no-op
+singleton); span ids come from a monotonic per-process sequence — not
+wall clock — so ordering is deterministic. Spans recorded inside spawn
+ingest workers are shipped back with the chunk payload
+(`parallel.ingest._chunk_job`) and re-anchored here onto per-worker
+lanes. Completed spans feed per-stage duration histograms and
+count/total roll-ups in the registry.
+
+**Export faces** — `write_trace(path)` emits Chrome `trace_event` JSON
+(open in Perfetto / chrome://tracing: one lane per pipeline stage plus
+one per ingest worker, which makes the encode/dispatch overlap of the
+three-stage pipeline visible instead of inferred from counters);
+`write_metrics(path)` / `metrics_snapshot()` emit a schema-versioned
+snapshot of every counter group, gauge, histogram and span roll-up
+(validated by `tools/check_metrics_schema.py`). `serve --stdio`
+returns the same snapshot live for a `{"metrics": true}` request.
+
+Failure-plane faithfulness: `EventedCounters` (the FAULT_COUNTERS
+dict class) turns every fault/recovery counter increment into an
+instant trace event when tracing is on, so quarantine, pool restarts
+and ladder fallbacks appear in the trace with zero per-site changes
+— and the chaos smoke becomes a traceable artifact.
+
+This module imports nothing from the rest of guard_tpu so every
+subsystem (including `utils.faults`) can import it without cycles.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import math
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+#: metrics-snapshot schema version (tools/check_metrics_schema.py
+#: validates against this; bump on breaking snapshot-shape changes)
+SCHEMA_VERSION = 1
+
+# fixed log2 histogram buckets: bucket i holds durations in
+# [2^(LOG2_LO+i-1), 2^(LOG2_LO+i)) seconds — ~1µs to ~128s, plus an
+# underflow bucket at index 0 and an overflow bucket at the end.
+LOG2_LO = -20
+LOG2_HI = 7
+_N_BUCKETS = LOG2_HI - LOG2_LO + 2
+
+
+def _bucket_index(seconds: float) -> int:
+    if seconds <= 0:
+        return 0
+    # frexp: seconds = m * 2^e with 0.5 <= m < 1, so seconds lives in
+    # [2^(e-1), 2^e) — exactly the log2 bucket boundaries
+    _m, e = math.frexp(seconds)
+    return min(max(e - LOG2_LO, 0), _N_BUCKETS - 1)
+
+
+def bucket_label(i: int) -> str:
+    """Human-readable upper bound of bucket i (snapshot keys)."""
+    if i >= _N_BUCKETS - 1:
+        return "inf"
+    return f"le_2^{LOG2_LO + i}s"
+
+
+class Histogram:
+    """Fixed log2-bucket duration histogram with count/total/min/max
+    and bucket-resolution quantiles (p50/p99 for the serve latency
+    story)."""
+
+    __slots__ = ("name", "persistent", "counts", "count", "total",
+                 "min", "max")
+
+    def __init__(self, name: str, persistent: bool = False):
+        self.name = name
+        # persistent histograms survive reset_all_stats (serve resets
+        # engine counters between requests but the per-request latency
+        # distribution must accumulate across the session)
+        self.persistent = persistent
+        self._zero()
+
+    def _zero(self) -> None:
+        self.counts = [0] * _N_BUCKETS
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, seconds: float) -> None:
+        self.counts[_bucket_index(seconds)] += 1
+        self.count += 1
+        self.total += seconds
+        if self.min is None or seconds < self.min:
+            self.min = seconds
+        if self.max is None or seconds > self.max:
+            self.max = seconds
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Upper bucket bound at quantile q (bucket resolution — a
+        factor-of-2 answer, which is what a latency SLO check needs)."""
+        if self.count == 0:
+            return None
+        target = q * self.count
+        seen = 0
+        for i, n in enumerate(self.counts):
+            seen += n
+            if seen >= target:
+                if i >= _N_BUCKETS - 1:
+                    return self.max
+                return 2.0 ** (LOG2_LO + i)
+        return self.max
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "total_seconds": self.total,
+            "min_seconds": self.min,
+            "max_seconds": self.max,
+            "p50_seconds": self.quantile(0.50),
+            "p99_seconds": self.quantile(0.99),
+            "buckets": {
+                bucket_label(i): n
+                for i, n in enumerate(self.counts) if n
+            },
+        }
+
+
+class EventedCounters(dict):
+    """A counter dict whose increments become instant trace events
+    when tracing is enabled (used for FAULT_COUNTERS: every injected
+    fault, retry, pool restart, quarantine and ladder fallback lands
+    in the trace with zero per-site changes). Plain-dict semantics
+    otherwise — existing `d[k] += 1` sites are untouched."""
+
+    __slots__ = ("group",)
+
+    def __init__(self, group: str, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.group = group
+
+    def __setitem__(self, key, value):
+        if _ON:
+            old = self.get(key, 0)
+            if isinstance(value, (int, float)) and value > old:
+                event(f"{self.group}.{key}", {"value": value})
+        super().__setitem__(key, value)
+
+
+class MetricsRegistry:
+    """Process-global registry of counter groups, gauges, duration
+    histograms and span roll-ups. One `reset()` clears every
+    observability plane atomically (under one lock) — the counter-
+    reset footgun killer behind `backend.reset_all_stats()`."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._groups: "OrderedDict[str, dict]" = OrderedDict()
+        self._group_zeros: Dict[str, dict] = {}
+        self._group_resets: Dict[str, object] = {}
+        self._gauges: "OrderedDict[str, float]" = OrderedDict()
+        self._hists: "OrderedDict[str, Histogram]" = OrderedDict()
+        # span roll-ups: name -> [count, total_seconds]
+        self._spans: "OrderedDict[str, list]" = OrderedDict()
+
+    # -- counter groups (the absorbed module dicts) -------------------
+    def counter_group(self, name: str, counters: dict,
+                      extra_reset=None) -> dict:
+        """Adopt `counters` as group `name` and return it. The dict
+        object remains the owning module's mutation surface; initial
+        values are snapshotted so reset restores them bit-compatibly
+        (ints stay ints, float accumulators stay floats)."""
+        with self._lock:
+            self._groups[name] = counters
+            self._group_zeros[name] = dict(counters)
+            if extra_reset is not None:
+                self._group_resets[name] = extra_reset
+        return counters
+
+    def group_stats(self, name: str) -> dict:
+        return dict(self._groups[name])
+
+    def reset_group(self, name: str) -> None:
+        with self._lock:
+            g = self._groups[name]
+            for k, v in self._group_zeros[name].items():
+                g[k] = v
+            extra = self._group_resets.get(name)
+            if extra is not None:
+                extra()
+
+    # -- gauges / histograms ------------------------------------------
+    def set_gauge(self, name: str, value) -> None:
+        self._gauges[name] = value
+
+    def histogram(self, name: str, persistent: bool = False) -> Histogram:
+        h = self._hists.get(name)
+        if h is None:
+            with self._lock:
+                h = self._hists.get(name)
+                if h is None:
+                    h = self._hists[name] = Histogram(name, persistent)
+        return h
+
+    # -- span roll-ups ------------------------------------------------
+    def observe_span(self, name: str, seconds: float) -> None:
+        roll = self._spans.get(name)
+        if roll is None:
+            with self._lock:
+                roll = self._spans.setdefault(name, [0, 0.0])
+        roll[0] += 1
+        roll[1] += seconds
+        self.histogram(f"stage.{name}").observe(seconds)
+
+    def span_rollups(self) -> Dict[str, dict]:
+        return {
+            name: {"count": c, "total_seconds": s}
+            for name, (c, s) in self._spans.items()
+        }
+
+    def stage_seconds(self) -> Dict[str, float]:
+        """Total seconds per span name — the registry-derived stage
+        decomposition bench.py reads (and tests reconcile against
+        end-to-end wall time)."""
+        return {name: s for name, (_c, s) in self._spans.items()}
+
+    # -- snapshot / reset ---------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "schema_version": SCHEMA_VERSION,
+                "counters": {
+                    name: dict(g) for name, g in self._groups.items()
+                },
+                "gauges": dict(self._gauges),
+                "histograms": {
+                    name: h.snapshot() for name, h in self._hists.items()
+                },
+                "spans": self.span_rollups(),
+            }
+
+    def reset(self, include_persistent: bool = False) -> None:
+        """Reset every group, gauge, histogram and span roll-up under
+        one lock. Persistent histograms (serve request latency) survive
+        unless `include_persistent`."""
+        with self._lock:
+            for name in self._groups:
+                self.reset_group(name)
+            self._gauges.clear()
+            for name in list(self._hists):
+                h = self._hists[name]
+                if h.persistent and not include_persistent:
+                    continue
+                h._zero()
+            self._spans.clear()
+
+
+#: the process-global registry every subsystem registers with
+REGISTRY = MetricsRegistry()
+
+
+# ---------------------------------------------------------------- spans
+
+#: single-branch disabled check: span()/event() read this module
+#: global and return the shared no-op before touching anything else
+_ON = False
+
+#: monotonic per-process span-id sequence (deterministic ordering —
+#: ids never come from wall clock)
+_SEQ = itertools.count(1)
+
+_TRACE: List[dict] = []  # finished span records
+_EVENTS: List[dict] = []  # instant events (fault/fallback annotations)
+_EPOCH = 0.0  # wall-clock anchor for trace timestamps (time.time)
+_TLS = threading.local()  # per-thread span stack (nesting/parents)
+_TRACE_LOCK = threading.Lock()
+
+#: span name -> trace lane (Chrome tid). One lane per pipeline stage;
+#: names not listed land on "main"; worker spans get "worker-<pid>".
+STAGE_LANES = {
+    "rule_parse": "rules",
+    "lower_compile": "rules",
+    "pack_compile": "rules",
+    "read_parse": "ingest",
+    "encode": "ingest",
+    "dispatch": "dispatch",
+    "collect": "collect",
+    "rim_reduce": "rim",
+    "report": "rim",
+    "oracle": "oracle",
+    "serve_request": "serve",
+}
+
+#: lane display order in the trace viewer (pipeline order)
+_LANE_ORDER = (
+    "main", "rules", "ingest", "dispatch", "collect", "rim",
+    "oracle", "serve",
+)
+
+
+def enabled() -> bool:
+    return _ON
+
+
+def enable() -> None:
+    """Turn span tracing on (idempotent). The wall-clock epoch anchors
+    trace timestamps; worker spans carry absolute wall times so both
+    sides of a process boundary land on one timeline."""
+    global _ON, _EPOCH
+    if not _ON:
+        if _EPOCH == 0.0:
+            _EPOCH = time.time()
+        _ON = True
+
+
+def disable() -> None:
+    global _ON
+    _ON = False
+
+
+def reset_trace() -> None:
+    """Drop the trace buffers and epoch (tests; fresh sessions)."""
+    global _EPOCH
+    with _TRACE_LOCK:
+        _TRACE.clear()
+        _EVENTS.clear()
+        _EPOCH = time.time() if _ON else 0.0
+    _TLS.stack = []
+
+
+class _NoopSpan:
+    """The shared disabled-path singleton: `span()` returns this
+    without allocating, entering/exiting/annotating it is free."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, key, value):
+        return self
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "sid", "parent", "t0", "wall0")
+
+    def __init__(self, name: str, attrs: Optional[dict]):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        self.sid = next(_SEQ)
+        stack = getattr(_TLS, "stack", None)
+        if stack is None:
+            stack = _TLS.stack = []
+        self.parent = stack[-1] if stack else 0
+        stack.append(self.sid)
+        self.wall0 = time.time()
+        self.t0 = time.perf_counter()
+        return self
+
+    def set(self, key, value):
+        """Annotate the live span (e.g. error_class on failure)."""
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs[key] = value
+        return self
+
+    def __exit__(self, exc_type, exc, _tb):
+        dur = time.perf_counter() - self.t0
+        stack = getattr(_TLS, "stack", None)
+        if stack:
+            stack.pop()
+        if exc is not None:
+            self.set("error_class", type(exc).__name__)
+        REGISTRY.observe_span(self.name, dur)
+        rec = {
+            "sid": self.sid,
+            "parent": self.parent,
+            "name": self.name,
+            "lane": STAGE_LANES.get(self.name, "main"),
+            "ts": self.wall0 - _EPOCH,
+            "dur": dur,
+        }
+        if self.attrs:
+            rec["attrs"] = self.attrs
+        with _TRACE_LOCK:
+            _TRACE.append(rec)
+        return False
+
+
+def span(name: str, attrs: Optional[dict] = None):
+    """A pipeline-stage span. Disabled: one branch, no allocation
+    (returns the shared no-op singleton). Enabled: a nestable context
+    manager whose completion feeds the registry roll-ups and the
+    trace buffer."""
+    if not _ON:
+        return _NOOP
+    return _Span(name, attrs)
+
+
+def span_begin(name: str, attrs: Optional[dict] = None):
+    """Open a span around a large inline block where a `with` would
+    force re-indenting the whole region; pair with `span_end`. Same
+    disabled-path cost as span()."""
+    if not _ON:
+        return _NOOP
+    sp = _Span(name, attrs)
+    sp.__enter__()
+    return sp
+
+
+def span_end(sp) -> None:
+    """Close a span opened with span_begin (exception annotation is
+    the caller's job via sp.set — an abort skips the close entirely,
+    leaving the span out of the trace rather than lying about it)."""
+    sp.__exit__(None, None, None)
+
+
+def event(name: str, attrs: Optional[dict] = None) -> None:
+    """Instant trace event (fault firings, fallbacks, pool restarts).
+    No-op when tracing is off."""
+    if not _ON:
+        return
+    stack = getattr(_TLS, "stack", None)
+    rec = {
+        "sid": next(_SEQ),
+        "parent": stack[-1] if stack else 0,
+        "name": name,
+        "lane": "events",
+        "ts": time.time() - _EPOCH,
+    }
+    if attrs:
+        rec["attrs"] = attrs
+    with _TRACE_LOCK:
+        _EVENTS.append(rec)
+
+
+# -------------------------------------------- worker span round-trip
+
+def worker_spans(stage_times: List[tuple]) -> List[dict]:
+    """Build the picklable span records an ingest worker ships back
+    with its chunk payload: [(name, wall_start, duration_seconds)].
+    Cheap enough to produce unconditionally — the parent drops them
+    when tracing is off."""
+    import os
+
+    return [
+        {"name": name, "wall0": wall0, "dur": dur, "pid": os.getpid()}
+        for name, wall0, dur in stage_times
+    ]
+
+
+def ingest_worker_spans(spans, chunk: Optional[int] = None) -> None:
+    """Re-anchor worker-shipped span records onto this process's
+    timeline: fresh ids from the parent's monotonic sequence, a
+    per-worker lane, wall-clock ts (shared across processes, so the
+    encode/dispatch overlap is genuinely visible in the trace)."""
+    if not _ON or not spans:
+        return
+    with _TRACE_LOCK:
+        for s in spans:
+            rec = {
+                "sid": next(_SEQ),
+                "parent": 0,
+                "name": s["name"],
+                "lane": f"worker-{s.get('pid', 0)}",
+                "ts": s["wall0"] - _EPOCH,
+                "dur": s["dur"],
+            }
+            attrs = {"worker": True}
+            if chunk is not None:
+                attrs["chunk"] = chunk
+            rec["attrs"] = attrs
+            _TRACE.append(rec)
+            REGISTRY.observe_span(s["name"], s["dur"])
+
+
+# ------------------------------------------------------- export faces
+
+def metrics_snapshot() -> dict:
+    """The schema-versioned metrics snapshot: every counter group,
+    gauge, histogram and span roll-up (`--metrics-out`, the serve
+    `metrics` request, bench)."""
+    return REGISTRY.snapshot()
+
+
+def write_metrics(path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(metrics_snapshot(), f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def trace_events() -> List[dict]:
+    """Chrome trace_event objects for the current buffers (the
+    `traceEvents` list of write_trace, exposed for tests/smokes)."""
+    lanes: "OrderedDict[str, int]" = OrderedDict()
+
+    def tid(lane: str) -> int:
+        if lane not in lanes:
+            lanes[lane] = len(lanes) + 1
+        return lanes[lane]
+
+    with _TRACE_LOCK:
+        spans = sorted(_TRACE, key=lambda s: (s["ts"], s["sid"]))
+        events = sorted(_EVENTS, key=lambda e: (e["ts"], e["sid"]))
+    out = []
+    for s in spans:
+        args = dict(s.get("attrs") or {})
+        args["sid"] = s["sid"]
+        if s["parent"]:
+            args["parent"] = s["parent"]
+        out.append({
+            "name": s["name"],
+            "cat": s["lane"],
+            "ph": "X",
+            "ts": round(max(s["ts"], 0.0) * 1e6, 3),
+            "dur": round(max(s["dur"], 0.0) * 1e6, 3),
+            "pid": 1,
+            "tid": tid(s["lane"]),
+            "args": args,
+        })
+    for e in events:
+        args = dict(e.get("attrs") or {})
+        args["sid"] = e["sid"]
+        out.append({
+            "name": e["name"],
+            "cat": "events",
+            "ph": "i",
+            "s": "g",
+            "ts": round(max(e["ts"], 0.0) * 1e6, 3),
+            "pid": 1,
+            "tid": tid("events"),
+            "args": args,
+        })
+    # metadata: stable lane names + pipeline-ordered sort
+    meta = [{
+        "name": "process_name", "ph": "M", "pid": 1,
+        "args": {"name": "guard-tpu"},
+    }]
+    order = {lane: i for i, lane in enumerate(_LANE_ORDER)}
+    for lane, t in lanes.items():
+        meta.append({
+            "name": "thread_name", "ph": "M", "pid": 1, "tid": t,
+            "args": {"name": lane},
+        })
+        meta.append({
+            "name": "thread_sort_index", "ph": "M", "pid": 1, "tid": t,
+            "args": {"sort_index": order.get(lane, 100 + t)},
+        })
+    return meta + out
+
+
+def write_trace(path: str) -> None:
+    """Chrome trace_event JSON (load in Perfetto / chrome://tracing):
+    one lane per pipeline stage plus per-worker lanes; fault events on
+    an instant-event lane."""
+    doc = {
+        "traceEvents": trace_events(),
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "tool": "guard-tpu",
+            "schema_version": SCHEMA_VERSION,
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f)
+        f.write("\n")
+
+
+def reset_metrics() -> None:
+    """Registry reset (counters/gauges/histograms/roll-ups). The trace
+    buffer is an artifact log, not a stat — reset_trace() is separate
+    so serve's between-request counter resets never eat the session
+    trace."""
+    REGISTRY.reset()

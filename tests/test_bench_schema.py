@@ -14,7 +14,8 @@ import check_bench_schema  # noqa: E402
 
 
 def _newest_artifact():
-    candidates = sorted(REPO.glob("bench_all_*.json"))
+    candidates = sorted(REPO.glob("bench_all_*.json"),
+                        key=check_bench_schema.artifact_order)
     assert candidates, "no committed bench_all_*.json artifact"
     return candidates[-1]
 
@@ -120,3 +121,110 @@ def test_checker_requires_quarantine_keys(tmp_path):
     problems = check_bench_schema.check(doctored)
     assert any("quarantined_docs" in p for p in problems)
     assert any("dispatch_fallbacks" in p for p in problems)
+
+
+def test_expected_metrics_cover_telemetry_rows():
+    """PR 6: the telemetry on/off overhead row pair is part of the
+    driver contract and gated by the schema checker."""
+    metrics = bench.expected_metrics()
+    assert "config5b_telemetry_off_templates_per_sec" in metrics
+    assert "config5b_telemetry_on_templates_per_sec" in metrics
+
+
+def test_checker_requires_telemetry_overhead_keys(tmp_path):
+    """A telemetry-on row that doesn't quantify its overhead against
+    the disabled branch fails the gate."""
+    row = {
+        "metric": "config5b_telemetry_on_templates_per_sec",
+        "value": 1.0,
+        "unit": "templates/sec",
+        "vs_baseline": 1.0,
+        "telemetry": "enabled",
+        # overhead_vs_off / spans_recorded_per_run missing
+    }
+    src = _newest_artifact().read_text().splitlines()
+    doctored = tmp_path / "bench_all_doctored_telemetry.json"
+    doctored.write_text(
+        "\n".join(
+            ln for ln in src
+            if '"config5b_telemetry_on_templates_per_sec"' not in ln
+        )
+        + "\n"
+        + __import__("json").dumps(row)
+        + "\n"
+    )
+    problems = check_bench_schema.check(doctored)
+    assert any("overhead_vs_off" in p for p in problems)
+    assert any("spans_recorded_per_run" in p for p in problems)
+
+
+def test_registry_stage_seconds_reconcile_with_wall_time(tmp_path):
+    """The registry-derived stage decomposition bench.py reports must
+    account for the run it claims to decompose: summing the top-level
+    pipeline stage totals over a serial (workers=0) sweep lands within
+    tolerance of the end-to-end wall time — no stage double-counted
+    past the wall, and the instrumented stages cover the bulk of it."""
+    import json
+    import time
+
+    from guard_tpu.cli import run
+    from guard_tpu.parallel import ingest
+    from guard_tpu.utils import telemetry
+    from guard_tpu.utils.io import Reader, Writer
+
+    rules = tmp_path / "rules.guard"
+    rules.write_text(
+        "let b = Resources.*[ Type == 'AWS::S3::Bucket' ]\n"
+        "rule sse when %b !empty { %b.Properties.Enc == true }\n"
+    )
+    data = tmp_path / "data"
+    data.mkdir()
+    for i in range(24):
+        doc = {
+            "Resources": {
+                "b": {
+                    "Type": "AWS::S3::Bucket",
+                    "Properties": {"Enc": True},
+                }
+            }
+        }
+        (data / f"t{i:02d}.json").write_text(json.dumps(doc))
+
+    def sweep(tag):
+        w = Writer.buffered()
+        rc = run(
+            ["sweep", "-r", str(rules), "-d", str(data),
+             "-M", str(tmp_path / f"{tag}.jsonl"), "-c", "8",
+             "--backend", "tpu", "--ingest-workers", "0"],
+            writer=w, reader=Reader(),
+        )
+        assert rc == 0
+
+    ingest.close_shared_pools()
+    sweep("warm")  # absorb first-touch compile outside the measurement
+    telemetry.enable()
+    telemetry.reset_trace()
+    try:
+        from guard_tpu.ops.backend import reset_all_stats
+
+        reset_all_stats()
+        t0 = time.perf_counter()
+        sweep("measured")
+        wall = time.perf_counter() - t0
+        stage = telemetry.REGISTRY.stage_seconds()
+    finally:
+        telemetry.disable()
+        telemetry.reset_trace()
+    # top-level (non-nested) stage names only: pack_compile nests
+    # inside dispatch, worker stages don't occur at workers=0
+    top = (
+        "rule_parse", "read_parse", "encode", "lower_compile",
+        "dispatch", "collect", "rim_reduce", "report", "oracle",
+    )
+    total = sum(stage.get(name, 0.0) for name in top)
+    assert stage.get("dispatch", 0.0) > 0.0
+    assert stage.get("report", 0.0) > 0.0
+    # stages never sum past the wall (5% slack for timer granularity),
+    # and the instrumented pipeline accounts for most of the run
+    assert total <= wall * 1.05, (total, wall, stage)
+    assert total >= wall * 0.35, (total, wall, stage)

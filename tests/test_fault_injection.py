@@ -350,6 +350,89 @@ def test_serve_error_response_names_exception_class():
     assert resp["error_class"] == "ValueError"
 
 
+def test_serve_metrics_request_returns_live_snapshot():
+    """A {"metrics": true} request answers with the same schema-
+    versioned snapshot --metrics-out writes, including the persistent
+    per-request latency histogram covering the preceding requests."""
+    from guard_tpu.utils import telemetry
+
+    telemetry.REGISTRY.reset(include_persistent=True)
+    w = Writer.buffered()
+    reqs = [
+        json.dumps({"rules": ["rule ok { a exists }"],
+                    "data": ['{"a": 1}']}),
+        json.dumps({"metrics": True}),
+    ]
+    rc = run(["serve", "--stdio"], writer=w,
+             reader=Reader.from_string("\n".join(reqs) + "\n"))
+    assert rc == 0
+    resps = [json.loads(l) for l in w.out.getvalue().splitlines()
+             if l.strip()]
+    assert resps[0]["code"] == 0
+    m = resps[1]
+    assert m["code"] == 0
+    snap = m["metrics"]
+    assert snap["schema_version"] == telemetry.SCHEMA_VERSION
+    for section in ("counters", "gauges", "histograms", "spans"):
+        assert section in snap
+    # the latency histogram is persistent: the validate request's
+    # reset_all_stats switch must not have erased it
+    lat = snap["histograms"]["serve_request_seconds"]
+    assert lat["count"] == 1  # the validate request before this one
+    assert lat["p50_seconds"] is not None
+    telemetry.REGISTRY.reset(include_persistent=True)
+
+
+def test_serve_timeout_leaves_annotated_span_and_counters(monkeypatch):
+    """The failure plane is faithful in the trace: a timed-out request
+    leaves a serve_request span annotated RequestTimeout, and the
+    persistent latency histogram still counts the abandoned request."""
+    import time
+
+    from guard_tpu.commands import validate as validate_mod
+    from guard_tpu.utils import telemetry
+
+    real_execute = validate_mod.Validate.execute
+
+    def slow_execute(self, writer, reader):
+        if self.verbose:
+            time.sleep(1.0)
+            return 0
+        return real_execute(self, writer, reader)
+
+    monkeypatch.setattr(validate_mod.Validate, "execute", slow_execute)
+    monkeypatch.setenv("GUARD_TPU_SERVE_TIMEOUT", "0.2")
+    telemetry.REGISTRY.reset(include_persistent=True)
+    telemetry.enable()
+    telemetry.reset_trace()
+    try:
+        w = Writer.buffered()
+        reqs = [
+            json.dumps({"rules": ["rule ok { a exists }"],
+                        "data": ['{"a": 1}'], "verbose": True}),
+            json.dumps({"rules": ["rule ok { a exists }"],
+                        "data": ['{"a": 1}']}),
+        ]
+        rc = run(["serve", "--stdio"], writer=w,
+                 reader=Reader.from_string("\n".join(reqs) + "\n"))
+        assert rc == 0
+        spans = [r for r in telemetry._TRACE
+                 if r["name"] == "serve_request"]
+        assert len(spans) == 2
+        timed_out = [r for r in spans
+                     if r.get("attrs", {}).get("error_class")
+                     == "RequestTimeout"]
+        assert len(timed_out) == 1
+        # counters survive the abandoned worker thread: both requests
+        # (the timed-out one included) landed in the latency histogram
+        lat = telemetry.REGISTRY.histogram("serve_request_seconds")
+        assert lat.count == 2
+    finally:
+        telemetry.disable()
+        telemetry.reset_trace()
+        telemetry.REGISTRY.reset(include_persistent=True)
+
+
 # ------------------------------------------ spawn-probe failure cache
 
 

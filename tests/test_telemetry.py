@@ -1,0 +1,354 @@
+"""Telemetry-plane suite (guard_tpu/utils/telemetry.py): span
+nesting/attribute correctness, the disabled-mode zero-allocation path,
+Chrome trace_event JSON well-formedness, the worker-span round-trip
+through the spawn ingest pool, and parity — the `--trace-out` /
+`--metrics-out` export flags must leave report bytes and exit codes
+bit-identical across worker counts and pack modes. Observability may
+cost microseconds, never output."""
+
+import json
+import pathlib
+import pickle
+import sys
+
+import pytest
+
+from guard_tpu.cli import run
+from guard_tpu.parallel import ingest
+from guard_tpu.utils import telemetry
+from guard_tpu.utils.io import Reader, Writer
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+
+from check_metrics_schema import check_snapshot  # noqa: E402
+
+RULES = (
+    "let b = Resources.*[ Type == 'AWS::S3::Bucket' ]\n"
+    "rule sse when %b !empty { %b.Properties.Enc == true }\n"
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Every test starts and ends with tracing off, empty buffers and
+    a fully zeroed registry (persistent histograms included)."""
+    telemetry.disable()
+    telemetry.reset_trace()
+    telemetry.REGISTRY.reset(include_persistent=True)
+    yield
+    telemetry.disable()
+    telemetry.reset_trace()
+    telemetry.REGISTRY.reset(include_persistent=True)
+
+
+def _mk_corpus(tmp_path, n=8, fail=(2,)):
+    rules = tmp_path / "rules.guard"
+    rules.write_text(RULES)
+    data = tmp_path / "data"
+    data.mkdir(exist_ok=True)
+    for i in range(n):
+        doc = {
+            "Resources": {
+                "b": {
+                    "Type": "AWS::S3::Bucket",
+                    "Properties": {"Enc": i not in fail},
+                }
+            }
+        }
+        (data / f"t{i:02d}.json").write_text(json.dumps(doc))
+    return rules, data
+
+
+# ------------------------------------------------------ span semantics
+
+
+def test_span_nesting_links_parent_and_keeps_attrs():
+    telemetry.enable()
+    telemetry.reset_trace()
+    with telemetry.span("dispatch", {"files": 3}):
+        with telemetry.span("pack_compile"):
+            pass
+    # inner span finishes (and is recorded) first
+    assert [r["name"] for r in telemetry._TRACE] == [
+        "pack_compile", "dispatch",
+    ]
+    inner, outer = telemetry._TRACE
+    assert inner["parent"] == outer["sid"]
+    assert outer["parent"] == 0
+    assert outer["attrs"] == {"files": 3}
+    assert outer["lane"] == "dispatch"
+    assert inner["lane"] == "rules"
+    rolls = telemetry.REGISTRY.span_rollups()
+    assert rolls["dispatch"]["count"] == 1
+    assert rolls["pack_compile"]["count"] == 1
+    # completed spans also feed the per-stage histogram
+    assert telemetry.REGISTRY.histogram("stage.dispatch").count == 1
+
+
+def test_span_ids_are_monotonic_and_deterministic():
+    telemetry.enable()
+    telemetry.reset_trace()
+    for _ in range(5):
+        with telemetry.span("report"):
+            pass
+    sids = [r["sid"] for r in telemetry._TRACE]
+    assert sids == sorted(sids)
+    assert len(set(sids)) == 5
+
+
+def test_span_annotates_error_class_on_exception():
+    telemetry.enable()
+    telemetry.reset_trace()
+    with pytest.raises(ValueError):
+        with telemetry.span("oracle"):
+            raise ValueError("boom")
+    (rec,) = telemetry._TRACE
+    assert rec["attrs"]["error_class"] == "ValueError"
+
+
+def test_span_begin_end_records_like_with_block():
+    telemetry.enable()
+    telemetry.reset_trace()
+    sp = telemetry.span_begin("serve_request")
+    sp.set("error_class", "RequestTimeout")
+    telemetry.span_end(sp)
+    (rec,) = telemetry._TRACE
+    assert rec["name"] == "serve_request"
+    assert rec["lane"] == "serve"
+    assert rec["attrs"]["error_class"] == "RequestTimeout"
+
+
+# -------------------------------------------------- disabled-mode cost
+
+
+def test_disabled_span_is_the_shared_noop_singleton():
+    sp = telemetry.span("dispatch", {"files": 3})
+    # no allocation: every disabled span() IS the same object
+    assert sp is telemetry.span("encode")
+    assert sp is telemetry._NOOP
+    assert telemetry.span_begin("rim_reduce") is telemetry._NOOP
+    with sp:
+        sp.set("key", "value")
+    telemetry.span_end(telemetry.span_begin("report"))
+    telemetry.event("fault.retries")
+    assert telemetry._TRACE == []
+    assert telemetry._EVENTS == []
+    assert telemetry.REGISTRY.span_rollups() == {}
+
+
+def test_evented_counters_emit_instant_events_only_when_on():
+    c = telemetry.EventedCounters("fault", {"retries": 0})
+    c["retries"] += 1  # tracing off: plain dict semantics
+    assert telemetry._EVENTS == []
+    telemetry.enable()
+    telemetry.reset_trace()
+    c["retries"] += 1
+    assert [e["name"] for e in telemetry._EVENTS] == ["fault.retries"]
+    c["retries"] = 0  # resets/decreases never produce events
+    assert len(telemetry._EVENTS) == 1
+
+
+# ---------------------------------------------- registry + histograms
+
+
+def test_histogram_buckets_sum_and_quantiles_order():
+    h = telemetry.Histogram("t")
+    for v in (0.001, 0.002, 0.004, 1.5):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert sum(snap["buckets"].values()) == 4
+    assert snap["min_seconds"] == 0.001
+    assert snap["max_seconds"] == 1.5
+    assert snap["p50_seconds"] is not None
+    assert snap["p50_seconds"] <= snap["p99_seconds"]
+    # non-positive durations land in the underflow bucket, not a crash
+    h.observe(0.0)
+    assert h.counts[0] == 1
+
+
+def test_persistent_histogram_survives_plain_reset():
+    h = telemetry.REGISTRY.histogram("serve_request_seconds",
+                                     persistent=True)
+    h.observe(0.1)
+    telemetry.REGISTRY.reset()
+    assert telemetry.REGISTRY.histogram("serve_request_seconds").count == 1
+    telemetry.REGISTRY.reset(include_persistent=True)
+    assert telemetry.REGISTRY.histogram("serve_request_seconds").count == 0
+
+
+def test_reset_all_stats_clears_every_plane_at_once():
+    from guard_tpu.ops import backend
+    from guard_tpu.utils import faults
+
+    telemetry.enable()
+    telemetry.reset_trace()
+    with telemetry.span("dispatch"):
+        pass
+    faults.FAULT_COUNTERS["retries"] += 1
+    backend.RIM_COUNTERS["docs_materialized"] += 7
+    telemetry.REGISTRY.set_gauge("g", 1.0)
+    backend.reset_all_stats()
+    assert faults.FAULT_COUNTERS["retries"] == 0
+    assert backend.RIM_COUNTERS["docs_materialized"] == 0
+    assert telemetry.REGISTRY.span_rollups() == {}
+    assert telemetry.REGISTRY.snapshot()["gauges"] == {}
+    # the trace buffer is an artifact log, not a stat: it survives
+    assert len(telemetry._TRACE) == 1
+
+
+def test_metrics_snapshot_passes_schema_checker():
+    from guard_tpu.utils import faults  # registers the fault group
+
+    telemetry.enable()
+    telemetry.reset_trace()
+    faults.FAULT_COUNTERS["retries"] += 1
+    with telemetry.span("rim_reduce"):
+        pass
+    snap = telemetry.metrics_snapshot()
+    assert check_snapshot(snap, require_groups=("fault",)) == []
+    # and the checker actually bites: a doctored histogram count fails
+    snap["histograms"]["stage.rim_reduce"]["count"] += 1
+    assert check_snapshot(snap)
+
+
+# -------------------------------------------------- trace export face
+
+
+def test_trace_event_json_is_well_formed(tmp_path):
+    telemetry.enable()
+    telemetry.reset_trace()
+    with telemetry.span("rule_parse", {"files": 1}):
+        pass
+    with telemetry.span("dispatch"):
+        with telemetry.span("pack_compile"):
+            pass
+    telemetry.event("fault.retries", {"value": 1})
+    path = tmp_path / "trace.json"
+    telemetry.write_trace(str(path))
+    doc = json.loads(path.read_text())
+    assert doc["otherData"]["schema_version"] == telemetry.SCHEMA_VERSION
+    evs = doc["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == 3
+    for e in xs:
+        assert e["pid"] == 1
+        assert e["ts"] >= 0 and e["dur"] >= 0
+        assert "sid" in e["args"]
+    # ts monotonic non-decreasing within every lane
+    by_tid = {}
+    for e in xs:
+        by_tid.setdefault(e["tid"], []).append(e["ts"])
+    for ts_list in by_tid.values():
+        assert ts_list == sorted(ts_list)
+    # instant events carry the global scope marker
+    (inst,) = [e for e in evs if e["ph"] == "i"]
+    assert inst["s"] == "g"
+    assert inst["name"] == "fault.retries"
+    # every used tid has thread_name metadata
+    named = {e["tid"] for e in evs if e.get("name") == "thread_name"}
+    used = {e["tid"] for e in evs if e["ph"] in ("X", "i")}
+    assert used <= named
+    # nesting is preserved through export: the child names its parent
+    child = next(e for e in xs if e["name"] == "pack_compile")
+    parent = next(e for e in xs if e["name"] == "dispatch")
+    assert child["args"]["parent"] == parent["args"]["sid"]
+
+
+# ------------------------------------------- worker span round-trips
+
+
+def test_worker_span_records_survive_pickle_and_reanchor():
+    recs = telemetry.worker_spans([
+        ("read_parse", 122.5, 0.4),
+        ("encode", 122.9, 0.5),
+    ])
+    recs = pickle.loads(pickle.dumps(recs))  # the pool boundary
+    telemetry.enable()
+    telemetry.reset_trace()
+    telemetry.ingest_worker_spans(recs, chunk=3)
+    assert len(telemetry._TRACE) == 2
+    lanes = {r["lane"] for r in telemetry._TRACE}
+    assert len(lanes) == 1 and next(iter(lanes)).startswith("worker-")
+    assert all(r["attrs"]["chunk"] == 3 for r in telemetry._TRACE)
+    rolls = telemetry.REGISTRY.span_rollups()
+    assert rolls["read_parse"]["count"] == 1
+    assert rolls["encode"]["count"] == 1
+    # dropped without tracing (parent-side single branch)
+    telemetry.disable()
+    telemetry.ingest_worker_spans(recs, chunk=4)
+    assert len(telemetry._TRACE) == 2
+
+
+def test_worker_spans_round_trip_through_spawn_pool(tmp_path):
+    ingest.close_shared_pools()
+    try:
+        rules, data = _mk_corpus(tmp_path, n=48, fail=())
+        trace = tmp_path / "trace.json"
+        w = Writer.buffered()
+        rc = run(
+            ["sweep", "-r", str(rules), "-d", str(data),
+             "-M", str(tmp_path / "m.jsonl"), "-c", "8",
+             "--backend", "tpu", "--ingest-workers", "2",
+             "--trace-out", str(trace)],
+            writer=w, reader=Reader(),
+        )
+        assert rc == 0
+        if "worker pool unavailable" in w.err.getvalue():
+            pytest.skip("spawn pool unavailable in this environment")
+        doc = json.loads(trace.read_text())
+        evs = doc["traceEvents"]
+        lane_of = {
+            e["tid"]: e["args"]["name"]
+            for e in evs if e.get("name") == "thread_name"
+        }
+        wspans = [
+            e for e in evs
+            if e["ph"] == "X"
+            and lane_of.get(e["tid"], "").startswith("worker-")
+        ]
+        assert wspans, "no worker-lane spans made it back to the trace"
+        assert {"read_parse", "encode"} <= {e["name"] for e in wspans}
+        assert all(e["args"].get("worker") for e in wspans)
+    finally:
+        ingest.close_shared_pools()
+
+
+# ------------------------------------------------------- parity gates
+
+
+def _validate(rules, data, *extra):
+    w = Writer.buffered()
+    rc = run(
+        ["validate", "-r", str(rules), "-d", str(data),
+         "--backend", "tpu", *extra],
+        writer=w, reader=Reader(),
+    )
+    return rc, w.out.getvalue()
+
+
+@pytest.mark.parametrize("workers", [0, 2])
+@pytest.mark.parametrize("pack", [(), ("--no-pack",)])
+def test_export_flags_leave_report_bytes_identical(tmp_path, workers,
+                                                   pack):
+    ingest.close_shared_pools()
+    try:
+        rules, data = _mk_corpus(tmp_path, n=8, fail=(2, 5))
+        common = ("--ingest-workers", str(workers), *pack)
+        base_rc, base_out = _validate(rules, data, *common)
+        trace = tmp_path / "trace.json"
+        metrics = tmp_path / "metrics.json"
+        rc, out = _validate(
+            rules, data, *common,
+            "--trace-out", str(trace), "--metrics-out", str(metrics),
+        )
+        assert (rc, out) == (base_rc, base_out)
+        # the exports themselves are well-formed
+        json.loads(trace.read_text())
+        snap = json.loads(metrics.read_text())
+        assert snap["schema_version"] == telemetry.SCHEMA_VERSION
+        assert check_snapshot(snap) == []
+        # and tracing was switched back off by the CLI exit path
+        assert not telemetry.enabled()
+    finally:
+        ingest.close_shared_pools()

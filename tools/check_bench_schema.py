@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import pathlib
+import re
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
@@ -46,6 +47,13 @@ METRIC_REQUIRED_KEYS = {
     ),
     "config5b_rim_scalar_docs_per_sec": (
         "docs_materialized", "rim_seconds_per_run",
+    ),
+    # PR 6 telemetry plane: the on row must quantify what enabled
+    # tracing costs against the disabled branch on the same packed
+    # dispatch, and say how many spans one traced run records
+    "config5b_telemetry_off_templates_per_sec": ("telemetry",),
+    "config5b_telemetry_on_templates_per_sec": (
+        "telemetry", "overhead_vs_off", "spans_recorded_per_run",
     ),
     # PR 5 failure plane: the clean row must quantify the always-on
     # quarantine plumbing's cost against fail-fast semantics, and the
@@ -113,11 +121,19 @@ def check(path: pathlib.Path) -> list:
     return problems
 
 
+def artifact_order(p: pathlib.Path):
+    """Sort key for bench_all_rN.json: numeric round, not lexical
+    (r10 comes after r9, not between r1 and r2)."""
+    m = re.search(r"(\d+)", p.stem)
+    return (int(m.group(1)) if m else -1, p.stem)
+
+
 def main(argv: list) -> int:
     if argv:
         paths = [pathlib.Path(a) for a in argv]
     else:
-        candidates = sorted(REPO.glob("bench_all_*.json"))
+        candidates = sorted(REPO.glob("bench_all_*.json"),
+                            key=artifact_order)
         if not candidates:
             print("no bench_all_*.json artifact found", file=sys.stderr)
             return 1

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Metrics-snapshot schema gate: validate a `--metrics-out` document
+(or the `serve --stdio` metrics response body) against the telemetry
+plane's published shape.
+
+The snapshot is the machine face of `guard_tpu.utils.telemetry` — the
+thing dashboards and the CI trace-smoke consume — so its shape is a
+contract: a schema_version pin, the four absorbed counter groups with
+integer-or-float counter values, histograms whose bucket counts sum to
+their `count`, and span roll-ups carrying count + total_seconds.
+
+Usage:
+    python tools/check_metrics_schema.py snapshot.json [...]
+
+Importable: `check_snapshot(doc) -> [problems]` (empty = valid), used
+by bench.py --trace-smoke and tests/test_telemetry.py.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+#: the schema_version this checker understands (mirrors
+#: guard_tpu.utils.telemetry.SCHEMA_VERSION; imported lazily in main
+#: so the checker also runs standalone against committed artifacts)
+KNOWN_SCHEMA_VERSION = 1
+
+#: top-level sections every snapshot must carry
+SECTIONS = ("schema_version", "counters", "gauges", "histograms", "spans")
+
+#: counter groups a full tpu-backend run registers. Groups register at
+#: module import, and a jax-free session (cpu validate, serve) never
+#: imports parallel.mesh — so dispatch/pipeline can be legitimately
+#: absent; callers that ran the full pipeline pass these as
+#: `require_groups` (the CI trace-smoke does)
+EXPECTED_GROUPS = ("dispatch", "pipeline", "rim", "fault")
+
+#: keys every histogram snapshot must carry
+HIST_KEYS = (
+    "count", "total_seconds", "min_seconds", "max_seconds",
+    "p50_seconds", "p99_seconds", "buckets",
+)
+
+
+def check_snapshot(doc, require_groups: tuple = ()) -> list:
+    """Validate one parsed snapshot document; returns a list of
+    problem strings (empty when the snapshot is schema-valid).
+    `require_groups` names counter groups that MUST be present (pass
+    EXPECTED_GROUPS after a full tpu-backend run)."""
+    problems = []
+    if not isinstance(doc, dict):
+        return ["snapshot is not a JSON object"]
+    for k in SECTIONS:
+        if k not in doc:
+            problems.append(f"missing top-level section {k!r}")
+    if problems:
+        return problems
+    if doc["schema_version"] != KNOWN_SCHEMA_VERSION:
+        problems.append(
+            f"schema_version {doc['schema_version']!r} != "
+            f"{KNOWN_SCHEMA_VERSION} (checker out of date, or snapshot "
+            "from a different telemetry plane)"
+        )
+    counters = doc["counters"]
+    if not isinstance(counters, dict):
+        problems.append("`counters` is not an object")
+    else:
+        for g in require_groups:
+            if g not in counters:
+                problems.append(f"missing counter group {g!r}")
+        for g, vals in counters.items():
+            if not isinstance(vals, dict):
+                problems.append(f"counter group {g!r} is not an object")
+                continue
+            for k, v in vals.items():
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    problems.append(
+                        f"counter {g}.{k} has non-numeric value {v!r}"
+                    )
+    if not isinstance(doc["gauges"], dict):
+        problems.append("`gauges` is not an object")
+    hists = doc["histograms"]
+    if not isinstance(hists, dict):
+        problems.append("`histograms` is not an object")
+        hists = {}
+    for name, h in hists.items():
+        if not isinstance(h, dict):
+            problems.append(f"histogram {name!r} is not an object")
+            continue
+        for k in HIST_KEYS:
+            if k not in h:
+                problems.append(f"histogram {name!r} missing key {k!r}")
+        if not isinstance(h.get("count"), int):
+            problems.append(f"histogram {name!r} count is not an int")
+            continue
+        buckets = h.get("buckets")
+        if not isinstance(buckets, dict):
+            problems.append(f"histogram {name!r} buckets is not an object")
+            continue
+        total = sum(buckets.values())
+        if total != h["count"]:
+            problems.append(
+                f"histogram {name!r}: bucket counts sum to {total}, "
+                f"count says {h['count']}"
+            )
+        if h["count"] > 0 and h.get("p50_seconds") is None:
+            problems.append(
+                f"histogram {name!r}: count > 0 but p50_seconds is null"
+            )
+    spans = doc["spans"]
+    if not isinstance(spans, dict):
+        problems.append("`spans` is not an object")
+        spans = {}
+    for name, roll in spans.items():
+        if (
+            not isinstance(roll, dict)
+            or not isinstance(roll.get("count"), int)
+            or not isinstance(roll.get("total_seconds"), (int, float))
+        ):
+            problems.append(
+                f"span roll-up {name!r} must carry int `count` and "
+                "numeric `total_seconds`"
+            )
+            continue
+        # every span roll-up has a matching per-stage histogram whose
+        # count agrees (observe_span feeds both under one call)
+        h = hists.get(f"stage.{name}")
+        if h is None:
+            problems.append(
+                f"span roll-up {name!r} has no stage.{name} histogram"
+            )
+        elif isinstance(h, dict) and h.get("count") != roll["count"]:
+            problems.append(
+                f"span {name!r}: roll-up count {roll['count']} != "
+                f"stage histogram count {h.get('count')}"
+            )
+    return problems
+
+
+def main(argv: list) -> int:
+    if not argv:
+        print("usage: check_metrics_schema.py snapshot.json [...]",
+              file=sys.stderr)
+        return 2
+    rc = 0
+    for a in argv:
+        path = pathlib.Path(a)
+        if not path.exists():
+            print(f"{path}: does not exist", file=sys.stderr)
+            rc = 1
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except json.JSONDecodeError as e:
+            print(f"{path}: unparseable JSON ({e})", file=sys.stderr)
+            rc = 1
+            continue
+        problems = check_snapshot(doc)
+        if problems:
+            rc = 1
+            for p in problems:
+                print(f"{path}: {p}", file=sys.stderr)
+        else:
+            print(f"{path}: ok (schema_version "
+                  f"{doc['schema_version']})")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
