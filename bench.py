@@ -1108,6 +1108,249 @@ def ingest_smoke(n_docs: int = 64, chunk_size: int = 16) -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def measure_plan_cache(corpus: str = "registry", n_docs: int = 1024,
+                       chunk_size: int = 64, reps: int = 2):
+    """The compiled-plan artifact layer's three regimes on the full
+    production sweep flow: `cold` is the pre-plan baseline — the
+    `--no-plan-cache` legacy path that re-lowers the whole registry
+    from rule bytes on EVERY chunk; `warm` reuses the in-process plan
+    memo (the steady-state sweep: every chunk relocates instead of
+    re-lowering, only fn-var slow files still compile per chunk); and
+    `restart` simulates a fresh process against a persisted artifact
+    dir (memo cleared per rep, disk artifact kept: zero
+    compile_rules_file passes, one pickle load). The chunk size is
+    deliberately small — the registry sweep's chunk-bound regime,
+    where the per-chunk re-lowering the plan layer deletes dominates
+    (PR 3's decomposition). XLA executables are pre-traced outside all
+    three phases, so the deltas isolate the lowering/packing plane,
+    not compilation. Extras carry the per-run stage decomposition
+    (lower/pack/relocate/load/save seconds from the span roll-ups)
+    and the plan_cache counters. Returns (cold, warm, restart) as
+    (docs_per_sec, extras) pairs."""
+    import pathlib
+    import shutil
+    import tempfile
+
+    from guard_tpu.commands.sweep import Sweep
+    from guard_tpu.ops.plan import clear_plan_memo, plan_stats
+    from guard_tpu.utils import telemetry
+    from guard_tpu.utils.io import Reader, Writer
+
+    tmp = tempfile.mkdtemp(prefix=f"guard_plan_{corpus}_")
+    plan_dir = pathlib.Path(tmp) / "plans"
+    prev_dir = os.environ.get("GUARD_TPU_PLAN_CACHE_DIR")
+    os.environ["GUARD_TPU_PLAN_CACHE_DIR"] = str(plan_dir)
+    try:
+        docdir, rules = _write_ingest_corpus(tmp, corpus, n_docs)
+
+        def run_once(tag: str, plan: bool) -> int:
+            cmd = Sweep(
+                rules=[rules],
+                data=[docdir],
+                manifest=str(pathlib.Path(tmp) / f"m-{tag}.jsonl"),
+                chunk_size=chunk_size,
+                backend="tpu",
+                plan_cache=plan,
+            )
+            return cmd.execute(Writer.buffered(), Reader.from_string(""))
+
+        # XLA trace/compile outside the phases; plan=True also
+        # populates the memo + artifact the warm/restart phases use.
+        # Earlier measures may have planned the byte-identical registry
+        # already (file names are excluded from the key) — clear the
+        # memo so pretrace actually builds and PERSISTS into this
+        # phase's plan dir instead of memo-hitting past the save
+        clear_plan_memo()
+        run_once("pretrace", plan=True)
+        n_chunks = (n_docs + chunk_size - 1) // chunk_size
+
+        def phase(tag: str, plan: bool, before_rep) -> tuple:
+            _reset_stats()
+            telemetry.enable()
+            telemetry.reset_trace()
+            t0 = time.perf_counter()
+            for r in range(reps):
+                # per-rep setup (cache clearing) happens OFF the clock:
+                # the phases time the sweep, not the memo reset
+                t_pause = time.perf_counter()
+                before_rep()
+                t0 += time.perf_counter() - t_pause
+                run_once(f"{tag}-r{r}", plan)
+            elapsed = time.perf_counter() - t0
+            stage = telemetry.REGISTRY.stage_seconds()
+            telemetry.disable()
+            stats = plan_stats()
+            extra = {
+                "chunks_per_run": n_chunks,
+                "lower_compile_seconds_per_run": round(
+                    stage.get("lower_compile", 0.0) / reps, 4
+                ),
+                "pack_compile_seconds_per_run": round(
+                    stage.get("pack_compile", 0.0) / reps, 4
+                ),
+                "relocate_seconds_per_run": round(
+                    stage.get("relocate", 0.0) / reps, 4
+                ),
+                "plan_load_seconds_per_run": round(
+                    stage.get("load_plan", 0.0) / reps, 4
+                ),
+                "plan_save_seconds_per_run": round(
+                    stage.get("save_plan", 0.0) / reps, 4
+                ),
+                "plan_hits": stats["hits"],
+                "plan_misses": stats["misses"],
+                "plan_relocations": stats["relocations"],
+                "plan_bytes_loaded": stats["bytes_loaded"],
+            }
+            return n_docs * reps / elapsed, extra
+
+        cold = phase("cold", False, lambda: None)
+        warm = phase("warm", True, lambda: None)
+        restart = phase("restart", True, clear_plan_memo)
+        return cold, warm, restart
+    finally:
+        if prev_dir is None:
+            os.environ.pop("GUARD_TPU_PLAN_CACHE_DIR", None)
+        else:
+            os.environ["GUARD_TPU_PLAN_CACHE_DIR"] = prev_dir
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def plan_smoke(n_docs: int = 64, chunk_size: int = 16) -> None:
+    """CI plan-smoke (JAX_PLATFORMS=cpu): the compiled-plan artifact
+    layer must (1) build + persist exactly one artifact on a cold
+    sweep, (2) serve the second in-process run from the memo with
+    hits > 0 and ZERO lower_compile/pack_compile seconds, (3) stay
+    BIT-IDENTICAL to `--no-plan-cache` — summary JSON, stderr, exit
+    code — (4) perform zero lowering passes on a simulated process
+    restart against the persisted artifact, and (5) degrade a
+    corrupted artifact to a logged miss, never an error. Prints one
+    JSON line; SystemExit(1) on violation."""
+    import json as _json
+    import logging as _logging
+    import pathlib
+    import shutil
+    import tempfile
+
+    from guard_tpu.commands.sweep import Sweep
+    from guard_tpu.ops.plan import clear_plan_memo, plan_stats
+    from guard_tpu.utils import telemetry
+    from guard_tpu.utils.io import Reader, Writer
+
+    tmp = tempfile.mkdtemp(prefix="guard_plan_smoke_")
+    plan_dir = pathlib.Path(tmp) / "plans"
+    prev_dir = os.environ.get("GUARD_TPU_PLAN_CACHE_DIR")
+    os.environ["GUARD_TPU_PLAN_CACHE_DIR"] = str(plan_dir)
+    try:
+        # the failheavy 4-rule set has no fn-var files, so a warm run
+        # must show literally zero lowering (the registry corpus keeps
+        # its fn-var slow files, measured in the bench rows instead)
+        docdir, rules = _write_ingest_corpus(tmp, "failheavy", n_docs)
+
+        def run_sweep(tag: str, plan: bool):
+            w = Writer.buffered()
+            cmd = Sweep(
+                rules=[rules],
+                data=[docdir],
+                manifest=str(pathlib.Path(tmp) / f"m-{tag}.jsonl"),
+                chunk_size=chunk_size,
+                backend="tpu",
+                plan_cache=plan,
+            )
+            rc = cmd.execute(w, Reader.from_string(""))
+            summary = _json.loads(
+                w.out.getvalue().strip().splitlines()[-1]
+            )
+            summary.pop("manifest")
+            return rc, summary, w.err.getvalue()
+
+        _reset_stats()
+        cold = run_sweep("cold", True)
+        s_cold = plan_stats()
+
+        _reset_stats()
+        telemetry.enable()
+        telemetry.reset_trace()
+        warm = run_sweep("warm", True)
+        stage = telemetry.REGISTRY.stage_seconds()
+        telemetry.disable()
+        s_warm = plan_stats()
+
+        legacy = run_sweep("legacy", False)
+
+        # simulated restart: memo gone, artifact on disk
+        clear_plan_memo()
+        _reset_stats()
+        restart = run_sweep("restart", True)
+        s_restart = plan_stats()
+
+        # corrupted artifact: degrades to a logged miss + rebuild
+        warned = []
+
+        class _Catch(_logging.Handler):
+            def emit(self, record):
+                warned.append(record.getMessage())
+
+        artifacts = list(plan_dir.glob("*.plan"))
+        for art in artifacts:
+            art.write_bytes(b"\x00 torn write, not a pickle")
+        clear_plan_memo()
+        _reset_stats()
+        h = _Catch(level=_logging.WARNING)
+        _logging.getLogger("guard_tpu.plan").addHandler(h)
+        try:
+            corrupt = run_sweep("corrupt", True)
+        finally:
+            _logging.getLogger("guard_tpu.plan").removeHandler(h)
+        s_corrupt = plan_stats()
+
+        parity = cold == warm == legacy == restart == corrupt
+        record = {
+            "metric": "plan_smoke",
+            "docs": n_docs,
+            "chunks": (n_docs + chunk_size - 1) // chunk_size,
+            "parity": parity,
+            "artifacts_saved_cold": s_cold["artifacts_saved"],
+            "warm_hits": s_warm["hits"],
+            "warm_misses": s_warm["misses"],
+            "warm_lower_compile_seconds": round(
+                stage.get("lower_compile", 0.0), 6
+            ),
+            "warm_pack_compile_seconds": round(
+                stage.get("pack_compile", 0.0), 6
+            ),
+            "restart_hits": s_restart["hits"],
+            "restart_bytes_loaded": s_restart["bytes_loaded"],
+            "corrupt_misses": s_corrupt["misses"],
+            "corrupt_warned": bool(warned),
+        }
+        print(_json.dumps(record), flush=True)
+        ok = (
+            parity
+            and s_cold["misses"] == 1
+            and s_cold["artifacts_saved"] == 1
+            and len(artifacts) == 1
+            and s_warm["hits"] > 0
+            and s_warm["misses"] == 0
+            and stage.get("lower_compile", 0.0) == 0.0
+            and stage.get("pack_compile", 0.0) == 0.0
+            and s_restart["hits"] > 0
+            and s_restart["misses"] == 0
+            and s_restart["bytes_loaded"] > 0
+            and s_corrupt["misses"] == 1
+            and s_corrupt["bytes_loaded"] == 0
+            and bool(warned)
+        )
+        if not ok:
+            raise SystemExit(1)
+    finally:
+        if prev_dir is None:
+            os.environ.pop("GUARD_TPU_PLAN_CACHE_DIR", None)
+        else:
+            os.environ["GUARD_TPU_PLAN_CACHE_DIR"] = prev_dir
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def measure_quarantine(n_docs: int = 1024, chunk_size: int = 256,
                        reps: int = 3, n_poison: int = 8):
     """The failure plane's overhead contract: the always-on quarantine
@@ -1497,6 +1740,12 @@ def trace_smoke(n_docs: int = 160, chunk_size: int = 16,
     from check_metrics_schema import EXPECTED_GROUPS, check_snapshot
 
     tmp = tempfile.mkdtemp(prefix="guard_trace_smoke_")
+    # the smoke's own plan dir: a stale/corrupt artifact under the
+    # operator's ~/.cache must not change what this smoke observes
+    prev_plan_dir = os.environ.get("GUARD_TPU_PLAN_CACHE_DIR")
+    os.environ["GUARD_TPU_PLAN_CACHE_DIR"] = str(
+        pathlib.Path(tmp) / "plans"
+    )
     try:
         def run(corpus: str, tag: str, nd: int, cs: int,
                 flags: tuple = ()):
@@ -1605,6 +1854,10 @@ def trace_smoke(n_docs: int = 160, chunk_size: int = 16,
         if not ok:
             raise SystemExit(1)
     finally:
+        if prev_plan_dir is None:
+            os.environ.pop("GUARD_TPU_PLAN_CACHE_DIR", None)
+        else:
+            os.environ["GUARD_TPU_PLAN_CACHE_DIR"] = prev_plan_dir
         shutil.rmtree(tmp, ignore_errors=True)
 
 
@@ -1793,6 +2046,9 @@ def expected_metrics() -> list:
         "config6_ingest_workers2_docs_per_sec",
         "config5b_quarantine_clean_templates_per_sec",
         "config5b_quarantine_degraded_templates_per_sec",
+        "config5b_plan_cold_templates_per_sec",
+        "config5b_plan_warm_templates_per_sec",
+        "config5b_plan_restart_templates_per_sec",
         "config5c_rule_sharded_templates_per_sec",
     ]
     for tag in ("50pct", "allfail"):
@@ -1831,6 +2087,17 @@ def main() -> None:
 
         _honor_platform_env()
         trace_smoke()
+        return
+    if "--plan-smoke" in sys.argv:
+        # CI smoke for the compiled-plan artifact layer: cold build +
+        # persist, warm memo hits with zero lowering seconds, restart
+        # from the disk artifact with zero compile passes, corrupted
+        # artifact degrading to a logged miss — all bit-identical to
+        # --no-plan-cache
+        from guard_tpu.ops.backend import _honor_platform_env
+
+        _honor_platform_env()
+        plan_smoke()
         return
     if "--chaos-smoke" in sys.argv:
         # CI smoke for the failure plane: injected worker crash +
@@ -2042,6 +2309,39 @@ def main() -> None:
         extra={
             **x_qd,
             "vs_note": "vs_baseline here = degraded-run throughput over the clean quarantine run on the same corpus (poisoned docs + injected dispatch fault)",
+        },
+    )
+
+    # config 5b plan artifact layer: the registry sweep's lowering
+    # plane under the three cache regimes — cold (re-lower from rule
+    # bytes each run, the pre-plan cost), warm (in-process memo: every
+    # chunk after the first relocates instead of re-lowering) and
+    # restart (fresh process against the persisted artifact: zero
+    # compile_rules_file passes). The stage-seconds extras decompose
+    # where each regime spends its host time
+    (v_pc, x_pc), (v_pw, x_pw), (v_pr, x_pr) = measure_plan_cache()
+    _emit(
+        "config5b_plan_cold_templates_per_sec",
+        v_pc,
+        1.0,
+        extra=x_pc,
+    )
+    _emit(
+        "config5b_plan_warm_templates_per_sec",
+        v_pw,
+        v_pw / max(v_pc, 1e-9),
+        extra={
+            **x_pw,
+            "vs_note": "vs_baseline here = warm in-process plan-memo sweep over the cold re-lower-every-run sweep on the same on-disk registry corpus",
+        },
+    )
+    _emit(
+        "config5b_plan_restart_templates_per_sec",
+        v_pr,
+        v_pr / max(v_pc, 1e-9),
+        extra={
+            **x_pr,
+            "vs_note": "vs_baseline here = fresh-process-with-persisted-artifact sweep over the cold sweep; plan_misses stays 0 (zero lowering passes after restart)",
         },
     )
 

@@ -114,6 +114,14 @@ def build_parser() -> argparse.ArgumentParser:
         "records but any failing doc still fails the run; omit the "
         "flag for the historical abort-on-first-failure behavior)",
     )
+    v.add_argument(
+        "--no-plan-cache",
+        action="store_true",
+        help="tpu backend: disable the compiled-plan artifact layer "
+        "(re-lower the rule registry per call instead of reusing the "
+        "canonical plan; bit-parity escape hatch — also "
+        "GUARD_TPU_PLAN_CACHE=0)",
+    )
     _add_telemetry_flags(v)
 
     t = sub.add_parser("test", help="Test rules against expectations")
@@ -187,6 +195,14 @@ def build_parser() -> argparse.ArgumentParser:
         "quarantined docs are recorded but never fail the run by "
         "themselves; 0 restores the historical any-doc-error-is-fatal "
         "exit code",
+    )
+    s.add_argument(
+        "--no-plan-cache",
+        action="store_true",
+        help="tpu backend: disable the compiled-plan artifact layer "
+        "(re-lower the rule registry per chunk instead of relocating "
+        "into the canonical plan; bit-parity escape hatch — also "
+        "GUARD_TPU_PLAN_CACHE=0)",
     )
     _add_telemetry_flags(s)
 
@@ -271,6 +287,7 @@ def _dispatch(args, writer: Writer, reader: Reader) -> int:
                 vector_rim=not args.no_vector_rim,
                 ingest_workers=args.ingest_workers,
                 max_doc_failures=args.max_doc_failures,
+                plan_cache=not args.no_plan_cache,
             )
             return cmd.execute(writer, reader)
         if args.command == "test":
@@ -299,6 +316,7 @@ def _dispatch(args, writer: Writer, reader: Reader) -> int:
                 vector_rim=not args.no_vector_rim,
                 ingest_workers=args.ingest_workers,
                 max_doc_failures=args.max_doc_failures,
+                plan_cache=not args.no_plan_cache,
             ).execute(writer, reader)
         if args.command == "parse-tree":
             return ParseTree(

@@ -16,7 +16,12 @@ cache (keyed by the exact rule texts), so a session alternating over a
 stable registry skips re-parsing per request — and, downstream, the
 trace/executable caches (`parallel/mesh._shared_evaluator_fns`, the
 backend pack cache) key off those same reused objects, so the tpu
-backend re-dispatches without re-lowering. Data documents flow through
+backend re-dispatches without re-lowering. The plan layer
+(`ops/plan.py`) compounds this: its process-global memo is keyed by
+rule-content digest, so even a request whose rule texts arrive as NEW
+RuleFile objects (parsed-cache miss after eviction, or a second serve
+session against a populated `GUARD_TPU_PLAN_CACHE_DIR`) reuses the
+canonical lowered plan instead of re-lowering. Data documents flow through
 the same chunk-encode entrypoint as the sweep ingest plane
 (`ops.encoder.encode_chunk_texts` / the native batch loader), so serve
 benefits from the host-plane work without a worker pool (payloads

@@ -134,6 +134,12 @@ class Sweep:
     # the quarantine count exceeds this. None = unlimited (quarantine
     # never fails the run by itself); 0 = today's fail-fast behavior
     max_doc_failures: Optional[int] = None
+    # compiled-plan artifact layer (ops/plan.py): lower + pack the
+    # registry once, relocate intern ids per chunk, persist the
+    # canonical artifact under GUARD_TPU_PLAN_CACHE_DIR;
+    # --no-plan-cache / GUARD_TPU_PLAN_CACHE=0 restores per-chunk
+    # lowering (bit-parity escape hatch)
+    plan_cache: bool = True
 
     def execute(self, writer: Writer, reader: Reader) -> int:
         if not self.rules:
@@ -800,7 +806,9 @@ class Sweep:
             vector_rim_enabled,
         )
         from ..ops.encoder import encode_batch
+        from ..ops.fnvars import precompute_fn_values, precomputable_fn_vars
         from ..ops.ir import compile_rules_file, pack_compatible
+        from ..ops.plan import get_plan, plan_cache_enabled, relocate_batch
 
         # JAX_PLATFORMS=cpu in the env is not reliably honored by
         # plugin discovery (a wedged TPU tunnel hangs device init);
@@ -815,35 +823,65 @@ class Sweep:
         else:
             batch, interner = self._encode_chunk(data_files, writer, err_box)
 
-        # lower every rule file up-front (pack planning needs the full
-        # registry before the first dispatch)
+        # plan layer (ops/plan.py): lower + pack the registry ONCE
+        # (in-process memo across chunks, content-addressed disk
+        # artifact across runs) and relocate each chunk's intern ids
+        # into the plan namespace — warm chunks pay a numpy remap, not
+        # a re-lower. --no-plan-cache / GUARD_TPU_PLAN_CACHE=0 restores
+        # the per-chunk lowering below, bit-identically.
         prep = []
-        with _span("lower_compile", {"files": len(rule_files)}):
-            for rf in rule_files:
-                from ..ops.fnvars import (
-                    precompute_fn_values,
-                    precomputable_fn_vars,
-                )
-
+        plan = None
+        if plan_cache_enabled(self.plan_cache):
+            plan = get_plan(rule_files)
+            relocate_batch(plan, batch, interner)
+            interner = plan.interner
+            for fi, rf in enumerate(rule_files):
                 rf_batch = batch
-                if precomputable_fn_vars(rf.rules):
-                    # precomputed function lets: re-encode with per-doc
-                    # results before compile (ops/fnvars.py) — this path
-                    # genuinely needs the Python documents
-                    pvs = self._padded_pvs(data_files, writer, err_box)
-                    fn_vars, fn_vals, fn_err = precompute_fn_values(
-                        rf.rules, pvs
-                    )
-                    rf_batch, _ = encode_batch(
-                        pvs,
-                        interner,
-                        fn_values=fn_vals,
-                        fn_var_order=fn_vars,
-                    )
-                    if fn_err:
-                        rf_batch.num_exotic[sorted(fn_err)] = True
-                compiled = compile_rules_file(rf.rules, interner)
+                compiled = plan.compiled[fi]
+                if compiled is None:
+                    # fn-var slow path, per chunk as before — against
+                    # the plan interner so ids stay in one namespace
+                    with _span(
+                        "lower_compile", {"files": 1, "mode": "fnvar"}
+                    ):
+                        pvs = self._padded_pvs(data_files, writer, err_box)
+                        fn_vars, fn_vals, fn_err = precompute_fn_values(
+                            rf.rules, pvs
+                        )
+                        rf_batch, _ = encode_batch(
+                            pvs,
+                            interner,
+                            fn_values=fn_vals,
+                            fn_var_order=fn_vars,
+                        )
+                        if fn_err:
+                            rf_batch.num_exotic[sorted(fn_err)] = True
+                        compiled = compile_rules_file(rf.rules, interner)
                 prep.append((rf, rf_batch, compiled))
+        else:
+            # lower every rule file up-front (pack planning needs the
+            # full registry before the first dispatch)
+            with _span("lower_compile", {"files": len(rule_files)}):
+                for rf in rule_files:
+                    rf_batch = batch
+                    if precomputable_fn_vars(rf.rules):
+                        # precomputed function lets: re-encode with per-doc
+                        # results before compile (ops/fnvars.py) — this path
+                        # genuinely needs the Python documents
+                        pvs = self._padded_pvs(data_files, writer, err_box)
+                        fn_vars, fn_vals, fn_err = precompute_fn_values(
+                            rf.rules, pvs
+                        )
+                        rf_batch, _ = encode_batch(
+                            pvs,
+                            interner,
+                            fn_values=fn_vals,
+                            fn_var_order=fn_vars,
+                        )
+                        if fn_err:
+                            rf_batch.num_exotic[sorted(fn_err)] = True
+                    compiled = compile_rules_file(rf.rules, interner)
+                    prep.append((rf, rf_batch, compiled))
 
         # vectorized rim (GUARD_TPU_VECTOR_RIM, --no-vector-rim): skip
         # the O(docs x rules) per-doc dict fill entirely — keep
@@ -881,7 +919,12 @@ class Sweep:
                         )
                 else:
                     state["pack_pending"] = dispatch_packs(
-                        items, batch, with_rim=vec_on
+                        items, batch, with_rim=vec_on,
+                        prepacked=(
+                            plan.prepacked_items()
+                            if plan is not None
+                            else None
+                        ),
                     )
             except Exception as e:
                 # a packed-plane failure is never fatal: the per-file

@@ -173,6 +173,11 @@ class Validate:
     # ERROR only when more than N docs were quarantined (0 = quarantine
     # on, but any quarantined doc still fails the run)
     max_doc_failures: Optional[int] = None
+    # TPU backend: compiled-plan artifact layer (ops/plan.py) — reuse
+    # the canonically lowered + packed program across calls and
+    # processes; `--no-plan-cache` / GUARD_TPU_PLAN_CACHE=0 restores
+    # per-call lowering (bit-parity escape hatch)
+    plan_cache: bool = True
 
     # -- argument validation (validate.rs:205-232) --------------------
     def _validate_args(self) -> None:

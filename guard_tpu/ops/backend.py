@@ -202,24 +202,25 @@ class PackPending:
         self.with_rim = with_rim
 
 
-def dispatch_packs(items, batch, with_rim=None) -> PackPending:
+def dispatch_packs(items, batch, with_rim=None, prepacked=None) -> PackPending:
     """Dispatch half of the fused multi-rule-file pipeline: pack the
     compatible compiled files (plan_packs) and dispatch EVERY (pack,
     bucket group) WITHOUT collecting — JAX dispatch is async, so the
-    returned PackPending represents genuinely in-flight device work."""
-    from .encoder import NODE_BUCKETS_EXTENDED, split_batch_by_size
-    from .ir import PackIncompatible
-    from ..parallel.mesh import ShardedBatchEvaluator
+    returned PackPending represents genuinely in-flight device work.
 
+    `prepacked` (the plan layer, ops/plan.py): an already-computed
+    [(pack, PackedRules, RimSpec)] list — the pack plan is part of the
+    canonical artifact, so warm chunks skip plan_packs/_pack_cached
+    entirely."""
     if with_rim is None:
         with_rim = vector_rim_enabled()
-    if len(items) < 2:
+    if (not prepacked) if prepacked is not None else (len(items) < 2):
         return PackPending([], set(), with_rim)
     with _span("dispatch", {"files": len(items)}):
-        return _dispatch_packs_inner(items, batch, with_rim)
+        return _dispatch_packs_inner(items, batch, with_rim, prepacked)
 
 
-def _dispatch_packs_inner(items, batch, with_rim) -> PackPending:
+def _dispatch_packs_inner(items, batch, with_rim, prepacked=None) -> PackPending:
     from .encoder import NODE_BUCKETS_EXTENDED, split_batch_by_size
     from .ir import PackIncompatible
     from ..parallel.mesh import ShardedBatchEvaluator
@@ -227,15 +228,21 @@ def _dispatch_packs_inner(items, batch, with_rim) -> PackPending:
     groups, oversize = split_batch_by_size(batch, NODE_BUCKETS_EXTENDED)
     host_docs = {int(i) for i in oversize}
     pending = []
-    for pack in plan_packs(items):
-        if len(pack) < 2:
-            continue  # a singleton pack gains nothing over per-file
-        try:
-            packed, spec = _pack_cached([c for _, c in pack])
-        except PackIncompatible as e:
-            log.info("pack of %d files fell back to per-file: %s",
-                     len(pack), e)
-            continue
+    if prepacked is not None:
+        planned = prepacked
+    else:
+        planned = []
+        for pack in plan_packs(items):
+            if len(pack) < 2:
+                continue  # a singleton pack gains nothing over per-file
+            try:
+                packed, spec = _pack_cached([c for _, c in pack])
+            except PackIncompatible as e:
+                log.info("pack of %d files fell back to per-file: %s",
+                         len(pack), e)
+                continue
+            planned.append((pack, packed, spec))
+    for pack, packed, spec in planned:
         ev = ShardedBatchEvaluator(
             packed.compiled, rim_spec=spec if with_rim else None
         )
@@ -379,7 +386,8 @@ def _collect_packs_inner(pp: PackPending, batch) -> dict:
     return results
 
 
-def _evaluate_packs(items, batch, after_dispatch=None, with_rim=None) -> dict:
+def _evaluate_packs(items, batch, after_dispatch=None, with_rim=None,
+                    prepacked=None) -> dict:
     """dispatch_packs + collect_packs fused: every (pack, bucket group)
     dispatches before anything collects, so host columnarization of the
     next bucket/pack overlaps device execution of the previous one.
@@ -387,7 +395,7 @@ def _evaluate_packs(items, batch, after_dispatch=None, with_rim=None) -> dict:
     sweep.py's serial path encodes doc chunk k+1 in it while the device
     executes chunk k) runs once everything is in flight, before the
     first collect."""
-    pp = dispatch_packs(items, batch, with_rim)
+    pp = dispatch_packs(items, batch, with_rim, prepacked=prepacked)
     if after_dispatch is not None:
         after_dispatch()
     return collect_packs(pp, batch)
@@ -717,30 +725,60 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
     # excluded from packing by ir.pack_compatible.
     from .fnvars import precompute_fn_values, precomputable_fn_vars
     from .ir import pack_compatible
+    from .plan import get_plan, plan_cache_enabled, relocate_batch
 
     prep = []
-    with _span("lower_compile", {"files": len(rule_files)}):
-        for rule_file in rule_files:
+    plan = None
+    if plan_cache_enabled(getattr(validate, "plan_cache", True)):
+        # plan layer (ops/plan.py): reuse the canonically lowered +
+        # packed program (in-process memo or disk artifact) and move
+        # the batch into its id namespace — warm calls skip
+        # compile_rules_file and pack_compiled entirely
+        plan = get_plan(rule_files)
+        relocate_batch(plan, batch, interner)
+        interner = plan.interner
+        for fi, rule_file in enumerate(rule_files):
             rbatch = batch
-            if precomputable_fn_vars(rule_file.rules):
-                docs = _docs()
-                fn_vars, fn_vals, fn_err = precompute_fn_values(
-                    rule_file.rules, docs
-                )
-                rbatch, _ = encode_batch(
-                    docs, interner, fn_values=fn_vals, fn_var_order=fn_vars
-                )
-                if fn_err:
-                    # a function raised on these docs: route them to the
-                    # oracle, which reproduces the error path
-                    rbatch.num_exotic[sorted(fn_err)] = True
-            compiled = compile_rules_file(rule_file.rules, interner)
-            n_dev, n_host = len(compiled.rules), len(compiled.host_rules)
-            log.info(
-                "%s: %d/%d rules lowered to device kernels (%d host-fallback)",
-                rule_file.name, n_dev, n_dev + n_host, n_host,
-            )
+            compiled = plan.compiled[fi]
+            if compiled is None:
+                # fn-var slow path, per batch as before — but against
+                # the plan interner, so ids stay in one namespace
+                with _span("lower_compile", {"files": 1, "mode": "fnvar"}):
+                    docs = _docs()
+                    fn_vars, fn_vals, fn_err = precompute_fn_values(
+                        rule_file.rules, docs
+                    )
+                    rbatch, _ = encode_batch(
+                        docs, interner, fn_values=fn_vals,
+                        fn_var_order=fn_vars,
+                    )
+                    if fn_err:
+                        rbatch.num_exotic[sorted(fn_err)] = True
+                    compiled = compile_rules_file(rule_file.rules, interner)
             prep.append((rule_file, rbatch, compiled))
+    else:
+        with _span("lower_compile", {"files": len(rule_files)}):
+            for rule_file in rule_files:
+                rbatch = batch
+                if precomputable_fn_vars(rule_file.rules):
+                    docs = _docs()
+                    fn_vars, fn_vals, fn_err = precompute_fn_values(
+                        rule_file.rules, docs
+                    )
+                    rbatch, _ = encode_batch(
+                        docs, interner, fn_values=fn_vals, fn_var_order=fn_vars
+                    )
+                    if fn_err:
+                        # a function raised on these docs: route them to the
+                        # oracle, which reproduces the error path
+                        rbatch.num_exotic[sorted(fn_err)] = True
+                compiled = compile_rules_file(rule_file.rules, interner)
+                n_dev, n_host = len(compiled.rules), len(compiled.host_rules)
+                log.info(
+                    "%s: %d/%d rules lowered to device kernels (%d host-fallback)",
+                    rule_file.name, n_dev, n_dev + n_host, n_host,
+                )
+                prep.append((rule_file, rbatch, compiled))
 
     # fused multi-rule-file dispatch: compatible files (shared batch,
     # no per-file fn re-encode) evaluate as packed executables, one
@@ -761,6 +799,7 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
             ],
             batch,
             with_rim=rim_on,
+            prepacked=plan.prepacked_items() if plan is not None else None,
         )
 
     for fi, (rule_file, rbatch, compiled) in enumerate(prep):

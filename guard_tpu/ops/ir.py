@@ -73,6 +73,7 @@ from ..core.values import (
     REGEX,
     STRING,
     PV,
+    compiled_regex,
 )
 from .encoder import Interner, num_key
 
@@ -323,6 +324,14 @@ class RhsSpec:
     # (path_value.rs:1048-1070 via compare_values; gt = ~le, ge = ~lt)
     lt_bits: Optional[np.ndarray] = None
     le_bits: Optional[np.ndarray] = None
+    # the predicate each table row answers, as a corpus-independent
+    # spec tuple (("substr", lit) / ("regex", pat) / ("lt", lit) /
+    # ("le", lit)) — recorded so a table compiled against one interner
+    # can be EXTENDED over strings interned later (ops/plan.py
+    # relocation) by evaluating the same predicate over the new suffix
+    bits_spec: Optional[tuple] = None
+    lt_spec: Optional[tuple] = None
+    le_spec: Optional[tuple] = None
     # slots into CompiledRules.bit_tables, assigned by _assign_bit_slots:
     # the (S,) per-string tables are materialized host-side into (D, N)
     # per-NODE bool columns per batch, so the kernel never gathers
@@ -458,6 +467,11 @@ class CompiledRules:
     # (table, target) per slot; target "scalar" applies the (S,) table
     # through scalar_id, "key" through node_key_id
     bit_tables: List[Tuple[np.ndarray, str]] = field(default_factory=list)
+    # parallel to bit_tables: the corpus-independent predicate each
+    # table evaluates (("substr", lit) / ("regex", pat) / ("lt", lit) /
+    # ("le", lit) / ("empty",)), so extend_bit_tables can grow a table
+    # over strings interned AFTER compile without re-lowering
+    bit_specs: List[tuple] = field(default_factory=list)
     str_empty_slot: int = -1
     # map / nested-list RHS literals, evaluated per batch into the
     # 'stri_m{i}'/'stri_c{i}'/'stri_l{i}' tri-state/loose columns
@@ -566,8 +580,9 @@ class CompiledRules:
                 col = np.zeros(ids.shape, dtype=bool)
             else:
                 # ids beyond the table (strings interned after compile)
-                # are conservatively False; lowering re-runs per chunk
-                # in the sweep path so this only affects padding
+                # are conservatively False; the plan layer (ops/plan.py)
+                # extends tables over newly interned strings before
+                # dispatch, so this only affects padding
                 safe = np.clip(ids, 0, len(table) - 1)
                 col = table[safe] & (ids >= 0) & (ids < len(table))
             out[f"bits{i}"] = col
@@ -1128,6 +1143,7 @@ class _RuleLowering:
                 kind="str",
                 str_val=lit,
                 bits=self.interner.substring_bits(-1, lit),
+                bits_spec=("substr", lit),
                 # ordering tables only when the clause actually orders
                 lt_bits=np.array(
                     [s < lit for s in self.interner.strings], dtype=bool
@@ -1139,9 +1155,15 @@ class _RuleLowering:
                 )
                 if ordering
                 else None,
+                lt_spec=("lt", lit) if ordering else None,
+                le_spec=("le", lit) if ordering else None,
             )
         if k == REGEX:
-            return RhsSpec(kind="regex", bits=self.interner.regex_match_bits(cw.val))
+            return RhsSpec(
+                kind="regex",
+                bits=self.interner.regex_match_bits(cw.val),
+                bits_spec=("regex", cw.val),
+            )
         if k == CHAR:
             # docs never contain CHAR nodes (loader emits STRING), and
             # STRING vs CHAR is NotComparable (path_value.rs:1048-1070)
@@ -2011,11 +2033,12 @@ def _assign_bit_slots(compiled: CompiledRules) -> None:
             compiled.kidc_tables.append(spec)
         return seen_kidc[spec]
 
-    def slot(arr: np.ndarray, target: str) -> int:
+    def slot(arr: np.ndarray, target: str, spec: tuple) -> int:
         k = (id(arr), target)
         if k not in seen:
             seen[k] = len(compiled.bit_tables)
             compiled.bit_tables.append((arr, target))
+            compiled.bit_specs.append(spec)
         return seen[k]
 
     def lit_slot(name: Optional[str]) -> int:
@@ -2036,11 +2059,11 @@ def _assign_bit_slots(compiled: CompiledRules) -> None:
             rhs.kind == "regex" and op in (CmpOperator.Eq, CmpOperator.In)
         ) or (rhs.kind == "str" and op == CmpOperator.In)
         if reads_bits and rhs.bits is not None:
-            rhs.bits_slot = slot(rhs.bits, target)
+            rhs.bits_slot = slot(rhs.bits, target, rhs.bits_spec)
         if rhs.lt_bits is not None:
-            rhs.lt_slot = slot(rhs.lt_bits, target)
+            rhs.lt_slot = slot(rhs.lt_bits, target, rhs.lt_spec)
         if rhs.le_bits is not None:
-            rhs.le_slot = slot(rhs.le_bits, target)
+            rhs.le_slot = slot(rhs.le_bits, target, rhs.le_spec)
         if rhs.items:
             ordering = op in (
                 CmpOperator.Gt, CmpOperator.Ge, CmpOperator.Lt, CmpOperator.Le,
@@ -2112,7 +2135,9 @@ def _assign_bit_slots(compiled: CompiledRules) -> None:
             do_conjs(r.conditions)
         do_conjs(r.conjunctions)
     if uses_empty[0]:
-        compiled.str_empty_slot = slot(compiled.str_empty_bits, "scalar")
+        compiled.str_empty_slot = slot(
+            compiled.str_empty_bits, "scalar", ("empty",)
+        )
     compiled.needs_pairwise = (
         compiled.needs_struct_ids
         or compiled.needs_str_rank
@@ -2291,6 +2316,7 @@ def pack_compiled(parts: List[CompiledRules]) -> PackedRules:
         if out.str_empty_slot < 0:
             out.str_empty_slot = len(out.bit_tables)
             out.bit_tables.append((out.str_empty_bits, "scalar"))
+            out.bit_specs.append(("empty",))
         return out.str_empty_slot
 
     for part in parts:
@@ -2308,6 +2334,7 @@ def pack_compiled(parts: List[CompiledRules]) -> PackedRules:
             else:
                 bits[old] = len(out.bit_tables)
                 out.bit_tables.append((table, target))
+                out.bit_specs.append(part.bit_specs[old])
         kidcs = {}
         for old, spec in enumerate(part.kidc_tables):
             if spec not in seen_kidc:
@@ -2436,3 +2463,77 @@ def pack_compiled(parts: List[CompiledRules]) -> PackedRules:
     # applies the same implication)
     out.needs_unsure = out.needs_unsure or out.needs_struct_ids
     return PackedRules(compiled=out, offsets=offsets, sizes=sizes)
+
+
+# ---------------------------------------------------------------------------
+# Bit-table extension: grow compiled tables over a grown interner
+# ---------------------------------------------------------------------------
+def _eval_bit_spec(spec: tuple, strings: List[str]) -> np.ndarray:
+    """Evaluate one bit_specs predicate over a string slice — the exact
+    semantics the table was originally built with (Interner.
+    substring_bits / regex_match_bits, the inline lt/le comprehensions
+    in lower_rhs, and the empty-string table in compile_rules_file)."""
+    kind = spec[0]
+    if kind == "substr":
+        lit = spec[1]
+        return np.array([s in lit for s in strings], dtype=bool)
+    if kind == "regex":
+        rx = compiled_regex(spec[1])
+        return np.array(
+            [rx.search(s) is not None for s in strings], dtype=bool
+        )
+    if kind == "lt":
+        lit = spec[1]
+        return np.array([s < lit for s in strings], dtype=bool)
+    if kind == "le":
+        lit = spec[1]
+        return np.array([s <= lit for s in strings], dtype=bool)
+    if kind == "empty":
+        return np.array([len(s) == 0 for s in strings], dtype=bool)
+    raise ValueError(f"unknown bit spec {spec!r}")
+
+
+def extend_bit_tables(
+    parts: List[CompiledRules], interner: Interner
+) -> int:
+    """Grow every (S,) bit table in `parts` to cover `interner`'s
+    current string count by evaluating each table's recorded bit_specs
+    predicate over just the newly interned suffix. This is what lets a
+    canonically lowered plan (ops/plan.py) survive interner growth
+    without re-lowering: device_arrays gathers tables host-side per
+    batch, so table LENGTH never reaches the kernel trace and extension
+    causes zero recompiles.
+
+    pack_compiled appends tables BY REFERENCE, so one underlying array
+    can appear in several CompiledRules (a per-file part and the packs
+    containing it); an id()-keyed memo extends each array once and
+    rebinds every (table, target) entry to the same grown array.
+    Returns the number of distinct arrays extended."""
+    n = len(interner.strings)
+    memo: dict = {}
+    grown = 0
+    for comp in parts:
+        for i, (table, target) in enumerate(comp.bit_tables):
+            if len(table) >= n:
+                continue
+            new = memo.get(id(table))
+            if new is None:
+                ext = _eval_bit_spec(
+                    comp.bit_specs[i], interner.strings[len(table):]
+                )
+                new = np.concatenate([table, ext]) if len(table) else ext
+                memo[id(table)] = new
+                grown += 1
+            comp.bit_tables[i] = (new, target)
+        # keep the standalone empty-string table consistent (it aliases
+        # bit_tables[str_empty_slot] when slotted; unused otherwise)
+        tbl = comp.str_empty_bits
+        if len(tbl) < n:
+            new = memo.get(id(tbl))
+            if new is None:
+                ext = _eval_bit_spec(("empty",), interner.strings[len(tbl):])
+                new = np.concatenate([tbl, ext]) if len(tbl) else ext
+                memo[id(tbl)] = new
+                grown += 1
+            comp.str_empty_bits = new
+    return grown

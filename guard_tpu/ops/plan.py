@@ -1,0 +1,425 @@
+"""The compiled-plan artifact layer: lower once, relocate per chunk.
+
+Sits between rule parsing and dispatch (ROADMAP item 1). Before this
+layer the sweep re-ran `compile_rules_file` + `pack_compiled` on every
+chunk because compiled IR bakes in chunk-local intern ids; PR 3's
+decomposition showed that re-lowering — not ingest or dispatch — was
+the dominant per-chunk cost on the registry corpus. The plan layer
+removes it in three moves:
+
+1. **Interner-canonical lowering** (`build_plan`): each rule file is
+   lowered ONCE against a rule-local canonical `Interner` that starts
+   EMPTY — lowering only ever *looks up* document strings (absent
+   literals bind to the never-matching id through the runtime
+   `lit_values` array), so every bit table starts at length 0 and the
+   compiled IR is corpus-independent. The pack plan (membership,
+   segment offsets, `RimSpec`) is computed here too, so warm chunks
+   skip `pack_compiled` as well.
+
+2. **Per-chunk relocation** (`relocate_batch`): a chunk batch arrives
+   in its own interner namespace; relocation interns the chunk's
+   strings into the plan interner, remaps the batch's id columns with
+   one numpy pass (`encoder.remap_interned_ids` — the symmetric twin
+   of the ingest-shard merge), and extends the plan's bit tables over
+   just the newly appended strings (`ir.extend_bit_tables`, driven by
+   the recorded `bit_specs` predicates). Because `device_arrays`
+   gathers bit tables host-side, table growth never reaches the kernel
+   trace: zero recompiles, and `trace_signature` — hence the
+   `_shared_evaluator_fns` executable cache — is untouched.
+
+3. **Content-addressed disk artifacts** (`get_plan`): the canonical
+   plan (still-empty interner + lowered IR + packs) is pickled under
+   `GUARD_TPU_PLAN_CACHE_DIR` keyed by a sha256 over (rule-file bytes
+   in order, pack config, bucket shape, device kind/count, artifact
+   schema version, guard_tpu version). A fresh process with a warm
+   cache performs zero lowering passes. Corrupt or mismatched
+   artifacts are MISSES, never errors. Jitted executables are not
+   serialized here: in-process reuse comes from `_shared_evaluator_fns`
+   and cross-process XLA persistence from `GUARD_TPU_JAX_CACHE`
+   (backend._setup_compile_cache); where the installed jax lacks a
+   stable `jax.export`, the IR-only artifact still skips lowering and
+   only re-traces (recorded in the artifact metadata).
+
+Escape hatches: `GUARD_TPU_PLAN_CACHE=0` or `--no-plan-cache` bypasses
+the layer entirely (per-chunk lowering, bit-identical output).
+Function-variable rule files keep their excluded-from-packing slow
+path: they re-encode + re-lower per chunk against the plan interner.
+
+This module imports no jax at module scope (serve sessions stay
+jax-free until a tpu-backend request arrives).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pickle
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.telemetry import REGISTRY as _TELEMETRY
+from ..utils.telemetry import span as _span
+from .encoder import Interner, remap_interned_ids
+from .ir import (
+    CompiledRules,
+    PackedRules,
+    RimSpec,
+    compile_rules_file,
+    extend_bit_tables,
+    pack_compatible,
+)
+
+log = logging.getLogger("guard_tpu.plan")
+
+#: bump when the pickled artifact layout changes — old artifacts then
+#: key to different digests and age out as misses
+PLAN_SCHEMA_VERSION = 1
+
+#: plan-cache observability, in every --metrics-out snapshot and reset
+#: by backend.reset_all_stats(): `hits` counts in-process memo AND disk
+#: loads (a warm sweep shows hits > 0 and zero lower_compile seconds),
+#: `misses` full builds, `relocations` per-chunk remap+extend passes,
+#: `artifacts_saved` / `bytes_loaded` the disk traffic.
+PLAN_COUNTERS = _TELEMETRY.counter_group(
+    "plan_cache",
+    {
+        "hits": 0,
+        "misses": 0,
+        "relocations": 0,
+        "artifacts_saved": 0,
+        "bytes_loaded": 0,
+    },
+)
+
+
+def plan_stats() -> dict:
+    return _TELEMETRY.group_stats("plan_cache")
+
+
+def reset_plan_stats() -> None:
+    _TELEMETRY.reset_group("plan_cache")
+
+
+def plan_cache_enabled(flag: bool = True) -> bool:
+    """The layer's on switch: the caller's --no-plan-cache flag AND the
+    `GUARD_TPU_PLAN_CACHE=0` env escape hatch (read at call time so one
+    process can compare both paths — the parity tests do)."""
+    return bool(flag) and os.environ.get("GUARD_TPU_PLAN_CACHE", "1") != "0"
+
+
+def plan_cache_dir() -> Path:
+    d = os.environ.get("GUARD_TPU_PLAN_CACHE_DIR", "").strip()
+    if d:
+        return Path(d)
+    return Path(os.path.expanduser("~")) / ".cache" / "guard_tpu" / "plans"
+
+
+def _device_fingerprint() -> Tuple[str, int]:
+    """(device kind, device count) for the cache key. Deliberately
+    lazy: importable (and keyable, for tests) without jax."""
+    try:
+        import jax
+
+        return str(jax.default_backend()), int(jax.device_count())
+    except Exception:
+        return ("unknown", 0)
+
+
+def _aot_export_supported() -> bool:
+    """Whether the installed jax exposes the export/AOT surface. Only
+    recorded in artifact metadata today: executables persist through
+    GUARD_TPU_JAX_CACHE instead, and IR-only artifacts re-trace."""
+    try:
+        import jax
+
+        return hasattr(jax, "export")
+    except Exception:
+        return False
+
+
+def plan_key(
+    rule_files,
+    device_kind: Optional[str] = None,
+    device_count: Optional[int] = None,
+    schema_version: int = PLAN_SCHEMA_VERSION,
+    buckets=None,
+    pack_max_rules: Optional[int] = None,
+) -> str:
+    """Content address of a plan: sha256 over everything the canonical
+    artifact depends on. The pack plan is a pure function of the rule
+    bytes in order plus `PACK_MAX_RULES`, so hashing those covers the
+    pack-set; bucket shape and device kind/count key the executables a
+    warm process will trace against the plan. File NAMES are excluded —
+    the artifact stores none, so byte-identical registries share."""
+    from ..ops.backend import PACK_MAX_RULES
+    from .encoder import NODE_BUCKETS_EXTENDED
+
+    if device_kind is None or device_count is None:
+        dk, dc = _device_fingerprint()
+        device_kind = dk if device_kind is None else device_kind
+        device_count = dc if device_count is None else device_count
+    if buckets is None:
+        buckets = NODE_BUCKETS_EXTENDED
+    if pack_max_rules is None:
+        pack_max_rules = PACK_MAX_RULES
+    h = hashlib.sha256()
+    h.update(f"schema={schema_version};".encode())
+    from .. import __version__
+
+    h.update(f"version={__version__};".encode())
+    h.update(f"device={device_kind}x{device_count};".encode())
+    h.update(f"buckets={tuple(buckets)};".encode())
+    h.update(f"pack_max_rules={pack_max_rules};".encode())
+    for rf in rule_files:
+        content = rf.content.encode() if isinstance(rf.content, str) else rf.content
+        h.update(hashlib.sha256(content).digest())
+    return h.hexdigest()
+
+
+@dataclass
+class RulePlan:
+    """One registry's canonical compiled program. `interner` starts
+    empty and grows monotonically as chunks relocate into it; the
+    on-disk artifact is saved BEFORE first use so it stays
+    corpus-independent. `compiled[i]` is rule file i's lowered IR, or
+    None for function-variable files (the slow path re-encodes and
+    re-lowers those per chunk against this same interner). `packs`
+    holds the precomputed >= 2-member pack plan: (member file
+    positions, PackedRules, RimSpec)."""
+
+    interner: Interner
+    compiled: List[Optional[CompiledRules]]
+    slow: List[int] = field(default_factory=list)
+    packs: List[Tuple[tuple, PackedRules, RimSpec]] = field(
+        default_factory=list
+    )
+    digest: str = ""
+
+    def all_compiled(self) -> List[CompiledRules]:
+        """Every CompiledRules whose bit tables must track the plan
+        interner — the per-file programs plus each pack's fused program
+        (pack_compiled aliases the underlying arrays, so
+        extend_bit_tables' id-memo grows each one exactly once)."""
+        parts = [c for c in self.compiled if c is not None]
+        parts.extend(p.compiled for _pos, p, _spec in self.packs)
+        return parts
+
+    def prepacked_items(self):
+        """The dispatch-ready pack list backend.dispatch_packs consumes
+        via its `prepacked` parameter: [(pack, PackedRules, RimSpec)]
+        with pack = [(file_idx, CompiledRules)]."""
+        return [
+            ([(fi, self.compiled[fi]) for fi in pos], packed, spec)
+            for pos, packed, spec in self.packs
+        ]
+
+
+def build_plan(rule_files) -> RulePlan:
+    """Lower + pack the registry once against a fresh empty interner.
+    Pure function of (rule bytes, pack config) — everything else in the
+    cache key exists to version the executables traced FROM the plan."""
+    from ..ops.backend import plan_packs
+    from .fnvars import precomputable_fn_vars
+
+    interner = Interner()
+    compiled: List[Optional[CompiledRules]] = []
+    slow: List[int] = []
+    with _span("lower_compile", {"files": len(rule_files), "mode": "plan"}):
+        for fi, rf in enumerate(rule_files):
+            if precomputable_fn_vars(rf.rules):
+                # fn-var files re-encode the batch with per-doc function
+                # results before compile — per chunk, on the slow path
+                compiled.append(None)
+                slow.append(fi)
+                continue
+            compiled.append(compile_rules_file(rf.rules, interner))
+    items = [
+        (fi, c)
+        for fi, c in enumerate(compiled)
+        if c is not None and pack_compatible(c) is None
+    ]
+    packs = []
+    for pack in plan_packs(items):
+        if len(pack) < 2:
+            continue  # a singleton pack gains nothing over per-file
+        with _span("pack_compile", {"files": len(pack), "mode": "plan"}):
+            from .ir import pack_compiled
+
+            packed = pack_compiled([c for _fi, c in pack])
+            spec = packed.rim_spec()
+        packs.append((tuple(fi for fi, _c in pack), packed, spec))
+    return RulePlan(
+        interner=interner, compiled=compiled, slow=slow, packs=packs
+    )
+
+
+def relocate_batch(plan: RulePlan, batch, chunk_interner: Interner) -> None:
+    """Move one chunk batch into the plan's id namespace, in place:
+    intern every chunk string into the plan interner (appending the
+    unseen ones), remap the batch's id columns through the resulting
+    (chunk id -> plan id) table, then extend the plan's bit tables over
+    whatever the interner just gained. After this the batch evaluates
+    against the plan's compiled IR bit-identically to IR lowered
+    directly against the chunk interner (tests/test_plan_cache.py pins
+    the parity)."""
+    with _span("relocate", {"docs": batch.n_docs}):
+        strings = chunk_interner.strings
+        if strings:
+            remap = np.fromiter(
+                (plan.interner.intern(s) for s in strings),
+                dtype=np.int32,
+                count=len(strings),
+            )
+            remap_interned_ids(batch, remap)
+        extend_bit_tables(plan.all_compiled(), plan.interner)
+        PLAN_COUNTERS["relocations"] += 1
+
+
+# -- in-process memo + on-disk artifacts ------------------------------------
+
+# digest -> RulePlan. Values are the live (grown) plans; sweep chunks,
+# serve requests and bench reps in one process share them. Small LRU:
+# a plan holds the registry's whole lowered IR.
+_PLAN_MEMO: "OrderedDict[str, RulePlan]" = OrderedDict()
+_PLAN_MEMO_MAX = 8
+
+# rule_files identity -> digest, so per-chunk lookups skip re-hashing
+# the registry bytes. Values keep the RuleFile objects alive so ids
+# cannot be recycled under the cache (same trick as _PACK_CACHE).
+_KEY_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
+_KEY_CACHE_MAX = 8
+
+
+def clear_plan_memo() -> None:
+    """Drop the in-process plan memo and key cache (tests, and
+    bench's simulated process restart). Disk artifacts survive."""
+    _PLAN_MEMO.clear()
+    _KEY_CACHE.clear()
+
+
+def _digest_for(rule_files) -> str:
+    ident = tuple(id(rf) for rf in rule_files)
+    hit = _KEY_CACHE.get(ident)
+    if hit is not None:
+        _KEY_CACHE.move_to_end(ident)
+        return hit[1]
+    digest = plan_key(rule_files)
+    _KEY_CACHE[ident] = (list(rule_files), digest)
+    while len(_KEY_CACHE) > _KEY_CACHE_MAX:
+        _KEY_CACHE.popitem(last=False)
+    return digest
+
+
+def _artifact_path(digest: str) -> Path:
+    return plan_cache_dir() / f"{digest}.plan"
+
+
+def save_plan(plan: RulePlan, digest: str) -> bool:
+    """Serialize a canonical plan; atomic (tmp + rename) so concurrent
+    writers and torn writes can only ever produce a whole artifact or a
+    miss. Failures warn and return False — persistence is an
+    optimization, never a correctness dependency."""
+    with _span("save_plan"):
+        try:
+            payload = {
+                "schema": PLAN_SCHEMA_VERSION,
+                "version": _guard_version(),
+                "digest": digest,
+                "aot_export": _aot_export_supported(),
+                "plan": plan,
+            }
+            blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+            path = _artifact_path(digest)
+            path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = path.with_suffix(f".tmp.{os.getpid()}")
+            tmp.write_bytes(blob)
+            os.replace(tmp, path)
+        except Exception as e:
+            log.warning("plan artifact save failed (%s); continuing "
+                        "without persistence", e)
+            return False
+        PLAN_COUNTERS["artifacts_saved"] += 1
+        return True
+
+
+def load_plan(digest: str) -> Optional[RulePlan]:
+    """Deserialize a plan artifact, or None on ANY problem — absent
+    file, truncated pickle, schema/version/digest mismatch. A corrupt
+    artifact logs a warning and counts as a miss; it is rewritten by
+    the save after the rebuild."""
+    path = _artifact_path(digest)
+    with _span("load_plan"):
+        try:
+            if not path.exists():
+                return None
+            blob = path.read_bytes()
+            payload = pickle.loads(blob)
+            if not isinstance(payload, dict):
+                raise ValueError("artifact payload is not a dict")
+            if payload.get("schema") != PLAN_SCHEMA_VERSION:
+                raise ValueError(
+                    f"schema {payload.get('schema')!r} != "
+                    f"{PLAN_SCHEMA_VERSION}"
+                )
+            if payload.get("version") != _guard_version():
+                raise ValueError("guard_tpu version mismatch")
+            if payload.get("digest") != digest:
+                raise ValueError("digest mismatch")
+            plan = payload["plan"]
+            if not isinstance(plan, RulePlan):
+                raise ValueError("artifact plan is not a RulePlan")
+        except Exception as e:
+            log.warning(
+                "plan artifact %s unusable (%s); treating as a cache "
+                "miss", path.name, e,
+            )
+            return None
+        PLAN_COUNTERS["bytes_loaded"] += len(blob)
+        return plan
+
+
+def _guard_version() -> str:
+    from .. import __version__
+
+    return __version__
+
+
+def _memo_store(digest: str, plan: RulePlan) -> None:
+    _PLAN_MEMO[digest] = plan
+    _PLAN_MEMO.move_to_end(digest)
+    while len(_PLAN_MEMO) > _PLAN_MEMO_MAX:
+        _PLAN_MEMO.popitem(last=False)
+
+
+def get_plan(rule_files, use_disk: bool = True) -> RulePlan:
+    """The layer's one entry point: in-process memo, then the disk
+    artifact, then a full build (saved back when `use_disk`). Callers
+    gate on `plan_cache_enabled()` BEFORE calling — a disabled plan
+    layer means the legacy per-chunk lowering path, untouched."""
+    digest = _digest_for(rule_files)
+    plan = _PLAN_MEMO.get(digest)
+    if plan is not None:
+        _PLAN_MEMO.move_to_end(digest)
+        PLAN_COUNTERS["hits"] += 1
+        return plan
+    if use_disk:
+        plan = load_plan(digest)
+        if plan is not None:
+            plan.digest = digest
+            PLAN_COUNTERS["hits"] += 1
+            _memo_store(digest, plan)
+            return plan
+    plan = build_plan(rule_files)
+    plan.digest = digest
+    PLAN_COUNTERS["misses"] += 1
+    if use_disk:
+        # saved BEFORE first relocation: the artifact's interner is
+        # still empty, keeping it corpus-independent
+        save_plan(plan, digest)
+    _memo_store(digest, plan)
+    return plan

@@ -26,6 +26,7 @@ with the per-file executables.
 from __future__ import annotations
 
 import copy
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -255,6 +256,40 @@ class RuleShardedEvaluator:
         return statuses
 
 
+# per-shard pack memo (the plan-layer analogue of backend._PACK_CACHE):
+# the shard composition depends on rule_shards and the device census,
+# neither of which is part of the on-disk plan artifact's key, so shard
+# packs live in-process only — keyed by member CompiledRules identity,
+# which the plan layer keeps stable across chunks. Entries carry the
+# member list so the id() keys cannot be recycled while cached.
+_SHARD_PACK_CACHE: OrderedDict = OrderedDict()
+_SHARD_PACK_MAX = 8
+
+
+def _pack_group(files: List[CompiledRules]):
+    """pack_compiled over one shard group, memoized on member identity.
+    Cached packs may predate the plan interner's latest relocation, so
+    their bit tables are re-extended before reuse (a no-op when the
+    interner has not grown)."""
+    from ..ops.ir import extend_bit_tables, pack_compiled
+
+    key = tuple(id(f) for f in files)
+    hit = _SHARD_PACK_CACHE.get(key)
+    if hit is not None:
+        _SHARD_PACK_CACHE.move_to_end(key)
+        packed = hit[1]
+        extend_bit_tables([packed.compiled], packed.compiled.interner)
+        return packed
+    # per-group pack compile is the sharded path's lowering cost
+    # (backend._pack_cached never sees these packs)
+    with _span("pack_compile", {"files": len(files)}):
+        packed = pack_compiled(files)
+    _SHARD_PACK_CACHE[key] = (list(files), packed)
+    while len(_SHARD_PACK_CACHE) > _SHARD_PACK_MAX:
+        _SHARD_PACK_CACHE.popitem(last=False)
+    return packed
+
+
 def partition_packs(compiled_files, n_groups: int) -> List[List[int]]:
     """Partition rule-FILE indices into <= n_groups groups balanced by
     rule count (greedy largest-first), file order preserved inside each
@@ -293,7 +328,7 @@ class PackShardedEvaluator:
         devices: Optional[Sequence] = None,
         with_rim: bool = False,
     ):
-        from ..ops.ir import build_rim_spec, pack_compiled
+        from ..ops.ir import build_rim_spec
 
         if not compiled_files:
             raise ValueError("no compiled rule files to shard")
@@ -316,10 +351,7 @@ class PackShardedEvaluator:
         splits = np.array_split(np.arange(len(devices)), len(self.groups))
         self.shards: List[Tuple[ShardedBatchEvaluator, np.ndarray]] = []
         for g, dev_idx in zip(self.groups, splits):
-            # per-group pack compile is the sharded path's lowering
-            # cost (backend._pack_cached never sees these packs)
-            with _span("pack_compile", {"files": len(g)}):
-                packed = pack_compiled([self.files[i] for i in g])
+            packed = _pack_group([self.files[i] for i in g])
             cols = np.concatenate(
                 [np.arange(col_base[i], col_base[i + 1]) for i in g]
             )

@@ -303,6 +303,9 @@ STAGE_LANES = {
     "rule_parse": "rules",
     "lower_compile": "rules",
     "pack_compile": "rules",
+    "load_plan": "rules",
+    "save_plan": "rules",
+    "relocate": "rules",
     "read_parse": "ingest",
     "encode": "ingest",
     "dispatch": "dispatch",

@@ -22,6 +22,17 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The plan artifact layer persists content-addressed plans under
+# ~/.cache/guard_tpu/plans by default. The suite must neither read a
+# previous checkout's artifacts nor leave its own behind, so it runs
+# against a throwaway cache dir (an explicit operator setting wins;
+# individual tests override with monkeypatch).
+import tempfile
+
+os.environ.setdefault(
+    "GUARD_TPU_PLAN_CACHE_DIR", tempfile.mkdtemp(prefix="guard_plans_")
+)
+
 # Force the CPU platform programmatically as well: with a wedged axon
 # TPU tunnel, plugin discovery can hang even under JAX_PLATFORMS=cpu.
 import jax

@@ -158,6 +158,43 @@ def test_checker_requires_telemetry_overhead_keys(tmp_path):
     assert any("spans_recorded_per_run" in p for p in problems)
 
 
+def test_expected_metrics_cover_plan_cache_rows():
+    """PR 7: the plan-artifact-layer regime rows (cold re-lower, warm
+    in-process memo, restart from the persisted artifact) are part of
+    the driver contract and gated by the schema checker."""
+    metrics = bench.expected_metrics()
+    assert "config5b_plan_cold_templates_per_sec" in metrics
+    assert "config5b_plan_warm_templates_per_sec" in metrics
+    assert "config5b_plan_restart_templates_per_sec" in metrics
+
+
+def test_checker_requires_plan_cache_keys(tmp_path):
+    """A plan-regime row missing its lowering decomposition or the
+    plan_cache counters fails the gate."""
+    row = {
+        "metric": "config5b_plan_warm_templates_per_sec",
+        "value": 1.0,
+        "unit": "templates/sec",
+        "vs_baseline": 2.0,
+        "plan_hits": 4,
+        # lower/pack/relocate seconds + misses/bytes_loaded missing
+    }
+    src = _newest_artifact().read_text().splitlines()
+    doctored = tmp_path / "bench_all_doctored_plan.json"
+    doctored.write_text(
+        "\n".join(
+            ln for ln in src
+            if '"config5b_plan_warm_templates_per_sec"' not in ln
+        )
+        + "\n"
+        + __import__("json").dumps(row)
+        + "\n"
+    )
+    problems = check_bench_schema.check(doctored)
+    assert any("lower_compile_seconds_per_run" in p for p in problems)
+    assert any("plan_bytes_loaded" in p for p in problems)
+
+
 def test_registry_stage_seconds_reconcile_with_wall_time(tmp_path):
     """The registry-derived stage decomposition bench.py reports must
     account for the run it claims to decompose: summing the top-level

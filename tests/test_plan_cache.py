@@ -1,0 +1,375 @@
+"""Compiled-plan artifact layer suite (guard_tpu/ops/plan.py): cache
+key sensitivity (one rule byte, bucket shape, device fingerprint,
+schema version each flip the digest; file names never do), bit-table
+extension parity against direct lowering, the disk artifact round trip
+(a warm cache performs zero lowering passes), corrupt/mismatched
+artifacts degrading to misses with a warning, and the end-to-end
+parity gates: plan-cached and --no-plan-cache runs must be
+byte-identical across worker counts, pack modes, rule sharding and
+every output format. The plan layer buys time, never bits."""
+
+import json
+import pickle
+
+import numpy as np
+import pytest
+
+from guard_tpu.cli import run
+from guard_tpu.commands.validate import RuleFile
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.ops import plan as plan_mod
+from guard_tpu.ops.encoder import Interner
+from guard_tpu.ops.ir import compile_rules_file, extend_bit_tables
+from guard_tpu.utils.io import Reader, Writer
+
+RULES_A = (
+    "let b = Resources.*[ Type == 'AWS::S3::Bucket' ]\n"
+    "rule sse when %b !empty { %b.Properties.Enc == true }\n"
+)
+RULES_B = (
+    "rule named { Resources.*.Properties.Name in ['web', 'db'] }\n"
+    "rule arnish { Resources.*.Properties.Arn == /^arn:aws:/ }\n"
+)
+# count() makes the file function-variable: excluded from packing, it
+# re-encodes + re-lowers per chunk on the plan's slow path
+RULES_FN = (
+    "let n = count(Resources.*)\n"
+    "rule few { %n <= 4 }\n"
+)
+
+
+def _rule_file(content: str, name: str = "r.guard") -> RuleFile:
+    return RuleFile(
+        name=name, full_name=name, content=content,
+        rules=parse_rules_file(content, name),
+    )
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan_state(tmp_path, monkeypatch):
+    """Each test gets an empty memo and its own artifact directory."""
+    monkeypatch.setenv("GUARD_TPU_PLAN_CACHE_DIR", str(tmp_path / "plans"))
+    plan_mod.clear_plan_memo()
+    plan_mod.reset_plan_stats()
+    yield
+    plan_mod.clear_plan_memo()
+    plan_mod.reset_plan_stats()
+
+
+def _mk_corpus(tmp_path, n=8, fail=(2,), extra_rules=()):
+    data = tmp_path / "data"
+    data.mkdir(exist_ok=True)
+    rule_paths = []
+    for i, content in enumerate((RULES_A,) + tuple(extra_rules)):
+        p = tmp_path / f"rules{i}.guard"
+        p.write_text(content)
+        rule_paths.append(str(p))
+    for i in range(n):
+        doc = {
+            "Resources": {
+                f"b{i}": {
+                    "Type": "AWS::S3::Bucket",
+                    "Properties": {
+                        "Enc": i not in fail,
+                        "Name": "web" if i % 2 else "worker",
+                        "Arn": f"arn:aws:s3:::b{i}",
+                    },
+                }
+            }
+        }
+        (data / f"t{i:02d}.json").write_text(json.dumps(doc))
+    return rule_paths, data
+
+
+# ------------------------------------------------------ cache key
+
+
+def test_plan_key_changes_with_one_rule_byte():
+    rf = _rule_file(RULES_A)
+    tweaked = _rule_file(RULES_A.replace("true", "false"))
+    assert plan_mod.plan_key([rf]) != plan_mod.plan_key([tweaked])
+    # and is stable for byte-identical content in fresh objects
+    assert plan_mod.plan_key([rf]) == plan_mod.plan_key(
+        [_rule_file(RULES_A)]
+    )
+
+
+def test_plan_key_ignores_file_names():
+    a = _rule_file(RULES_A, name="one.guard")
+    b = _rule_file(RULES_A, name="two.guard")
+    assert plan_mod.plan_key([a]) == plan_mod.plan_key([b])
+
+
+def test_plan_key_covers_file_order():
+    a, b = _rule_file(RULES_A), _rule_file(RULES_B)
+    assert plan_mod.plan_key([a, b]) != plan_mod.plan_key([b, a])
+
+
+def test_plan_key_sensitive_to_every_environment_axis():
+    rf = _rule_file(RULES_A)
+    base = plan_mod.plan_key(
+        [rf], device_kind="cpu", device_count=8,
+    )
+    assert base != plan_mod.plan_key(
+        [rf], device_kind="tpu", device_count=8,
+    )
+    assert base != plan_mod.plan_key(
+        [rf], device_kind="cpu", device_count=4,
+    )
+    assert base != plan_mod.plan_key(
+        [rf], device_kind="cpu", device_count=8,
+        schema_version=plan_mod.PLAN_SCHEMA_VERSION + 1,
+    )
+    assert base != plan_mod.plan_key(
+        [rf], device_kind="cpu", device_count=8, buckets=(64, 256),
+    )
+    assert base != plan_mod.plan_key(
+        [rf], device_kind="cpu", device_count=8, pack_max_rules=7,
+    )
+
+
+# ------------------------------------------- extension vs direct lower
+
+
+def test_extend_bit_tables_matches_direct_lowering():
+    """A plan lowered against an EMPTY interner and then extended over
+    the corpus strings must hold bit tables identical to IR lowered
+    directly against an interner that already knew those strings."""
+    rules = parse_rules_file(RULES_B, "r.guard")
+    corpus = [
+        "web", "db", "worker", "arn:aws:s3:::b1", "arn:gcp:thing", "",
+    ]
+
+    direct_int = Interner()
+    for s in corpus:
+        direct_int.intern(s)
+    direct = compile_rules_file(rules, direct_int)
+
+    plan_int = Interner()
+    lazy = compile_rules_file(rules, plan_int)
+    assert all(len(t) == 0 for t, _tg in lazy.bit_tables)
+    for s in corpus:
+        plan_int.intern(s)
+    extend_bit_tables([lazy], plan_int)
+
+    assert len(lazy.bit_tables) == len(direct.bit_tables)
+    assert len(lazy.bit_specs) == len(lazy.bit_tables)
+    for (lt, ltg), (dt, dtg) in zip(lazy.bit_tables, direct.bit_tables):
+        assert ltg == dtg
+        np.testing.assert_array_equal(lt, dt)
+    np.testing.assert_array_equal(lazy.str_empty_bits,
+                                  direct.str_empty_bits)
+
+
+def test_extend_bit_tables_grows_shared_arrays_once():
+    """pack_compiled aliases part tables by reference; the id()-memo
+    must grow each underlying array exactly once and rebind every
+    alias, keeping the pack and its parts in lockstep."""
+    from guard_tpu.ops.ir import pack_compiled
+
+    interner = Interner()
+    a = compile_rules_file(parse_rules_file(RULES_A, "a"), interner)
+    b = compile_rules_file(parse_rules_file(RULES_B, "b"), interner)
+    packed = pack_compiled([a, b])
+    for s in ("web", "db", "arn:aws:x", ""):
+        interner.intern(s)
+    extend_bit_tables([a, b, packed.compiled], interner)
+    n = len(interner.strings)
+    for comp in (a, b, packed.compiled):
+        assert all(len(t) == n for t, _tg in comp.bit_tables)
+        assert len(comp.str_empty_bits) == n
+    # aliases stayed aliases: the pack's tables are the parts' tables
+    # (a contributes none here), rebound to the same grown arrays —
+    # never re-evaluated into diverging copies
+    part_tables = [t for t, _tg in a.bit_tables + b.bit_tables]
+    for pt, _tg in packed.compiled.bit_tables:
+        assert any(pt is t for t in part_tables)
+    # a second pass over an unchanged interner is a no-op
+    assert extend_bit_tables([a, b, packed.compiled], interner) == 0
+
+
+# ------------------------------------------------------ disk artifacts
+
+
+def test_disk_roundtrip_skips_lowering(monkeypatch):
+    rfs = [_rule_file(RULES_A), _rule_file(RULES_B)]
+    plan_mod.get_plan(rfs)
+    stats = plan_mod.plan_stats()
+    assert stats["misses"] == 1 and stats["artifacts_saved"] == 1
+    arts = list(plan_mod.plan_cache_dir().glob("*.plan"))
+    assert len(arts) == 1
+
+    # fresh "process": memo gone, artifact present — the build path
+    # must never run again
+    plan_mod.clear_plan_memo()
+    plan_mod.reset_plan_stats()
+
+    def _boom(_rfs):
+        raise AssertionError("warm cache must not rebuild")
+
+    monkeypatch.setattr(plan_mod, "build_plan", _boom)
+    plan = plan_mod.get_plan([_rule_file(RULES_A), _rule_file(RULES_B)])
+    stats = plan_mod.plan_stats()
+    assert stats["hits"] == 1 and stats["misses"] == 0
+    assert stats["bytes_loaded"] > 0
+    # the loaded plan is canonical: empty interner, no corpus leakage
+    assert len(plan.interner.strings) == 0
+    assert all(
+        len(t) == 0 for c in plan.all_compiled() for t, _tg in c.bit_tables
+    )
+
+
+def test_saved_artifact_stays_corpus_independent():
+    """Relocation AFTER the save must not leak chunk strings into the
+    on-disk artifact (it is written before first use)."""
+    from guard_tpu.core.values import from_plain
+    from guard_tpu.ops.encoder import encode_batch
+
+    rfs = [_rule_file(RULES_B)]
+    plan = plan_mod.get_plan(rfs)
+    chunk = Interner()
+    batch, _ = encode_batch(
+        [from_plain({"Resources": {"x": {"Properties": {"Name": "web"}}}})],
+        chunk,
+    )
+    plan_mod.relocate_batch(plan, batch, chunk)
+    assert len(plan.interner.strings) > 0  # live plan grew
+    reloaded = plan_mod.load_plan(plan.digest)
+    assert reloaded is not None
+    assert len(reloaded.interner.strings) == 0  # artifact did not
+
+
+def test_corrupt_artifact_degrades_to_miss(caplog):
+    rfs = [_rule_file(RULES_A)]
+    plan_mod.get_plan(rfs)
+    art = next(plan_mod.plan_cache_dir().glob("*.plan"))
+    art.write_bytes(b"\x00garbage, not a pickle")
+    plan_mod.clear_plan_memo()
+    plan_mod.reset_plan_stats()
+    with caplog.at_level("WARNING", logger="guard_tpu.plan"):
+        plan = plan_mod.get_plan([_rule_file(RULES_A)])
+    assert plan is not None
+    stats = plan_mod.plan_stats()
+    assert stats["misses"] == 1 and stats["hits"] == 0
+    assert any("treating as a cache miss" in r.message for r in
+               caplog.records)
+    # the rebuild rewrote a valid artifact in place
+    assert plan_mod.load_plan(plan.digest) is not None
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda p: {**p, "schema": p["schema"] + 1},
+    lambda p: {**p, "version": "0.0.0-other"},
+    lambda p: {**p, "digest": "0" * 64},
+    lambda p: ["not", "a", "dict"],
+])
+def test_mismatched_artifact_payloads_are_misses(mutate, caplog):
+    rfs = [_rule_file(RULES_A)]
+    plan = plan_mod.get_plan(rfs)
+    art = plan_mod._artifact_path(plan.digest)
+    payload = pickle.loads(art.read_bytes())
+    art.write_bytes(pickle.dumps(mutate(payload)))
+    with caplog.at_level("WARNING", logger="guard_tpu.plan"):
+        assert plan_mod.load_plan(plan.digest) is None
+
+
+def test_unwritable_cache_dir_warns_and_continues(monkeypatch, caplog,
+                                                  tmp_path):
+    blocker = tmp_path / "blocked"
+    blocker.write_text("a file where the cache dir should be")
+    monkeypatch.setenv("GUARD_TPU_PLAN_CACHE_DIR", str(blocker))
+    with caplog.at_level("WARNING", logger="guard_tpu.plan"):
+        plan = plan_mod.get_plan([_rule_file(RULES_A)])
+    assert plan is not None  # persistence failure is never fatal
+    assert plan_mod.plan_stats()["artifacts_saved"] == 0
+
+
+# ------------------------------------------------------- parity gates
+
+
+def _sweep(rule_paths, data, tmp_path, tag, *extra):
+    w = Writer.buffered()
+    rc = run(
+        ["sweep", "-r", *rule_paths, "-d", str(data),
+         "-M", str(tmp_path / f"m-{tag}.jsonl"), "-c", "4",
+         "--backend", "tpu", *extra],
+        writer=w, reader=Reader(),
+    )
+    summary = json.loads(w.out.getvalue())
+    summary.pop("manifest", None)  # the only path-bearing key
+    return rc, summary, w.err.getvalue()
+
+
+@pytest.mark.parametrize("workers", [0, 2])
+@pytest.mark.parametrize("pack", [(), ("--no-pack",)])
+def test_sweep_parity_plan_vs_legacy(tmp_path, workers, pack):
+    """Cold plan, warm plan and --no-plan-cache sweeps are identical
+    in exit code, summary and stderr — per-file and packed, with and
+    without ingest workers, fn-var slow path included."""
+    rule_paths, data = _mk_corpus(
+        tmp_path, n=8, fail=(2, 5), extra_rules=(RULES_B, RULES_FN)
+    )
+    common = ("--ingest-workers", str(workers), *pack)
+    cold = _sweep(rule_paths, data, tmp_path, "cold", *common)
+    assert plan_mod.plan_stats()["misses"] == 1
+    warm = _sweep(rule_paths, data, tmp_path, "warm", *common)
+    assert plan_mod.plan_stats()["hits"] >= 1
+    legacy = _sweep(
+        rule_paths, data, tmp_path, "off", *common, "--no-plan-cache"
+    )
+    assert cold == warm == legacy
+
+
+def test_sweep_parity_rule_sharded(tmp_path):
+    """Plan + PackShardedEvaluator: the per-shard pack memo re-extends
+    cached packs after later chunks relocate, staying bit-identical to
+    the legacy per-chunk repack."""
+    rule_paths, data = _mk_corpus(
+        tmp_path, n=8, fail=(1, 6), extra_rules=(RULES_B,)
+    )
+    on = _sweep(rule_paths, data, tmp_path, "on", "--rule-shards", "2")
+    warm = _sweep(rule_paths, data, tmp_path, "w", "--rule-shards", "2")
+    off = _sweep(
+        rule_paths, data, tmp_path, "off", "--rule-shards", "2",
+        "--no-plan-cache",
+    )
+    assert on == warm == off
+
+
+def _validate(rule_paths, data, *extra):
+    w = Writer.buffered()
+    rc = run(
+        ["validate", "-r", *rule_paths, "-d", str(data),
+         "--backend", "tpu", *extra],
+        writer=w, reader=Reader(),
+    )
+    return rc, w.out.getvalue(), w.err.getvalue()
+
+
+@pytest.mark.parametrize(
+    "fmt", ["single-line-summary", "json", "yaml", "junit", "sarif"]
+)
+def test_validate_output_modes_parity(tmp_path, fmt):
+    rule_paths, data = _mk_corpus(
+        tmp_path, n=6, fail=(1, 4), extra_rules=(RULES_B,)
+    )
+    extra = ("-o", fmt) + (
+        ("--structured",) if fmt in ("json", "yaml", "junit", "sarif")
+        else ()
+    )
+    cached = _validate(rule_paths, data, *extra)
+    warm = _validate(rule_paths, data, *extra)
+    legacy = _validate(rule_paths, data, *extra, "--no-plan-cache")
+    assert cached == warm == legacy
+
+
+def test_env_escape_hatch_disables_layer(tmp_path, monkeypatch):
+    rule_paths, data = _mk_corpus(tmp_path, n=4, fail=(0,))
+    monkeypatch.setenv("GUARD_TPU_PLAN_CACHE", "0")
+    out = _sweep(rule_paths, data, tmp_path, "env-off")
+    stats = plan_mod.plan_stats()
+    assert stats["hits"] == stats["misses"] == 0
+    assert not list(plan_mod.plan_cache_dir().glob("*.plan"))
+    monkeypatch.delenv("GUARD_TPU_PLAN_CACHE")
+    on = _sweep(rule_paths, data, tmp_path, "env-on")
+    assert out == on

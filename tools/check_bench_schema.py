@@ -32,6 +32,13 @@ import bench  # noqa: E402  (repo root on sys.path above)
 
 CONTRACT_KEYS = ("metric", "value", "unit", "vs_baseline")
 
+# shared by the three plan-regime rows below
+PLAN_REQUIRED_KEYS = (
+    "lower_compile_seconds_per_run", "pack_compile_seconds_per_run",
+    "relocate_seconds_per_run", "plan_hits", "plan_misses",
+    "plan_bytes_loaded",
+)
+
 # per-metric REQUIRED extra keys (PR 2 rim decomposition): the rim rows
 # must say how many docs materialized vs settled and how the run time
 # split between kernel and host rim, and every config6 fail-heavy row
@@ -66,6 +73,14 @@ METRIC_REQUIRED_KEYS = {
         "poisoned_docs", "quarantined_docs", "retries",
         "dispatch_fallbacks",
     ),
+    # PR 7 plan artifact layer: each regime row must carry the
+    # lowering-plane decomposition (where the host time went) and the
+    # plan_cache counters — "did the warm run actually skip lowering"
+    # and "did the restart run re-compile" are answerable from the
+    # artifact alone
+    "config5b_plan_cold_templates_per_sec": PLAN_REQUIRED_KEYS,
+    "config5b_plan_warm_templates_per_sec": PLAN_REQUIRED_KEYS,
+    "config5b_plan_restart_templates_per_sec": PLAN_REQUIRED_KEYS,
 }
 
 # PR 3 ingest decomposition: every *_ingest_workers* row must say how

@@ -34,8 +34,10 @@ SECTIONS = ("schema_version", "counters", "gauges", "histograms", "spans")
 #: module import, and a jax-free session (cpu validate, serve) never
 #: imports parallel.mesh — so dispatch/pipeline can be legitimately
 #: absent; callers that ran the full pipeline pass these as
-#: `require_groups` (the CI trace-smoke does)
-EXPECTED_GROUPS = ("dispatch", "pipeline", "rim", "fault")
+#: `require_groups` (the CI trace-smoke does). plan_cache registers
+#: with ops.plan and is part of every tpu-backend run since the plan
+#: layer became the default lowering path.
+EXPECTED_GROUPS = ("dispatch", "pipeline", "rim", "fault", "plan_cache")
 
 #: keys every histogram snapshot must carry
 HIST_KEYS = (
