@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import sys
+import time
 from typing import List, Optional
 
 from .commands.completions import Completions
@@ -230,6 +231,48 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--stdio", action="store_true")
     _add_telemetry_flags(sv)
 
+    rp = sub.add_parser(
+        "report",
+        help="Render and diff run-ledger records (the operations "
+        "plane's cross-run memory; needs GUARD_TPU_LEDGER_DIR or "
+        "--ledger)",
+    )
+    rp.add_argument(
+        "--ledger",
+        default=None,
+        metavar="FILE",
+        help="ledger JSONL to read (default: "
+        "$GUARD_TPU_LEDGER_DIR/ledger.jsonl)",
+    )
+    rp.add_argument(
+        "--baseline",
+        default=None,
+        metavar="FILE",
+        help="diff the newest record against the newest record of this "
+        "committed baseline ledger instead of the previous record",
+    )
+    rp.add_argument(
+        "--efficiency",
+        action="store_true",
+        help="render the newest record's hardware-efficiency metrics "
+        "(padding waste, pack occupancy, transfer bytes)",
+    )
+    rp.add_argument(
+        "--check",
+        default=None,
+        metavar="METRIC",
+        help="min-of-N noise-band regression gate on this headline "
+        "metric; exits 19 on a regression",
+    )
+    rp.add_argument("--tolerance", type=float, default=0.15)
+    rp.add_argument(
+        "--window",
+        type=int,
+        default=3,
+        help="how many prior records form the noise band (best-of-N "
+        "baseline)",
+    )
+
     return p
 
 
@@ -253,8 +296,14 @@ def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reade
 
         telemetry.enable()
         telemetry.reset_trace()
+    t0 = time.perf_counter()
+    rc: Optional[int] = None
     try:
-        return _dispatch(args, writer, reader)
+        rc = _dispatch(args, writer, reader)
+        return rc
+    except BrokenPipeError:
+        rc = 141
+        raise
     finally:
         if trace_out or metrics_out:
             from .utils import telemetry
@@ -264,6 +313,41 @@ def run(argv: Optional[List[str]] = None, writer: Optional[Writer] = None, reade
             if metrics_out:
                 telemetry.write_metrics(metrics_out)
             telemetry.disable()
+        _session_epilogue(args, rc, time.perf_counter() - t0)
+
+
+def _session_epilogue(args, rc: Optional[int], dt: float) -> None:
+    """Operations-plane exit hooks for the engine-driving commands:
+    the flight recorder dumps forensics on abnormal exits (code 5,
+    unhandled exceptions — rc None here — or latched fault activity),
+    and the run ledger appends one session record when
+    GUARD_TPU_LEDGER_DIR is set. Both are best-effort: a failing dump
+    or append must never change the session's exit code."""
+    if args.command not in ("validate", "sweep", "serve"):
+        return
+    from .utils import telemetry
+
+    try:
+        telemetry.flightrec_on_exit(rc)
+    except Exception:
+        pass
+    from .utils import ledger
+
+    if not ledger.ledger_enabled():
+        return
+    try:
+        ledger.append_record(
+            kind=args.command,
+            headline={
+                "metric": f"{args.command}_session_seconds",
+                "value": dt,
+                "unit": "seconds",
+            },
+            config=dict(sorted(vars(args).items())),
+            exit_code=rc,
+        )
+    except Exception:
+        pass
 
 
 def _dispatch(args, writer: Writer, reader: Reader) -> int:
@@ -338,6 +422,17 @@ def _dispatch(args, writer: Writer, reader: Reader) -> int:
             from .commands.serve import Serve
 
             return Serve(stdio=True).execute(writer, reader)
+        if args.command == "report":
+            from .commands.ops_report import OpsReport
+
+            return OpsReport(
+                ledger_file=args.ledger,
+                baseline=args.baseline,
+                efficiency=args.efficiency,
+                check=args.check,
+                tolerance=args.tolerance,
+                window=args.window,
+            ).execute(writer, reader)
     except GuardError as e:
         writer.writeln_err(f"Error: {e}")
         return 5

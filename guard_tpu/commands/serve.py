@@ -220,6 +220,14 @@ class Serve:
                     }
             except Exception as e:  # poisoned request: keep serving
                 sp.set("error_class", type(e).__name__)
+                # arm the flight recorder: a timed-out or poisoned
+                # request answers code 5 but the SESSION exits 0, so
+                # without this latch the abnormal-exit dump would never
+                # fire for serve-side failures
+                telemetry.flightrec_mark_fault(
+                    "serve.request_error",
+                    {"error_class": type(e).__name__},
+                )
                 resp = {
                     "code": 5,
                     "output": "",

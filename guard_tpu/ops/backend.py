@@ -146,6 +146,24 @@ def reset_pipeline_stats() -> None:
     _TELEMETRY.reset_group("pipeline")
 
 
+def efficiency_stats() -> dict:
+    """Snapshot of the hardware-efficiency counters
+    (parallel.mesh.EFFICIENCY_COUNTERS): padded-batch occupancy (real
+    vs padding doc/node slots), host<->device transfer bytes, and pack
+    rule-slot usage vs the PACK_MAX_RULES ceiling. `guard-tpu report
+    --efficiency` renders these from ledger records; tests reconcile
+    them against hand-computed batch shapes."""
+    from ..parallel import mesh  # noqa: F401  registers the group
+
+    return _TELEMETRY.group_stats("efficiency")
+
+
+def reset_efficiency_stats() -> None:
+    from ..parallel import mesh  # noqa: F401  registers the group
+
+    _TELEMETRY.reset_group("efficiency")
+
+
 def reset_fault_stats() -> None:
     """Reset the failure-plane counters (utils.faults.FAULT_COUNTERS);
     `fault_stats` is re-exported above them for symmetry with the
@@ -223,7 +241,7 @@ def dispatch_packs(items, batch, with_rim=None, prepacked=None) -> PackPending:
 def _dispatch_packs_inner(items, batch, with_rim, prepacked=None) -> PackPending:
     from .encoder import NODE_BUCKETS_EXTENDED, split_batch_by_size
     from .ir import PackIncompatible
-    from ..parallel.mesh import ShardedBatchEvaluator
+    from ..parallel.mesh import EFFICIENCY_COUNTERS, ShardedBatchEvaluator
 
     groups, oversize = split_batch_by_size(batch, NODE_BUCKETS_EXTENDED)
     host_docs = {int(i) for i in oversize}
@@ -243,6 +261,14 @@ def _dispatch_packs_inner(items, batch, with_rim, prepacked=None) -> PackPending
                 continue
             planned.append((pack, packed, spec))
     for pack, packed, spec in planned:
+        # pack-slot occupancy: rule slots this pack fills against the
+        # PACK_MAX_RULES ceiling packs close at (one executable traces
+        # every packed rule, so unused slots are pure headroom, not
+        # padding — but the fill fraction says how fused dispatch is)
+        EFFICIENCY_COUNTERS["pack_rule_slots_used"] += len(
+            packed.compiled.rules
+        )
+        EFFICIENCY_COUNTERS["pack_rule_slots_capacity"] += PACK_MAX_RULES
         ev = ShardedBatchEvaluator(
             packed.compiled, rim_spec=spec if with_rim else None
         )
@@ -262,6 +288,12 @@ def _dispatch_packs_inner(items, batch, with_rim, prepacked=None) -> PackPending
                 FAULT_COUNTERS["dispatch_fallbacks"] += 1
                 handles.append((idx, sub, None))
         pending.append((pack, packed, spec, ev, handles))
+    used = EFFICIENCY_COUNTERS["pack_rule_slots_used"]
+    cap = EFFICIENCY_COUNTERS["pack_rule_slots_capacity"]
+    if cap:
+        _TELEMETRY.set_gauge(
+            "efficiency.pack_slot_utilization", used / cap
+        )
     return PackPending(pending, host_docs, with_rim)
 
 

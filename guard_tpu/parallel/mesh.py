@@ -150,6 +150,44 @@ def reset_pipeline_counters() -> None:
     _TELEMETRY.reset_group("pipeline")
 
 
+# Hardware-efficiency observability (the `efficiency` group,
+# snapshot schema v2): how much of the padded (docs x nodes) batch the
+# device actually chews on, and how many bytes cross the host<->device
+# boundary per dispatch/collect — the occupancy/transfer numbers the
+# multi-chip mesh and serving tier must tune against.
+#   docs_real / docs_padded       — documents dispatched vs padding
+#                                   docs added by pad_to_multiple so
+#                                   the doc axis divides the mesh;
+#   node_slots_real / _padded     — non-padding node slots vs wasted
+#                                   slots (doc padding + per-bucket
+#                                   node-ceiling padding combined);
+#   host_to_device_bytes          — batch arrays + rule literals
+#                                   shipped per dispatch;
+#   device_to_host_bytes          — status/unsure matrices + rim
+#                                   blocks converted back per collect
+#                                   (padded shapes: what actually
+#                                   crosses, not the trimmed view);
+#   pack_rule_slots_used /        — rule slots occupied vs the
+#   _capacity                       PACK_MAX_RULES ceiling per planned
+#                                   pack (ops.backend increments).
+# Per-bucket fill fractions and the live-executable census land as
+# `efficiency.*` gauges next to the counters.
+EFFICIENCY_COUNTERS = _TELEMETRY.counter_group("efficiency", {
+    "docs_real": 0,
+    "docs_padded": 0,
+    "node_slots_real": 0,
+    "node_slots_padded": 0,
+    "host_to_device_bytes": 0,
+    "device_to_host_bytes": 0,
+    "pack_rule_slots_used": 0,
+    "pack_rule_slots_capacity": 0,
+})
+
+
+def reset_efficiency_counters() -> None:
+    _TELEMETRY.reset_group("efficiency")
+
+
 def _mesh_key(mesh: Mesh) -> tuple:
     # platform included: device ids are unique only per backend
     # (CpuDevice 0 and TpuDevice 0 coexist), and an explicit CPU mesh
@@ -343,11 +381,40 @@ class ShardedBatchEvaluator:
         if shape_key not in _COMPILED_SHAPES:
             _COMPILED_SHAPES.add(shape_key)
             DISPATCH_COUNTERS["executables_compiled"] += 1
+        lits = self._lits()
+        # hardware-efficiency seam: padded-batch occupancy + the bytes
+        # this dispatch ships to the device (batch arrays + literals)
+        padded_d, n_nodes = arrays["node_kind"].shape
+        real_slots = int((arrays["node_kind"] >= 0).sum())
+        EFFICIENCY_COUNTERS["docs_real"] += d
+        EFFICIENCY_COUNTERS["docs_padded"] += padded_d - d
+        EFFICIENCY_COUNTERS["node_slots_real"] += real_slots
+        EFFICIENCY_COUNTERS["node_slots_padded"] += (
+            padded_d * n_nodes - real_slots
+        )
+        EFFICIENCY_COUNTERS["host_to_device_bytes"] += int(
+            sum(a.nbytes for a in arrays.values()) + lits.nbytes
+        )
+        _TELEMETRY.set_gauge(
+            f"efficiency.bucket_{n_nodes}.doc_fill",
+            d / padded_d if padded_d else 0.0,
+        )
+        _TELEMETRY.set_gauge(
+            f"efficiency.bucket_{n_nodes}.node_fill",
+            real_slots / (padded_d * n_nodes) if padded_d * n_nodes
+            else 0.0,
+        )
+        _TELEMETRY.set_gauge(
+            "efficiency.live_executables", len(_COMPILED_SHAPES)
+        )
+        _TELEMETRY.set_gauge(
+            "efficiency.shared_evaluators", len(_SHARED_FNS)
+        )
         # numpy straight into the jitted call: in_shardings place the
         # arrays on this evaluator's mesh; jnp.asarray would commit them
         # to the default device first (wrong backend on TPU hosts when
         # the mesh is a CPU mesh).
-        out = self._fn(arrays, self._lits())
+        out = self._fn(arrays, lits)
         rim = None
         if self.rim_spec is not None:
             statuses = out[0] if self._with_unsure else out
@@ -366,14 +433,28 @@ class ShardedBatchEvaluator:
         element (each trimmed to d docs) when this evaluator carries a
         rim_spec."""
         out, d, rim_dev = handle
+        # hardware-efficiency seam: the PADDED device arrays are what
+        # cross back to the host (the [:d] trim happens host-side)
         if self._with_unsure:
             statuses, unsure = out
-            st, un = np.asarray(statuses)[:d], np.asarray(unsure)[:d]
+            st_full, un_full = np.asarray(statuses), np.asarray(unsure)
+            EFFICIENCY_COUNTERS["device_to_host_bytes"] += int(
+                st_full.nbytes + un_full.nbytes
+            )
+            st, un = st_full[:d], un_full[:d]
         else:
-            st, un = np.asarray(out)[:d], None
+            st_full = np.asarray(out)
+            EFFICIENCY_COUNTERS["device_to_host_bytes"] += int(
+                st_full.nbytes
+            )
+            st, un = st_full[:d], None
         if self.rim_spec is None:
             return st, un
-        rim = tuple(np.asarray(b)[:d] for b in rim_dev)
+        rim_full = [np.asarray(b) for b in rim_dev]
+        EFFICIENCY_COUNTERS["device_to_host_bytes"] += int(
+            sum(b.nbytes for b in rim_full)
+        )
+        rim = tuple(b[:d] for b in rim_full)
         return st, un, rim
 
     def __call__(self, batch: DocBatch) -> np.ndarray:

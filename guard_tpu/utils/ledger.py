@@ -1,0 +1,291 @@
+"""Persistent run ledger: the cross-run memory of the telemetry plane.
+
+`utils/telemetry.py` made a single run observable; every snapshot
+still died with the process, so regressions between bench artifacts
+were caught by eyeball and a sweep's counters evaporated at exit. The
+ledger is an append-only JSONL file (`$GUARD_TPU_LEDGER_DIR/
+ledger.jsonl`) of schema-versioned records — one per validate/sweep/
+serve session (cli.run's epilogue) and one per `bench.py measure_*`
+row (`bench._emit`) — each carrying the config hash, guard_tpu
+version, device census, headline throughput/latency and the full
+metrics snapshot (counter groups, histograms, span roll-ups,
+plan-cache stats).
+
+Opt-in by construction: nothing is written unless GUARD_TPU_LEDGER_DIR
+is set, so ordinary CLI use and the test suite stay side-effect-free.
+
+Consumers: `guard-tpu report` (commands/ops_report.py) diffs the two
+newest records or a run against a committed baseline ledger;
+`regression_check` is the min-of-N noise-band gate behind
+`bench.py --ledger-smoke`; `tools/perf_ledger.py` backfills records
+from the committed `bench_all_r*.json` artifacts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from .telemetry import metrics_snapshot
+
+#: ledger-record schema version (bump on breaking record-shape changes)
+LEDGER_SCHEMA_VERSION = 1
+
+#: record kinds the ledger understands (check_record pins these)
+RECORD_KINDS = ("validate", "sweep", "serve", "bench")
+
+#: keys every ledger record must carry
+RECORD_KEYS = (
+    "schema_version", "kind", "ts", "guard_tpu_version", "config_hash",
+    "device_census", "headline", "exit_code", "metrics", "extra",
+)
+
+
+def ledger_dir() -> Optional[str]:
+    return os.environ.get("GUARD_TPU_LEDGER_DIR") or None
+
+
+def ledger_enabled() -> bool:
+    return ledger_dir() is not None
+
+
+def ledger_path() -> Optional[str]:
+    d = ledger_dir()
+    return os.path.join(d, "ledger.jsonl") if d else None
+
+
+def config_hash(config) -> str:
+    """Stable short digest of a JSON-serializable config mapping: two
+    sessions with identical flags hash identically regardless of key
+    order (canonical JSON), so `report` can tell "same config, slower"
+    from "different config"."""
+    canon = json.dumps(config, sort_keys=True, separators=(",", ":"),
+                       default=str)
+    return hashlib.sha256(canon.encode()).hexdigest()[:16]
+
+
+def device_census() -> dict:
+    """Backend + device count for the record. Reads jax ONLY if it is
+    already imported — a jax-free serve/validate session must not pay
+    (or hang on) device discovery just to write a ledger line."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return {"backend": "none", "device_count": 0}
+    try:
+        devs = jax.devices()
+        return {
+            "backend": devs[0].platform if devs else "none",
+            "device_count": len(devs),
+        }
+    except Exception:
+        return {"backend": "unknown", "device_count": 0}
+
+
+def build_record(kind: str, headline: Optional[dict] = None,
+                 config=None, exit_code: Optional[int] = None,
+                 extra: Optional[dict] = None,
+                 ts: Optional[float] = None,
+                 capture_metrics: bool = True) -> dict:
+    """Assemble one schema-versioned ledger record (no I/O). `headline`
+    is {"metric", "value", "unit"}; `capture_metrics=False` (backfill)
+    records `metrics: null` instead of the live snapshot."""
+    try:
+        from guard_tpu import __version__ as version
+    except Exception:
+        version = "unknown"
+    return {
+        "schema_version": LEDGER_SCHEMA_VERSION,
+        "kind": kind,
+        "ts": time.time() if ts is None else ts,
+        "guard_tpu_version": version,
+        "config_hash": config_hash(config) if config is not None else None,
+        "device_census": device_census(),
+        "headline": headline,
+        "exit_code": exit_code,
+        "metrics": metrics_snapshot() if capture_metrics else None,
+        "extra": extra or {},
+    }
+
+
+def append_record(kind: str, headline: Optional[dict] = None,
+                  config=None, exit_code: Optional[int] = None,
+                  extra: Optional[dict] = None,
+                  ts: Optional[float] = None,
+                  capture_metrics: bool = True,
+                  path: Optional[str] = None) -> Optional[dict]:
+    """Append one record to the ledger (creating the directory/file on
+    first use). Returns the record, or None when no ledger is
+    configured and no explicit path given."""
+    if path is None:
+        path = ledger_path()
+        if path is None:
+            return None
+    rec = build_record(kind, headline=headline, config=config,
+                       exit_code=exit_code, extra=extra, ts=ts,
+                       capture_metrics=capture_metrics)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    # NO sort_keys: the embedded metrics snapshot's histogram-bucket
+    # order is schema-relevant (ascending exponents; lexical sorting
+    # scrambles "le_2^-7s" vs "le_2^-10s"); record-level canonicality
+    # is config_hash's job, not the storage line's
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    return rec
+
+
+def read_ledger(path: Optional[str] = None) -> List[dict]:
+    """All records of a ledger file, in append order. Raises
+    FileNotFoundError for a missing ledger and ValueError (with the
+    line number) for a corrupt line — an append-only log that fails to
+    parse is a bug worth surfacing, not skipping."""
+    if path is None:
+        path = ledger_path()
+        if path is None:
+            raise FileNotFoundError(
+                "no ledger configured (set GUARD_TPU_LEDGER_DIR)"
+            )
+    records = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{ln}: corrupt ledger line ({e})")
+    return records
+
+
+def check_record(rec) -> List[str]:
+    """Schema validation for one record; returns problem strings
+    (empty = valid). The machine face of the record contract — tests
+    round-trip through this, and `report` refuses malformed input."""
+    problems = []
+    if not isinstance(rec, dict):
+        return ["record is not a JSON object"]
+    for k in RECORD_KEYS:
+        if k not in rec:
+            problems.append(f"missing key {k!r}")
+    if problems:
+        return problems
+    if rec["schema_version"] != LEDGER_SCHEMA_VERSION:
+        problems.append(
+            f"schema_version {rec['schema_version']!r} != "
+            f"{LEDGER_SCHEMA_VERSION}"
+        )
+    if rec["kind"] not in RECORD_KINDS:
+        problems.append(f"unknown kind {rec['kind']!r}")
+    if not isinstance(rec["ts"], (int, float)):
+        problems.append("ts is not numeric")
+    census = rec["device_census"]
+    if (not isinstance(census, dict) or "backend" not in census
+            or "device_count" not in census):
+        problems.append("device_census must carry backend + device_count")
+    head = rec["headline"]
+    if head is not None:
+        if (not isinstance(head, dict)
+                or not isinstance(head.get("metric"), str)
+                or not isinstance(head.get("value"), (int, float))
+                or not isinstance(head.get("unit"), str)):
+            problems.append(
+                "headline must be null or {metric: str, value: number, "
+                "unit: str}"
+            )
+    if rec["metrics"] is not None and not isinstance(rec["metrics"], dict):
+        problems.append("metrics must be null or a snapshot object")
+    if not isinstance(rec["extra"], dict):
+        problems.append("extra is not an object")
+    return problems
+
+
+def _counter_flat(rec: dict) -> dict:
+    """{group.key: value} for a record's counter groups (empty when
+    metrics were not captured)."""
+    out = {}
+    metrics = rec.get("metrics") or {}
+    for g, vals in (metrics.get("counters") or {}).items():
+        if isinstance(vals, dict):
+            for k, v in vals.items():
+                out[f"{g}.{k}"] = v
+    return out
+
+
+def diff_records(a: dict, b: dict) -> dict:
+    """Structured diff of two records (a = older, b = newer): headline
+    ratio when both carry comparable headlines, plus every counter
+    whose value changed."""
+    diff = {
+        "a": {"kind": a.get("kind"), "ts": a.get("ts"),
+              "config_hash": a.get("config_hash"),
+              "headline": a.get("headline")},
+        "b": {"kind": b.get("kind"), "ts": b.get("ts"),
+              "config_hash": b.get("config_hash"),
+              "headline": b.get("headline")},
+        "same_config": (a.get("config_hash") is not None
+                        and a.get("config_hash") == b.get("config_hash")),
+        "headline_ratio": None,
+        "counters": {},
+    }
+    ha, hb = a.get("headline"), b.get("headline")
+    if (isinstance(ha, dict) and isinstance(hb, dict)
+            and ha.get("metric") == hb.get("metric")
+            and isinstance(ha.get("value"), (int, float))
+            and isinstance(hb.get("value"), (int, float))
+            and ha["value"]):
+        diff["headline_ratio"] = hb["value"] / ha["value"]
+    ca, cb = _counter_flat(a), _counter_flat(b)
+    for key in sorted(set(ca) | set(cb)):
+        va, vb = ca.get(key), cb.get(key)
+        if va != vb:
+            diff["counters"][key] = {"a": va, "b": vb}
+    return diff
+
+
+def regression_check(records: List[dict], metric: str,
+                     tolerance: float = 0.15, window: int = 3) -> dict:
+    """Min-of-N noise-band regression gate: compare the NEWEST record
+    carrying `metric` against the best of the up-to-`window` records
+    before it. Host noise only ever makes a run look slower, so the
+    best previous value is the honest baseline; `tolerance` is the
+    band a single noisy rep may dip below it without failing. Metrics
+    whose unit is seconds are lower-is-better; everything else
+    (throughput) is higher-is-better."""
+    matching = [
+        r for r in records
+        if isinstance(r.get("headline"), dict)
+        and r["headline"].get("metric") == metric
+        and isinstance(r["headline"].get("value"), (int, float))
+    ]
+    if len(matching) < 2:
+        return {
+            "metric": metric, "status": "insufficient",
+            "records": len(matching), "regressed": False,
+        }
+    cur = matching[-1]["headline"]["value"]
+    prev = [r["headline"]["value"] for r in matching[-(window + 1):-1]]
+    unit = matching[-1]["headline"].get("unit", "")
+    lower_better = "second" in unit
+    if lower_better:
+        base = min(prev)
+        regressed = cur > base * (1.0 + tolerance)
+    else:
+        base = max(prev)
+        regressed = cur < base * (1.0 - tolerance)
+    return {
+        "metric": metric,
+        "status": "regressed" if regressed else "ok",
+        "current": cur,
+        "baseline": base,
+        "window": len(prev),
+        "tolerance": tolerance,
+        "lower_is_better": lower_better,
+        "ratio": (cur / base) if base else None,
+        "regressed": regressed,
+    }

@@ -53,14 +53,17 @@ from __future__ import annotations
 import itertools
 import json
 import math
+import os
 import threading
 import time
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
 #: metrics-snapshot schema version (tools/check_metrics_schema.py
-#: validates against this; bump on breaking snapshot-shape changes)
-SCHEMA_VERSION = 1
+#: validates against this; bump on breaking snapshot-shape changes).
+#: v2: the `efficiency` counter/gauge group (padding waste, pack slot
+#: occupancy, transfer bytes) joined the snapshot contract.
+SCHEMA_VERSION = 2
 
 # fixed log2 histogram buckets: bucket i holds durations in
 # [2^(LOG2_LO+i-1), 2^(LOG2_LO+i)) seconds — ~1µs to ~128s, plus an
@@ -120,17 +123,24 @@ class Histogram:
 
     def quantile(self, q: float) -> Optional[float]:
         """Upper bucket bound at quantile q (bucket resolution — a
-        factor-of-2 answer, which is what a latency SLO check needs)."""
+        factor-of-2 answer, which is what a latency SLO check needs).
+        Edges: an empty histogram has no quantiles (None); q <= 0 is
+        the observed minimum, not the first nonempty bucket's bound;
+        and the answer never exceeds the observed maximum (a single
+        observation reports p50 == p99 == that value instead of its
+        bucket ceiling)."""
         if self.count == 0:
             return None
         target = q * self.count
+        if target <= 0:
+            return self.min
         seen = 0
         for i, n in enumerate(self.counts):
             seen += n
             if seen >= target:
                 if i >= _N_BUCKETS - 1:
                     return self.max
-                return 2.0 ** (LOG2_LO + i)
+                return min(2.0 ** (LOG2_LO + i), self.max)
         return self.max
 
     def snapshot(self) -> dict:
@@ -162,7 +172,7 @@ class EventedCounters(dict):
         self.group = group
 
     def __setitem__(self, key, value):
-        if _ON:
+        if _ON or _FR_ON:
             old = self.get(key, 0)
             if isinstance(value, (int, float)) and value > old:
                 event(f"{self.group}.{key}", {"value": value})
@@ -286,6 +296,19 @@ REGISTRY = MetricsRegistry()
 #: single-branch disabled check: span()/event() read this module
 #: global and return the shared no-op before touching anything else
 _ON = False
+
+
+def _flightrec_env() -> bool:
+    return os.environ.get("GUARD_TPU_FLIGHT_RECORDER", "1").lower() not in (
+        "0", "false", "no", "off",
+    )
+
+
+#: flight-recorder switch, resolved from GUARD_TPU_FLIGHT_RECORDER at
+#: import (default ON — the recorder's whole point is being armed when
+#: nobody asked for --trace-out). flightrec_refresh() re-reads the env
+#: for tests and long-lived embedders.
+_FR_ON = _flightrec_env()
 
 #: monotonic per-process span-id sequence (deterministic ordering —
 #: ids never come from wall clock)
@@ -417,27 +440,246 @@ class _Span:
             rec["attrs"] = self.attrs
         with _TRACE_LOCK:
             _TRACE.append(rec)
+        if _FR_ON:
+            _FLIGHTREC.record(
+                "X", self.name, STAGE_LANES.get(self.name, "main"),
+                self.wall0, dur, self.attrs,
+            )
+        return False
+
+
+# -------------------------------------------------- flight recorder
+
+class _FlightRecorder:
+    """Always-on fixed-size ring of the most recent spans and instant
+    events: slots are preallocated 7-element lists mutated in place
+    (no per-record allocation), so the recorder can stay armed in
+    production at negligible cost and an abnormal exit can dump the
+    last ~256 things the process did — without --trace-out having been
+    passed. GUARD_TPU_FLIGHT_RECORDER=0 is the escape hatch."""
+
+    __slots__ = ("capacity", "slots", "head", "written", "fault_seen",
+                 "lock")
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = capacity
+        # slot layout: [seq, ph, name, lane, wall_ts, dur, attrs]
+        self.slots = [[0, "", "", "", 0.0, 0.0, None]
+                      for _ in range(capacity)]
+        self.lock = threading.Lock()
+        self._zero()
+
+    def _zero(self) -> None:
+        self.head = 0
+        self.written = 0
+        self.fault_seen = False
+
+    def record(self, ph: str, name: str, lane: str, wall_ts: float,
+               dur: float, attrs: Optional[dict]) -> None:
+        with self.lock:
+            slot = self.slots[self.head]
+            slot[0] = self.written + 1
+            slot[1] = ph
+            slot[2] = name
+            slot[3] = lane
+            slot[4] = wall_ts
+            slot[5] = dur
+            slot[6] = attrs
+            self.head = (self.head + 1) % self.capacity
+            self.written += 1
+
+    def snapshot(self) -> List[list]:
+        """Copies of the live slots, oldest first (seq order)."""
+        with self.lock:
+            if self.written <= self.capacity:
+                ordered = self.slots[: self.written]
+            else:
+                ordered = self.slots[self.head:] + self.slots[: self.head]
+            return [list(s) for s in ordered]
+
+
+_FLIGHTREC = _FlightRecorder(
+    int(os.environ.get("GUARD_TPU_FLIGHTREC_SLOTS", "256") or 256)
+)
+_FR_DUMP_SEQ = itertools.count(1)
+
+
+def flightrec_enabled() -> bool:
+    return _FR_ON
+
+
+def flightrec_refresh() -> bool:
+    """Re-read GUARD_TPU_FLIGHT_RECORDER (tests; embedders that flip
+    the env after import)."""
+    global _FR_ON
+    _FR_ON = _flightrec_env()
+    return _FR_ON
+
+
+def flightrec_reset() -> None:
+    """Drop the ring contents and the fault-seen latch (tests; fresh
+    serve sessions)."""
+    with _FLIGHTREC.lock:
+        _FLIGHTREC._zero()
+
+
+def flightrec_mark_fault(name: str, attrs: Optional[dict] = None) -> None:
+    """Record a fault-class instant event and arm the abnormal-exit
+    dump (serve request timeouts/errors use this; fault.* counter
+    events arm it automatically through EventedCounters)."""
+    if _FR_ON:
+        _FLIGHTREC.fault_seen = True
+    event(name, attrs)
+
+
+def flightrec_events() -> List[dict]:
+    """Chrome trace_event objects for the ring contents, oldest first.
+    Timestamps are normalized to the oldest retained record so the
+    dump opens at t=0 in a trace viewer."""
+    slots = _FLIGHTREC.snapshot()
+    t0 = min((s[4] for s in slots), default=0.0)
+    lanes: "OrderedDict[str, int]" = OrderedDict()
+
+    def tid(lane: str) -> int:
+        if lane not in lanes:
+            lanes[lane] = len(lanes) + 1
+        return lanes[lane]
+
+    out = []
+    for seq, ph, name, lane, wall_ts, dur, attrs in slots:
+        args = dict(attrs or {})
+        args["seq"] = seq
+        ev = {
+            "name": name,
+            "cat": lane,
+            "ph": ph,
+            "ts": round(max(wall_ts - t0, 0.0) * 1e6, 3),
+            "pid": 1,
+            "tid": tid(lane),
+            "args": args,
+        }
+        if ph == "X":
+            ev["dur"] = round(max(dur, 0.0) * 1e6, 3)
+        else:
+            ev["s"] = "g"
+        out.append(ev)
+    meta = [{
+        "name": "process_name", "ph": "M", "pid": 1,
+        "args": {"name": "guard-tpu flight recorder"},
+    }]
+    for lane, t in lanes.items():
+        meta.append({
+            "name": "thread_name", "ph": "M", "pid": 1, "tid": t,
+            "args": {"name": lane},
+        })
+    return meta + out
+
+
+def flightrec_dump(reason: str, path: Optional[str] = None) -> Optional[str]:
+    """Write the flight-recorder forensics document: the ring as
+    Chrome-trace-compatible `traceEvents` plus a full metrics snapshot.
+    Returns the written path, or None when the recorder is disabled.
+    Destination: `path`, else flightrec-<pid>-<n>.json under
+    GUARD_TPU_FLIGHTREC_DIR (default: the working directory)."""
+    if not _FR_ON:
+        return None
+    if path is None:
+        d = os.environ.get("GUARD_TPU_FLIGHTREC_DIR") or "."
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(
+            d, f"flightrec-{os.getpid()}-{next(_FR_DUMP_SEQ)}.json"
+        )
+    doc = {
+        "traceEvents": flightrec_events(),
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "tool": "guard-tpu",
+            "flight_recorder": True,
+            "schema_version": SCHEMA_VERSION,
+            "reason": reason,
+            "records_written": _FLIGHTREC.written,
+            "ring_capacity": _FLIGHTREC.capacity,
+        },
+        "metrics": metrics_snapshot(),
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f)
+        f.write("\n")
+    return path
+
+
+def flightrec_on_exit(exit_code: Optional[int]) -> Optional[str]:
+    """Session epilogue hook (cli.run): dump when the run ended
+    abnormally — exit code 5 (hard errors, --max-doc-failures trips),
+    an unhandled exception (exit_code None), or fault activity latched
+    during an otherwise-clean run (dispatch-ladder fallbacks, serve
+    request timeouts). Returns the dump path or None."""
+    if not _FR_ON:
+        return None
+    if exit_code == 5:
+        return flightrec_dump("exit_code_5")
+    if exit_code is None:
+        return flightrec_dump("unhandled_exception")
+    if _FLIGHTREC.fault_seen:
+        return flightrec_dump("fault_activity")
+    return None
+
+
+class _FrSpan:
+    """The flight-recorder-only span: when tracing is off but the
+    recorder is armed, span() returns this instead of the no-op — its
+    exit writes one ring slot and one registry roll-up (so the dump's
+    metrics section has the stage story), nothing else."""
+
+    __slots__ = ("name", "attrs", "t0", "wall0")
+
+    def __init__(self, name: str, attrs: Optional[dict]):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        self.wall0 = time.time()
+        self.t0 = time.perf_counter()
+        return self
+
+    def set(self, key, value):
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs[key] = value
+        return self
+
+    def __exit__(self, exc_type, exc, _tb):
+        dur = time.perf_counter() - self.t0
+        if exc is not None:
+            self.set("error_class", type(exc).__name__)
+        REGISTRY.observe_span(self.name, dur)
+        _FLIGHTREC.record(
+            "X", self.name, STAGE_LANES.get(self.name, "main"),
+            self.wall0, dur, self.attrs,
+        )
         return False
 
 
 def span(name: str, attrs: Optional[dict] = None):
-    """A pipeline-stage span. Disabled: one branch, no allocation
-    (returns the shared no-op singleton). Enabled: a nestable context
-    manager whose completion feeds the registry roll-ups and the
-    trace buffer."""
-    if not _ON:
-        return _NOOP
-    return _Span(name, attrs)
+    """A pipeline-stage span. Fully disabled: two branches, no
+    allocation (returns the shared no-op singleton). Tracing enabled:
+    a nestable context manager whose completion feeds the registry
+    roll-ups and the trace buffer. Tracing off but flight recorder
+    armed: a slim span whose completion writes one ring slot."""
+    if _ON:
+        return _Span(name, attrs)
+    if _FR_ON:
+        return _FrSpan(name, attrs)
+    return _NOOP
 
 
 def span_begin(name: str, attrs: Optional[dict] = None):
     """Open a span around a large inline block where a `with` would
     force re-indenting the whole region; pair with `span_end`. Same
     disabled-path cost as span()."""
-    if not _ON:
-        return _NOOP
-    sp = _Span(name, attrs)
-    sp.__enter__()
+    sp = span(name, attrs)
+    if sp is not _NOOP:
+        sp.__enter__()
     return sp
 
 
@@ -450,7 +692,15 @@ def span_end(sp) -> None:
 
 def event(name: str, attrs: Optional[dict] = None) -> None:
     """Instant trace event (fault firings, fallbacks, pool restarts).
-    No-op when tracing is off."""
+    No-op when both tracing and the flight recorder are off. A fault.*
+    event latches the recorder's fault-seen flag, arming the
+    abnormal-exit dump."""
+    if not _ON and not _FR_ON:
+        return
+    if _FR_ON:
+        if name.startswith("fault."):
+            _FLIGHTREC.fault_seen = True
+        _FLIGHTREC.record("i", name, "events", time.time(), 0.0, attrs)
     if not _ON:
         return
     stack = getattr(_TLS, "stack", None)
@@ -517,8 +767,12 @@ def metrics_snapshot() -> dict:
 
 
 def write_metrics(path: str) -> None:
+    # NO sort_keys: histogram bucket labels ("le_2^-7s") do not sort
+    # lexically, and the snapshot's insertion order (ascending bucket
+    # exponents) is part of the schema contract —
+    # check_metrics_schema._check_bucket_labels enforces it
     with open(path, "w") as f:
-        json.dump(metrics_snapshot(), f, indent=1, sort_keys=True)
+        json.dump(metrics_snapshot(), f, indent=1)
         f.write("\n")
 
 

@@ -33,6 +33,13 @@ os.environ.setdefault(
     "GUARD_TPU_PLAN_CACHE_DIR", tempfile.mkdtemp(prefix="guard_plans_")
 )
 
+# The flight recorder is armed by default in production (abnormal exits
+# dump forensics into the working directory). The suite exercises
+# hundreds of deliberate exit-5 paths — without this default-off, every
+# one would litter flightrec-*.json files in the checkout. Dedicated
+# operations-plane tests arm it explicitly (monkeypatch + refresh).
+os.environ.setdefault("GUARD_TPU_FLIGHT_RECORDER", "0")
+
 # Force the CPU platform programmatically as well: with a wedged axon
 # TPU tunnel, plugin discovery can hang even under JAX_PLATFORMS=cpu.
 import jax

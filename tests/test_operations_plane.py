@@ -1,0 +1,553 @@
+"""Operations-plane suite (PR 8): the persistent run ledger
+(utils/ledger.py + `guard-tpu report`), the always-on flight recorder
+(telemetry ring buffer + abnormal-exit dumps), and the
+hardware-efficiency counter group — plus the Histogram.quantile edge
+cases and the bucket-label monotonicity gate that rode along.
+
+The invariants: the recorder must never change report bytes or exit
+codes; the ledger must never write unless GUARD_TPU_LEDGER_DIR is set;
+the efficiency counters must reconcile EXACTLY with hand-computed
+batch shapes, not approximately."""
+
+import json
+import os
+import pathlib
+import sys
+
+import numpy as np
+import pytest
+
+from guard_tpu.cli import run
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.core.values import from_plain
+from guard_tpu.ops import backend
+from guard_tpu.ops.encoder import encode_batch
+from guard_tpu.ops.ir import compile_rules_file, pack_compatible
+from guard_tpu.parallel import ingest
+from guard_tpu.parallel.mesh import ShardedBatchEvaluator, pad_to_multiple
+from guard_tpu.utils import ledger, telemetry
+from guard_tpu.utils.io import Reader, Writer
+
+sys.path.insert(
+    0, str(pathlib.Path(__file__).resolve().parent.parent / "tools")
+)
+
+from check_metrics_schema import _check_bucket_labels, check_snapshot  # noqa: E402
+from perf_ledger import backfill  # noqa: E402
+
+RULES = (
+    "let b = Resources.*[ Type == 'AWS::S3::Bucket' ]\n"
+    "rule sse when %b !empty { %b.Properties.Enc == true }\n"
+)
+
+_ENV_KEYS = (
+    "GUARD_TPU_FLIGHT_RECORDER",
+    "GUARD_TPU_FLIGHTREC_DIR",
+    "GUARD_TPU_LEDGER_DIR",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    """Every test starts and ends with tracing off, a disarmed flight
+    recorder (conftest pins GUARD_TPU_FLIGHT_RECORDER=0), an empty
+    ring, a zeroed registry and no ledger destination. Env mutations
+    are restored HERE (not via monkeypatch) so flightrec_refresh()
+    runs after the restore, never before it."""
+    saved = {k: os.environ.get(k) for k in _ENV_KEYS}
+    os.environ.pop("GUARD_TPU_LEDGER_DIR", None)
+    telemetry.disable()
+    telemetry.reset_trace()
+    telemetry.REGISTRY.reset(include_persistent=True)
+    telemetry.flightrec_refresh()
+    telemetry.flightrec_reset()
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    telemetry.flightrec_refresh()
+    telemetry.flightrec_reset()
+    telemetry.disable()
+    telemetry.reset_trace()
+    telemetry.REGISTRY.reset(include_persistent=True)
+
+
+def _arm_flightrec(tmp_path) -> None:
+    os.environ["GUARD_TPU_FLIGHT_RECORDER"] = "1"
+    os.environ["GUARD_TPU_FLIGHTREC_DIR"] = str(tmp_path)
+    telemetry.flightrec_refresh()
+    telemetry.flightrec_reset()
+
+
+def _mk_corpus(tmp_path, n=8, fail=(2,)):
+    rules = tmp_path / "rules.guard"
+    rules.write_text(RULES)
+    data = tmp_path / "data"
+    data.mkdir(exist_ok=True)
+    for i in range(n):
+        doc = {
+            "Resources": {
+                "b": {
+                    "Type": "AWS::S3::Bucket",
+                    "Properties": {"Enc": i not in fail},
+                }
+            }
+        }
+        (data / f"t{i:02d}.json").write_text(json.dumps(doc))
+    return rules, data
+
+
+def _cli(*argv):
+    w = Writer.buffered()
+    rc = run(list(argv), writer=w, reader=Reader())
+    return rc, w.out.getvalue(), w.err.getvalue()
+
+
+# ------------------------------------------------- quantile edge cases
+
+
+def test_quantile_empty_histogram_returns_none():
+    h = telemetry.Histogram("empty")
+    assert h.quantile(0.5) is None
+    assert h.quantile(0.99) is None
+    snap = h.snapshot()
+    assert snap["count"] == 0
+    assert snap["p50_seconds"] is None
+
+
+def test_quantile_single_observation_is_exact():
+    h = telemetry.Histogram("one")
+    h.observe(0.001)
+    # a single sample IS every quantile: the bucket upper bound must
+    # clamp to the observed max, not report 2^-9
+    assert h.quantile(0.5) == 0.001
+    assert h.quantile(0.99) == 0.001
+    assert h.quantile(1.0) == 0.001
+
+
+def test_quantile_zero_returns_min_and_one_returns_max():
+    h = telemetry.Histogram("spread")
+    for v in (0.002, 0.5, 4.0):
+        h.observe(v)
+    assert h.quantile(0.0) == 0.002
+    assert h.quantile(1.0) == 4.0
+
+
+def test_quantile_overflow_bucket_clamps_to_max():
+    h = telemetry.Histogram("huge")
+    h.observe(1e9)  # beyond 2^LOG2_HI: lands in the inf bucket
+    assert h.quantile(0.5) == 1e9
+    assert h.snapshot()["buckets"]["inf"] == 1
+
+
+# -------------------------------------------- bucket-label schema gate
+
+
+def test_bucket_label_gate_accepts_live_snapshot():
+    telemetry.REGISTRY.histogram("stagey").observe(0.01)
+    snap = telemetry.metrics_snapshot()
+    assert check_snapshot(snap) == []
+
+
+def test_bucket_label_gate_rejects_scrambled_order():
+    bad = {"le_2^-3s": 1, "le_2^-5s": 0, "inf": 0}
+    problems = _check_bucket_labels("h", bad)
+    assert any("not monotonically ordered" in p for p in problems)
+
+
+def test_bucket_label_gate_rejects_misplaced_inf_and_garbage():
+    assert any(
+        "'inf' bucket is not last" in p
+        for p in _check_bucket_labels("h", {"inf": 0, "le_2^-3s": 1})
+    )
+    assert any(
+        "malformed bucket label" in p
+        for p in _check_bucket_labels("h", {"le_2pow3s": 1})
+    )
+
+
+# ------------------------------------------------ flight recorder ring
+
+
+def test_ring_wraps_and_keeps_newest_in_seq_order():
+    fr = telemetry._FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.record("i", f"ev{i}", "events", float(i), 0.0, None)
+    assert fr.written == 10
+    snap = fr.snapshot()
+    assert len(snap) == 4
+    assert [s[0] for s in snap] == [7, 8, 9, 10]
+    assert [s[2] for s in snap] == ["ev6", "ev7", "ev8", "ev9"]
+
+
+def test_armed_recorder_feeds_ring_and_registry_without_tracing(tmp_path):
+    _arm_flightrec(tmp_path)
+    with telemetry.span("encode", {"docs": 3}):
+        pass
+    telemetry.event("fault.retries", {"n": 1})
+    assert not telemetry.enabled()  # tracing stayed off
+    # trace buffer untouched (metadata rows aside, which are static)
+    assert all(
+        e.get("ph") == "M" for e in telemetry.trace_events()
+    )
+    snap = telemetry._FLIGHTREC.snapshot()
+    assert [(s[1], s[2]) for s in snap] == [
+        ("X", "encode"), ("i", "fault.retries"),
+    ]
+    # the dump's metrics section carries the stage story
+    assert telemetry.REGISTRY.span_rollups()["encode"]["count"] == 1
+    assert telemetry._FLIGHTREC.fault_seen  # fault.* latched the dump
+
+
+def test_disarmed_recorder_is_inert():
+    assert not telemetry.flightrec_enabled()
+    with telemetry.span("encode"):
+        pass
+    telemetry.event("fault.retries", {"n": 1})
+    assert telemetry._FLIGHTREC.written == 0
+    assert telemetry.flightrec_dump("test") is None
+    assert telemetry.flightrec_on_exit(5) is None
+
+
+def test_flightrec_dump_schema_and_determinism(tmp_path):
+    _arm_flightrec(tmp_path)
+    with telemetry.span("encode"):
+        pass
+    telemetry.flightrec_mark_fault(
+        "serve.request_error", {"error_class": "ValueError"}
+    )
+    p1 = telemetry.flightrec_dump("test", path=str(tmp_path / "a.json"))
+    p2 = telemetry.flightrec_dump("test", path=str(tmp_path / "b.json"))
+    d1 = json.loads(pathlib.Path(p1).read_text())
+    d2 = json.loads(pathlib.Path(p2).read_text())
+    # two dumps of the same ring are event-identical (ts normalized to
+    # the oldest retained record, not to dump time)
+    assert d1["traceEvents"] == d2["traceEvents"]
+    other = d1["otherData"]
+    assert other["schema_version"] == telemetry.SCHEMA_VERSION
+    assert other["reason"] == "test"
+    assert other["records_written"] == 2
+    assert other["ring_capacity"] == telemetry._FLIGHTREC.capacity
+    assert check_snapshot(d1["metrics"]) == []
+    names = {
+        e["name"] for e in d1["traceEvents"] if e.get("ph") == "i"
+    }
+    assert "serve.request_error" in names
+
+
+def test_cli_exit_code_5_triggers_dump_without_trace_out(tmp_path):
+    _arm_flightrec(tmp_path)
+    rc, _out, err = _cli(
+        "validate", "-r", str(tmp_path / "nope.guard"),
+        "-d", str(tmp_path), "--backend", "tpu",
+    )
+    assert rc == 5
+    dumps = sorted(tmp_path.glob("flightrec-*.json"))
+    assert len(dumps) == 1
+    doc = json.loads(dumps[0].read_text())
+    assert doc["otherData"]["reason"] == "exit_code_5"
+    assert check_snapshot(doc["metrics"]) == []
+
+
+@pytest.mark.parametrize("workers", [0, 2])
+@pytest.mark.parametrize("pack", [(), ("--no-pack",)])
+def test_recorder_leaves_report_bytes_identical(tmp_path, workers, pack):
+    ingest.close_shared_pools()
+    try:
+        rules, data = _mk_corpus(tmp_path, n=8, fail=(2, 5))
+        common = (
+            "validate", "-r", str(rules), "-d", str(data),
+            "--backend", "tpu", "--ingest-workers", str(workers), *pack,
+        )
+        os.environ["GUARD_TPU_FLIGHT_RECORDER"] = "0"
+        os.environ["GUARD_TPU_FLIGHTREC_DIR"] = str(tmp_path)
+        telemetry.flightrec_refresh()
+        off_rc, off_out, _ = _cli(*common)
+        _arm_flightrec(tmp_path)
+        on_rc, on_out, _ = _cli(*common)
+        assert (on_rc, on_out) == (off_rc, off_out)
+        assert off_rc == 19  # failing docs: FAILURE, not an error exit
+        # a normal (non-5, fault-free) exit leaves no dump behind
+        assert sorted(tmp_path.glob("flightrec-*.json")) == []
+        assert telemetry._FLIGHTREC.written > 0  # but the ring saw spans
+    finally:
+        ingest.close_shared_pools()
+
+
+# ------------------------------------------------------------- ledger
+
+
+def test_ledger_append_and_roundtrip(tmp_path):
+    os.environ["GUARD_TPU_LEDGER_DIR"] = str(tmp_path)
+    rec = ledger.append_record(
+        "validate",
+        headline={"metric": "docs_per_sec", "value": 100.0, "unit": "docs/sec"},
+        config={"backend": "tpu", "chunk_size": 64},
+        exit_code=0,
+    )
+    assert ledger.check_record(rec) == []
+    recs = ledger.read_ledger()
+    assert len(recs) == 1
+    assert ledger.check_record(recs[0]) == []
+    assert recs[0]["kind"] == "validate"
+    assert recs[0]["schema_version"] == ledger.LEDGER_SCHEMA_VERSION
+    assert len(recs[0]["config_hash"]) == 16
+    assert isinstance(recs[0]["metrics"], dict)
+
+
+def test_config_hash_is_key_order_stable():
+    a = ledger.config_hash({"a": 1, "b": [2, 3]})
+    b = ledger.config_hash({"b": [2, 3], "a": 1})
+    assert a == b
+    assert ledger.config_hash({"a": 1, "b": [2, 4]}) != a
+
+
+def test_unconfigured_ledger_writes_nothing():
+    assert not ledger.ledger_enabled()
+    assert ledger.append_record("validate") is None
+    with pytest.raises(FileNotFoundError):
+        ledger.read_ledger()
+
+
+def test_corrupt_ledger_line_raises_with_line_number(tmp_path):
+    p = tmp_path / "ledger.jsonl"
+    p.write_text('{"ok": 1}\n{corrupt\n')
+    with pytest.raises(ValueError, match=":2:"):
+        ledger.read_ledger(str(p))
+
+
+def _rec(value, metric="tps", unit="templates/sec", counters=None):
+    r = ledger.build_record(
+        "bench",
+        headline={"metric": metric, "value": value, "unit": unit},
+        capture_metrics=False,
+    )
+    if counters is not None:
+        r["metrics"] = {"counters": counters}
+    return r
+
+
+def test_diff_records_ratio_and_counter_deltas():
+    a = _rec(100.0, counters={"dispatch": {"dispatches": 4}})
+    b = _rec(110.0, counters={"dispatch": {"dispatches": 6}})
+    d = ledger.diff_records(a, b)
+    assert d["headline_ratio"] == pytest.approx(1.1)
+    assert d["counters"] == {"dispatch.dispatches": {"a": 4, "b": 6}}
+    assert not d["same_config"]  # neither record carries a config hash
+
+
+def test_regression_check_parity_regression_and_direction():
+    recs = [_rec(100.0), _rec(101.0), _rec(99.0)]
+    assert ledger.regression_check(recs, "tps")["status"] == "ok"
+    regressed = ledger.regression_check(recs + [_rec(79.0)], "tps")
+    assert regressed["status"] == "regressed"
+    assert regressed["baseline"] == 101.0  # best-of-window, not last
+    # seconds-unit metrics are lower-is-better
+    lat = [_rec(10.0, "p99", "seconds"), _rec(13.0, "p99", "seconds")]
+    assert ledger.regression_check(lat, "p99")["status"] == "regressed"
+    assert ledger.regression_check(lat, "p99")["lower_is_better"]
+
+
+def test_regression_check_insufficient_records():
+    v = ledger.regression_check([_rec(100.0)], "tps")
+    assert v["status"] == "insufficient"
+    assert not v["regressed"]
+
+
+def test_backfill_ingests_bench_artifact_rows(tmp_path):
+    art = tmp_path / "bench_all_r11.json"
+    art.write_text(
+        json.dumps({"metric": "m1", "value": 10.0, "unit": "u",
+                    "vs_baseline": 1.0}) + "\n"
+        + json.dumps({"metric": "m2", "value": 20.0, "unit": "u",
+                      "vs_baseline": 2.0}) + "\n"
+    )
+    dest = tmp_path / "ledger.jsonl"
+    assert backfill([art], ledger_file=str(dest)) == 2
+    recs = ledger.read_ledger(str(dest))
+    assert [r["headline"]["metric"] for r in recs] == ["m1", "m2"]
+    for r in recs:
+        assert ledger.check_record(r) == []
+        assert r["kind"] == "bench"
+        assert r["extra"]["backfilled"] is True
+        assert r["extra"]["round"] == 11
+        assert r["metrics"] is None  # no fake snapshot for history
+
+
+# --------------------------------------------------- report subcommand
+
+
+def test_report_diffs_two_newest_records(tmp_path):
+    os.environ["GUARD_TPU_LEDGER_DIR"] = str(tmp_path)
+    for v in (100.0, 98.0):
+        ledger.append_record(
+            "bench",
+            headline={"metric": "tps", "value": v, "unit": "templates/sec"},
+            config={"backend": "tpu"},
+        )
+    rc, out, _ = _cli("report")
+    assert rc == 0
+    assert "previous:" in out and "newest:" in out
+    assert "headline ratio: x0.980" in out
+    assert "same config" in out
+
+
+def test_report_check_gates_regressions(tmp_path):
+    os.environ["GUARD_TPU_LEDGER_DIR"] = str(tmp_path)
+    for v in (100.0, 99.0):
+        ledger.append_record(
+            "bench",
+            headline={"metric": "tps", "value": v, "unit": "templates/sec"},
+        )
+    rc, out, _ = _cli("report", "--check", "tps")
+    assert rc == 0 and "ok" in out
+    ledger.append_record(
+        "bench",
+        headline={"metric": "tps", "value": 80.0, "unit": "templates/sec"},
+    )
+    rc, out, _ = _cli("report", "--check", "tps")
+    assert rc == 19
+    assert "regressed" in out
+
+
+def test_report_error_exits(tmp_path):
+    # no ledger configured at all
+    rc, _out, err = _cli("report")
+    assert rc == 5 and "Error" in err
+    # configured but too few records to diff
+    os.environ["GUARD_TPU_LEDGER_DIR"] = str(tmp_path)
+    ledger.append_record("bench", headline={
+        "metric": "tps", "value": 1.0, "unit": "templates/sec"})
+    rc, _out, err = _cli("report")
+    assert rc == 5 and "at least 2" in err
+    # a record without a metrics snapshot (backfilled history) cannot
+    # render the efficiency view
+    ledger.append_record("bench", headline={
+        "metric": "tps", "value": 1.0, "unit": "templates/sec"},
+        capture_metrics=False)
+    rc, _out, err = _cli("report", "--efficiency")
+    assert rc == 5 and "no efficiency metrics" in err
+
+
+def test_report_efficiency_renders_utilization(tmp_path):
+    os.environ["GUARD_TPU_LEDGER_DIR"] = str(tmp_path)
+    backend.reset_efficiency_stats()
+    docs = [_doc(i) for i in range(3)]
+    batch, interner = encode_batch(docs)
+    compiled = compile_rules_file(
+        parse_rules_file(RULES, "r.guard"), interner
+    )
+    ev = ShardedBatchEvaluator(compiled)
+    ev.collect(ev.dispatch(batch))
+    ledger.append_record("validate", exit_code=0)
+    rc, out, _ = _cli("report", "--efficiency")
+    assert rc == 0
+    assert "efficiency.docs_real: 3" in out
+    assert "doc slot fill:" in out and "node slot fill:" in out
+
+
+def test_session_epilogue_appends_one_record_per_session(tmp_path):
+    os.environ["GUARD_TPU_LEDGER_DIR"] = str(tmp_path)
+    rules, data = _mk_corpus(tmp_path, n=4, fail=())
+    rc, _out, _err = _cli(
+        "validate", "-r", str(rules), "-d", str(data), "--backend", "tpu",
+    )
+    assert rc == 0
+    recs = ledger.read_ledger()
+    assert len(recs) == 1
+    rec = recs[0]
+    assert ledger.check_record(rec) == []
+    assert rec["kind"] == "validate"
+    assert rec["exit_code"] == 0
+    assert rec["headline"]["metric"] == "validate_session_seconds"
+    assert rec["config_hash"] is not None
+
+
+# ------------------------------------------------- efficiency metrics
+
+
+def _doc(i: int, ok: bool = True):
+    return from_plain({
+        "Resources": {
+            "b": {
+                "Type": "AWS::S3::Bucket",
+                "Properties": {"Enc": ok if i % 2 == 0 else True},
+            }
+        }
+    })
+
+
+def test_efficiency_counters_reconcile_with_batch_shapes():
+    backend.reset_efficiency_stats()
+    docs = [_doc(i) for i in range(3)]
+    batch, interner = encode_batch(docs)
+    compiled = compile_rules_file(
+        parse_rules_file(RULES, "r.guard"), interner
+    )
+    ev = ShardedBatchEvaluator(compiled)
+    ev.collect(ev.dispatch(batch))
+    stats = backend.efficiency_stats()
+
+    # hand-compute the same shapes the dispatch saw
+    arrays, d = pad_to_multiple(
+        compiled.device_arrays(batch), ev.mesh.devices.size
+    )
+    padded_d, n_nodes = arrays["node_kind"].shape
+    real_slots = int((arrays["node_kind"] >= 0).sum())
+    assert d == 3
+    assert stats["docs_real"] == 3
+    assert stats["docs_padded"] == padded_d - 3
+    assert stats["node_slots_real"] == real_slots
+    assert stats["node_slots_padded"] == padded_d * n_nodes - real_slots
+    expected_h2d = int(
+        sum(a.nbytes for a in arrays.values())
+        + compiled.lit_values().nbytes
+    )
+    assert stats["host_to_device_bytes"] == expected_h2d
+    # d2h: the PADDED status matrix (int8) crosses back, plus the
+    # unsure bitmap when the rule file compares against query RHS
+    n_rules = len(compiled.rules)
+    expected_d2h = padded_d * n_rules
+    if compiled.needs_unsure:
+        expected_d2h += padded_d * n_rules
+    assert stats["device_to_host_bytes"] == expected_d2h
+
+    gauges = telemetry.metrics_snapshot()["gauges"]
+    assert gauges[f"efficiency.bucket_{n_nodes}.doc_fill"] == (
+        pytest.approx(3 / padded_d)
+    )
+    assert gauges[f"efficiency.bucket_{n_nodes}.node_fill"] == (
+        pytest.approx(real_slots / (padded_d * n_nodes))
+    )
+    assert gauges["efficiency.live_executables"] >= 1
+
+
+def test_pack_slot_utilization_gauge_matches_counters():
+    backend.reset_efficiency_stats()
+    docs = [_doc(i) for i in range(4)]
+    batch, interner = encode_batch(docs)
+    rf_b = parse_rules_file(
+        "rule always_pass { Resources exists }\n", "r2.guard"
+    )
+    compiled_files = [
+        compile_rules_file(parse_rules_file(RULES, "r1.guard"), interner),
+        compile_rules_file(rf_b, interner),
+    ]
+    items = [
+        (fi, c)
+        for fi, c in enumerate(compiled_files)
+        if pack_compatible(c) is None
+    ]
+    assert len(items) == 2
+    backend._evaluate_packs(items, batch)
+    stats = backend.efficiency_stats()
+    used = stats["pack_rule_slots_used"]
+    cap = stats["pack_rule_slots_capacity"]
+    assert used == sum(len(c.rules) for _fi, c in items)
+    assert cap > 0 and cap % backend.PACK_MAX_RULES == 0
+    util = telemetry.metrics_snapshot()["gauges"][
+        "efficiency.pack_slot_utilization"
+    ]
+    assert util == pytest.approx(used / cap)

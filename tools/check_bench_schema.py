@@ -12,10 +12,15 @@ lists, and every row must carry the driver-contract keys.
 Usage:
     python tools/check_bench_schema.py [artifact.json ...]
 
-With no arguments, checks the newest `bench_all_*.json` in the repo
-root. Artifacts are JSONL (one metric object per line). Extra metrics
-in the artifact are fine (forward compatibility); missing expected
-metrics, malformed lines, or rows without the contract keys exit 1.
+With no arguments, checks EVERY `bench_all_*.json` in the repo root —
+the whole historical set, not just the newest. An artifact named
+`bench_all_rN.json` is only required to carry the metrics the round-N
+bench driver emitted (METRIC_SINCE below maps each metric to the
+round that introduced it); artifacts without a parseable round must
+carry everything. Artifacts are JSONL (one metric object per line).
+Extra metrics in the artifact are fine (forward compatibility);
+missing expected metrics, malformed lines, or rows without the
+contract keys exit 1.
 """
 
 from __future__ import annotations
@@ -31,6 +36,42 @@ sys.path.insert(0, str(REPO))
 import bench  # noqa: E402  (repo root on sys.path above)
 
 CONTRACT_KEYS = ("metric", "value", "unit", "vs_baseline")
+
+#: metric -> the bench round that introduced it (metrics absent here
+#: fall through metric_since()'s pattern rules, default round 5 — the
+#: oldest committed artifact). Keeps the whole historical artifact set
+#: checkable: bench_all_r5.json is held to the round-5 driver's
+#: contract, not today's.
+METRIC_SINCE = {
+    "config5b_packed_templates_per_sec": 6,
+    "config5b_perfile_templates_per_sec": 6,
+    "config5b_rim_vector_docs_per_sec": 7,
+    "config5b_rim_scalar_docs_per_sec": 7,
+    "config5b_telemetry_off_templates_per_sec": 10,
+    "config5b_telemetry_on_templates_per_sec": 10,
+    "config5b_flightrec_off_templates_per_sec": 12,
+    "config5b_flightrec_on_templates_per_sec": 12,
+    "config5b_quarantine_clean_templates_per_sec": 9,
+    "config5b_quarantine_degraded_templates_per_sec": 9,
+    "config5b_plan_cold_templates_per_sec": 11,
+    "config5b_plan_warm_templates_per_sec": 11,
+    "config5b_plan_restart_templates_per_sec": 11,
+}
+
+
+def metric_since(metric: str) -> int:
+    """The bench round whose driver first emitted `metric`."""
+    if metric in METRIC_SINCE:
+        return METRIC_SINCE[metric]
+    if "_ingest_workers" in metric:
+        return 8  # PR 3 ingest decomposition rows
+    if metric.startswith("config6_fail_") and (
+        "python_rerun" in metric
+        or "docs8192" in metric
+        or "docs16384" in metric
+    ):
+        return 6  # rerun flow + batch-size grid arrived with round 6
+    return 5
 
 # shared by the three plan-regime rows below
 PLAN_REQUIRED_KEYS = (
@@ -61,6 +102,14 @@ METRIC_REQUIRED_KEYS = {
     "config5b_telemetry_off_templates_per_sec": ("telemetry",),
     "config5b_telemetry_on_templates_per_sec": (
         "telemetry", "overhead_vs_off", "spans_recorded_per_run",
+    ),
+    # PR 8 operations plane: the armed row must quantify what the
+    # always-on flight-recorder ring costs against the disarmed branch
+    # (the <=2% default-on bar), and say how many ring records one
+    # armed run writes
+    "config5b_flightrec_off_templates_per_sec": ("flight_recorder",),
+    "config5b_flightrec_on_templates_per_sec": (
+        "flight_recorder", "overhead_vs_off", "ring_records_per_run",
     ),
     # PR 5 failure plane: the clean row must quantify the always-on
     # quarantine plumbing's cost against fail-fast semantics, and the
@@ -93,21 +142,26 @@ INGEST_REQUIRED_KEYS = (
 )
 
 
-def _required_keys(metric: str):
+def _required_keys(metric: str, art_round=None):
     keys = METRIC_REQUIRED_KEYS.get(metric, ())
     if "_ingest_workers" in metric:
         keys = keys + INGEST_REQUIRED_KEYS
     elif metric.startswith("config6_fail_"):
-        keys = keys + (
-            "docs_materialized", "docs_settled", "device_seconds",
-            "host_materialize_seconds",
-        )
+        # the device/host decomposition extras arrived with the round-7
+        # driver; the r5/r6 artifacts legitimately predate them
+        if art_round is None or art_round >= 7:
+            keys = keys + (
+                "docs_materialized", "docs_settled", "device_seconds",
+                "host_materialize_seconds",
+            )
     return keys
 
 
 def check(path: pathlib.Path) -> list:
     problems = []
     rows = {}
+    m = re.search(r"r(\d+)", path.stem)
+    art_round = int(m.group(1)) if m else None
     for ln, line in enumerate(path.read_text().splitlines(), 1):
         line = line.strip()
         if not line:
@@ -120,7 +174,7 @@ def check(path: pathlib.Path) -> list:
         if not isinstance(obj, dict) or "metric" not in obj:
             problems.append(f"{path}:{ln}: row without a `metric` key")
             continue
-        for k in CONTRACT_KEYS + _required_keys(obj["metric"]):
+        for k in CONTRACT_KEYS + _required_keys(obj["metric"], art_round):
             if k not in obj:
                 problems.append(
                     f"{path}:{ln}: metric {obj.get('metric')!r} missing "
@@ -128,10 +182,13 @@ def check(path: pathlib.Path) -> list:
                 )
         rows[obj["metric"]] = obj
     for metric in bench.expected_metrics():
+        if art_round is not None and metric_since(metric) > art_round:
+            continue  # metric postdates this artifact's driver round
         if metric not in rows:
             problems.append(
                 f"{path}: missing metric {metric!r} (artifact predates "
-                "the current bench driver — regenerate it)"
+                "the metric's round — METRIC_SINCE says it arrived in "
+                f"r{metric_since(metric)})"
             )
     return problems
 
@@ -147,12 +204,10 @@ def main(argv: list) -> int:
     if argv:
         paths = [pathlib.Path(a) for a in argv]
     else:
-        candidates = sorted(REPO.glob("bench_all_*.json"),
-                            key=artifact_order)
-        if not candidates:
+        paths = sorted(REPO.glob("bench_all_*.json"), key=artifact_order)
+        if not paths:
             print("no bench_all_*.json artifact found", file=sys.stderr)
             return 1
-        paths = [candidates[-1]]
     rc = 0
     for path in paths:
         if not path.exists():
@@ -165,8 +220,12 @@ def main(argv: list) -> int:
             for p in problems:
                 print(p, file=sys.stderr)
         else:
-            print(f"{path}: ok ({len(bench.expected_metrics())} expected "
-                  "metrics all present)")
+            m = re.search(r"r(\d+)", path.stem)
+            n = sum(
+                1 for metric in bench.expected_metrics()
+                if m is None or metric_since(metric) <= int(m.group(1))
+            )
+            print(f"{path}: ok ({n} expected metrics all present)")
     return rc
 
 

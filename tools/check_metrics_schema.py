@@ -20,12 +20,14 @@ from __future__ import annotations
 
 import json
 import pathlib
+import re
 import sys
 
 #: the schema_version this checker understands (mirrors
 #: guard_tpu.utils.telemetry.SCHEMA_VERSION; imported lazily in main
-#: so the checker also runs standalone against committed artifacts)
-KNOWN_SCHEMA_VERSION = 1
+#: so the checker also runs standalone against committed artifacts).
+#: v2: the `efficiency` counter/gauge group joined the contract.
+KNOWN_SCHEMA_VERSION = 2
 
 #: top-level sections every snapshot must carry
 SECTIONS = ("schema_version", "counters", "gauges", "histograms", "spans")
@@ -37,13 +39,50 @@ SECTIONS = ("schema_version", "counters", "gauges", "histograms", "spans")
 #: `require_groups` (the CI trace-smoke does). plan_cache registers
 #: with ops.plan and is part of every tpu-backend run since the plan
 #: layer became the default lowering path.
-EXPECTED_GROUPS = ("dispatch", "pipeline", "rim", "fault", "plan_cache")
+EXPECTED_GROUPS = (
+    "dispatch", "pipeline", "rim", "fault", "plan_cache", "efficiency",
+)
 
 #: keys every histogram snapshot must carry
 HIST_KEYS = (
     "count", "total_seconds", "min_seconds", "max_seconds",
     "p50_seconds", "p99_seconds", "buckets",
 )
+
+#: bucket labels are "le_2^{E}s" (E the integer upper-bound exponent)
+#: plus the "inf" overflow bucket
+_BUCKET_LABEL = re.compile(r"^le_2\^(-?\d+)s$")
+
+
+def _check_bucket_labels(name: str, buckets: dict) -> list:
+    """Bucket keys must be well-formed and monotonically ordered:
+    strictly increasing exponents in insertion order, with "inf" only
+    allowed as the final key — a scrambled snapshot writer would
+    otherwise silently corrupt the quantile story downstream."""
+    problems = []
+    last_exp = None
+    keys = list(buckets)
+    for i, k in enumerate(keys):
+        if k == "inf":
+            if i != len(keys) - 1:
+                problems.append(
+                    f"histogram {name!r}: 'inf' bucket is not last"
+                )
+            continue
+        m = _BUCKET_LABEL.match(k)
+        if m is None:
+            problems.append(
+                f"histogram {name!r}: malformed bucket label {k!r}"
+            )
+            continue
+        exp = int(m.group(1))
+        if last_exp is not None and exp <= last_exp:
+            problems.append(
+                f"histogram {name!r}: bucket labels not monotonically "
+                f"ordered ({k!r} after le_2^{last_exp}s)"
+            )
+        last_exp = exp
+    return problems
 
 
 def check_snapshot(doc, require_groups: tuple = ()) -> list:
@@ -101,6 +140,7 @@ def check_snapshot(doc, require_groups: tuple = ()) -> list:
         if not isinstance(buckets, dict):
             problems.append(f"histogram {name!r} buckets is not an object")
             continue
+        problems.extend(_check_bucket_labels(name, buckets))
         total = sum(buckets.values())
         if total != h["count"]:
             problems.append(

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Backfill the run ledger from committed bench artifacts.
+
+The ledger (guard_tpu/utils/ledger.py) only accumulates from the day
+it was configured — but eight rounds of bench history already exist as
+`bench_all_r5…r12.json`. This tool ingests every committed artifact in
+one pass, appending one `bench`-kind ledger record per metric row
+(headline = the row's metric/value/unit, extra = the artifact name,
+round and the row's remaining keys, ts = the artifact's mtime so
+records sort in history order). With a backfilled ledger,
+`guard-tpu report --check <metric>` has a real noise band on day one.
+
+Usage:
+    GUARD_TPU_LEDGER_DIR=... python tools/perf_ledger.py [artifact...]
+
+With no arguments, ingests every `bench_all_*.json` in the repo root
+(oldest round first). Prints one summary line; exits 1 when no ledger
+destination is configured or an artifact fails to parse.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from guard_tpu.utils import ledger  # noqa: E402
+
+from check_bench_schema import artifact_order  # noqa: E402
+
+
+def backfill(paths, ledger_file=None) -> int:
+    """Append one bench-kind record per metric row of each artifact.
+    Returns the number of records appended; raises ValueError on an
+    unparseable artifact line."""
+    appended = 0
+    for path in paths:
+        path = pathlib.Path(path)
+        m_round = artifact_order(path)[0]
+        mtime = path.stat().st_mtime
+        for ln, line in enumerate(path.read_text().splitlines(), 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{ln}: unparseable line ({e})")
+            if not isinstance(row, dict) or "metric" not in row:
+                raise ValueError(f"{path}:{ln}: row without a metric key")
+            extra = {
+                "artifact": path.name,
+                "round": m_round,
+                "backfilled": True,
+            }
+            extra.update({
+                k: v for k, v in row.items()
+                if k not in ("metric", "value", "unit")
+            })
+            ledger.append_record(
+                "bench",
+                headline={
+                    "metric": row["metric"],
+                    "value": row.get("value"),
+                    "unit": row.get("unit", ""),
+                },
+                extra=extra,
+                ts=mtime,
+                # historical rows carry no live registry state; a fake
+                # snapshot would lie, so metrics stays null
+                capture_metrics=False,
+                path=ledger_file,
+            )
+            appended += 1
+    return appended
+
+
+def main(argv) -> int:
+    if argv:
+        paths = [pathlib.Path(a) for a in argv]
+    else:
+        paths = sorted(REPO.glob("bench_all_*.json"), key=artifact_order)
+    if not paths:
+        print("no bench artifacts to ingest", file=sys.stderr)
+        return 1
+    missing = [p for p in paths if not p.exists()]
+    if missing:
+        for p in missing:
+            print(f"{p}: does not exist", file=sys.stderr)
+        return 1
+    if not ledger.ledger_enabled():
+        print("no ledger destination: set GUARD_TPU_LEDGER_DIR",
+              file=sys.stderr)
+        return 1
+    try:
+        n = backfill(paths)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "ledger": ledger.ledger_path(),
+        "artifacts": [p.name for p in paths],
+        "records_appended": n,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
