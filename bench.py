@@ -2241,8 +2241,202 @@ def _measure_spread(med, fn1, fnk, k_inner: int, n_docs: int, reps: int = 3):
     }
 
 
+def _serve_workload(rng, n_requests: int, docs_per_req: int = 2) -> list:
+    """Request lines for the serving plane: the headline 4-rule set
+    over synthetic CFN templates, `docs_per_req` docs per request —
+    the interactive-client shape (small payloads, one shared rule
+    digest, so every request is coalescing-eligible)."""
+    lines = []
+    for i in range(n_requests):
+        docs = [
+            json.dumps(make_template(rng, i * docs_per_req + j))
+            for j in range(docs_per_req)
+        ]
+        lines.append(
+            json.dumps({"rules": [RULES], "data": docs, "backend": "tpu"})
+        )
+    return lines
+
+
+def _serve_leg(lines, concurrency: int, coalesce: bool, rounds: int):
+    """One (concurrency, coalesce) cell: replay `lines` in waves of
+    `concurrency` threads against a fresh serve session. Returns
+    (p50_ms, p99_ms, dispatches_per_request) over rounds*concurrency
+    requests, with one untimed warmup request absorbing compile."""
+    import threading
+
+    from guard_tpu.commands.serve import Serve
+    from guard_tpu.parallel.mesh import DISPATCH_COUNTERS
+
+    srv = Serve(stdio=True, coalesce=coalesce)
+    warm = srv.handle_line(lines[0])
+    # 0 = all pass, 19 = rule FAILs — both are healthy evaluations for
+    # the synthetic corpus; anything else is a serve-plane error
+    if warm.get("code") not in (0, 19):
+        raise RuntimeError(f"serve warmup failed: {warm}")
+    lat = []
+    errs = []
+    d0 = DISPATCH_COUNTERS["dispatches"]
+    idx = 0
+    # one untimed wave first: a coalesced group packs 2*concurrency
+    # docs into one batch — a doc-count the sequential warmup never
+    # produced, so its executable compiles HERE, not in the timed runs
+    for wave_i in range(rounds + 1):
+        timed = wave_i > 0
+        wave = [lines[(idx + k) % len(lines)] for k in range(concurrency)]
+        idx += concurrency
+        barrier = threading.Barrier(concurrency)
+
+        def worker(line):
+            barrier.wait()
+            t0 = time.perf_counter()
+            resp = srv.handle_line(line)
+            if timed:
+                lat.append((time.perf_counter() - t0) * 1000.0)
+            if resp.get("code") not in (0, 19):
+                errs.append(resp)
+
+        threads = [
+            threading.Thread(target=worker, args=(w,)) for w in wave
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise RuntimeError(f"serve request failed: {errs[0]}")
+        if not timed:
+            d0 = DISPATCH_COUNTERS["dispatches"]
+    dispatches = DISPATCH_COUNTERS["dispatches"] - d0
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(len(lat) - 1, int(round(0.99 * (len(lat) - 1))))]
+    return p50, p99, dispatches / max(rounds * concurrency, 1)
+
+
+def measure_serve_latency(rounds: int = 8, wait_ms: float = 10.0):
+    """The serving plane's latency/dispatch profile: per-request
+    p50/p99 at client concurrency 1/4/16, coalescing on vs off, plus
+    device dispatches per request. Coalescing trades a bounded
+    formation wait (GUARD_TPU_COALESCE_WAIT_MS) for packed dispatches:
+    at c=1 the on leg pays the window for nothing (the honest cost
+    row); at c=16 the batch fills instantly — formation exits at
+    max-batch — and ONE dispatch answers sixteen clients, which is
+    where the on leg's p50 must beat off. Returns
+    {(concurrency, "on"|"off"): (p50_ms, p99_ms, dispatches_per_req)}."""
+    from guard_tpu.commands.serve import Serve
+
+    rng = np.random.default_rng(23)
+    # ONE workload for every cell: each distinct template shape lands
+    # in its own size bucket and compiles one executable, so fresh docs
+    # per leg would charge XLA compiles to whichever cell hit the shape
+    # first — generate once, then warm EVERY line before timing any leg
+    lines = _serve_workload(rng, 32)
+    warm_srv = Serve(stdio=True, coalesce=False)
+    for ln in lines:
+        warm_srv.handle_line(ln)
+    out = {}
+    prev = os.environ.get("GUARD_TPU_COALESCE_WAIT_MS")
+    os.environ["GUARD_TPU_COALESCE_WAIT_MS"] = str(wait_ms)
+    try:
+        for concurrency in (1, 4, 16):
+            for coalesce in (False, True):
+                cell = _serve_leg(lines, concurrency, coalesce, rounds)
+                out[(concurrency, "on" if coalesce else "off")] = cell
+    finally:
+        if prev is None:
+            os.environ.pop("GUARD_TPU_COALESCE_WAIT_MS", None)
+        else:
+            os.environ["GUARD_TPU_COALESCE_WAIT_MS"] = prev
+    return out
+
+
+def serve_smoke(n_requests: int = 16) -> None:
+    """CI smoke for the serving plane (JAX_PLATFORMS=cpu): 16
+    concurrent requests against ONE rule digest must coalesce into
+    >= 4x fewer device dispatches than the sequential baseline, with
+    byte-identical response envelopes and a nonzero coalesced-batch
+    counter. Prints one JSON line; raises SystemExit(1) on violation."""
+    import threading
+
+    from guard_tpu.commands.serve import Serve
+    from guard_tpu.parallel.mesh import DISPATCH_COUNTERS
+    from guard_tpu.utils.telemetry import SERVE_COUNTERS
+
+    rng = np.random.default_rng(41)
+    lines = _serve_workload(rng, n_requests)
+
+    def envelope(resp):
+        return (
+            resp.get("code"), resp.get("output"), resp.get("error"),
+            resp.get("error_class"),
+        )
+
+    prev = os.environ.get("GUARD_TPU_COALESCE_WAIT_MS")
+    # a generous formation window: CI machines stagger thread starts,
+    # and the smoke asserts grouping, not latency
+    os.environ["GUARD_TPU_COALESCE_WAIT_MS"] = "200"
+    try:
+        seq_srv = Serve(stdio=True, coalesce=False)
+        d0 = DISPATCH_COUNTERS["dispatches"]
+        seq = [envelope(seq_srv.handle_line(ln)) for ln in lines]
+        seq_dispatches = DISPATCH_COUNTERS["dispatches"] - d0
+
+        con_srv = Serve(stdio=True, coalesce=True)
+        results = [None] * n_requests
+        barrier = threading.Barrier(n_requests)
+
+        def worker(i):
+            barrier.wait()
+            results[i] = envelope(con_srv.handle_line(lines[i]))
+
+        b0 = SERVE_COUNTERS["coalesced_batches"]
+        r0 = SERVE_COUNTERS["coalesced_requests"]
+        d0 = DISPATCH_COUNTERS["dispatches"]
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(n_requests)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        con_dispatches = DISPATCH_COUNTERS["dispatches"] - d0
+        coalesced_batches = SERVE_COUNTERS["coalesced_batches"] - b0
+        coalesced_requests = SERVE_COUNTERS["coalesced_requests"] - r0
+    finally:
+        if prev is None:
+            os.environ.pop("GUARD_TPU_COALESCE_WAIT_MS", None)
+        else:
+            os.environ["GUARD_TPU_COALESCE_WAIT_MS"] = prev
+
+    parity = results == seq
+    record = {
+        "metric": "serve_smoke",
+        "requests": n_requests,
+        "sequential_dispatches": seq_dispatches,
+        "coalesced_dispatches": con_dispatches,
+        "dispatch_reduction": round(
+            seq_dispatches / max(con_dispatches, 1), 1
+        ),
+        "coalesced_batches": coalesced_batches,
+        "coalesced_requests": coalesced_requests,
+        "parity": parity,
+    }
+    print(json.dumps(record), flush=True)
+    ok = (
+        parity
+        and all(e[0] in (0, 19) for e in seq)
+        and seq_dispatches >= n_requests
+        and con_dispatches * 4 <= seq_dispatches
+        and coalesced_batches >= 1
+    )
+    if not ok:
+        raise SystemExit(1)
+
+
 def _emit(metric: str, value: float, vs: float, vs_native=None, spread=None,
-          extra=None) -> None:
+          extra=None, unit: str = "templates/sec") -> None:
     # `vs_baseline` is required by the driver contract; `vs_oracle` is
     # the honest name: the divisor is this framework's own pure-Python
     # CPU oracle, NOT the reference's native engine (no Rust toolchain
@@ -2252,7 +2446,7 @@ def _emit(metric: str, value: float, vs: float, vs_native=None, spread=None,
     row = {
         "metric": metric,
         "value": round(value, 1),
-        "unit": "templates/sec",
+        "unit": unit,
         "vs_baseline": round(vs, 2),
         "vs_oracle": round(vs, 2),
         **(
@@ -2327,6 +2521,9 @@ def expected_metrics() -> list:
         "config5b_plan_restart_templates_per_sec",
         "config5c_rule_sharded_templates_per_sec",
     ]
+    for c in (1, 4, 16):
+        for leg in ("off", "on"):
+            out.append(f"serve_c{c}_coalesce_{leg}_p50_ms")
     for tag in ("50pct", "allfail"):
         for flow in ("full", "python_rerun", "statuses_only"):
             out.append(f"config6_fail_{tag}_{flow}_docs_per_sec")
@@ -2393,6 +2590,15 @@ def main() -> None:
 
         _honor_platform_env()
         ledger_smoke()
+        return
+    if "--serve-smoke" in sys.argv:
+        # CI smoke for the serving plane: 16 concurrent same-digest
+        # requests must coalesce into >= 4x fewer device dispatches
+        # than the sequential baseline with byte-identical envelopes
+        from guard_tpu.ops.backend import _honor_platform_env
+
+        _honor_platform_env()
+        serve_smoke()
         return
     if not _probe_tpu_responsive():
         import jax as _jax
@@ -2673,6 +2879,39 @@ def main() -> None:
             ),
         },
     )
+
+    # serving plane: per-request p50/p99 against one warm session at
+    # client concurrency 1/4/16, coalescing on vs off — the off leg at
+    # each concurrency is the baseline its on row divides by, so "what
+    # did cross-request coalescing buy at c=16" (and "what did the
+    # formation window cost at c=1") is read directly off vs_baseline
+    serve_cells = measure_serve_latency()
+    for c in (1, 4, 16):
+        p50_off, p99_off, dpr_off = serve_cells[(c, "off")]
+        p50_on, p99_on, dpr_on = serve_cells[(c, "on")]
+        _emit(
+            f"serve_c{c}_coalesce_off_p50_ms",
+            p50_off,
+            1.0,
+            unit="ms",
+            extra={
+                "p99_ms": round(p99_off, 2),
+                "dispatches_per_request": round(dpr_off, 3),
+                "concurrency": c,
+            },
+        )
+        _emit(
+            f"serve_c{c}_coalesce_on_p50_ms",
+            p50_on,
+            p50_off / max(p50_on, 1e-9),
+            unit="ms",
+            extra={
+                "p99_ms": round(p99_on, 2),
+                "dispatches_per_request": round(dpr_on, 3),
+                "concurrency": c,
+                "vs_note": "vs_baseline here = coalescing-off p50 over coalescing-on p50 at the same concurrency (> 1 means coalescing cut latency); value rows are milliseconds, lower is better",
+            },
+        )
 
     # config 6: fail-heavy cliff — end-to-end docs/sec including the
     # oracle fail-rerun (rich reports per failing doc) vs the

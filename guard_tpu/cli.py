@@ -226,9 +226,25 @@ def build_parser() -> argparse.ArgumentParser:
         "payload requests on stdin, one JSON response line each "
         "(amortizes startup for embedders, e.g. the npm package)",
     )
-    # the transport must be chosen explicitly; stdio is the only one
-    # today, so `serve` without it is an error, not a silent default
+    # the transport must be chosen explicitly: --stdio for a piped
+    # session, --listen for the threaded TCP/HTTP listener (both at
+    # once is fine — one warm process serving pipes and sockets)
     sv.add_argument("--stdio", action="store_true")
+    sv.add_argument(
+        "--listen",
+        default=None,
+        metavar="HOST:PORT",
+        help="serve the same protocol to TCP/HTTP clients (port 0 = "
+        "OS-assigned, announced on stderr); shares the session's "
+        "prepared-rules cache, plan memo and coalescing batcher "
+        "across connections",
+    )
+    sv.add_argument(
+        "--no-coalesce",
+        action="store_true",
+        help="disable cross-request batch coalescing (same as "
+        "GUARD_TPU_COALESCE=0): every request dispatches alone",
+    )
     _add_telemetry_flags(sv)
 
     rp = sub.add_parser(
@@ -416,12 +432,20 @@ def _dispatch(args, writer: Writer, reader: Reader) -> int:
         if args.command == "completions":
             return Completions(shell=args.shell).execute(writer, reader)
         if args.command == "serve":
-            if not args.stdio:
-                writer.writeln_err("serve requires --stdio (the only transport)")
+            if not args.stdio and not args.listen:
+                writer.writeln_err(
+                    "serve requires a transport: --stdio and/or "
+                    "--listen HOST:PORT"
+                )
                 return 5
             from .commands.serve import Serve
 
-            return Serve(stdio=True).execute(writer, reader)
+            coalesce = False if args.no_coalesce else None
+            return Serve(
+                stdio=args.stdio,
+                listen=args.listen,
+                coalesce=coalesce,
+            ).execute(writer, reader)
         if args.command == "report":
             from .commands.ops_report import OpsReport
 

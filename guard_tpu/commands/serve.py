@@ -1,4 +1,4 @@
-"""`guard-tpu serve --stdio`: a persistent validate session.
+"""`guard-tpu serve`: a persistent, multi-client validate session.
 
 The npm surface (ts_lib) — like any embedder paying per-call process
 spawn — loses ~seconds of Python+JAX import per `validate()` when it
@@ -12,7 +12,8 @@ warm interpreter, warm JAX, warm compile caches across calls.
 
 Persistent sessions also reuse the PREPARED evaluation pipeline across
 requests: rule payloads seen before are served from a parsed-RuleFile
-cache (keyed by the exact rule texts), so a session alternating over a
+cache (keyed by the exact rule texts, LRU-bounded, size exported as
+the `serve_rules_cache_size` gauge), so a session alternating over a
 stable registry skips re-parsing per request — and, downstream, the
 trace/executable caches (`parallel/mesh._shared_evaluator_fns`, the
 backend pack cache) key off those same reused objects, so the tpu
@@ -21,29 +22,42 @@ backend re-dispatches without re-lowering. The plan layer
 rule-content digest, so even a request whose rule texts arrive as NEW
 RuleFile objects (parsed-cache miss after eviction, or a second serve
 session against a populated `GUARD_TPU_PLAN_CACHE_DIR`) reuses the
-canonical lowered plan instead of re-lowering. Data documents flow through
-the same chunk-encode entrypoint as the sweep ingest plane
-(`ops.encoder.encode_chunk_texts` / the native batch loader), so serve
-benefits from the host-plane work without a worker pool (payloads
-arrive in-memory; there is nothing to read from disk). A rules payload
-that fails to parse always takes the uncached path, so per-request
-parse errors reproduce byte-identically.
+canonical lowered plan instead of re-lowering. A rules payload that
+fails to parse always takes the uncached path, so per-request parse
+errors reproduce byte-identically.
 
 Protocol (one line in, one line out):
 
   request:  {"rules": [..], "data": [..]}          (payload contract,
             validate.rs:507-513) plus optional
             {"output_format": "sarif"|"json"|"yaml",
-             "backend": "auto"|"cpu"|"native"|"tpu", "verbose": bool}
+             "backend": "auto"|"cpu"|"native"|"tpu", "verbose": bool,
+             "id": <any JSON scalar>}
   response: {"code": <exit code 0|19|5>, "output": "<stdout text>",
-             "error": "<stderr text>"}
+             "error": "<stderr text>"}  (+ "id" echoed when tagged)
 
-A `{"metrics": true}` request returns the live telemetry snapshot
-instead: `{"code": 0, "metrics": {...}}` — the same schema-versioned
-document `--metrics-out` writes (utils.telemetry), reflecting the
-previous validate request's counters (each validate request starts
-with one `backend.reset_all_stats()` switch) plus the persistent
-per-request latency histogram (`serve_request_seconds`, p50/p99).
+**Concurrency** (the serving plane, guard_tpu/serve/): untagged
+requests answer strictly in order — byte-compatible with the original
+single-client session. Requests tagged with an `"id"` are MULTIPLEXED:
+handled on a worker pool, answered as they finish (possibly out of
+order, id echoed so clients demux). Explicit `"backend": "tpu"`
+requests additionally enter the coalescing batcher
+(serve/batcher.py): in-flight requests that share a rule digest
+evaluate as ONE packed (docs x rules) device dispatch and demux
+byte-identically to sequential runs. `--listen HOST:PORT` serves the
+same protocol to many TCP/HTTP clients over one warm process
+(serve/server.py). `GUARD_TPU_COALESCE=0` or `--no-coalesce` disables
+coalescing.
+
+A `{"metrics": true}` request returns the live telemetry snapshot:
+`{"code": 0, "metrics": {...}, "last_request": {...}}` — `metrics` is
+the same schema-versioned CUMULATIVE document `--metrics-out` writes
+(utils.telemetry), including the persistent per-request latency
+histograms (`serve_request_seconds`, `serve_queue_wait_seconds`);
+`last_request` holds the snapshot-DIFF of counters attributable to the
+most recently completed validate request. Counters are never reset
+per request (a global reset would race in-flight peers under
+concurrency — diffs are computed, not destructive).
 
 An empty line or EOF ends the session with exit code 0. Request
 isolation (the failure plane's serve leg): a malformed or poisoned
@@ -52,13 +66,19 @@ request produces a structured error response — code 5 plus an
 alive; `GUARD_TPU_SERVE_TIMEOUT=<seconds>` bounds each request
 (a timed-out request answers `error_class: "RequestTimeout"` and the
 session keeps serving; the wedged worker thread is abandoned, not
-joined — a stuck device call cannot be cancelled, only orphaned).
+joined — a stuck device call cannot be cancelled, only orphaned; at
+most `GUARD_TPU_SERVE_ABANDONED_MAX` threads are ever abandoned, the
+count rides the `serve_abandoned_threads` gauge, and past the cap the
+session logs a warning and queues behind the wedged executor instead
+of leaking more threads).
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Optional
@@ -67,6 +87,9 @@ from ..core.errors import ParseError
 from ..core.parser import parse_rules_file
 from ..utils import telemetry
 from ..utils.io import Reader, Writer
+from ..utils.telemetry import SERVE_COUNTERS
+
+log = logging.getLogger("guard_tpu.serve")
 
 
 def _serve_timeout() -> float:
@@ -78,6 +101,17 @@ def _serve_timeout() -> float:
     except ValueError:
         return 0.0
 
+
+def _abandoned_max() -> int:
+    """Ceiling on timeout-abandoned worker threads per session
+    (GUARD_TPU_SERVE_ABANDONED_MAX, default 4)."""
+    raw = os.environ.get("GUARD_TPU_SERVE_ABANDONED_MAX", "").strip()
+    try:
+        return int(raw) if raw else 4
+    except ValueError:
+        return 4
+
+
 #: parsed-rules cache ceiling per session (rule registries are few and
 #: stable in practice; the bound only guards a hostile request stream)
 _RULES_CACHE_MAX = 8
@@ -88,9 +122,29 @@ class RequestTimeout(Exception):
     answers with a structured error and keeps serving."""
 
 
+def _counters_diff(before: dict, after: dict) -> dict:
+    """Non-zero per-group counter deltas between two snapshots (the
+    non-destructive replacement for the old per-request global reset)."""
+    diff: dict = {}
+    for group, counters in after.items():
+        base = before.get(group, {})
+        for name, val in counters.items():
+            if not isinstance(val, (int, float)):
+                continue
+            delta = val - base.get(name, 0)
+            if delta:
+                diff.setdefault(group, {})[name] = delta
+    return diff
+
+
 @dataclass
 class Serve:
     stdio: bool = True
+    #: HOST:PORT for the TCP/HTTP listener (serve/server.py); None =
+    #: stdio-only session
+    listen: Optional[str] = None
+    #: None = GUARD_TPU_COALESCE env default; False = --no-coalesce
+    coalesce: Optional[bool] = None
     # parsed RuleFile lists keyed by the exact rules-text tuple;
     # instance-scoped so sessions never share stale registries
     _rules_cache: "OrderedDict[tuple, list]" = field(
@@ -100,20 +154,33 @@ class Serve:
     # lazily created single-worker executor for bounded requests
     # (GUARD_TPU_SERVE_TIMEOUT); abandoned + recreated after a timeout
     _executor: Optional[object] = field(default=None, repr=False)
+    #: timeout-abandoned worker threads this session (satellite cap)
+    _abandoned: int = 0
+    _abandoned_warned: bool = False
+    _cache_lock: object = field(default_factory=threading.Lock, repr=False)
+    _metrics_lock: object = field(default_factory=threading.Lock, repr=False)
+    _batcher_lock: object = field(
+        default_factory=threading.Lock, repr=False
+    )
+    _batcher: Optional[object] = field(default=None, repr=False)
+    _last_request: Optional[dict] = field(default=None, repr=False)
 
+    # -- shared caches ------------------------------------------------
     def _prepared_rules(self, rules_strs):
         """Parsed RuleFile list for this request's rule texts, reused
-        across requests. Returns None when any text fails to parse —
-        the request then takes the ordinary payload path so the parse
-        error output reproduces exactly, and nothing is cached."""
+        across requests (and across CLIENTS — one cache per session
+        feeds every connection). Returns None when any text fails to
+        parse — the request then takes the ordinary payload path so the
+        parse error output reproduces exactly, and nothing is cached."""
         from .validate import RuleFile
 
         key = tuple(rules_strs)
-        hit = self._rules_cache.get(key)
-        if hit is not None:
-            self._rules_cache.move_to_end(key)
-            self.cache_hits += 1
-            return hit
+        with self._cache_lock:
+            hit = self._rules_cache.get(key)
+            if hit is not None:
+                self._rules_cache.move_to_end(key)
+                self.cache_hits += 1
+                return hit
         rule_files = []
         with telemetry.span("rule_parse", {"files": len(rules_strs)}):
             for i, content in enumerate(rules_strs):
@@ -129,17 +196,42 @@ class Serve:
                             rules=rf
                         )
                     )
-        self._rules_cache[key] = rule_files
-        while len(self._rules_cache) > _RULES_CACHE_MAX:
-            self._rules_cache.popitem(last=False)
+        with self._cache_lock:
+            self._rules_cache[key] = rule_files
+            while len(self._rules_cache) > _RULES_CACHE_MAX:
+                self._rules_cache.popitem(last=False)
+            telemetry.REGISTRY.set_gauge(
+                "serve_rules_cache_size", len(self._rules_cache)
+            )
         return rule_files
 
+    def _coalesce_on(self) -> bool:
+        from ..serve.batcher import coalesce_enabled
+
+        if self.coalesce is not None:
+            return bool(self.coalesce)
+        return coalesce_enabled()
+
+    def _get_batcher(self):
+        # lock-guarded: the first wave of concurrent requests all see
+        # None and would each spin up a batcher (plus its dispatcher
+        # thread), splitting one coalescable batch across strays
+        with self._batcher_lock:
+            if self._batcher is None:
+                from ..serve.batcher import CoalescingBatcher
+
+                self._batcher = CoalescingBatcher()
+            return self._batcher
+
+    # -- bounded execution --------------------------------------------
     def _run_bounded(self, cmd, buf, payload):
         """Run one request under GUARD_TPU_SERVE_TIMEOUT. The
         single-worker executor is created lazily and reused across
         requests; on timeout it is abandoned (its thread may still be
         wedged in a device call) and a fresh one serves the next
-        request."""
+        request — up to GUARD_TPU_SERVE_ABANDONED_MAX abandonments,
+        after which the session warns once and keeps the (possibly
+        wedged) executor so a flaky device can't leak threads forever."""
         timeout = _serve_timeout()
         if timeout <= 0:
             return cmd.execute(buf, Reader.from_string(payload))
@@ -154,92 +246,208 @@ class Serve:
         try:
             return fut.result(timeout=timeout)
         except FutTimeout:
-            ex, self._executor = self._executor, None
-            ex.shutdown(wait=False)
+            if self._abandoned < _abandoned_max():
+                ex, self._executor = self._executor, None
+                ex.shutdown(wait=False)
+                self._abandoned += 1
+                SERVE_COUNTERS["abandoned_threads"] += 1
+                telemetry.REGISTRY.set_gauge(
+                    "serve_abandoned_threads", self._abandoned
+                )
+            elif not self._abandoned_warned:
+                self._abandoned_warned = True
+                log.warning(
+                    "serve: abandoned-thread cap (%d) reached; keeping "
+                    "the current worker — subsequent requests queue "
+                    "behind it instead of leaking more threads",
+                    _abandoned_max(),
+                )
             raise RequestTimeout(
                 f"request timed out after {timeout:g}s"
             )
 
-    def execute(self, writer: Writer, reader: Reader) -> int:
+    # -- request handling ---------------------------------------------
+    @staticmethod
+    def request_id(line: str):
+        """The request's `"id"` tag, or None (malformed JSON included —
+        the error envelope for it is produced untagged, in order)."""
+        try:
+            req = json.loads(line)
+        except ValueError:
+            return None
+        if isinstance(req, dict):
+            return req.get("id")
+        return None
+
+    def handle_line(self, line: str) -> dict:
+        """Answer ONE request line with its response envelope (no id
+        handling — callers echo ids). Every transport lands here: the
+        stdio loop, the TCP/HTTP listener, and the bench/parity
+        harnesses driving a session in-process."""
         import time
 
-        from ..ops.backend import reset_all_stats
+        t0 = time.perf_counter()
+        sp = telemetry.span_begin("serve_request")
+        try:
+            req = json.loads(line)
+            if not isinstance(req, dict):
+                raise ValueError("request must be a JSON object")
+            resp = self._handle_request(req, sp)
+        except Exception as e:  # poisoned request: keep serving
+            sp.set("error_class", type(e).__name__)
+            # arm the flight recorder: a timed-out or poisoned
+            # request answers code 5 but the SESSION exits 0, so
+            # without this latch the abnormal-exit dump would never
+            # fire for serve-side failures
+            telemetry.flightrec_mark_fault(
+                "serve.request_error",
+                {"error_class": type(e).__name__},
+            )
+            resp = {
+                "code": 5,
+                "output": "",
+                "error": str(e),
+                "error_class": type(e).__name__,
+            }
+        telemetry.span_end(sp)
+        # per-request latency distribution (p50/p99): persistent,
+        # so a registry reset never erases the session story
+        telemetry.REGISTRY.histogram(
+            "serve_request_seconds", persistent=True
+        ).observe(time.perf_counter() - t0)
+        return resp
+
+    def _handle_request(self, req: dict, sp) -> dict:
+        from ..serve.batcher import BatchTimeout
+
+        if req.get("metrics"):
+            # live observability face: `metrics` is the cumulative
+            # snapshot --metrics-out writes; `last_request` the
+            # counter DIFF of the most recent validate request
+            # (computed, never reset — a global reset would race
+            # concurrent in-flight peers)
+            sp.set("kind", "metrics")
+            with self._metrics_lock:
+                last = self._last_request
+            return {
+                "code": 0,
+                "metrics": telemetry.metrics_snapshot(),
+                "last_request": last or {},
+            }
         from .validate import Validate
 
-        stream = reader.stream()
-        for line in stream:
-            line = line.strip()
-            if not line:
-                break
-            t0 = time.perf_counter()
-            sp = telemetry.span_begin("serve_request")
+        SERVE_COUNTERS["requests"] += 1
+        rules_strs = req.get("rules", [])
+        payload = json.dumps(
+            {
+                "rules": rules_strs,
+                "data": req.get("data", []),
+            }
+        )
+        prepared = None
+        if all(isinstance(r, str) for r in rules_strs):
+            prepared = self._prepared_rules(rules_strs)
+        out_fmt = req.get("output_format", "sarif")
+        structured = out_fmt in ("sarif", "json", "yaml", "junit")
+        cmd = Validate(
+            payload=True,
+            structured=structured,
+            output_format=out_fmt,
+            show_summary=["none"] if structured else ["fail"],
+            verbose=bool(req.get("verbose", False)),
+            backend=req.get("backend", "auto"),
+            prepared_rules=prepared,
+        )
+        buf = Writer.buffered()
+        before = telemetry.REGISTRY.snapshot()["counters"]
+        # coalescing eligibility: an explicit device-backend request
+        # whose rules parsed clean (the digest IS the group key); auto
+        # and host backends keep the sequential path
+        if (
+            self._coalesce_on()
+            and req.get("backend") == "tpu"
+            and prepared is not None
+        ):
+            SERVE_COUNTERS["coalesce_eligible"] += 1
+            from ..ops.plan import plan_digest
+
             try:
-                req = json.loads(line)
-                if not isinstance(req, dict):
-                    raise ValueError("request must be a JSON object")
-                if req.get("metrics"):
-                    # live observability face: the same snapshot
-                    # --metrics-out writes, reflecting the PREVIOUS
-                    # validate request (counters reset at the start of
-                    # each one, not after — so they stay inspectable)
-                    sp.set("kind", "metrics")
-                    resp = {"code": 0, "metrics": telemetry.metrics_snapshot()}
-                else:
-                    # one reset switch per request: a poisoned or
-                    # timed-out request must not bleed counters into
-                    # the next one (persistent latency histograms and
-                    # the session trace survive by design)
-                    reset_all_stats()
-                    rules_strs = req.get("rules", [])
-                    payload = json.dumps(
-                        {
-                            "rules": rules_strs,
-                            "data": req.get("data", []),
-                        }
-                    )
-                    prepared = None
-                    if all(isinstance(r, str) for r in rules_strs):
-                        prepared = self._prepared_rules(rules_strs)
-                    out_fmt = req.get("output_format", "sarif")
-                    structured = out_fmt in ("sarif", "json", "yaml", "junit")
-                    cmd = Validate(
-                        payload=True,
-                        structured=structured,
-                        output_format=out_fmt,
-                        show_summary=["none"] if structured else ["fail"],
-                        verbose=bool(req.get("verbose", False)),
-                        backend=req.get("backend", "auto"),
-                        prepared_rules=prepared,
-                    )
-                    buf = Writer.buffered()
-                    code = self._run_bounded(cmd, buf, payload)
-                    resp = {
-                        "code": code,
-                        "output": buf.out.getvalue(),
-                        "error": buf.err.getvalue(),
-                    }
-            except Exception as e:  # poisoned request: keep serving
-                sp.set("error_class", type(e).__name__)
-                # arm the flight recorder: a timed-out or poisoned
-                # request answers code 5 but the SESSION exits 0, so
-                # without this latch the abnormal-exit dump would never
-                # fire for serve-side failures
-                telemetry.flightrec_mark_fault(
-                    "serve.request_error",
-                    {"error_class": type(e).__name__},
+                code = self._get_batcher().submit(
+                    cmd, payload, plan_digest(prepared), buf,
+                    timeout=_serve_timeout(),
                 )
-                resp = {
-                    "code": 5,
-                    "output": "",
-                    "error": str(e),
-                    "error_class": type(e).__name__,
-                }
-            telemetry.span_end(sp)
-            # per-request latency distribution (p50/p99): persistent,
-            # so between-request resets never erase the session story
-            telemetry.REGISTRY.histogram(
-                "serve_request_seconds", persistent=True
-            ).observe(time.perf_counter() - t0)
-            writer.writeln(json.dumps(resp))
-            writer.flush()
+            except BatchTimeout as e:
+                raise RequestTimeout(str(e))
+        else:
+            SERVE_COUNTERS["coalesce_bypass"] += 1
+            code = self._run_bounded(cmd, buf, payload)
+        after = telemetry.REGISTRY.snapshot()["counters"]
+        with self._metrics_lock:
+            self._last_request = _counters_diff(before, after)
+        return {
+            "code": code,
+            "output": buf.out.getvalue(),
+            "error": buf.err.getvalue(),
+        }
+
+    # -- session loops ------------------------------------------------
+    def execute(self, writer: Writer, reader: Reader) -> int:
+        server = None
+        if self.listen:
+            from ..serve.server import ServeServer, run_listener
+
+            if not self.stdio:
+                return run_listener(self, self.listen, writer)
+            # both transports: the listener serves sockets while the
+            # stdio loop below serves the pipe; EOF on stdin ends both
+            server = ServeServer(self, self.listen).start()
+            writer.writeln_err(
+                f"guard-tpu serve: listening on {server.host}:{server.port}"
+            )
+
+        wlock = threading.Lock()
+        pool = None
+        pending = []
+        stream = reader.stream()
+        try:
+            for line in stream:
+                line = line.strip()
+                if not line:
+                    break
+                rid = self.request_id(line)
+                if rid is None:
+                    # untagged: answer strictly in order — the original
+                    # single-client protocol, byte-compatible
+                    resp = self.handle_line(line)
+                    writer.writeln(json.dumps(resp))
+                    writer.flush()
+                    continue
+                # tagged: multiplex — handled on the pool, answered as
+                # finished (id echoed so the client demuxes), so many
+                # in-flight requests can coalesce into shared batches
+                if pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    from ..serve.batcher import coalesce_max_batch
+
+                    pool = ThreadPoolExecutor(
+                        max_workers=max(4, coalesce_max_batch()),
+                        thread_name_prefix="guard-tpu-serve",
+                    )
+
+                def _answer(line=line, rid=rid):
+                    resp = self.handle_line(line)
+                    resp["id"] = rid
+                    with wlock:
+                        writer.writeln(json.dumps(resp))
+                        writer.flush()
+
+                pending.append(pool.submit(_answer))
+        finally:
+            for fut in pending:
+                fut.result()
+            if pool is not None:
+                pool.shutdown(wait=True)
+            if server is not None:
+                server.stop()
         return 0

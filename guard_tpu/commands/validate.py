@@ -385,37 +385,9 @@ class Validate:
                 raise GuardError(err)
 
         if self.payload:
-            payload_content = reader.read()
-            rules_strs, data_strs = load_payload(payload_content)
-            data_files = [
-                DataFile(
-                    name=f"DATA_STDIN[{i + 1}]",
-                    content=d if isinstance(d, str) else json.dumps(d),
-                    _pv=load_document(d if isinstance(d, str) else json.dumps(d)),
-                )
-                for i, d in enumerate(data_strs)
-            ]
-            rule_files = []
-            errors = 0
-            if self.prepared_rules is not None:
-                # serve sessions: the rules were parsed once when the
-                # session first saw them (all clean — parse errors
-                # always take the uncached path so stderr reproduces)
-                rule_files = list(self.prepared_rules)
-            else:
-                for i, content in enumerate(rules_strs):
-                    name = f"RULES_STDIN[{i + 1}]"
-                    try:
-                        rf = parse_rules_file(content, name)
-                    except ParseError as e:
-                        writer.writeln_err(f"Parse Error on ruleset file {name}")
-                        writer.writeln_err(str(e))
-                        errors += 1
-                        continue
-                    if rf is not None:
-                        rule_files.append(
-                            RuleFile(name=name, full_name=name, content=content, rules=rf)
-                        )
+            rule_files, data_files, errors = payload_inputs(
+                reader.read(), writer, self.prepared_rules
+            )
         else:
             try:
                 data_files = self._load_data_files(reader, writer)
@@ -578,6 +550,45 @@ class Validate:
         if had_fail:
             return FAILURE_STATUS_CODE
         return SUCCESS_STATUS_CODE
+
+
+def payload_inputs(payload_content, writer: Writer, prepared_rules=None):
+    """Build `(rule_files, data_files, parse_errors)` from a payload
+    document (`{"rules": [...], "data": [...]}`). Shared by
+    Validate.execute's payload branch and the serve coalescing batcher
+    (serve/batcher.py), which must construct a request's inputs exactly
+    as the sequential path does for byte parity."""
+    rules_strs, data_strs = load_payload(payload_content)
+    data_files = [
+        DataFile(
+            name=f"DATA_STDIN[{i + 1}]",
+            content=d if isinstance(d, str) else json.dumps(d),
+            _pv=load_document(d if isinstance(d, str) else json.dumps(d)),
+        )
+        for i, d in enumerate(data_strs)
+    ]
+    rule_files = []
+    errors = 0
+    if prepared_rules is not None:
+        # serve sessions: the rules were parsed once when the
+        # session first saw them (all clean — parse errors
+        # always take the uncached path so stderr reproduces)
+        rule_files = list(prepared_rules)
+    else:
+        for i, content in enumerate(rules_strs):
+            name = f"RULES_STDIN[{i + 1}]"
+            try:
+                rf = parse_rules_file(content, name)
+            except ParseError as e:
+                writer.writeln_err(f"Parse Error on ruleset file {name}")
+                writer.writeln_err(str(e))
+                errors += 1
+                continue
+            if rf is not None:
+                rule_files.append(
+                    RuleFile(name=name, full_name=name, content=content, rules=rf)
+                )
+    return rule_files, data_files, errors
 
 
 def _missing_file_message(e: FileNotFoundError) -> str:

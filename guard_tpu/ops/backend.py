@@ -631,23 +631,29 @@ def _settled_template(name_row, names):
     return fields, rule_statuses, status
 
 
-def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
-    """Drop-in body for Validate.execute's evaluation loop."""
-    _honor_platform_env()
-    from ..commands.validate import (
-        ERROR_STATUS_CODE,
-        FAILURE_STATUS_CODE,
-        SUCCESS_STATUS_CODE,
-    )
-    from ..commands.reporters.aware import console_chain
-    from ..commands.reporters.junit import JunitTestCase, write_junit
-    from ..commands.reporters.sarif import write_sarif
-    from ..commands.reporters.structured import write_structured
-    from ..parallel.mesh import ShardedBatchEvaluator
+def _docs_for(data_files, quarantined):
+    """Python document trees, built LAZILY (DataFile.path_value): on
+    all-JSON corpora the native encoder, device kernels and native
+    oracle run entirely from raw content, and the eager per-doc tree
+    build (~40% of all-lowered sweep time, measured round 3) is paid
+    only by the docs something actually walks. Quarantined docs stand
+    in as `null` so batch geometry stays aligned."""
+    if quarantined:
+        from ..core.values import PV
+        from ..core.values import Path as VPath
 
-    if not data_files or not rule_files:
-        return SUCCESS_STATUS_CODE
+        return [
+            PV.null(VPath.root()) if di in quarantined else df.path_value
+            for di, df in enumerate(data_files)
+        ]
+    return [df.path_value for df in data_files]
 
+
+def _encode_docs(validate, data_files, writer: Writer):
+    """Encode front half of the tpu path: quarantine-aware encode,
+    parallel-ingest or inline (native/Python) encode. Returns (batch,
+    interner, quarantined, max_df) — `quarantined` maps doc index to
+    its failure record (empty outside --max-doc-failures mode)."""
     # failure plane: with --max-doc-failures set, a doc that fails to
     # parse/encode is QUARANTINED — structured error record, `null`
     # stand-in in the batch, excluded from every report pass — instead
@@ -655,22 +661,6 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
     quarantined: dict = {}
     max_df = getattr(validate, "max_doc_failures", None)
     q_mode = max_df is not None and not validate.input_params
-
-    # Python document trees build LAZILY (DataFile.path_value): on
-    # all-JSON corpora the native encoder, device kernels and native
-    # oracle run entirely from raw content, and the eager per-doc tree
-    # build (~40% of all-lowered sweep time, measured round 3) is paid
-    # only by the docs something actually walks.
-    def _docs():
-        if quarantined:
-            from ..core.values import PV
-            from ..core.values import Path as VPath
-
-            return [
-                PV.null(VPath.root()) if di in quarantined else df.path_value
-                for di, df in enumerate(data_files)
-            ]
-        return [df.path_value for df in data_files]
 
     batch = interner = None
     if q_mode:
@@ -737,26 +727,23 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                     except RuntimeError:
                         pass
             if batch is None:
-                batch, interner = encode_batch(_docs())
+                batch, interner = encode_batch(
+                    _docs_for(data_files, quarantined)
+                )
+    return batch, interner, quarantined, max_df
 
-    errors = 0
-    had_fail = False
-    all_reports: List[dict] = []
-    junit_suites = {
-        df.name: []
-        for di, df in enumerate(data_files)
-        if di not in quarantined
-    }
-    host_docs = set()
 
-    # lower every rule file UP-FRONT: the pack planner needs the whole
-    # registry before the first dispatch. Files with precomputable
-    # function lets (ops/fnvars.py) re-encode the batch with per-doc
-    # function results BEFORE compile (result strings must intern under
-    # the bit tables) — those files keep a per-file batch and are
-    # excluded from packing by ir.pack_compatible.
+def _lower_rules(validate, rule_files, batch, interner, data_files,
+                 quarantined):
+    """Lowering front half: every rule file compiles UP-FRONT (the
+    pack planner needs the whole registry before the first dispatch),
+    via the plan layer when enabled. Files with precomputable function
+    lets (ops/fnvars.py) re-encode the batch with per-doc function
+    results BEFORE compile (result strings must intern under the bit
+    tables) — those keep a per-file batch and are excluded from packing
+    by ir.pack_compatible. Returns (prep, plan, interner) with
+    prep = [(rule_file, rbatch, compiled)]."""
     from .fnvars import precompute_fn_values, precomputable_fn_vars
-    from .ir import pack_compatible
     from .plan import get_plan, plan_cache_enabled, relocate_batch
 
     prep = []
@@ -776,7 +763,7 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                 # fn-var slow path, per batch as before — but against
                 # the plan interner, so ids stay in one namespace
                 with _span("lower_compile", {"files": 1, "mode": "fnvar"}):
-                    docs = _docs()
+                    docs = _docs_for(data_files, quarantined)
                     fn_vars, fn_vals, fn_err = precompute_fn_values(
                         rule_file.rules, docs
                     )
@@ -793,7 +780,7 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
             for rule_file in rule_files:
                 rbatch = batch
                 if precomputable_fn_vars(rule_file.rules):
-                    docs = _docs()
+                    docs = _docs_for(data_files, quarantined)
                     fn_vars, fn_vals, fn_err = precompute_fn_values(
                         rule_file.rules, docs
                     )
@@ -811,10 +798,15 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                     rule_file.name, n_dev, n_dev + n_host, n_host,
                 )
                 prep.append((rule_file, rbatch, compiled))
+    return prep, plan, interner
 
-    # fused multi-rule-file dispatch: compatible files (shared batch,
-    # no per-file fn re-encode) evaluate as packed executables, one
-    # device dispatch per (pack, bucket) instead of one per file
+
+def _eval_packed(validate, prep, batch, plan):
+    """Fused multi-rule-file dispatch: compatible files (shared batch,
+    no per-file fn re-encode) evaluate as packed executables, one
+    device dispatch per (pack, bucket) instead of one per file.
+    Returns (packed_results, rim_on)."""
+    from .ir import pack_compatible
 
     pack_enabled = (
         getattr(validate, "pack_rules", True)
@@ -833,8 +825,41 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
             with_rim=rim_on,
             prepacked=plan.prepacked_items() if plan is not None else None,
         )
+    return packed_results, rim_on
 
-    for fi, (rule_file, rbatch, compiled) in enumerate(prep):
+
+class _ReportAcc:
+    """Per-request report accumulators threaded through _report_files —
+    request-scoped so the coalesced serve path (tpu_validate_multi) can
+    run one report pass per caller over a shared device evaluation."""
+
+    __slots__ = ("errors", "had_fail", "all_reports", "junit_suites")
+
+    def __init__(self, data_files, quarantined):
+        self.errors = 0
+        self.had_fail = False
+        self.all_reports: List[dict] = []
+        self.junit_suites = {
+            df.name: []
+            for di, df in enumerate(data_files)
+            if di not in quarantined
+        }
+
+
+def _report_files(validate, file_iter, data_files, quarantined, writer,
+                  acc: _ReportAcc, rim_on: bool) -> None:
+    """Report half of the tpu path: pass A (which docs need the
+    oracle), the pooled/native/inline oracle reruns, and pass B (report
+    emission) — one iteration per rule file. `file_iter` yields
+    (fi, rule_file, compiled, statuses, unsure, host_docs, rim); the
+    sequential path yields lazily (dispatch of file k+1 overlaps the
+    report pass of file k exactly as before the eval/report split), the
+    coalesced serve path yields per-request doc-segment slices of a
+    shared evaluation."""
+    from ..commands.reporters.aware import console_chain
+    from ..commands.reporters.junit import JunitTestCase
+
+    for fi, rule_file, compiled, statuses, unsure, host_docs, rim in file_iter:
         # native statuses oracle (native/oracle.cpp): the compiled-
         # engine prefilter. When the full record tree isn't required it
         # answers host-rule/unsure/oversized-doc statuses at native
@@ -872,20 +897,6 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                 elif st == Status.FAIL:
                     merged[name] = Status.FAIL
             return merged
-        statuses = None
-        unsure = None
-        rim = None
-        if fi in packed_results:
-            # the packed segment slice is bit-identical to the
-            # per-file path (tests/test_rule_packing.py parity)
-            statuses, unsure, host_docs, rim = packed_results[fi]
-        elif compiled.rules:
-            evaluator = ShardedBatchEvaluator(compiled)
-            with _span("dispatch", {"mode": "per_file", "file": fi}):
-                statuses, unsure, host_docs = (
-                    evaluator.evaluate_bucketed(rbatch)
-                )
-
         statuses_only = getattr(validate, "statuses_only", False)
 
         def _native_prefilter(data_file):
@@ -1134,7 +1145,7 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                     )
                 fields, rule_statuses, doc_status = cached
                 if doc_status == Status.FAIL:
-                    had_fail = True
+                    acc.had_fail = True
                 if not validate.structured:
                     report = {
                         "name": data_file.name,
@@ -1240,7 +1251,7 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                     (_key, st_val, p_report, p_statuses, err) = pooled_results[di]
                     if err is not None:
                         writer.writeln_err(err)
-                        errors += 1
+                        acc.errors += 1
                         continue
                     oracle_status = Status(st_val)
                     report = p_report
@@ -1256,7 +1267,7 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                         )
                     except GuardError as e:
                         writer.writeln_err(str(e))
-                        errors += 1
+                        acc.errors += 1
                         continue
                     root_record = scope.reset_recorder().extract()
                     report = simplified_report_from_root(
@@ -1277,12 +1288,12 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
                 doc_status = oracle_status
 
             if doc_status == Status.FAIL:
-                had_fail = True
-            all_reports.append(report)
+                acc.had_fail = True
+            acc.all_reports.append(report)
             from ..commands.reporters.junit import failure_info_from_report
 
             fname, fmsgs = failure_info_from_report(report)
-            junit_suites[data_file.name].append(
+            acc.junit_suites[data_file.name].append(
                 JunitTestCase(
                     name=rule_file.name,
                     status=doc_status,
@@ -1303,21 +1314,186 @@ def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
         if native is not None:
             native.close()
 
+
+def _finish_report(validate, acc: _ReportAcc, writer: Writer, quarantined,
+                   max_df) -> int:
+    """Structured-output emission + exit-code resolution over one
+    request's accumulators."""
+    from ..commands.validate import (
+        ERROR_STATUS_CODE,
+        FAILURE_STATUS_CODE,
+        SUCCESS_STATUS_CODE,
+    )
+    from ..commands.reporters.junit import write_junit
+    from ..commands.reporters.sarif import write_sarif
+    from ..commands.reporters.structured import write_structured
+
     if validate.structured:
         if validate.output_format in ("json", "yaml"):
-            write_structured(writer, all_reports, validate.output_format)
+            write_structured(writer, acc.all_reports, validate.output_format)
         elif validate.output_format == "sarif":
-            write_sarif(writer, all_reports)
+            write_sarif(writer, acc.all_reports)
         elif validate.output_format == "junit":
-            write_junit(writer, junit_suites)
+            write_junit(writer, acc.junit_suites)
 
-    if errors > 0:
+    if acc.errors > 0:
         return ERROR_STATUS_CODE
     if quarantined:
         FAULT_COUNTERS["quarantined_docs"] += len(quarantined)
         # negative limit = unlimited quarantine (degrade, never error)
         if max_df is not None and 0 <= max_df < len(quarantined):
             return ERROR_STATUS_CODE
-    if had_fail:
+    if acc.had_fail:
         return FAILURE_STATUS_CODE
     return SUCCESS_STATUS_CODE
+
+
+def tpu_validate(validate, rule_files, data_files, writer: Writer) -> int:
+    """Drop-in body for Validate.execute's evaluation loop."""
+    _honor_platform_env()
+    from ..commands.validate import SUCCESS_STATUS_CODE
+    from ..parallel.mesh import ShardedBatchEvaluator
+
+    if not data_files or not rule_files:
+        return SUCCESS_STATUS_CODE
+
+    batch, interner, quarantined, max_df = _encode_docs(
+        validate, data_files, writer
+    )
+    prep, plan, interner = _lower_rules(
+        validate, rule_files, batch, interner, data_files, quarantined
+    )
+    packed_results, rim_on = _eval_packed(validate, prep, batch, plan)
+
+    def _eval_iter():
+        # lazy per-file dispatch: fused packs resolved above, the
+        # per-file fallback dispatches inside iteration — ordering
+        # (dispatch k, report k, dispatch k+1, ...) and the host_docs
+        # carry-over across files are exactly the pre-split loop
+        host_docs = set()
+        for fi, (rule_file, rbatch, compiled) in enumerate(prep):
+            statuses = unsure = rim = None
+            if fi in packed_results:
+                # the packed segment slice is bit-identical to the
+                # per-file path (tests/test_rule_packing.py parity)
+                statuses, unsure, host_docs, rim = packed_results[fi]
+            elif compiled.rules:
+                evaluator = ShardedBatchEvaluator(compiled)
+                with _span("dispatch", {"mode": "per_file", "file": fi}):
+                    statuses, unsure, host_docs = (
+                        evaluator.evaluate_bucketed(rbatch)
+                    )
+            yield fi, rule_file, compiled, statuses, unsure, host_docs, rim
+
+    acc = _ReportAcc(data_files, quarantined)
+    _report_files(
+        validate, _eval_iter(), data_files, quarantined, writer, acc, rim_on
+    )
+    return _finish_report(validate, acc, writer, quarantined, max_df)
+
+
+def _segment_iter(file_results, start, end):
+    """Slice a shared multi-request evaluation down to one request's
+    doc segment. Status/unsure matrices are (docs x rules) and rim
+    blocks doc-major, so everything slices on axis 0; host_docs shift
+    to segment-local indices."""
+    for fi, (rule_file, compiled, statuses, unsure, host_docs,
+             rim) in enumerate(file_results):
+        seg_st = None if statuses is None else statuses[start:end]
+        seg_un = None if unsure is None else unsure[start:end]
+        seg_hosts = {hd - start for hd in host_docs if start <= hd < end}
+        seg_rim = None
+        if rim is not None:
+            seg_rim = tuple(b[start:end] for b in rim[:6]) + (rim[6],)
+        yield fi, rule_file, compiled, seg_st, seg_un, seg_hosts, seg_rim
+
+
+def tpu_validate_multi(requests) -> list:
+    """Coalesced serve path: evaluate SEVERAL validate requests that
+    share one rule digest as ONE packed (docs x rules) device batch,
+    then run each request's report pass over its own doc-segment slice.
+
+    `requests` is a list of (validate, rule_files, data_files, writer)
+    tuples whose rule files coalesce to the same plan digest and whose
+    evaluation-relevant Validate fields are identical (the serve
+    batcher guarantees both; see serve/batcher.py). Statuses are
+    invariant under batch composition and intern-id labels (the plan
+    layer's relocation contract, ops/plan.py), so each demuxed segment
+    is byte-identical to running that request sequentially.
+
+    Returns one entry per request: an int exit code, or the exception
+    the request's REPORT phase raised (captured so one poisoned
+    request cannot fail its batch peers). Shared-phase failures
+    (encode/lower/dispatch) propagate to the caller, which re-fires
+    each request solo.
+    """
+    _honor_platform_env()
+    from ..commands.validate import ERROR_STATUS_CODE, SUCCESS_STATUS_CODE
+    from ..parallel.mesh import ShardedBatchEvaluator
+
+    base_validate, rule_files, _bd, base_writer = requests[0]
+
+    all_data = []
+    segments = []
+    for _v, _rf, data_files, _w in requests:
+        start = len(all_data)
+        all_data.extend(data_files)
+        segments.append((start, len(all_data)))
+
+    outcomes: list = [None] * len(requests)
+    if not all_data or not rule_files:
+        # mirror the sequential early return: no report pass runs, so
+        # no structured doc is emitted for an empty corpus
+        return [SUCCESS_STATUS_CODE] * len(requests)
+
+    # shared phases (encode -> lower -> dispatch) run once under the
+    # first request's settings; the batcher only coalesces requests
+    # without --max-doc-failures, so quarantine mode stays off here
+    batch, interner, quarantined, _mdf = _encode_docs(
+        base_validate, all_data, base_writer
+    )
+    prep, plan, interner = _lower_rules(
+        base_validate, rule_files, batch, interner, all_data, quarantined
+    )
+    packed_results, rim_on = _eval_packed(base_validate, prep, batch, plan)
+
+    file_results = []
+    host_docs = set()
+    for fi, (rule_file, rbatch, compiled) in enumerate(prep):
+        statuses = unsure = rim = None
+        if fi in packed_results:
+            statuses, unsure, host_docs, rim = packed_results[fi]
+        elif compiled.rules:
+            evaluator = ShardedBatchEvaluator(compiled)
+            with _span(
+                "dispatch",
+                {"mode": "per_file", "file": fi, "requests": len(requests)},
+            ):
+                statuses, unsure, host_docs = (
+                    evaluator.evaluate_bucketed(rbatch)
+                )
+        file_results.append(
+            (rule_file, compiled, statuses, unsure, host_docs, rim)
+        )
+
+    for ri, (validate, _rf, data_files, writer) in enumerate(requests):
+        start, end = segments[ri]
+        if not data_files:
+            outcomes[ri] = SUCCESS_STATUS_CODE
+            continue
+        try:
+            acc = _ReportAcc(data_files, {})
+            _report_files(
+                validate,
+                _segment_iter(file_results, start, end),
+                data_files, {}, writer, acc, rim_on,
+            )
+            outcomes[ri] = _finish_report(validate, acc, writer, {}, None)
+        except GuardError as exc:
+            # parity with Validate.execute's tpu wrapper: GuardError
+            # becomes a stderr line + error exit for THIS request only
+            writer.writeln_err(str(exc))
+            outcomes[ri] = ERROR_STATUS_CODE
+        except Exception as exc:  # noqa: BLE001 — peer isolation
+            outcomes[ri] = exc
+    return outcomes

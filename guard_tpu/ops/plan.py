@@ -55,6 +55,7 @@ import hashlib
 import logging
 import os
 import pickle
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -266,8 +267,10 @@ def relocate_batch(plan: RulePlan, batch, chunk_interner: Interner) -> None:
     whatever the interner just gained. After this the batch evaluates
     against the plan's compiled IR bit-identically to IR lowered
     directly against the chunk interner (tests/test_plan_cache.py pins
-    the parity)."""
-    with _span("relocate", {"docs": batch.n_docs}):
+    the parity). Serialized under PLAN_LOCK: concurrent serve requests
+    share one plan object, and interner growth + bit-table extension
+    must be atomic with respect to each other."""
+    with PLAN_LOCK, _span("relocate", {"docs": batch.n_docs}):
         strings = chunk_interner.strings
         if strings:
             remap = np.fromiter(
@@ -288,6 +291,11 @@ def relocate_batch(plan: RulePlan, batch, chunk_interner: Interner) -> None:
 _PLAN_MEMO: "OrderedDict[str, RulePlan]" = OrderedDict()
 _PLAN_MEMO_MAX = 8
 
+#: one lock for the memo/key caches AND per-chunk relocation — the
+#: concurrent serving plane (serve/batcher.py) reaches get_plan +
+#: relocate_batch from many request threads against shared plan objects
+PLAN_LOCK = threading.RLock()
+
 # rule_files identity -> digest, so per-chunk lookups skip re-hashing
 # the registry bytes. Values keep the RuleFile objects alive so ids
 # cannot be recycled under the cache (same trick as _PACK_CACHE).
@@ -303,16 +311,24 @@ def clear_plan_memo() -> None:
 
 
 def _digest_for(rule_files) -> str:
-    ident = tuple(id(rf) for rf in rule_files)
-    hit = _KEY_CACHE.get(ident)
-    if hit is not None:
-        _KEY_CACHE.move_to_end(ident)
-        return hit[1]
-    digest = plan_key(rule_files)
-    _KEY_CACHE[ident] = (list(rule_files), digest)
-    while len(_KEY_CACHE) > _KEY_CACHE_MAX:
-        _KEY_CACHE.popitem(last=False)
-    return digest
+    with PLAN_LOCK:
+        ident = tuple(id(rf) for rf in rule_files)
+        hit = _KEY_CACHE.get(ident)
+        if hit is not None:
+            _KEY_CACHE.move_to_end(ident)
+            return hit[1]
+        digest = plan_key(rule_files)
+        _KEY_CACHE[ident] = (list(rule_files), digest)
+        while len(_KEY_CACHE) > _KEY_CACHE_MAX:
+            _KEY_CACHE.popitem(last=False)
+        return digest
+
+
+def plan_digest(rule_files) -> str:
+    """Public face of the plan-cache key: the content digest the serve
+    coalescing batcher groups in-flight requests by (same digest = same
+    lowered program = coalescible into one packed dispatch)."""
+    return _digest_for(rule_files)
 
 
 def _artifact_path(digest: str) -> Path:
@@ -401,25 +417,26 @@ def get_plan(rule_files, use_disk: bool = True) -> RulePlan:
     artifact, then a full build (saved back when `use_disk`). Callers
     gate on `plan_cache_enabled()` BEFORE calling — a disabled plan
     layer means the legacy per-chunk lowering path, untouched."""
-    digest = _digest_for(rule_files)
-    plan = _PLAN_MEMO.get(digest)
-    if plan is not None:
-        _PLAN_MEMO.move_to_end(digest)
-        PLAN_COUNTERS["hits"] += 1
-        return plan
-    if use_disk:
-        plan = load_plan(digest)
+    with PLAN_LOCK:
+        digest = _digest_for(rule_files)
+        plan = _PLAN_MEMO.get(digest)
         if plan is not None:
-            plan.digest = digest
+            _PLAN_MEMO.move_to_end(digest)
             PLAN_COUNTERS["hits"] += 1
-            _memo_store(digest, plan)
             return plan
-    plan = build_plan(rule_files)
-    plan.digest = digest
-    PLAN_COUNTERS["misses"] += 1
-    if use_disk:
-        # saved BEFORE first relocation: the artifact's interner is
-        # still empty, keeping it corpus-independent
-        save_plan(plan, digest)
-    _memo_store(digest, plan)
-    return plan
+        if use_disk:
+            plan = load_plan(digest)
+            if plan is not None:
+                plan.digest = digest
+                PLAN_COUNTERS["hits"] += 1
+                _memo_store(digest, plan)
+                return plan
+        plan = build_plan(rule_files)
+        plan.digest = digest
+        PLAN_COUNTERS["misses"] += 1
+        if use_disk:
+            # saved BEFORE first relocation: the artifact's interner is
+            # still empty, keeping it corpus-independent
+            save_plan(plan, digest)
+        _memo_store(digest, plan)
+        return plan

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import copy
 import dataclasses
+import threading as _threading
 from collections import OrderedDict
 from functools import partial
 from typing import Dict, Optional, Tuple
@@ -103,6 +104,19 @@ _SHARED_FNS_MAX = 64
 # shape, and node_kind's (D, N) shape determines the bucket. The packed
 # path's whole point is driving both counters down ~n_files-fold.
 _COMPILED_SHAPES: set = set()
+
+# Process-wide device-EXECUTION lock (the serving plane made dispatch
+# multi-threaded): a sharded execution enqueues one program per mesh
+# device, and cross-device collectives inside it wait for every
+# participant. Two threads interleaving their per-device enqueues can
+# order A,B on one device queue and B,A on another — each collective
+# then waits on a participant stuck behind the OTHER execution:
+# deadlock (observed on the forced 8-device CPU mesh under concurrent
+# serve requests). Holding this lock across the enqueue makes the
+# order identical on every queue; COLLECTION (blocking on an already
+# enqueued result) stays outside, so the dispatch-then-collect
+# pipelining in evaluate_bucketed is preserved.
+_EXEC_LOCK = _threading.RLock()
 
 # absorbed into the central telemetry registry (utils/telemetry.py):
 # this dict stays the mutation surface (the dispatch sites below
@@ -414,17 +428,18 @@ class ShardedBatchEvaluator:
         # arrays on this evaluator's mesh; jnp.asarray would commit them
         # to the default device first (wrong backend on TPU hosts when
         # the mesh is a CPU mesh).
-        out = self._fn(arrays, lits)
-        rim = None
-        if self.rim_spec is not None:
-            statuses = out[0] if self._with_unsure else out
-            unsure = out[1] if self._with_unsure else None
-            rim = _rim_device(
-                statuses, unsure,
-                self.rim_spec.group_ids, self.rim_spec.file_ids,
-                self.rim_spec.last_ids,
-                self.rim_spec.n_groups, self.rim_spec.n_files,
-            )
+        with _EXEC_LOCK:
+            out = self._fn(arrays, lits)
+            rim = None
+            if self.rim_spec is not None:
+                statuses = out[0] if self._with_unsure else out
+                unsure = out[1] if self._with_unsure else None
+                rim = _rim_device(
+                    statuses, unsure,
+                    self.rim_spec.group_ids, self.rim_spec.file_ids,
+                    self.rim_spec.last_ids,
+                    self.rim_spec.n_groups, self.rim_spec.n_files,
+                )
         return out, d, rim
 
     def collect(self, handle):
@@ -468,7 +483,10 @@ class ShardedBatchEvaluator:
 
     def with_summary(self, batch: DocBatch) -> Tuple[np.ndarray, np.ndarray]:
         arrays, d = self._arrays(batch)
-        statuses, counts = self._summary_fn(arrays, self._lits(), np.int32(d))
+        with _EXEC_LOCK:
+            statuses, counts = self._summary_fn(
+                arrays, self._lits(), np.int32(d)
+            )
         return np.asarray(statuses)[:d], np.asarray(counts)
 
 

@@ -1,0 +1,14 @@
+"""The concurrent serving plane: multi-client connection handling
+(`server.py` — multiplexed stdio ids, threaded TCP/HTTP listener) and
+cross-request batch coalescing (`batcher.py` — one packed device
+dispatch per rule digest instead of one per request)."""
+
+from .batcher import BatchTimeout, CoalescingBatcher, coalesce_enabled
+from .server import ServeServer
+
+__all__ = [
+    "BatchTimeout",
+    "CoalescingBatcher",
+    "ServeServer",
+    "coalesce_enabled",
+]

@@ -1,0 +1,195 @@
+"""Threaded TCP/HTTP listener for the serving plane (`--listen`).
+
+One process, many clients: each accepted connection gets a handler
+thread that speaks either of two protocols, sniffed from the first
+bytes —
+
+* **JSONL** (the stdio protocol over a socket): newline-delimited JSON
+  requests, one JSON response line per request, identical envelopes to
+  `serve --stdio`. Requests tagged with an `"id"` are answered with
+  the id echoed (responses may interleave across a connection's
+  pipelined requests exactly as the multiplexed stdio session does).
+* **HTTP/1.1** (curl-able face): `POST /validate` with a JSON request
+  body returns the response envelope as `application/json`;
+  `GET /metrics` returns the live telemetry snapshot. Minimal by
+  design — one request per connection, no keep-alive.
+
+Every connection shares the session's `Serve` instance, so the
+prepared-rules cache, the process-global plan memo and the coalescing
+batcher amortize across clients — sixteen connections asking about one
+registry fill one packed dispatch (serve/batcher.py).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Optional
+
+from ..utils.io import Writer
+
+
+def _parse_hostport(listen: str) -> tuple:
+    """`HOST:PORT` (port 0 = OS-assigned); bare `PORT` binds localhost."""
+    if ":" in listen:
+        host, _, port = listen.rpartition(":")
+        return host or "127.0.0.1", int(port)
+    return "127.0.0.1", int(listen)
+
+
+class ServeServer:
+    """Accept loop + per-connection handler threads over one shared
+    `Serve` session. `start()` binds and returns (port available as
+    `.port` — bind with :0 in tests); `serve_forever()` blocks until
+    `stop()` or KeyboardInterrupt."""
+
+    def __init__(self, serve, listen: str):
+        self.serve = serve
+        self.host, self.port = _parse_hostport(listen)
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> "ServeServer":
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self.port))
+        s.listen(64)
+        self.port = s.getsockname()[1]
+        self._sock = s
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="guard-tpu-accept"
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def serve_forever(self) -> int:
+        if self._sock is None:
+            self.start()
+        try:
+            self._stopped.wait()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+        return 0
+
+    # -- connection handling ------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return  # socket closed by stop()
+            t = threading.Thread(
+                target=self._handle_conn, args=(conn,), daemon=True,
+                name="guard-tpu-conn",
+            )
+            t.start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        try:
+            f = conn.makefile("rwb")
+            first = f.peek(8)[:8] if hasattr(f, "peek") else b""
+            if first.split(b" ", 1)[0] in (b"POST", b"GET", b"PUT", b"HEAD"):
+                self._handle_http(f)
+            else:
+                self._handle_jsonl(f)
+        except (OSError, ValueError):
+            pass  # client went away mid-request
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_jsonl(self, f) -> None:
+        """The stdio protocol over a socket, ids multiplexed exactly
+        like the stdio session: untagged requests answer in order,
+        tagged ones may coalesce with peers from other connections."""
+        wlock = threading.Lock()
+        pending = []
+        for raw in f:
+            line = raw.decode("utf-8", "replace").strip()
+            if not line:
+                break
+            rid = self.serve.request_id(line)
+
+            def _answer(line=line, rid=rid):
+                resp = self.serve.handle_line(line)
+                if rid is not None:
+                    resp["id"] = rid
+                with wlock:
+                    f.write((json.dumps(resp) + "\n").encode())
+                    f.flush()
+
+            if rid is None:
+                _answer()
+            else:
+                t = threading.Thread(target=_answer, daemon=True)
+                t.start()
+                pending.append(t)
+        for t in pending:
+            t.join()
+
+    def _handle_http(self, f) -> None:
+        request_line = f.readline().decode("latin-1").strip()
+        parts = request_line.split(" ")
+        if len(parts) < 2:
+            return
+        method, path = parts[0], parts[1]
+        clen = 0
+        while True:
+            h = f.readline().decode("latin-1").strip()
+            if not h:
+                break
+            k, _, v = h.partition(":")
+            if k.strip().lower() == "content-length":
+                try:
+                    clen = int(v.strip())
+                except ValueError:
+                    clen = 0
+        if method == "GET" and path == "/metrics":
+            body = json.dumps(self.serve.handle_line('{"metrics": true}'))
+            self._http_reply(f, 200, body)
+            return
+        if method == "POST":
+            payload = f.read(clen).decode("utf-8", "replace") if clen else ""
+            resp = self.serve.handle_line(payload.strip() or "{}")
+            code = 200 if "error_class" not in resp else 422
+            self._http_reply(f, code, json.dumps(resp))
+            return
+        self._http_reply(f, 404, json.dumps({"error": "not found"}))
+
+    @staticmethod
+    def _http_reply(f, status: int, body: str) -> None:
+        reason = {200: "OK", 404: "Not Found", 422: "Unprocessable Entity"}
+        data = body.encode()
+        head = (
+            f"HTTP/1.1 {status} {reason.get(status, 'OK')}\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(data)}\r\n"
+            f"Connection: close\r\n\r\n"
+        )
+        f.write(head.encode("latin-1") + data)
+        f.flush()
+
+
+def run_listener(serve, listen: str, writer: Writer) -> int:
+    """CLI entry: bind, announce the bound address on stderr (port 0
+    resolves here), then serve until interrupted."""
+    server = ServeServer(serve, listen).start()
+    writer.writeln_err(
+        f"guard-tpu serve: listening on {server.host}:{server.port}"
+    )
+    return server.serve_forever()

@@ -40,10 +40,11 @@ from ..core.errors import GuardError
 from .telemetry import REGISTRY as _TELEMETRY
 from .telemetry import EventedCounters
 
-#: named injection points, in pipeline order
+#: named injection points, in pipeline order (serve_batch fires in the
+#: serving plane's coalescing batcher, before a grouped dispatch)
 POINTS = (
     "read", "parse", "encode", "worker_crash",
-    "dispatch", "collect", "oracle",
+    "dispatch", "collect", "oracle", "serve_batch",
 )
 
 #: observability beside DISPATCH_COUNTERS / PIPELINE_COUNTERS /

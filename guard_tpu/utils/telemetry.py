@@ -290,6 +290,28 @@ class MetricsRegistry:
 #: the process-global registry every subsystem registers with
 REGISTRY = MetricsRegistry()
 
+#: serving-plane observability (commands/serve.py + serve/batcher.py):
+#: request admission, cross-request coalescing, and the failure-plane
+#: actions the batcher takes (solo refires after an injected or real
+#: batch fault). Lives here — not in the serve package — so the group
+#: registers exactly once however the serving plane is entered (stdio
+#: session, TCP listener, or the bench harness driving Serve directly).
+#: Gauges set beside it: serve_queue_depth, serve_batch_fill,
+#: serve_rules_cache_size, serve_abandoned_threads; histograms:
+#: serve_request_seconds, serve_queue_wait_seconds (both persistent).
+SERVE_COUNTERS = REGISTRY.counter_group("serve", EventedCounters("serve", {
+    "requests": 0,
+    "coalesce_eligible": 0,
+    "coalesce_bypass": 0,
+    "coalesced_batches": 0,
+    "coalesced_requests": 0,
+    "singleton_batches": 0,
+    "solo_fallbacks": 0,
+    "isolation_refires": 0,
+    "request_timeouts": 0,
+    "abandoned_threads": 0,
+}))
+
 
 # ---------------------------------------------------------------- spans
 
@@ -580,11 +602,15 @@ def flightrec_dump(reason: str, path: Optional[str] = None) -> Optional[str]:
     Chrome-trace-compatible `traceEvents` plus a full metrics snapshot.
     Returns the written path, or None when the recorder is disabled.
     Destination: `path`, else flightrec-<pid>-<n>.json under
-    GUARD_TPU_FLIGHTREC_DIR (default: the working directory)."""
+    GUARD_TPU_FLIGHTREC_DIR (default: ~/.cache/guard_tpu/flightrec —
+    NOT the working directory, so abnormal-exit dumps never litter
+    whatever repo the CLI happened to run from)."""
     if not _FR_ON:
         return None
     if path is None:
-        d = os.environ.get("GUARD_TPU_FLIGHTREC_DIR") or "."
+        d = os.environ.get("GUARD_TPU_FLIGHTREC_DIR") or os.path.join(
+            os.path.expanduser("~"), ".cache", "guard_tpu", "flightrec"
+        )
         os.makedirs(d, exist_ok=True)
         path = os.path.join(
             d, f"flightrec-{os.getpid()}-{next(_FR_DUMP_SEQ)}.json"

@@ -1,0 +1,347 @@
+"""Concurrent-parity suite for the serving plane (guard_tpu/serve/).
+
+The coalescing batcher's one correctness contract: N threads replaying
+a request mix against a concurrent session must produce BYTE-IDENTICAL
+per-request responses (code, output, error) to N sequential
+`serve --stdio` runs of the same mix — across packed/per-file dispatch
+and ingest-worker settings, including a poisoned request per batch
+(which must drop to the solo path without failing its batch peers).
+On top of parity: 16 concurrent same-rules requests must produce
+several-fold fewer device dispatches than sequential serve, the stdio
+session must multiplex `"id"`-tagged requests, and the TCP/HTTP
+listener must answer the same envelopes over sockets.
+"""
+
+import json
+import socket
+import threading
+
+import pytest
+
+from guard_tpu.commands.serve import Serve
+from guard_tpu.utils import telemetry
+from guard_tpu.utils.io import Reader, Writer
+
+RULES = [
+    "rule has_a { a exists }\nrule b_is_one { b == 1 }",
+    "rule c_small { c < 10 }",
+]
+
+
+def _req(i, poisoned=False, rules=None, **extra):
+    data = [
+        json.dumps({"a": i, "b": 1, "c": i % 7}),
+        json.dumps({"a": i + 1, "b": 1, "c": 3}),
+    ]
+    if poisoned:
+        data[0] = '{"a": '  # truncated JSON: load_document raises
+    body = {
+        "rules": RULES if rules is None else rules,
+        "data": data,
+        "backend": "tpu",
+        **extra,
+    }
+    return json.dumps(body)
+
+
+def _envelope(resp):
+    return (resp["code"], resp.get("output"), resp.get("error"),
+            resp.get("error_class"))
+
+
+def _sequential(monkeypatch, lines):
+    """The baseline: one request at a time, coalescing off — exactly
+    the original single-client session."""
+    monkeypatch.setenv("GUARD_TPU_COALESCE", "0")
+    srv = Serve(stdio=True)
+    out = [_envelope(srv.handle_line(ln)) for ln in lines]
+    monkeypatch.setenv("GUARD_TPU_COALESCE", "1")
+    return out
+
+
+def _concurrent(lines, wait_ms="150"):
+    """N threads against one coalescing session."""
+    srv = Serve(stdio=True, coalesce=True)
+    results = [None] * len(lines)
+    barrier = threading.Barrier(len(lines))
+
+    def worker(i):
+        barrier.wait()
+        results[i] = _envelope(srv.handle_line(lines[i]))
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(len(lines))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+@pytest.mark.parametrize("pack", ["0", "1"])
+@pytest.mark.parametrize("workers", ["0", "2"])
+def test_concurrent_parity_with_poisoned_peer(monkeypatch, pack, workers):
+    """Byte parity across dispatch modes, with one poisoned request in
+    the mix: its error envelope reproduces exactly and its batch peers
+    still answer correctly."""
+    monkeypatch.setenv("GUARD_TPU_PACK", pack)
+    monkeypatch.setenv("GUARD_TPU_INGEST_WORKERS", workers)
+    monkeypatch.setenv("GUARD_TPU_COALESCE_WAIT_MS", "150")
+    lines = [_req(i, poisoned=(i == 3)) for i in range(8)]
+    seq = _sequential(monkeypatch, lines)
+    con = _concurrent(lines)
+    assert con == seq
+    assert seq[3][0] == 5  # the poisoned request errored in BOTH runs
+    assert seq[3][3] == "ParseError"
+    ok = [i for i in range(8) if i != 3]
+    assert all(seq[i][0] == 0 for i in ok)
+
+
+@pytest.mark.parametrize("out_fmt", ["sarif", "json"])
+def test_concurrent_parity_output_formats(monkeypatch, out_fmt):
+    monkeypatch.setenv("GUARD_TPU_COALESCE_WAIT_MS", "150")
+    lines = [_req(i, output_format=out_fmt) for i in range(6)]
+    assert _concurrent(lines) == _sequential(monkeypatch, lines)
+
+
+def test_concurrent_mixed_digests_group_separately(monkeypatch):
+    """Two distinct rule registries in flight: each coalesces with its
+    own digest group, responses stay per-request correct."""
+    monkeypatch.setenv("GUARD_TPU_COALESCE_WAIT_MS", "150")
+    alt = ["rule alt { z exists }"]
+    lines = [
+        _req(i, rules=(alt if i % 2 else None)) for i in range(8)
+    ]
+    assert _concurrent(lines) == _sequential(monkeypatch, lines)
+
+
+def test_coalescing_reduces_dispatches(monkeypatch):
+    """The acceptance gate: 16 concurrent requests against one rule
+    digest must coalesce into >= 4x fewer device dispatches than the
+    sequential baseline, with byte-identical responses, visible in the
+    serve counters."""
+    from guard_tpu.parallel.mesh import DISPATCH_COUNTERS
+    from guard_tpu.utils.telemetry import SERVE_COUNTERS
+
+    monkeypatch.setenv("GUARD_TPU_COALESCE_WAIT_MS", "300")
+    lines = [_req(i) for i in range(16)]
+
+    telemetry.REGISTRY.reset()
+    seq = _sequential(monkeypatch, lines)
+    seq_dispatches = DISPATCH_COUNTERS["dispatches"]
+
+    telemetry.REGISTRY.reset()
+    con = _concurrent(lines)
+    con_dispatches = DISPATCH_COUNTERS["dispatches"]
+
+    assert con == seq
+    assert seq_dispatches >= 16
+    assert con_dispatches * 4 <= seq_dispatches
+    assert SERVE_COUNTERS["coalesced_batches"] >= 1
+    assert SERVE_COUNTERS["coalesced_requests"] >= 2
+
+
+def test_injected_serve_batch_fault_refires_solo(monkeypatch):
+    """The failure plane's serving leg: an injected serve_batch fault
+    quarantines the BATCH — every member re-fires through the solo
+    path and still answers byte-identically to sequential."""
+    from guard_tpu.utils import faults
+    from guard_tpu.utils.telemetry import SERVE_COUNTERS
+
+    monkeypatch.setenv("GUARD_TPU_COALESCE_WAIT_MS", "150")
+    lines = [_req(i) for i in range(4)]
+    seq = _sequential(monkeypatch, lines)
+
+    faults.reset_faults()
+    monkeypatch.setenv("GUARD_TPU_FAULT", "serve_batch:nth=1")
+    telemetry.REGISTRY.reset()
+    try:
+        con = _concurrent(lines)
+    finally:
+        monkeypatch.delenv("GUARD_TPU_FAULT")
+        refires = SERVE_COUNTERS["isolation_refires"]
+        injected = faults.FAULT_COUNTERS["injected_serve_batch"]
+        faults.reset_faults()
+    assert con == seq
+    assert injected == 1
+    assert refires >= 1
+
+
+def test_stdio_session_multiplexes_tagged_requests(monkeypatch):
+    """`"id"`-tagged requests over one stdio session: every response
+    carries its request's id and matches the sequential envelope."""
+    monkeypatch.setenv("GUARD_TPU_COALESCE_WAIT_MS", "100")
+    lines = [_req(i, id=f"r{i}") for i in range(6)]
+    seq = _sequential(monkeypatch, [_req(i) for i in range(6)])
+
+    w = Writer.buffered()
+    rc = Serve(stdio=True).execute(
+        w, Reader.from_string("\n".join(lines) + "\n")
+    )
+    assert rc == 0
+    resps = {r["id"]: r for r in
+             (json.loads(l) for l in w.out.getvalue().splitlines() if l)}
+    assert set(resps) == {f"r{i}" for i in range(6)}
+    for i in range(6):
+        assert _envelope(resps[f"r{i}"]) == seq[i]
+
+
+def test_untagged_stdio_session_stays_in_order(monkeypatch):
+    """Untagged requests keep the original strictly-ordered protocol."""
+    lines = [_req(i) for i in range(3)]
+    seq = _sequential(monkeypatch, lines)
+    w = Writer.buffered()
+    rc = Serve(stdio=True).execute(
+        w, Reader.from_string("\n".join(lines) + "\n")
+    )
+    assert rc == 0
+    got = [json.loads(l) for l in w.out.getvalue().splitlines() if l]
+    assert [_envelope(r) for r in got] == seq
+    assert all("id" not in r for r in got)
+
+
+def _recv_lines(sock_file, n):
+    return [json.loads(sock_file.readline()) for _ in range(n)]
+
+
+def test_tcp_listener_serves_jsonl_clients(monkeypatch):
+    """Two TCP clients against one listener: same envelopes as a
+    sequential stdio session, ids echoed."""
+    from guard_tpu.serve.server import ServeServer
+
+    monkeypatch.setenv("GUARD_TPU_COALESCE_WAIT_MS", "100")
+    lines = [_req(i) for i in range(4)]
+    seq = _sequential(monkeypatch, lines)
+
+    srv = Serve(stdio=False, coalesce=True)
+    server = ServeServer(srv, "127.0.0.1:0").start()
+    try:
+        results = {}
+
+        def client(idx):
+            with socket.create_connection(
+                ("127.0.0.1", server.port), timeout=30
+            ) as s:
+                f = s.makefile("rwb")
+                for i in range(idx, 4, 2):
+                    tagged = json.loads(lines[i])
+                    tagged["id"] = i
+                    f.write((json.dumps(tagged) + "\n").encode())
+                f.flush()
+                s.shutdown(socket.SHUT_WR)
+                for r in (json.loads(l) for l in f if l.strip()):
+                    results[r["id"]] = r
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        server.stop()
+    assert set(results) == {0, 1, 2, 3}
+    for i in range(4):
+        assert _envelope(results[i]) == seq[i]
+
+
+def test_http_listener_answers_post_and_metrics(monkeypatch):
+    """The curl-able face: POST /validate returns the response
+    envelope, GET /metrics the live snapshot."""
+    import http.client
+
+    from guard_tpu.serve.server import ServeServer
+
+    srv = Serve(stdio=False)
+    server = ServeServer(srv, "127.0.0.1:0").start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=30)
+        conn.request("POST", "/validate", body=_req(1),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 200
+        assert body["code"] == 0
+        conn.close()
+
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=30)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        snap = json.loads(resp.read())
+        assert resp.status == 200
+        assert snap["metrics"]["schema_version"] == telemetry.SCHEMA_VERSION
+        conn.close()
+    finally:
+        server.stop()
+
+
+def test_metrics_survive_concurrency_without_reset(monkeypatch):
+    """Satellite: no per-request global reset — cumulative counters
+    grow monotonically across concurrent requests and the metrics
+    envelope carries a last_request diff."""
+    monkeypatch.setenv("GUARD_TPU_COALESCE_WAIT_MS", "100")
+    telemetry.REGISTRY.reset(include_persistent=True)
+    srv = Serve(stdio=True, coalesce=True)
+    lines = [_req(i) for i in range(6)]
+    threads = [
+        threading.Thread(target=srv.handle_line, args=(lines[i],))
+        for i in range(6)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    m = srv.handle_line(json.dumps({"metrics": True}))
+    snap = m["metrics"]
+    assert snap["counters"]["serve"]["requests"] == 6
+    assert snap["histograms"]["serve_request_seconds"]["count"] == 6
+    assert isinstance(m["last_request"], dict)
+    telemetry.REGISTRY.reset(include_persistent=True)
+
+
+def test_abandoned_thread_cap(monkeypatch):
+    """Satellite: past GUARD_TPU_SERVE_ABANDONED_MAX the session stops
+    abandoning executors (no unbounded thread leak), keeps answering
+    RequestTimeout, and the abandoned count rides the gauge."""
+    import time
+
+    from guard_tpu.commands import validate as validate_mod
+    from guard_tpu.utils.telemetry import SERVE_COUNTERS
+
+    def slow_execute(self, writer, reader):
+        time.sleep(0.6)
+        return 0
+
+    monkeypatch.setattr(validate_mod.Validate, "execute", slow_execute)
+    monkeypatch.setenv("GUARD_TPU_SERVE_TIMEOUT", "0.05")
+    monkeypatch.setenv("GUARD_TPU_SERVE_ABANDONED_MAX", "1")
+    telemetry.REGISTRY.reset()
+    srv = Serve(stdio=True, coalesce=False)
+    r1 = srv.handle_line(_req(0))
+    assert r1["error_class"] == "RequestTimeout"
+    assert srv._abandoned == 1
+    r2 = srv.handle_line(_req(1))
+    assert r2["error_class"] == "RequestTimeout"
+    assert srv._abandoned == 1  # cap held: no second abandonment
+    assert SERVE_COUNTERS["abandoned_threads"] == 1
+    assert srv._abandoned_warned
+
+
+def test_rules_cache_stays_bounded_with_gauge(monkeypatch):
+    """Satellite: the prepared-rules cache evicts LRU past its ceiling
+    and exports its size as a gauge."""
+    from guard_tpu.commands.serve import _RULES_CACHE_MAX
+
+    srv = Serve(stdio=True, coalesce=False)
+    for i in range(_RULES_CACHE_MAX + 4):
+        srv.handle_line(json.dumps({
+            "rules": [f"rule r{i} {{ a exists }}"],
+            "data": ['{"a": 1}'],
+        }))
+    assert len(srv._rules_cache) == _RULES_CACHE_MAX
+    snap = telemetry.metrics_snapshot()
+    assert snap["gauges"]["serve_rules_cache_size"] == _RULES_CACHE_MAX

@@ -58,6 +58,13 @@ METRIC_SINCE = {
     "config5b_plan_restart_templates_per_sec": 11,
 }
 
+# PR 9 serving plane: the latency grid arrived with round 13
+METRIC_SINCE.update({
+    f"serve_c{c}_coalesce_{leg}_p50_ms": 13
+    for c in (1, 4, 16)
+    for leg in ("off", "on")
+})
+
 
 def metric_since(metric: str) -> int:
     """The bench round whose driver first emitted `metric`."""
@@ -131,6 +138,18 @@ METRIC_REQUIRED_KEYS = {
     "config5b_plan_warm_templates_per_sec": PLAN_REQUIRED_KEYS,
     "config5b_plan_restart_templates_per_sec": PLAN_REQUIRED_KEYS,
 }
+
+# PR 9 serving plane: every latency row must carry the tail percentile
+# and the dispatch amortization alongside the p50, so "what did
+# coalescing buy at this concurrency" is answerable from the committed
+# artifact alone
+METRIC_REQUIRED_KEYS.update({
+    f"serve_c{c}_coalesce_{leg}_p50_ms": (
+        "p99_ms", "dispatches_per_request", "concurrency",
+    )
+    for c in (1, 4, 16)
+    for leg in ("off", "on")
+})
 
 # PR 3 ingest decomposition: every *_ingest_workers* row must say how
 # the host plane's time split (file read vs parse/encode vs consumer
