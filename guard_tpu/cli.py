@@ -9,6 +9,7 @@ same flags and exit-code protocol (validate 0/19/5, test 0/7/1).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 from typing import List, Optional
@@ -123,6 +124,15 @@ def build_parser() -> argparse.ArgumentParser:
         "canonical plan; bit-parity escape hatch — also "
         "GUARD_TPU_PLAN_CACHE=0)",
     )
+    v.add_argument(
+        "--mesh-shape",
+        default=None,
+        metavar="RxC",
+        help="tpu backend: 2-D (doc shards x pack columns) device mesh "
+        "shape, e.g. 2x1 or 2x4; 'auto' (the default when >1 device is "
+        "visible) picks 2x1, 'off' is the single-device escape hatch "
+        "(overrides GUARD_TPU_MESH)",
+    )
     _add_telemetry_flags(v)
 
     t = sub.add_parser("test", help="Test rules against expectations")
@@ -204,6 +214,15 @@ def build_parser() -> argparse.ArgumentParser:
         "(re-lower the rule registry per chunk instead of relocating "
         "into the canonical plan; bit-parity escape hatch — also "
         "GUARD_TPU_PLAN_CACHE=0)",
+    )
+    s.add_argument(
+        "--mesh-shape",
+        default=None,
+        metavar="RxC",
+        help="tpu backend: 2-D (doc shards x pack columns) device mesh "
+        "shape, e.g. 2x1 or 2x4; 'auto' (the default when >1 device is "
+        "visible) picks 2x1, 'off' is the single-device escape hatch "
+        "(overrides GUARD_TPU_MESH)",
     )
     _add_telemetry_flags(s)
 
@@ -367,6 +386,11 @@ def _session_epilogue(args, rc: Optional[int], dt: float) -> None:
 
 
 def _dispatch(args, writer: Writer, reader: Reader) -> int:
+    # --mesh-shape wins over the GUARD_TPU_MESH environment: the mesh
+    # plane resolves its shape from the env at dispatch time
+    # (parallel/mesh2d.resolve_mesh_shape), so the flag just seeds it
+    if getattr(args, "mesh_shape", None) is not None:
+        os.environ["GUARD_TPU_MESH"] = args.mesh_shape
     try:
         if args.command == "validate":
             cmd = Validate(

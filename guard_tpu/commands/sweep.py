@@ -925,6 +925,10 @@ class Sweep:
                             if plan is not None
                             else None
                         ),
+                        # tally-path rim profile: on the 2-D mesh only
+                        # any_unsure + name_last (+ names) leave the
+                        # mesh — all _tally_vectorized consumes
+                        profile="sweep",
                     )
             except Exception as e:
                 # a packed-plane failure is never fatal: the per-file
@@ -1009,7 +1013,11 @@ class Sweep:
                         )
             names: list = []
             name_last = None
-            if statuses is not None and vec_on:
+            # device coverage: the full status matrix (legacy / per-
+            # file) or the mesh rim-only collect (statuses stayed on
+            # device; the shipped blocks carry everything read below)
+            has_device = statuses is not None or rim is not None
+            if has_device and vec_on:
                 if rim is not None:
                     name_last, names = rim[5], rim[6]
                 else:
@@ -1053,9 +1061,17 @@ class Sweep:
                         writer,
                         err_box,
                     )
+            unsure_any = None
             if unsure is not None:
+                unsure_any = unsure.any(axis=1)
+            elif rim is not None and rim[4] is not None:
+                # mesh rim-only collect: block 4 IS the per-file
+                # any-unsure reduction the device ran (bit-identical
+                # to unsure.any(axis=1) over this file's columns)
+                unsure_any = np.asarray(rim[4]).astype(bool)
+            if unsure_any is not None:
                 oracle_docs = {
-                    int(di) for di in np.nonzero(unsure.any(axis=1))[0]
+                    int(di) for di in np.nonzero(unsure_any)[0]
                 }
                 if oracle_docs:
                     errors += self._eval_oracle(
@@ -1064,7 +1080,7 @@ class Sweep:
                     )
             if vec_on:
                 recs.append(
-                    (names, name_last, statuses is not None,
+                    (names, name_last, has_device,
                      set(host_docs), target)
                 )
         if vec_box is not None:

@@ -210,41 +210,70 @@ class PackPending:
     """In-flight state between `dispatch_packs` and `collect_packs` —
     the decoupling the three-stage sweep pipeline needs: chunk k's
     packs stay dispatched (device executing) while the host emits
-    chunk k-1's reports and the ingest workers encode chunk k+1."""
+    chunk k-1's reports and the ingest workers encode chunk k+1.
 
-    __slots__ = ("pending", "host_docs", "with_rim")
+    `rim_blocks` (mesh2d.RIM_PROFILES) records which rim blocks this
+    dispatch shipped: None means the full legacy protocol (statuses +
+    all six blocks); a tuple means the mesh rim-only collect — the
+    padded status matrix never crossed, so collect_packs returns
+    statuses/unsure as None and only the shipped blocks in each
+    file's rim."""
 
-    def __init__(self, pending, host_docs, with_rim):
+    __slots__ = ("pending", "host_docs", "with_rim", "rim_blocks")
+
+    def __init__(self, pending, host_docs, with_rim, rim_blocks=None):
         self.pending = pending
         self.host_docs = host_docs
         self.with_rim = with_rim
+        self.rim_blocks = rim_blocks
 
 
-def dispatch_packs(items, batch, with_rim=None, prepacked=None) -> PackPending:
+def dispatch_packs(items, batch, with_rim=None, prepacked=None,
+                   profile=None) -> PackPending:
     """Dispatch half of the fused multi-rule-file pipeline: pack the
     compatible compiled files (plan_packs) and dispatch EVERY (pack,
-    bucket group) WITHOUT collecting — JAX dispatch is async, so the
-    returned PackPending represents genuinely in-flight device work.
+    doc shard, bucket group) WITHOUT collecting — JAX dispatch is
+    async, so the returned PackPending represents genuinely in-flight
+    device work.
 
     `prepacked` (the plan layer, ops/plan.py): an already-computed
     [(pack, PackedRules, RimSpec)] list — the pack plan is part of the
     canonical artifact, so warm chunks skip plan_packs/_pack_cached
-    entirely."""
+    entirely.
+
+    `profile` ("validate" | "sweep", mesh2d.RIM_PROFILES) activates
+    the 2-D mesh rim-only collect when the mesh is on and the rim
+    rides the dispatch: the consumer's named rim blocks are the ONLY
+    payload that leaves the mesh per collect."""
     if with_rim is None:
         with_rim = vector_rim_enabled()
     if (not prepacked) if prepacked is not None else (len(items) < 2):
         return PackPending([], set(), with_rim)
     with _span("dispatch", {"files": len(items)}):
-        return _dispatch_packs_inner(items, batch, with_rim, prepacked)
+        return _dispatch_packs_inner(items, batch, with_rim, prepacked,
+                                     profile)
 
 
-def _dispatch_packs_inner(items, batch, with_rim, prepacked=None) -> PackPending:
+def _dispatch_packs_inner(items, batch, with_rim, prepacked=None,
+                          profile=None) -> PackPending:
     from .encoder import NODE_BUCKETS_EXTENDED, split_batch_by_size
     from .ir import PackIncompatible
+    from ..parallel import mesh2d
     from ..parallel.mesh import EFFICIENCY_COUNTERS, ShardedBatchEvaluator
 
-    groups, oversize = split_batch_by_size(batch, NODE_BUCKETS_EXTENDED)
-    host_docs = {int(i) for i in oversize}
+    # the 2-D (docs x packs) mesh is the default whenever >1 device is
+    # visible (GUARD_TPU_MESH=off is the single-device escape hatch):
+    # contiguous doc shards dispatch independently, and with a rim
+    # consumer profile only that profile's rim blocks leave the mesh
+    shape = mesh2d.resolve_mesh_shape()
+    rim_blocks = (
+        mesh2d.RIM_PROFILES.get(profile)
+        if (with_rim and shape is not None) else None
+    )
+    if shape is not None:
+        bounds = mesh2d.doc_shard_bounds(batch.n_docs, shape[0])
+    else:
+        bounds = [(0, batch.n_docs)]
     pending = []
     if prepacked is not None:
         planned = prepacked
@@ -260,7 +289,20 @@ def _dispatch_packs_inner(items, batch, with_rim, prepacked=None) -> PackPending
                          len(pack), e)
                 continue
             planned.append((pack, packed, spec))
-    for pack, packed, spec in planned:
+    if not planned:
+        return PackPending([], set(), with_rim, rim_blocks)
+    columns = (
+        mesh2d.assign_columns(
+            [len(p.compiled.rules) for _pk, p, _sp in planned], shape[1]
+        )
+        if shape is not None else None
+    )
+    # every pack's evaluator is built BEFORE the shard loop so that
+    # loop is pure dispatch: shards OUTER, packs INNER, consuming the
+    # bounded shard prefetcher — shard s+1's host prep (take_docs +
+    # bucket columnarization, on the prefetch thread) overlaps shard
+    # s's in-flight device programs
+    for pi, (pack, packed, spec) in enumerate(planned):
         # pack-slot occupancy: rule slots this pack fills against the
         # PACK_MAX_RULES ceiling packs close at (one executable traces
         # every packed rule, so unused slots are pure headroom, not
@@ -269,32 +311,67 @@ def _dispatch_packs_inner(items, batch, with_rim, prepacked=None) -> PackPending
             packed.compiled.rules
         )
         EFFICIENCY_COUNTERS["pack_rule_slots_capacity"] += PACK_MAX_RULES
-        ev = ShardedBatchEvaluator(
-            packed.compiled, rim_spec=spec if with_rim else None
-        )
-        # a failed bucket dispatch keeps its sub-batch (handle None) so
-        # collect_packs can walk the degradation ladder: per-file
-        # dispatch for just that bucket, then the host oracle
-        handles = []
-        for sub, idx in groups:
-            try:
-                maybe_fail("dispatch")
-                handles.append((idx, sub, ev.dispatch(sub)))
-            except Exception as e:
-                log.warning(
-                    "packed dispatch failed for a %d-doc bucket (%s); "
-                    "will retry per-file at collect", len(idx), e,
+        if shape is not None:
+            ev = mesh2d.MeshSweepEvaluator(
+                packed.compiled,
+                rim_spec=spec if with_rim else None,
+                shape=shape, column=columns[pi],
+                rim_blocks=rim_blocks,
+                ship_statuses=rim_blocks is None,
+            )
+        else:
+            ev = ShardedBatchEvaluator(
+                packed.compiled, rim_spec=spec if with_rim else None
+            )
+        pending.append((pack, packed, spec, ev, []))
+    host_docs = set()
+    if len(bounds) > 1:
+        from ..parallel.ingest import ShardPrefetcher
+
+        shard_iter = iter(ShardPrefetcher(
+            batch, bounds, NODE_BUCKETS_EXTENDED
+        ))
+    else:
+        def _inline_shards():
+            for s, (lo, hi) in enumerate(bounds):
+                sub_batch = mesh2d.take_docs(batch, lo, hi)
+                groups, oversize = split_batch_by_size(
+                    sub_batch, NODE_BUCKETS_EXTENDED
                 )
-                FAULT_COUNTERS["dispatch_fallbacks"] += 1
-                handles.append((idx, sub, None))
-        pending.append((pack, packed, spec, ev, handles))
+                yield s, lo, groups, oversize
+
+        shard_iter = _inline_shards()
+    # a failed bucket dispatch keeps its sub-batch (handle None) so
+    # collect_packs can walk the degradation ladder: per-file dispatch
+    # for just that (doc shard, bucket), then the host oracle — scoped
+    # to THAT shard's docs, other shards stand
+    for s, lo, groups, oversize in shard_iter:
+        host_docs.update(int(i) + lo for i in oversize)
+        for pack, packed, spec, ev, handles in pending:
+            for sub, idx in groups:
+                gidx = idx + lo
+                try:
+                    maybe_fail("dispatch")
+                    handle = (
+                        ev.dispatch(sub, shard=s) if shape is not None
+                        else ev.dispatch(sub)
+                    )
+                    handles.append((gidx, sub, handle))
+                except Exception as e:
+                    log.warning(
+                        "packed dispatch failed for a %d-doc bucket "
+                        "of shard %d (%s); will retry per-file at "
+                        "collect", len(idx), s, e,
+                    )
+                    FAULT_COUNTERS["dispatch_fallbacks"] += 1
+                    handles.append((gidx, sub, None))
     used = EFFICIENCY_COUNTERS["pack_rule_slots_used"]
     cap = EFFICIENCY_COUNTERS["pack_rule_slots_capacity"]
     if cap:
         _TELEMETRY.set_gauge(
             "efficiency.pack_slot_utilization", used / cap
         )
-    return PackPending(pending, host_docs, with_rim)
+    return PackPending(pending, host_docs, with_rim, rim_blocks)
 
 
 def collect_packs(pp: PackPending, batch) -> dict:
@@ -325,6 +402,11 @@ def _collect_packs_inner(pp: PackPending, batch) -> dict:
     results: dict = {}
     with_rim = pp.with_rim
     host_docs = pp.host_docs
+    # mesh rim-only protocol: pp.rim_blocks names the blocks that
+    # actually shipped — the (D, R) scratch below only receives data
+    # on degradation rungs (full per-file recovery), so per-file
+    # statuses/unsure return as None and consumers read the rim
+    rim_only = pp.rim_blocks is not None
     for pack, packed, spec, ev, handles in pp.pending:
         n_rules = len(packed.compiled.rules)
         statuses = np.full((batch.n_docs, n_rules), SKIP, np.int8)
@@ -354,12 +436,17 @@ def _collect_packs_inner(pp: PackPending, batch) -> dict:
                     FAULT_COUNTERS["dispatch_fallbacks"] += 1
                     handle = None
                 else:
-                    statuses[idx] = collected[0]
+                    if collected[0] is not None:
+                        statuses[idx] = collected[0]
                     if collected[1] is not None:
                         unsure[idx] = collected[1]
                     if with_rim:
                         for b, block in enumerate(collected[2]):
-                            rim[b][idx] = block
+                            # None = a block the rim profile did not
+                            # ship; its scratch rows stay SKIP-filled
+                            # and are never exposed below
+                            if block is not None:
+                                rim[b][idx] = block
                     continue
             # degradation rung 2: per-file dispatch for just this
             # bucket; a file that still fails lands on the host oracle
@@ -406,20 +493,29 @@ def _collect_packs_inner(pp: PackPending, batch) -> dict:
             rim_f = None
             if with_rim:
                 gsl = spec.file_slice(k)
-                rim_f = (
+                blocks_f = (
                     rim[0][:, gsl], rim[1][:, gsl], rim[2][:, k],
                     rim[3][:, k], rim[4][:, k], rim[5][:, gsl],
-                    spec.file_group_names[k],
                 )
+                if rim_only:
+                    # expose ONLY the shipped blocks: degradation rungs
+                    # recover every block for their rows, but the other
+                    # rows of an unshipped block are SKIP scratch
+                    blocks_f = tuple(
+                        b if i in pp.rim_blocks else None
+                        for i, b in enumerate(blocks_f)
+                    )
+                rim_f = blocks_f + (spec.file_group_names[k],)
             results[fi] = (
-                statuses[:, seg], unsure[:, seg],
+                None if rim_only else statuses[:, seg],
+                None if rim_only else unsure[:, seg],
                 set(host_docs) | host_extra.get(fi, set()), rim_f,
             )
     return results
 
 
 def _evaluate_packs(items, batch, after_dispatch=None, with_rim=None,
-                    prepacked=None) -> dict:
+                    prepacked=None, profile=None) -> dict:
     """dispatch_packs + collect_packs fused: every (pack, bucket group)
     dispatches before anything collects, so host columnarization of the
     next bucket/pack overlaps device execution of the previous one.
@@ -427,7 +523,8 @@ def _evaluate_packs(items, batch, after_dispatch=None, with_rim=None,
     sweep.py's serial path encodes doc chunk k+1 in it while the device
     executes chunk k) runs once everything is in flight, before the
     first collect."""
-    pp = dispatch_packs(items, batch, with_rim, prepacked=prepacked)
+    pp = dispatch_packs(items, batch, with_rim, prepacked=prepacked,
+                        profile=profile)
     if after_dispatch is not None:
         after_dispatch()
     return collect_packs(pp, batch)
@@ -824,6 +921,9 @@ def _eval_packed(validate, prep, batch, plan):
             batch,
             with_rim=rim_on,
             prepacked=plan.prepacked_items() if plan is not None else None,
+            # report-path rim profile: on the 2-D mesh only the blocks
+            # _report_files' pass A reads (0-4 + names) leave the mesh
+            profile="validate",
         )
     return packed_results, rim_on
 
@@ -985,7 +1085,11 @@ def _report_files(validate, file_iter, data_files, quarantined, writer,
             for di in np.nonzero(materialize_v)[0]:
                 di = int(di)
                 data_file = data_files[di]
-                if statuses is not None and not host_mask[di]:
+                # device coverage for this doc: either the full status
+                # matrix crossed (legacy) or the rim-only mesh collect
+                # shipped the reduced blocks the row builds from
+                if (statuses is not None or rim is not None) \
+                        and not host_mask[di]:
                     rule_statuses, unsure_rules = _materialize_row(
                         name_st[di], None if name_un is None else name_un[di],
                         names,
@@ -1404,7 +1508,9 @@ def _segment_iter(file_results, start, end):
         seg_hosts = {hd - start for hd in host_docs if start <= hd < end}
         seg_rim = None
         if rim is not None:
-            seg_rim = tuple(b[start:end] for b in rim[:6]) + (rim[6],)
+            seg_rim = tuple(
+                None if b is None else b[start:end] for b in rim[:6]
+            ) + (rim[6],)
         yield fi, rule_file, compiled, seg_st, seg_un, seg_hosts, seg_rim
 
 

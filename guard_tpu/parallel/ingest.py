@@ -382,3 +382,71 @@ def parallel_encode_documents(names: List[str], contents: List[str],
         remap_interned_ids(batch, remap)
         parts.append(batch)
     return concat_batches(parts), merged
+
+
+class ShardPrefetcher:
+    """Bounded host-side prefetch of per-doc-shard dispatch inputs for
+    the 2-D mesh (`parallel/mesh2d.py`).
+
+    The mesh dispatch loop consumes one `(shard, lo, bucket_groups,
+    oversize)` tuple per contiguous doc shard. Producing that tuple is
+    pure host work — `mesh2d.take_docs` slicing plus the
+    `split_batch_by_size` bucket columnarization — and JAX dispatch is
+    asynchronous, so a producer thread can prepare shard s+1 while
+    shard s's device programs are still in flight. The queue is bounded
+    at `pipeline_depth()` (the PR 3 backpressure discipline: at most
+    depth shards' sliced columns exist ahead of dispatch), and the
+    `pipeline.shards_prefetched` / `shard_prefetch_stall_seconds`
+    counters report how much overlap the thread actually bought.
+
+    Single-shard batches (the MIN_DOCS floor) and the mesh-off path
+    never construct this class — callers prepare inline, keeping the
+    legacy path thread-free. Unlike chunk encode (the spawn pool),
+    shard prep is thread-based: it is numpy slicing over an
+    already-encoded batch, where process transport would cost more
+    than the slice itself.
+    """
+
+    def __init__(self, batch, bounds, buckets, depth: Optional[int] = None):
+        import queue
+        import threading
+
+        self._q: "queue.Queue" = queue.Queue(
+            maxsize=depth if depth else pipeline_depth()
+        )
+        self._thread = threading.Thread(
+            target=self._produce, args=(batch, list(bounds), buckets),
+            daemon=True, name="guard-tpu-shard-prefetch",
+        )
+        self._thread.start()
+
+    def _produce(self, batch, bounds, buckets) -> None:
+        from ..ops.encoder import split_batch_by_size
+        from . import mesh2d
+        from .mesh import PIPELINE_COUNTERS
+
+        try:
+            for s, (lo, hi) in enumerate(bounds):
+                sub = mesh2d.take_docs(batch, lo, hi)
+                groups, oversize = split_batch_by_size(sub, buckets)
+                PIPELINE_COUNTERS["shards_prefetched"] += 1
+                self._q.put(("ok", (s, lo, groups, oversize)))
+        except Exception as e:  # surfaced at the consumer's next get
+            self._q.put(("error", e))
+        else:
+            self._q.put(("done", None))
+
+    def __iter__(self):
+        from .mesh import PIPELINE_COUNTERS
+
+        while True:
+            t0 = time.perf_counter()
+            kind, payload = self._q.get()
+            PIPELINE_COUNTERS["shard_prefetch_stall_seconds"] += (
+                time.perf_counter() - t0
+            )
+            if kind == "done":
+                return
+            if kind == "error":
+                raise payload
+            yield payload

@@ -149,7 +149,16 @@ def reset_dispatch_counters() -> None:
 #                             ingest queue (the pipeline_stall bench
 #                             decomposition row);
 #   read_parse_seconds /    — cumulative stage-1 timings as measured
-#   encode_seconds            inside the workers (or inline).
+#   encode_seconds            inside the workers (or inline);
+#   shards_prefetched       — per-doc-shard dispatch inputs prepared
+#                             AHEAD of the 2-D mesh dispatch loop by
+#                             the bounded shard prefetcher
+#                             (ingest.ShardPrefetcher — zero when the
+#                             mesh is off or the batch is one shard);
+#   shard_prefetch_stall_   — dispatch-loop time blocked waiting on
+#   seconds                   the next shard's host prep (small =
+#                             shard prep genuinely overlapped the
+#                             previous shard's device execution).
 PIPELINE_COUNTERS = _TELEMETRY.counter_group("pipeline", {
     "chunks_prefetched": 0,
     "encode_dispatch_overlap": 0,
@@ -157,6 +166,8 @@ PIPELINE_COUNTERS = _TELEMETRY.counter_group("pipeline", {
     "ingest_stall_seconds": 0.0,
     "read_parse_seconds": 0.0,
     "encode_seconds": 0.0,
+    "shards_prefetched": 0,
+    "shard_prefetch_stall_seconds": 0.0,
 })
 
 
@@ -181,11 +192,28 @@ def reset_pipeline_counters() -> None:
 #                                   blocks converted back per collect
 #                                   (padded shapes: what actually
 #                                   crosses, not the trimmed view);
+#   device_to_host_bytes_trimmed  — the same transfers after the [:d]
+#                                   doc trim (padding docs excluded),
+#                                   so mesh bench rows can report both
+#                                   and never overstate the rim-only
+#                                   transfer savings;
 #   pack_rule_slots_used /        — rule slots occupied vs the
 #   _capacity                       PACK_MAX_RULES ceiling per planned
 #                                   pack (ops.backend increments).
 # Per-bucket fill fractions and the live-executable census land as
 # `efficiency.*` gauges next to the counters.
+
+# late-bound reset hooks: mesh2d registers its per-doc-shard
+# accumulator clear here (a direct import at group-registration time
+# would be circular)
+_EFFICIENCY_RESET_HOOKS: list = []
+
+
+def _run_efficiency_reset_hooks() -> None:
+    for hook in list(_EFFICIENCY_RESET_HOOKS):
+        hook()
+
+
 EFFICIENCY_COUNTERS = _TELEMETRY.counter_group("efficiency", {
     "docs_real": 0,
     "docs_padded": 0,
@@ -193,9 +221,10 @@ EFFICIENCY_COUNTERS = _TELEMETRY.counter_group("efficiency", {
     "node_slots_padded": 0,
     "host_to_device_bytes": 0,
     "device_to_host_bytes": 0,
+    "device_to_host_bytes_trimmed": 0,
     "pack_rule_slots_used": 0,
     "pack_rule_slots_capacity": 0,
-})
+}, extra_reset=_run_efficiency_reset_hooks)
 
 
 def reset_efficiency_counters() -> None:
@@ -363,15 +392,25 @@ class ShardedBatchEvaluator:
     third element. On accelerators this shrinks the per-collect
     transfer from the (D, R) status matrix to the (D, G)/(D, F) blocks
     the backend's mask arithmetic actually consumes. Without rim_spec
-    the two-element protocol is unchanged."""
+    the two-element protocol is unchanged.
+
+    `rim_blocks` (tuple of rim block indices 0..5) narrows the rim
+    protocol further: only the named blocks are converted host-side
+    per collect (the rest come back as None placeholders), and
+    `ship_statuses=False` skips the padded (D, R) status/unsure
+    conversion entirely — the mesh sweep's whole d2h win, since the
+    report/tally consumers read ONLY their profile's rim blocks."""
 
     def __init__(self, compiled: CompiledRules, mesh: Optional[Mesh] = None,
-                 rim_spec=None):
+                 rim_spec=None, rim_blocks=None, ship_statuses: bool = True):
         self.compiled = compiled
         self.mesh = mesh if mesh is not None else default_mesh()
         self._with_unsure = compiled.needs_unsure
         self._fn, self._summary_fn = _shared_evaluator_fns(compiled, self.mesh)
         self.rim_spec = rim_spec
+        self.rim_blocks = None if rim_blocks is None else tuple(rim_blocks)
+        # without a rim there is nothing else to return: statuses ship
+        self.ship_statuses = bool(ship_statuses) or rim_spec is None
         self.last_unsure = None
 
     def _arrays(self, batch: DocBatch):
@@ -449,28 +488,45 @@ class ShardedBatchEvaluator:
         rim_spec."""
         out, d, rim_dev = handle
         # hardware-efficiency seam: the PADDED device arrays are what
-        # cross back to the host (the [:d] trim happens host-side)
-        if self._with_unsure:
-            statuses, unsure = out
-            st_full, un_full = np.asarray(statuses), np.asarray(unsure)
-            EFFICIENCY_COUNTERS["device_to_host_bytes"] += int(
-                st_full.nbytes + un_full.nbytes
-            )
-            st, un = st_full[:d], un_full[:d]
-        else:
-            st_full = np.asarray(out)
-            EFFICIENCY_COUNTERS["device_to_host_bytes"] += int(
-                st_full.nbytes
-            )
-            st, un = st_full[:d], None
+        # cross back to the host (the [:d] trim happens host-side);
+        # the _trimmed counter records the post-trim view of the same
+        # transfers so padding docs never inflate the mesh bench rows
+        st = un = None
+        if self.ship_statuses:
+            if self._with_unsure:
+                statuses, unsure = out
+                st_full, un_full = np.asarray(statuses), np.asarray(unsure)
+                EFFICIENCY_COUNTERS["device_to_host_bytes"] += int(
+                    st_full.nbytes + un_full.nbytes
+                )
+                st, un = st_full[:d], un_full[:d]
+                EFFICIENCY_COUNTERS["device_to_host_bytes_trimmed"] += int(
+                    st.nbytes + un.nbytes
+                )
+            else:
+                st_full = np.asarray(out)
+                EFFICIENCY_COUNTERS["device_to_host_bytes"] += int(
+                    st_full.nbytes
+                )
+                st, un = st_full[:d], None
+                EFFICIENCY_COUNTERS["device_to_host_bytes_trimmed"] += int(
+                    st.nbytes
+                )
         if self.rim_spec is None:
             return st, un
-        rim_full = [np.asarray(b) for b in rim_dev]
-        EFFICIENCY_COUNTERS["device_to_host_bytes"] += int(
-            sum(b.nbytes for b in rim_full)
-        )
-        rim = tuple(b[:d] for b in rim_full)
-        return st, un, rim
+        blocks = []
+        for i, b in enumerate(rim_dev):
+            if self.rim_blocks is not None and i not in self.rim_blocks:
+                blocks.append(None)
+                continue
+            full = np.asarray(b)
+            EFFICIENCY_COUNTERS["device_to_host_bytes"] += int(full.nbytes)
+            trimmed = full[:d]
+            EFFICIENCY_COUNTERS["device_to_host_bytes_trimmed"] += int(
+                trimmed.nbytes
+            )
+            blocks.append(trimmed)
+        return st, un, tuple(blocks)
 
     def __call__(self, batch: DocBatch) -> np.ndarray:
         collected = self.collect(self.dispatch(batch))

@@ -1,0 +1,297 @@
+"""The production 2-D (docs x packs) mesh: doc-axis sharding composed
+with pack-column sub-meshes, as the DEFAULT sweep / validate dispatch
+path whenever more than one device is visible.
+
+Shape semantics — `GUARD_TPU_MESH` / `--mesh-shape`, resolved by
+`resolve_mesh_shape`:
+
+  * ``RxC`` — R host-level DOC shards x C pack COLUMNS. The visible
+    devices partition into C contiguous groups; each planned pack is
+    assigned to one column (greedy rule-count balance, the
+    `rules.partition_packs` discipline) and its documents still
+    DP-shard over that column's devices via NamedSharding. A column
+    spanning m >= 4 devices (m even) gets the hierarchical (dcn, ici)
+    layout from `mesh.hierarchical_mesh`; smaller columns stay 1-D.
+  * ``auto`` / unset — (2, 1) when >= 2 devices are visible, else off.
+    The single column then spans ALL devices, so the column mesh IS
+    `mesh.default_mesh()` and every jitted evaluator hits the same
+    `_SHARED_FNS` entry the single-shard path compiled — the default
+    costs doc-shard concurrency setup, not a second XLA compile.
+  * ``off`` / ``0`` / ``1`` / ``1x1`` — the single-device escape
+    hatch: the legacy unsharded dispatch path, bit-identical to every
+    release before the mesh plane.
+
+Doc shards are CONTIGUOUS row ranges of the encoded batch
+(`take_docs`), never an interleave: per-shard results write back
+through a plain `lo:hi` offset, and the shard boundary is also the
+degradation boundary — a dispatch/collect fault on one (doc-shard,
+pack, bucket) walks packed -> per-file -> host-oracle for that shard's
+docs only (ops/backend.py), while every other shard's results stand.
+
+`GUARD_TPU_MESH_MIN_DOCS` (default 32) floors the per-shard doc count:
+a 48-doc smoke batch under an R=2 shape stays ONE shard, so small
+corpora keep the exact legacy dispatch count (and the pack-smoke
+dispatch ceiling) while registry-scale chunks fan out.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading as _threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.encoder import DocBatch
+from ..utils.telemetry import REGISTRY as _TELEMETRY
+from .mesh import (
+    DOC_AXIS,
+    EFFICIENCY_COUNTERS,
+    Mesh,
+    ShardedBatchEvaluator,
+    _EFFICIENCY_RESET_HOOKS,
+    default_mesh,
+    hierarchical_mesh,
+)
+
+log = logging.getLogger("guard_tpu.mesh2d")
+
+_SHAPE_RE = re.compile(r"(\d+)\s*x\s*(\d+)")
+
+# Rim-block subsets per consumer (mesh.ShardedBatchEvaluator
+# rim_blocks): ONLY these blocks of the 7-tuple rim protocol cross the
+# device boundary per collect; the padded status matrix stays on
+# device entirely (ship_statuses=False). This is the mesh plane's d2h
+# shrink — the report path (validate) reads blocks 0-4 + names, the
+# sweep tally reads only any_unsure (4) and name_last (5).
+RIM_PROFILE_VALIDATE = (0, 1, 2, 3, 4)
+RIM_PROFILE_SWEEP = (4, 5)
+
+RIM_PROFILES = {
+    "validate": RIM_PROFILE_VALIDATE,
+    "sweep": RIM_PROFILE_SWEEP,
+}
+
+
+def resolve_mesh_shape(n_devices: Optional[int] = None) -> Optional[Tuple[int, int]]:
+    """(doc_shards, pack_columns) from GUARD_TPU_MESH, or None for the
+    legacy unsharded path. See the module docstring for the grammar."""
+    raw = os.environ.get("GUARD_TPU_MESH", "").strip().lower()
+    if raw in ("off", "none", "0", "1", "1x1"):
+        return None
+    if n_devices is None:
+        import jax
+
+        n_devices = jax.device_count()
+    if raw in ("", "auto"):
+        return (2, 1) if n_devices >= 2 else None
+    m = _SHAPE_RE.fullmatch(raw)
+    if m is None:
+        raise ValueError(
+            f"GUARD_TPU_MESH={raw!r}: expected RxC (e.g. 2x4), "
+            "'auto', or 'off'"
+        )
+    r, c = int(m.group(1)), int(m.group(2))
+    if r < 1 or c < 1:
+        raise ValueError(f"GUARD_TPU_MESH={raw!r}: axes must be >= 1")
+    if (r, c) == (1, 1):
+        return None
+    if c > n_devices:
+        log.warning(
+            "GUARD_TPU_MESH=%s wants %d pack columns but only %d "
+            "device(s) are visible; falling back to the unsharded path",
+            raw, c, n_devices,
+        )
+        return None
+    return r, c
+
+
+def mesh_active(n_devices: Optional[int] = None) -> bool:
+    return resolve_mesh_shape(n_devices) is not None
+
+
+def min_shard_docs() -> int:
+    try:
+        return int(os.environ.get("GUARD_TPU_MESH_MIN_DOCS", "32") or 32)
+    except ValueError:
+        return 32
+
+
+def doc_shard_bounds(n_docs: int, r: int) -> List[Tuple[int, int]]:
+    """Contiguous (lo, hi) doc ranges for <= r shards, floored so every
+    shard carries at least GUARD_TPU_MESH_MIN_DOCS documents (small
+    batches collapse to one shard = the exact legacy dispatch count)."""
+    floor = max(1, min_shard_docs())
+    s = max(1, min(r, n_docs // floor))
+    base, rem = divmod(n_docs, s)
+    bounds, lo = [], 0
+    for i in range(s):
+        hi = lo + base + (1 if i < rem else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
+def take_docs(batch: DocBatch, lo: int, hi: int) -> DocBatch:
+    """Contiguous doc-range slice of an encoded batch (numpy views, no
+    copies): the unit a doc shard dispatches. Derived per-node columns
+    are passed through so __post_init__ skips the edge re-scatter."""
+    if lo == 0 and hi == batch.n_docs:
+        return batch
+    sl = slice(lo, hi)
+    return DocBatch(
+        node_kind=batch.node_kind[sl],
+        node_parent=batch.node_parent[sl],
+        scalar_id=batch.scalar_id[sl],
+        num_hi=batch.num_hi[sl],
+        num_lo=batch.num_lo[sl],
+        child_count=batch.child_count[sl],
+        edge_parent=batch.edge_parent[sl],
+        edge_child=batch.edge_child[sl],
+        edge_key_id=batch.edge_key_id[sl],
+        edge_index=batch.edge_index[sl],
+        edge_valid=batch.edge_valid[sl],
+        n_docs=hi - lo,
+        n_nodes=batch.n_nodes,
+        n_edges=batch.n_edges,
+        node_key_id=batch.node_key_id[sl],
+        node_index=batch.node_index[sl],
+        node_parent_kind=batch.node_parent_kind[sl],
+        num_exotic=batch.num_exotic[sl],
+        fn_origin=(
+            batch.fn_origin[sl] if batch.fn_origin is not None else None
+        ),
+    )
+
+
+def column_mesh(shape: Tuple[int, int], column: int,
+                devices: Optional[Sequence] = None) -> Mesh:
+    """The device mesh for pack column `column` of `shape`: C=1 spans
+    every device as the flat default mesh (identical _SHARED_FNS keys
+    to the single-shard path); C>1 partitions the devices contiguously,
+    laying each column out hierarchically (dcn, ici) when it is big
+    and even enough to split into two slices."""
+    import jax
+
+    devices = list(devices) if devices is not None else jax.devices()
+    _r, c = shape
+    if c <= 1:
+        return default_mesh(devices)
+    groups = np.array_split(np.arange(len(devices)), c)
+    col_devices = [devices[i] for i in groups[column]]
+    m = len(col_devices)
+    if m >= 4 and m % 2 == 0:
+        return hierarchical_mesh(col_devices, n_slices=2)
+    return Mesh(np.array(col_devices), (DOC_AXIS,))
+
+
+def assign_columns(loads: Sequence[int], n_columns: int) -> List[int]:
+    """Greedy min-load column per item (largest first) — the
+    rules.partition_packs balance discipline, but returning a per-item
+    column index so pack order is preserved."""
+    n_columns = max(1, n_columns)
+    col_load = [0] * n_columns
+    out = [0] * len(loads)
+    for i in sorted(range(len(loads)), key=lambda i: -loads[i]):
+        g = col_load.index(min(col_load))
+        out[i] = g
+        col_load[g] += max(1, loads[i])
+    return out
+
+
+# -- per-doc-shard efficiency attribution ------------------------------
+# cumulative per-shard h2d/d2h bytes and doc fill, attributed by
+# measuring the EFFICIENCY_COUNTERS deltas around each wrapped
+# dispatch/collect and surfaced as `efficiency.shard_{s}.h2d / d2h /
+# doc_fill` gauges — the skew view --metrics-out and the flight
+# recorder dump for mesh runs. The delta window is NOT held under a
+# lock across the (blocking) device call — that would serialize
+# concurrent serve-path collects — so simultaneous mesh evaluations
+# can misattribute bytes between shards; these are gauges, and the
+# sweep path (the mesh's primary consumer) is single-threaded.
+_SHARD_LOCK = _threading.Lock()
+_SHARD_TOTALS: dict = {}
+
+
+def _reset_shard_totals() -> None:
+    _SHARD_TOTALS.clear()
+
+
+_EFFICIENCY_RESET_HOOKS.append(_reset_shard_totals)
+
+
+def _shard_totals(shard: int) -> dict:
+    return _SHARD_TOTALS.setdefault(
+        int(shard), {"h2d": 0, "d2h": 0, "docs_real": 0, "docs_padded": 0}
+    )
+
+
+def shard_efficiency_snapshot() -> dict:
+    with _SHARD_LOCK:
+        return {s: dict(t) for s, t in _SHARD_TOTALS.items()}
+
+
+class MeshSweepEvaluator:
+    """One pack's evaluator on the 2-D mesh: a ShardedBatchEvaluator on
+    this pack's COLUMN sub-mesh, dispatched once per (doc shard,
+    bucket) with per-shard efficiency attribution. `rim_blocks` /
+    `ship_statuses` narrow the collect payload to the consumer's rim
+    profile (RIM_PROFILES) — the cross-device rim reduction already ran
+    behind the dispatch (mesh._rim_device), so only the merged
+    per-name-group blocks the profile names leave the mesh."""
+
+    def __init__(self, compiled, rim_spec=None,
+                 shape: Optional[Tuple[int, int]] = None, column: int = 0,
+                 rim_blocks=None, ship_statuses: bool = True,
+                 devices: Optional[Sequence] = None):
+        self.shape = shape if shape is not None else resolve_mesh_shape()
+        self.column = int(column)
+        mesh = (
+            column_mesh(self.shape, self.column, devices)
+            if self.shape is not None else None
+        )
+        self._ev = ShardedBatchEvaluator(
+            compiled, mesh, rim_spec=rim_spec,
+            rim_blocks=rim_blocks, ship_statuses=ship_statuses,
+        )
+        self.compiled = compiled
+        self.rim_spec = rim_spec
+        self.mesh = self._ev.mesh
+
+    def dispatch(self, sub: DocBatch, shard: int = 0):
+        real0 = EFFICIENCY_COUNTERS["docs_real"]
+        pad0 = EFFICIENCY_COUNTERS["docs_padded"]
+        h2d0 = EFFICIENCY_COUNTERS["host_to_device_bytes"]
+        handle = self._ev.dispatch(sub)
+        with _SHARD_LOCK:
+            tot = _shard_totals(shard)
+            tot["docs_real"] += EFFICIENCY_COUNTERS["docs_real"] - real0
+            tot["docs_padded"] += EFFICIENCY_COUNTERS["docs_padded"] - pad0
+            tot["h2d"] += (
+                EFFICIENCY_COUNTERS["host_to_device_bytes"] - h2d0
+            )
+            denom = tot["docs_real"] + tot["docs_padded"]
+            _TELEMETRY.set_gauge(
+                f"efficiency.shard_{shard}.doc_fill",
+                tot["docs_real"] / denom if denom else 0.0,
+            )
+            _TELEMETRY.set_gauge(
+                f"efficiency.shard_{shard}.h2d", tot["h2d"]
+            )
+        return shard, handle
+
+    def collect(self, handle):
+        shard, inner = handle
+        d2h0 = EFFICIENCY_COUNTERS["device_to_host_bytes"]
+        out = self._ev.collect(inner)
+        with _SHARD_LOCK:
+            tot = _shard_totals(shard)
+            tot["d2h"] += (
+                EFFICIENCY_COUNTERS["device_to_host_bytes"] - d2h0
+            )
+            _TELEMETRY.set_gauge(
+                f"efficiency.shard_{shard}.d2h", tot["d2h"]
+            )
+        return out

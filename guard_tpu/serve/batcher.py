@@ -94,7 +94,7 @@ class _Item:
 
     __slots__ = (
         "cmd", "payload", "digest", "writer",
-        "done", "code", "error", "enqueued_at",
+        "done", "code", "error", "enqueued_at", "arrived_alone",
     )
 
     def __init__(self, cmd, payload, digest, writer):
@@ -106,6 +106,7 @@ class _Item:
         self.code: Optional[int] = None
         self.error: Optional[BaseException] = None
         self.enqueued_at = time.monotonic()
+        self.arrived_alone = False
 
 
 class CoalescingBatcher:
@@ -144,6 +145,7 @@ class CoalescingBatcher:
                 self._cv.wait(0.05)
             if self._closed:
                 raise RuntimeError("serve batcher is closed")
+            item.arrived_alone = not self._q
             self._q.append(item)
             telemetry.REGISTRY.set_gauge("serve_queue_depth", len(self._q))
             self._cv.notify_all()
@@ -168,13 +170,22 @@ class CoalescingBatcher:
                 if not self._q and self._closed:
                     return
                 # batch formation: after the first arrival, wait up to
-                # the coalesce window for peers (or until max-batch)
-                deadline = time.monotonic() + self._wait
-                while len(self._q) < self._max_batch:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        break
-                    self._cv.wait(remaining)
+                # the coalesce window for peers (or until max-batch) —
+                # UNLESS the sole queued request found the queue empty
+                # on admission: with no peer in flight the window buys
+                # only latency, so dispatch immediately (c=1 parity
+                # with coalesce-off). Concurrent arrivals — a request
+                # admitted while others were queued — still pay the
+                # window so their peers can join the batch.
+                if len(self._q) == 1 and self._q[0].arrived_alone:
+                    SERVE_COUNTERS["coalesce_window_adaptive"] += 1
+                else:
+                    deadline = time.monotonic() + self._wait
+                    while len(self._q) < self._max_batch:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cv.wait(remaining)
                 batch = [
                     self._q.popleft()
                     for _ in range(min(len(self._q), self._max_batch))

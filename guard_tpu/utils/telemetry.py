@@ -63,7 +63,12 @@ from typing import Dict, List, Optional
 #: validates against this; bump on breaking snapshot-shape changes).
 #: v2: the `efficiency` counter/gauge group (padding waste, pack slot
 #: occupancy, transfer bytes) joined the snapshot contract.
-SCHEMA_VERSION = 2
+#: v3: per-doc-shard mesh gauges (`efficiency.shard_{s}.doc_fill` /
+#: `.h2d` / `.d2h`), the `device_to_host_bytes_trimmed` efficiency
+#: counter, the shard-prefetch pipeline counters, and the serve
+#: `coalesce_window_adaptive` counter (2-D mesh plane + adaptive
+#: coalesce window).
+SCHEMA_VERSION = 3
 
 # fixed log2 histogram buckets: bucket i holds durations in
 # [2^(LOG2_LO+i-1), 2^(LOG2_LO+i)) seconds — ~1µs to ~128s, plus an
@@ -310,6 +315,11 @@ SERVE_COUNTERS = REGISTRY.counter_group("serve", EventedCounters("serve", {
     "isolation_refires": 0,
     "request_timeouts": 0,
     "abandoned_threads": 0,
+    # adaptive coalesce window: batches dispatched immediately because
+    # the sole queued request found the admission queue empty — the
+    # formation wait would have bought pure latency (c=1 parity with
+    # coalesce-off)
+    "coalesce_window_adaptive": 0,
 }))
 
 

@@ -1,0 +1,422 @@
+"""2-D (docs x packs) mesh plane suite (parallel/mesh2d.py): shape
+grammar, contiguous doc-shard bounds under the MIN_DOCS floor, the
+bounded shard prefetcher, and the production guarantees — a mesh sweep
+must be byte-identical to the single-device escape hatch across output
+modes, ship strictly fewer d2h bytes than the padded status matrix,
+surface per-shard efficiency gauges that pass the metrics schema gate,
+and scope the dispatch degradation ladder to the faulted shard (other
+shards' documents never touch the host oracle)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from guard_tpu.cli import run
+from guard_tpu.parallel import ingest, mesh2d
+from guard_tpu.parallel.mesh import PIPELINE_COUNTERS
+from guard_tpu.utils import faults
+from guard_tpu.utils.io import Reader, Writer
+
+# two device-lowerable rule files that pack together (>= 2 compiled
+# files is the packed-path precondition, and the mesh plane lives on
+# the packed path)
+RULES_A = (
+    "let b = Resources.*[ Type == 'AWS::S3::Bucket' ]\n"
+    "rule sse when %b !empty { %b.Properties.Enc == true }\n"
+)
+RULES_B = "rule sized { Resources.*.Size <= 100 }\n"
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh(monkeypatch):
+    """Every test controls the mesh shape explicitly and starts with
+    fresh fault state and no cached worker pools."""
+    monkeypatch.delenv("GUARD_TPU_MESH", raising=False)
+    monkeypatch.delenv("GUARD_TPU_MESH_MIN_DOCS", raising=False)
+    monkeypatch.delenv("GUARD_TPU_FAULT", raising=False)
+    monkeypatch.setenv("GUARD_TPU_RETRY_BACKOFF", "0")
+    faults.reset_faults()
+    ingest.close_shared_pools()
+    yield
+    ingest.close_shared_pools()
+    faults.reset_faults()
+
+
+def _doc(i, n=80, fail=()):
+    return {
+        "Resources": {
+            "b": {
+                "Type": "AWS::S3::Bucket",
+                "Properties": {"Enc": i not in fail},
+                "Size": 500 if i in fail else 50,
+            }
+        }
+    }
+
+
+def _mk_corpus(tmp_path, n=80, fail=(3, 71)):
+    """n docs over two packable rule files. Files a...json sort before
+    b...json, so under 2 contiguous doc shards the a-docs are shard 0
+    and the b-docs are shard 1 — the prefix encodes the shard."""
+    ra = tmp_path / "a.guard"
+    ra.write_text(RULES_A)
+    rb = tmp_path / "b.guard"
+    rb.write_text(RULES_B)
+    data = tmp_path / "data"
+    data.mkdir(exist_ok=True)
+    for i in range(n):
+        prefix = "a" if i < n // 2 else "b"
+        (data / f"{prefix}{i:03d}.json").write_text(
+            json.dumps(_doc(i, n, fail))
+        )
+    return [str(ra), str(rb)], data
+
+
+def _sweep(tmp_path, rules, data, *extra, tag="m", workers=0, chunk=80):
+    w = Writer.buffered()
+    rc = run(
+        ["sweep", "-r", *rules, "-d", str(data),
+         "-M", str(tmp_path / f"{tag}.jsonl"), "-c", str(chunk),
+         "--backend", "tpu", "--ingest-workers", str(workers), *extra],
+        writer=w, reader=Reader.from_string(""),
+    )
+    summary = json.loads(w.out.getvalue().strip().splitlines()[-1])
+    summary.pop("manifest")
+    return rc, summary
+
+
+def _validate(rules, data, *extra):
+    w = Writer.buffered()
+    rc = run(
+        ["validate", "-r", *rules, "-d", str(data),
+         "--backend", "tpu", *extra],
+        writer=w, reader=Reader.from_string(""),
+    )
+    return rc, w.out.getvalue(), w.err.getvalue()
+
+
+# ------------------------------------------------------ shape grammar
+
+
+def test_resolve_mesh_shape_grammar(monkeypatch):
+    for off in ("off", "none", "0", "1", "1x1"):
+        monkeypatch.setenv("GUARD_TPU_MESH", off)
+        assert mesh2d.resolve_mesh_shape(8) is None
+    for auto in ("", "auto", " AUTO "):
+        monkeypatch.setenv("GUARD_TPU_MESH", auto)
+        assert mesh2d.resolve_mesh_shape(8) == (2, 1)
+        assert mesh2d.resolve_mesh_shape(1) is None
+    monkeypatch.setenv("GUARD_TPU_MESH", "2x4")
+    assert mesh2d.resolve_mesh_shape(8) == (2, 4)
+    assert mesh2d.mesh_active(8)
+    # more columns than devices: warn + legacy fallback, not a crash
+    monkeypatch.setenv("GUARD_TPU_MESH", "4x16")
+    assert mesh2d.resolve_mesh_shape(8) is None
+    for bad in ("2x", "x2", "axb", "2x2x2", "0x2", "2x0"):
+        monkeypatch.setenv("GUARD_TPU_MESH", bad)
+        with pytest.raises(ValueError):
+            mesh2d.resolve_mesh_shape(8)
+
+
+def test_doc_shard_bounds_contiguous_and_floored(monkeypatch):
+    # default floor 32: 100 docs split in two, 48 stay one shard,
+    # 65 docs support only 2 floored shards even at r=4
+    assert mesh2d.doc_shard_bounds(100, 2) == [(0, 50), (50, 100)]
+    assert mesh2d.doc_shard_bounds(48, 2) == [(0, 48)]
+    assert mesh2d.doc_shard_bounds(65, 4) == [(0, 33), (33, 65)]
+    monkeypatch.setenv("GUARD_TPU_MESH_MIN_DOCS", "1")
+    assert mesh2d.doc_shard_bounds(5, 2) == [(0, 3), (3, 5)]
+    # bounds always partition [0, n) contiguously
+    for n, r in ((7, 3), (64, 2), (257, 8)):
+        bounds = mesh2d.doc_shard_bounds(n, r)
+        assert bounds[0][0] == 0 and bounds[-1][1] == n
+        assert all(a[1] == b[0] for a, b in zip(bounds, bounds[1:]))
+
+
+def test_take_docs_slices_every_per_doc_column():
+    from guard_tpu.core.values import from_plain
+    from guard_tpu.ops.encoder import encode_batch
+
+    docs = [from_plain(_doc(i, fail=(1,))) for i in range(6)]
+    batch, _ = encode_batch(docs)
+    # the full range returns the batch itself (no copy)
+    assert mesh2d.take_docs(batch, 0, batch.n_docs) is batch
+    sub = mesh2d.take_docs(batch, 2, 5)
+    assert sub.n_docs == 3
+    assert sub.n_nodes == batch.n_nodes
+    np.testing.assert_array_equal(sub.node_kind, batch.node_kind[2:5])
+    np.testing.assert_array_equal(sub.edge_valid, batch.edge_valid[2:5])
+    np.testing.assert_array_equal(
+        sub.node_key_id, batch.node_key_id[2:5]
+    )
+
+
+def test_assign_columns_balances_and_preserves_order():
+    cols = mesh2d.assign_columns([5, 3, 2, 2], 2)
+    assert len(cols) == 4 and set(cols) <= {0, 1}
+    # greedy balance: the two column loads differ by at most the
+    # smallest item
+    loads = [0, 0]
+    for load, c in zip([5, 3, 2, 2], cols):
+        loads[c] += load
+    assert abs(loads[0] - loads[1]) <= 2
+    assert mesh2d.assign_columns([7], 1) == [0]
+    assert mesh2d.assign_columns([], 4) == []
+
+
+def test_column_mesh_partitions_devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) == 8  # conftest forces the 8-device CPU mesh
+    # C=1 spans everything = the default mesh (shared _SHARED_FNS keys)
+    m1 = mesh2d.column_mesh((2, 1), 0)
+    assert len(m1.devices.flatten()) == 8
+    # C=2 partitions contiguously, 4 devices each, no overlap
+    c0 = mesh2d.column_mesh((2, 2), 0)
+    c1 = mesh2d.column_mesh((2, 2), 1)
+    d0 = set(d.id for d in c0.devices.flatten())
+    d1 = set(d.id for d in c1.devices.flatten())
+    assert len(d0) == len(d1) == 4 and not (d0 & d1)
+
+
+# -------------------------------------------------- shard prefetcher
+
+
+def test_shard_prefetcher_matches_inline_split():
+    from guard_tpu.core.values import from_plain
+    from guard_tpu.ops.encoder import (
+        NODE_BUCKETS_EXTENDED,
+        encode_batch,
+        split_batch_by_size,
+    )
+
+    docs = [from_plain(_doc(i)) for i in range(8)]
+    batch, _ = encode_batch(docs)
+    bounds = [(0, 4), (4, 8)]
+    before = PIPELINE_COUNTERS["shards_prefetched"]
+    got = list(ingest.ShardPrefetcher(
+        batch, bounds, NODE_BUCKETS_EXTENDED
+    ))
+    assert PIPELINE_COUNTERS["shards_prefetched"] - before == 2
+    assert [(s, lo) for s, lo, _g, _o in got] == [(0, 0), (1, 4)]
+    for s, (lo, hi) in enumerate(bounds):
+        want_groups, want_over = split_batch_by_size(
+            mesh2d.take_docs(batch, lo, hi), NODE_BUCKETS_EXTENDED
+        )
+        _s, _lo, groups, oversize = got[s]
+        np.testing.assert_array_equal(oversize, want_over)
+        assert len(groups) == len(want_groups)
+        for (sub, idx), (wsub, widx) in zip(groups, want_groups):
+            np.testing.assert_array_equal(idx, widx)
+            np.testing.assert_array_equal(sub.node_kind, wsub.node_kind)
+
+
+def test_shard_prefetcher_propagates_producer_errors():
+    class Boom:
+        n_docs = 4
+
+        def __getattr__(self, name):
+            raise RuntimeError("poisoned batch")
+
+    it = iter(ingest.ShardPrefetcher(Boom(), [(0, 2), (2, 4)], (64,)))
+    with pytest.raises(RuntimeError, match="poisoned batch"):
+        list(it)
+
+
+# ------------------------------------------------- sweep/validate parity
+
+
+@pytest.mark.parametrize("workers", [0, 2])
+@pytest.mark.parametrize("shape", ["2x1", "2x2"])
+def test_mesh_sweep_byte_identical_to_single_device(
+    tmp_path, monkeypatch, shape, workers
+):
+    """The tentpole parity bar: the 2-D mesh sweep reproduces the
+    single-device escape hatch byte-for-byte (summary minus manifest,
+    exit code) and genuinely fans out (>1 shard prefetched)."""
+    rules, data = _mk_corpus(tmp_path)
+    monkeypatch.setenv("GUARD_TPU_MESH", "off")
+    base = _sweep(tmp_path, rules, data, tag="base", workers=workers)
+    monkeypatch.setenv("GUARD_TPU_MESH", shape)
+    before = PIPELINE_COUNTERS["shards_prefetched"]
+    got = _sweep(
+        tmp_path, rules, data, tag=f"mesh{shape}-w{workers}",
+        workers=workers,
+    )
+    assert got == base
+    assert base[0] == 19  # the seeded failures genuinely fail
+    assert PIPELINE_COUNTERS["shards_prefetched"] - before >= 2
+
+
+@pytest.mark.parametrize(
+    "mode",
+    [
+        [],
+        ["-o", "yaml"],
+        ["--structured", "-o", "json", "--show-summary", "none"],
+        ["--structured", "-o", "junit", "--show-summary", "none"],
+    ],
+    ids=["console", "yaml", "json", "junit"],
+)
+def test_mesh_validate_byte_identical_across_output_modes(
+    tmp_path, monkeypatch, mode
+):
+    rules, data = _mk_corpus(tmp_path)
+    monkeypatch.setenv("GUARD_TPU_MESH", "off")
+    base = _validate(rules, data, *mode)
+    monkeypatch.setenv("GUARD_TPU_MESH", "2x2")
+    got = _validate(rules, data, *mode)
+    assert got == base
+    assert base[0] == 19
+
+
+def test_mesh_shape_flag_overrides_env(tmp_path, monkeypatch):
+    """--mesh-shape is the CLI face of GUARD_TPU_MESH: `off` under an
+    env-forced mesh must reproduce the escape hatch."""
+    rules, data = _mk_corpus(tmp_path, n=68, fail=(2,))
+    monkeypatch.setenv("GUARD_TPU_MESH", "off")
+    base = _sweep(tmp_path, rules, data, tag="flag-base")
+    monkeypatch.setenv("GUARD_TPU_MESH", "2x1")
+    got = _sweep(
+        tmp_path, rules, data, "--mesh-shape", "off", tag="flag-off"
+    )
+    assert got == base
+
+
+# --------------------------------------- shard-scoped degradation
+
+
+@pytest.mark.parametrize("workers", [0, 2])
+@pytest.mark.parametrize("pack", ["1", "0"], ids=["packed", "perfile"])
+def test_dispatch_fault_under_mesh_keeps_parity(
+    tmp_path, monkeypatch, pack, workers
+):
+    """An injected dispatch fault under the mesh walks the degradation
+    ladder for the faulted (shard, bucket) only — the run still
+    reproduces the clean single-device output byte-for-byte."""
+    rules, data = _mk_corpus(tmp_path)
+    monkeypatch.setenv("GUARD_TPU_PACK", pack)
+    monkeypatch.setenv("GUARD_TPU_MESH", "off")
+    base = _sweep(
+        tmp_path, rules, data, tag=f"fb{pack}-w{workers}",
+        workers=workers,
+    )
+    monkeypatch.setenv("GUARD_TPU_MESH", "2x2")
+    monkeypatch.setenv("GUARD_TPU_FAULT", "dispatch:nth=1")
+    faults.reset_faults()
+    got = _sweep(
+        tmp_path, rules, data, tag=f"ff{pack}-w{workers}",
+        workers=workers,
+    )
+    assert got == base
+    assert faults.fault_stats()["dispatch_fallbacks"] >= 1
+
+
+def test_shard_fault_never_sends_other_shards_to_oracle(
+    tmp_path, monkeypatch
+):
+    """The shard boundary is the degradation boundary. The first
+    dispatch fault lands on shard 0 (the a-docs); with the per-file
+    retry rung ALSO killed, shard 0's bucket must land on the host
+    oracle — and an armed oracle fault on every b-doc (shard 1) proves
+    no other shard's document ever reaches that rung: if one did, the
+    injected oracle fault would surface as a hard evaluation error."""
+    from guard_tpu.parallel import mesh
+
+    rules, data = _mk_corpus(tmp_path)
+    # the oracle trap alone must be inert on a clean mesh run: no
+    # document visits the oracle when every dispatch succeeds
+    monkeypatch.setenv("GUARD_TPU_MESH", "off")
+    base = _sweep(tmp_path, rules, data, tag="orc-base")
+    monkeypatch.setenv("GUARD_TPU_MESH", "2x2")
+    monkeypatch.setenv("GUARD_TPU_FAULT", "oracle:glob=b*")
+    faults.reset_faults()
+    clean = _sweep(tmp_path, rules, data, tag="orc-clean")
+    assert clean == base
+
+    # now fault shard 0's packed dispatch AND the per-file retry rung
+    class _NoRetry:
+        def __init__(self, *a, **k):
+            raise RuntimeError("per-file rung disabled for test")
+
+    monkeypatch.setattr(mesh, "ShardedBatchEvaluator", _NoRetry)
+    monkeypatch.setenv(
+        "GUARD_TPU_FAULT", "dispatch:nth=1,oracle:glob=b*"
+    )
+    faults.reset_faults()
+    got = _sweep(tmp_path, rules, data, tag="orc-fault")
+    assert got == base  # b-docs never tripped the oracle trap
+    stats = faults.fault_stats()
+    assert stats["dispatch_fallbacks"] >= 1
+    assert stats["oracle_fallbacks"] >= 1  # shard 0 genuinely degraded
+    assert stats.get("injected_oracle", 0) == 0
+
+
+# ------------------------------------------- efficiency + schema
+
+
+def test_mesh_shard_gauges_and_trimmed_d2h(tmp_path, monkeypatch):
+    """A mesh sweep must surface schema-valid per-shard gauges and ship
+    strictly fewer d2h bytes than the padded status protocol would."""
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(
+        __file__).resolve().parent.parent / "tools"))
+    from check_metrics_schema import check_snapshot
+
+    from guard_tpu.ops.backend import efficiency_stats, reset_all_stats
+    from guard_tpu.utils.telemetry import REGISTRY
+
+    from guard_tpu.ops.backend import dispatch_stats
+
+    rules, data = _mk_corpus(tmp_path)
+    monkeypatch.setenv("GUARD_TPU_MESH", "off")
+    reset_all_stats()
+    _sweep(tmp_path, rules, data, tag="eff-off")
+    off = efficiency_stats()
+    off_collects = dispatch_stats()["dispatches"]
+    monkeypatch.setenv("GUARD_TPU_MESH", "2x1")
+    reset_all_stats()
+    rc, _ = _sweep(tmp_path, rules, data, tag="gauges")
+    assert rc == 19
+    snap = REGISTRY.snapshot()
+    gauges = snap["gauges"]
+    for s in (0, 1):
+        for g in ("doc_fill", "h2d", "d2h"):
+            assert f"efficiency.shard_{s}.{g}" in gauges
+        assert 0.0 < gauges[f"efficiency.shard_{s}.doc_fill"] <= 1.0
+        assert gauges[f"efficiency.shard_{s}.d2h"] > 0
+    assert check_snapshot(snap) == []
+    eff = efficiency_stats()
+    # the counters record actual transfers: trimmed never exceeds the
+    # padded device shapes
+    assert 0 < eff["device_to_host_bytes_trimmed"]
+    assert (
+        eff["device_to_host_bytes_trimmed"]
+        <= eff["device_to_host_bytes"]
+    )
+    # the rim-only shrink is cross-leg (the bench's d2h claim): the
+    # sweep profile ships 2 small reduced blocks per collect where the
+    # off leg ships the full status/unsure matrices + all 7 rim blocks
+    mesh_collects = dispatch_stats()["dispatches"]
+    per_off = off["device_to_host_bytes"] / off_collects
+    per_mesh = eff["device_to_host_bytes"] / mesh_collects
+    assert per_mesh * 4 <= per_off
+
+
+def test_plan_cache_hits_under_mesh(tmp_path, monkeypatch):
+    """Shard plans hit the compiled-plan memo: the device count is in
+    the cache key, so a second identical mesh sweep re-lowers nothing."""
+    from guard_tpu.ops.plan import plan_stats, reset_plan_stats
+
+    rules, data = _mk_corpus(tmp_path, n=68, fail=(2,))
+    monkeypatch.setenv("GUARD_TPU_MESH", "2x1")
+    monkeypatch.setenv("GUARD_TPU_PLAN_CACHE_DIR", str(tmp_path / "pl"))
+    _sweep(tmp_path, rules, data, tag="p1")
+    reset_plan_stats()
+    _sweep(tmp_path, rules, data, tag="p2")
+    stats = plan_stats()
+    assert stats["hits"] >= 1
+    assert stats["misses"] == 0

@@ -15,6 +15,7 @@ listener must answer the same envelopes over sockets.
 import json
 import socket
 import threading
+import time
 
 import pytest
 
@@ -141,6 +142,27 @@ def test_coalescing_reduces_dispatches(monkeypatch):
     assert con_dispatches * 4 <= seq_dispatches
     assert SERVE_COUNTERS["coalesced_batches"] >= 1
     assert SERVE_COUNTERS["coalesced_requests"] >= 2
+
+
+def test_adaptive_window_skips_for_lone_request(monkeypatch):
+    """A request admitted to an EMPTY queue dispatches immediately —
+    the formation wait is skipped and counted, so an unloaded session
+    (concurrency 1) does not pay the coalesce window as pure latency.
+    The answer stays byte-identical to the sequential baseline."""
+    from guard_tpu.utils.telemetry import SERVE_COUNTERS
+
+    monkeypatch.setenv("GUARD_TPU_COALESCE_WAIT_MS", "300")
+    lines = [_req(0)]
+    seq = _sequential(monkeypatch, lines)
+    telemetry.REGISTRY.reset()
+    srv = Serve(stdio=True, coalesce=True)
+    t0 = time.monotonic()
+    got = [_envelope(srv.handle_line(lines[0]))]
+    elapsed = time.monotonic() - t0
+    assert got == seq
+    assert SERVE_COUNTERS["coalesce_window_adaptive"] >= 1
+    # far under the 300ms window it would otherwise have waited out
+    assert elapsed < 0.25
 
 
 def test_injected_serve_batch_fault_refires_solo(monkeypatch):

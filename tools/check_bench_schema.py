@@ -65,6 +65,14 @@ METRIC_SINCE.update({
     for leg in ("off", "on")
 })
 
+# PR 10 mesh plane: the 2-D (docs x packs) mesh legs and the adaptive
+# coalesce-window parity row arrived with round 14
+METRIC_SINCE.update({
+    "config5b_mesh_d1_templates_per_sec": 14,
+    "config5b_mesh_d8_templates_per_sec": 14,
+    "serve_c1_adaptive_p50_ratio": 14,
+})
+
 
 def metric_since(metric: str) -> int:
     """The bench round whose driver first emitted `metric`."""
@@ -149,6 +157,26 @@ METRIC_REQUIRED_KEYS.update({
     )
     for c in (1, 4, 16)
     for leg in ("off", "on")
+})
+
+# PR 10 mesh plane: the d8 row must carry the transfer-plane evidence
+# (padded vs trimmed d2h bytes and the per-collect reduction against
+# the legacy full-ship leg) plus the cross-leg parity verdict — the
+# ">= 4x fewer bytes leave the mesh" claim must be answerable from the
+# committed artifact alone; the adaptive serve row must carry the
+# counter proving the window actually skipped
+METRIC_REQUIRED_KEYS.update({
+    "config5b_mesh_d1_templates_per_sec": (
+        "devices", "dispatches_per_run", "d2h_bytes_per_run",
+    ),
+    "config5b_mesh_d8_templates_per_sec": (
+        "devices", "mesh_shape", "dispatches_per_run",
+        "d2h_bytes_per_run", "d2h_bytes_trimmed_per_run",
+        "d2h_per_collect_reduction_vs_padded", "parity",
+    ),
+    "serve_c1_adaptive_p50_ratio": (
+        "p50_on_ms", "p50_off_ms", "coalesce_window_adaptive",
+    ),
 })
 
 # PR 3 ingest decomposition: every *_ingest_workers* row must say how

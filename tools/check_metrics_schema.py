@@ -27,7 +27,10 @@ import sys
 #: guard_tpu.utils.telemetry.SCHEMA_VERSION; imported lazily in main
 #: so the checker also runs standalone against committed artifacts).
 #: v2: the `efficiency` counter/gauge group joined the contract.
-KNOWN_SCHEMA_VERSION = 2
+#: v3: per-doc-shard mesh gauges (efficiency.shard_{s}.*), the trimmed
+#: d2h byte counter, shard-prefetch pipeline counters and the serve
+#: coalesce_window_adaptive counter.
+KNOWN_SCHEMA_VERSION = 3
 
 #: top-level sections every snapshot must carry
 SECTIONS = ("schema_version", "counters", "gauges", "histograms", "spans")
@@ -52,6 +55,30 @@ HIST_KEYS = (
 #: bucket labels are "le_2^{E}s" (E the integer upper-bound exponent)
 #: plus the "inf" overflow bucket
 _BUCKET_LABEL = re.compile(r"^le_2\^(-?\d+)s$")
+
+#: per-doc-shard mesh gauges (v3): any gauge under the
+#: `efficiency.shard_` namespace must be exactly shard index + one of
+#: the three published per-shard metrics — a typo'd shard gauge would
+#: otherwise silently vanish from mesh-skew dashboards
+_SHARD_GAUGE = re.compile(r"^efficiency\.shard_(\d+)\.(doc_fill|h2d|d2h)$")
+
+
+def _check_shard_gauges(gauges: dict) -> list:
+    problems = []
+    for name, v in gauges.items():
+        if not name.startswith("efficiency.shard_"):
+            continue
+        m = _SHARD_GAUGE.match(name)
+        if m is None:
+            problems.append(f"malformed per-shard gauge name {name!r}")
+            continue
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            problems.append(f"gauge {name} has non-numeric value {v!r}")
+        elif m.group(2) == "doc_fill" and not (0.0 <= v <= 1.0):
+            problems.append(
+                f"gauge {name} = {v!r} outside the [0, 1] fill range"
+            )
+    return problems
 
 
 def _check_bucket_labels(name: str, buckets: dict) -> list:
@@ -122,6 +149,8 @@ def check_snapshot(doc, require_groups: tuple = ()) -> list:
                     )
     if not isinstance(doc["gauges"], dict):
         problems.append("`gauges` is not an object")
+    else:
+        problems.extend(_check_shard_gauges(doc["gauges"]))
     hists = doc["histograms"]
     if not isinstance(hists, dict):
         problems.append("`histograms` is not an object")
