@@ -1,0 +1,21 @@
+"""Incremental validation plane: the content-addressed result cache.
+
+`results` holds the persistence layer (keying, atomic entry files,
+corrupt-entry-is-a-miss loads); the sweep/validate wiring lives with
+the callers in `commands.sweep` and `ops.backend`.
+"""
+
+from .results import (  # noqa: F401
+    RESULT_COUNTERS,
+    RESULT_SCHEMA_VERSION,
+    config_hash,
+    doc_digest,
+    load_entry,
+    reset_result_cache_stats,
+    result_cache_dir,
+    result_cache_enabled,
+    result_cache_stats,
+    result_key,
+    set_delta_gauge,
+    store_entry,
+)

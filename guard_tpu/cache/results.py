@@ -1,0 +1,226 @@
+"""Content-addressed per-document result cache (ROADMAP item 3).
+
+The compiled-plan layer (`ops/plan.py`) made the *programs* warm; this
+layer makes the *results* warm. A CI fleet re-validating a corpus that
+is 99% unchanged between commits pays device dispatch only for the
+delta: each document's validation outcome is persisted keyed by
+
+    sha256(result schema version;
+           plan digest            -- covers rule bytes in order,
+                                     guard_tpu version, device census,
+                                     bucket shape, pack config
+           doc content sha256;
+           output-mode/config hash)
+
+so invalidation is purely structural — any change to the doc bytes,
+the rule content, the guard_tpu version, or the device census changes
+the key. No mtime heuristics, no TTLs. The caching contract rides the
+plan layer's relocation contract: statuses are invariant under batch
+composition and intern-id labels, so a result computed in one chunk
+shape replays bit-identically in any other.
+
+Entries store per-doc status/rim blocks and materialized report
+fragments — NOT raw stdout bytes — and are replayed through the
+existing lazy report path, so console/yaml/structured/junit modes all
+reconstruct exactly. Discipline matches the plan artifact layer:
+atomic tmp+rename writes, and a corrupt / truncated / mismatched
+entry is a logged MISS (rewritten after the recompute), never an
+error. The `cache` fault-injection point (`utils/faults.py`) proves
+the degradation path in CI.
+
+Escape hatches: `GUARD_TPU_RESULT_CACHE=0` or `--no-result-cache`
+bypasses the layer entirely (full dispatch, bit-identical output).
+
+This module imports no jax (serve sessions stay jax-free until a
+tpu-backend request arrives).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+from pathlib import Path
+from typing import Optional
+
+from ..utils.faults import maybe_fail
+from ..utils.telemetry import REGISTRY as _TELEMETRY
+from ..utils.telemetry import span as _span
+
+log = logging.getLogger("guard_tpu.result_cache")
+
+#: bump when the entry layout changes — old entries then key to
+#: different digests and age out as misses
+RESULT_SCHEMA_VERSION = 1
+
+#: result-cache observability, in every --metrics-out snapshot and
+#: reset by backend.reset_all_stats(): `hits`/`misses` per-doc lookup
+#: outcomes (a 0%-changed warm sweep shows hits == docs and zero pack
+#: dispatches), `stores` write-backs, `corrupt_entries` the subset of
+#: misses that found an unusable entry on disk, bytes_* disk traffic.
+RESULT_COUNTERS = _TELEMETRY.counter_group(
+    "result_cache",
+    {
+        "hits": 0,
+        "misses": 0,
+        "stores": 0,
+        "corrupt_entries": 0,
+        "bytes_loaded": 0,
+        "bytes_stored": 0,
+    },
+)
+
+
+def result_cache_stats() -> dict:
+    return _TELEMETRY.group_stats("result_cache")
+
+
+def reset_result_cache_stats() -> None:
+    _TELEMETRY.reset_group("result_cache")
+
+
+def set_delta_gauge(delta_docs: int, total_docs: int) -> None:
+    """Publish the partition outcome of one run: how many docs had to
+    encode+dispatch, out of how many eligible."""
+    _TELEMETRY.set_gauge("result_cache.delta_docs", int(delta_docs))
+    _TELEMETRY.set_gauge("result_cache.total_docs", int(total_docs))
+
+
+def result_cache_enabled(flag: bool = True) -> bool:
+    """The layer's on switch: the caller's --no-result-cache flag AND
+    the `GUARD_TPU_RESULT_CACHE=0` env escape hatch (read at call time
+    so one process can compare both paths — the parity tests do)."""
+    return bool(flag) and os.environ.get(
+        "GUARD_TPU_RESULT_CACHE", "1"
+    ) != "0"
+
+
+def result_cache_dir() -> Path:
+    d = os.environ.get("GUARD_TPU_RESULT_CACHE_DIR", "").strip()
+    if d:
+        return Path(d)
+    return Path(os.path.expanduser("~")) / ".cache" / "guard_tpu" / "results"
+
+
+def doc_digest(content) -> str:
+    """sha256 of one document's bytes (str content hashes its utf-8)."""
+    if isinstance(content, str):
+        content = content.encode()
+    return hashlib.sha256(content).hexdigest()
+
+
+def config_hash(**fields) -> str:
+    """Hash of everything in the OUTPUT contract that is not covered by
+    the plan digest or the doc bytes: output mode, summary type, rule
+    naming, packing mode — any knob that changes report text or tally
+    shape for the same validation verdict. Key/value JSON so field
+    order cannot perturb the digest."""
+    blob = json.dumps(fields, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def result_key(plan_digest: str, doc_sha: str, cfg_hash: str) -> str:
+    """Content address of one (doc, registry, output config) result."""
+    h = hashlib.sha256()
+    h.update(f"schema={RESULT_SCHEMA_VERSION};".encode())
+    h.update(f"plan={plan_digest};".encode())
+    h.update(f"doc={doc_sha};".encode())
+    h.update(f"config={cfg_hash};".encode())
+    return h.hexdigest()
+
+
+def _entry_path(key: str) -> Path:
+    return result_cache_dir() / f"{key}.result.json"
+
+
+def store_entry(key: str, payload: dict) -> bool:
+    """Persist one doc's result payload; atomic (tmp + rename) so
+    concurrent writers and torn writes can only ever produce a whole
+    entry or a miss. Failures warn and return False — persistence is
+    an optimization, never a correctness dependency."""
+    with _span("cache_store"):
+        try:
+            maybe_fail("cache", key)
+            doc = {
+                "schema": RESULT_SCHEMA_VERSION,
+                "version": _guard_version(),
+                "key": key,
+                "payload": payload,
+            }
+            blob = json.dumps(doc, separators=(",", ":")).encode()
+            path = _entry_path(key)
+            path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = path.with_suffix(f".tmp.{os.getpid()}")
+            tmp.write_bytes(blob)
+            os.replace(tmp, path)
+        except Exception as e:
+            log.warning("result-cache store failed (%s); continuing "
+                        "without persistence", e)
+            return False
+        RESULT_COUNTERS["stores"] += 1
+        RESULT_COUNTERS["bytes_stored"] += len(blob)
+        return True
+
+
+def load_entry(key: str, name: Optional[str] = None) -> Optional[dict]:
+    """Load one doc's result payload, or None on ANY problem — absent
+    file, truncated JSON, schema/version/key mismatch. A corrupt entry
+    logs a warning and counts as a miss; the recompute's store rewrites
+    it. Counters are the caller-facing hit/miss ledger: exactly one of
+    hits/misses increments per call.
+
+    `name` guards replay fidelity: report text embeds the document's
+    file name, which the content-addressed key deliberately excludes.
+    A same-content doc under a different name replays only when the
+    writer marked the entry `portable` (the serialized name appears
+    nowhere but the report's top-level name field, so the reader can
+    substitute its own); otherwise the mismatch is a plain miss (not
+    corrupt), recomputed and stored under the new name."""
+    path = _entry_path(key)
+    with _span("cache_lookup"):
+        try:
+            maybe_fail("cache", key)
+            if not path.exists():
+                RESULT_COUNTERS["misses"] += 1
+                return None
+            blob = path.read_bytes()
+            doc = json.loads(blob)
+            if not isinstance(doc, dict):
+                raise ValueError("entry is not an object")
+            if doc.get("schema") != RESULT_SCHEMA_VERSION:
+                raise ValueError(
+                    f"schema {doc.get('schema')!r} != "
+                    f"{RESULT_SCHEMA_VERSION}"
+                )
+            if doc.get("version") != _guard_version():
+                raise ValueError("guard_tpu version mismatch")
+            if doc.get("key") != key:
+                raise ValueError("key mismatch")
+            payload = doc.get("payload")
+            if not isinstance(payload, dict):
+                raise ValueError("entry payload is not an object")
+        except Exception as e:
+            log.warning(
+                "result-cache entry %s unusable (%s); treating as a "
+                "cache miss", path.name, e,
+            )
+            RESULT_COUNTERS["misses"] += 1
+            RESULT_COUNTERS["corrupt_entries"] += 1
+            return None
+        if (
+            name is not None
+            and payload.get("name") != name
+            and not payload.get("portable")
+        ):
+            RESULT_COUNTERS["misses"] += 1
+            return None
+        RESULT_COUNTERS["hits"] += 1
+        RESULT_COUNTERS["bytes_loaded"] += len(blob)
+        return payload
+
+
+def _guard_version() -> str:
+    from .. import __version__
+
+    return __version__

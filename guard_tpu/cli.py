@@ -133,6 +133,20 @@ def build_parser() -> argparse.ArgumentParser:
         "visible) picks 2x1, 'off' is the single-device escape hatch "
         "(overrides GUARD_TPU_MESH)",
     )
+    v.add_argument(
+        "--no-result-cache",
+        action="store_true",
+        help="tpu backend: disable the incremental validation plane "
+        "(always encode+dispatch every document instead of replaying "
+        "unchanged docs from the content-addressed result cache; "
+        "bit-parity escape hatch — also GUARD_TPU_RESULT_CACHE=0)",
+    )
+    v.add_argument(
+        "--delta-stats",
+        action="store_true",
+        help="tpu backend: print a result-cache partition summary "
+        "(cached vs dispatched docs) to stderr after the run",
+    )
     _add_telemetry_flags(v)
 
     t = sub.add_parser("test", help="Test rules against expectations")
@@ -223,6 +237,20 @@ def build_parser() -> argparse.ArgumentParser:
         "shape, e.g. 2x1 or 2x4; 'auto' (the default when >1 device is "
         "visible) picks 2x1, 'off' is the single-device escape hatch "
         "(overrides GUARD_TPU_MESH)",
+    )
+    s.add_argument(
+        "--no-result-cache",
+        action="store_true",
+        help="tpu backend: disable the incremental validation plane "
+        "(always encode+dispatch every document instead of replaying "
+        "unchanged docs from the content-addressed result cache; "
+        "bit-parity escape hatch — also GUARD_TPU_RESULT_CACHE=0)",
+    )
+    s.add_argument(
+        "--delta-stats",
+        action="store_true",
+        help="tpu backend: print a result-cache partition summary "
+        "(cached vs dispatched docs) to stderr after the run",
     )
     _add_telemetry_flags(s)
 
@@ -370,6 +398,27 @@ def _session_epilogue(args, rc: Optional[int], dt: float) -> None:
 
     if not ledger.ledger_enabled():
         return
+    # incremental-plane session shape: what fraction of eligible docs
+    # actually hit the device (None when the run never partitioned)
+    extra = None
+    try:
+        gauges = telemetry.REGISTRY.snapshot().get("gauges", {})
+        total = gauges.get("result_cache.total_docs")
+        if total:
+            extra = {
+                "delta_docs": gauges.get("result_cache.delta_docs"),
+                "total_docs": total,
+                "delta_fraction": gauges.get(
+                    "result_cache.delta_docs", 0
+                ) / total,
+            }
+            # the registry is process-global: zero the gauges so a
+            # later session that never partitions (cpu backend, cache
+            # off) cannot inherit this session's delta story
+            telemetry.REGISTRY.set_gauge("result_cache.delta_docs", 0)
+            telemetry.REGISTRY.set_gauge("result_cache.total_docs", 0)
+    except Exception:
+        extra = None
     try:
         ledger.append_record(
             kind=args.command,
@@ -380,6 +429,7 @@ def _session_epilogue(args, rc: Optional[int], dt: float) -> None:
             },
             config=dict(sorted(vars(args).items())),
             exit_code=rc,
+            extra=extra,
         )
     except Exception:
         pass
@@ -412,6 +462,8 @@ def _dispatch(args, writer: Writer, reader: Reader) -> int:
                 ingest_workers=args.ingest_workers,
                 max_doc_failures=args.max_doc_failures,
                 plan_cache=not args.no_plan_cache,
+                result_cache=not args.no_result_cache,
+                delta_stats=args.delta_stats,
             )
             return cmd.execute(writer, reader)
         if args.command == "test":
@@ -441,6 +493,8 @@ def _dispatch(args, writer: Writer, reader: Reader) -> int:
                 ingest_workers=args.ingest_workers,
                 max_doc_failures=args.max_doc_failures,
                 plan_cache=not args.no_plan_cache,
+                result_cache=not args.no_result_cache,
+                delta_stats=args.delta_stats,
             ).execute(writer, reader)
         if args.command == "parse-tree":
             return ParseTree(

@@ -176,4 +176,23 @@ class OpsReport:
         for name, val in sorted((metrics.get("gauges") or {}).items()):
             if name.startswith("efficiency."):
                 writer.writeln(f"  {name}: {_fmt_val(val)}")
+        # incremental plane: how much of the run the result cache
+        # absorbed (hits / lookups) and the delta fraction the session
+        # actually dispatched
+        rcache = (metrics.get("counters") or {}).get("result_cache")
+        if rcache:
+            hits = rcache.get("hits", 0)
+            lookups = hits + rcache.get("misses", 0)
+            if lookups:
+                writer.writeln(
+                    f"  result-cache hit rate: {hits / lookups:.1%} "
+                    f"({hits:,}/{lookups:,} lookups)"
+                )
+        extra = rec.get("extra") or {}
+        if extra.get("delta_fraction") is not None:
+            writer.writeln(
+                f"  delta fraction: {extra['delta_fraction']:.1%} "
+                f"({extra.get('delta_docs')}/{extra.get('total_docs')} "
+                "docs dispatched)"
+            )
         return 0

@@ -85,6 +85,7 @@ from typing import Optional
 
 from ..core.errors import ParseError
 from ..core.parser import parse_rules_file
+from ..ops.plan import plan_digest
 from ..utils import telemetry
 from ..utils.io import Reader, Writer
 from ..utils.telemetry import SERVE_COUNTERS
@@ -369,8 +370,6 @@ class Serve:
             and prepared is not None
         ):
             SERVE_COUNTERS["coalesce_eligible"] += 1
-            from ..ops.plan import plan_digest
-
             try:
                 code = self._get_batcher().submit(
                     cmd, payload, plan_digest(prepared), buf,

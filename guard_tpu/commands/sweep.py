@@ -106,6 +106,14 @@ def _read_manifest(path: Path) -> Dict[int, dict]:
     return done
 
 
+def _fault_level() -> int:
+    """Sum of every failure-plane counter: the incremental plane's
+    did-anything-degrade probe, compared around one chunk's compute —
+    any movement (injected faults, retries, fallbacks, quarantines)
+    disqualifies that chunk's result-cache store-backs."""
+    return int(sum(FAULT_COUNTERS.values()))
+
+
 @dataclass
 class Sweep:
     rules: List[str] = field(default_factory=list)
@@ -140,6 +148,17 @@ class Sweep:
     # --no-plan-cache / GUARD_TPU_PLAN_CACHE=0 restores per-chunk
     # lowering (bit-parity escape hatch)
     plan_cache: bool = True
+    # incremental validation plane (cache/results.py): per-doc outcomes
+    # persist under GUARD_TPU_RESULT_CACHE_DIR keyed by (doc content
+    # sha256, plan digest, config hash); unchanged docs replay from
+    # cache with byte-identical manifest rows / summary / exit codes
+    # and only the delta encodes + dispatches. --no-result-cache /
+    # GUARD_TPU_RESULT_CACHE=0 restores full dispatch (bit-parity
+    # escape hatch)
+    result_cache: bool = True
+    # --delta-stats: one stderr summary line with the run's hit/delta
+    # split (stdout stays byte-identical either way)
+    delta_stats: bool = False
 
     def execute(self, writer: Writer, reader: Reader) -> int:
         if not self.rules:
@@ -165,6 +184,10 @@ class Sweep:
         manifest_path.parent.mkdir(parents=True, exist_ok=True)
 
         evaluated = skipped = 0
+        # incremental-plane accumulators: [cache hits, delta docs]
+        # across every chunk this run partitioned (--delta-stats and
+        # the run-ledger delta fraction read them)
+        self._delta_seen = [0, 0]
         todo = []
         for ci, chunk in enumerate(chunks):
             sig = _chunk_signature(chunk)
@@ -208,8 +231,12 @@ class Sweep:
                     return
                 err_box2 = [0, []]
                 dfs = self._read_chunk(chunk2, writer, err_box2)
-                enc = self._encode_chunk(dfs, writer, err_box2)
-                prepared[ci2] = (dfs, enc, err_box2)
+                # incremental plane: partition BEFORE encode — cached
+                # docs never columnarize, only the delta pays encode
+                ctx2 = self._cache_lookup(dfs, rule_files)
+                delta2, _ = self._cache_subset(ctx2, dfs, None)
+                enc = self._encode_chunk(delta2, writer, err_box2)
+                prepared[ci2] = (dfs, ctx2, delta2, enc, err_box2)
 
             with manifest_path.open("a") as mf:
                 for j, (ci, sig, chunk) in enumerate(todo):
@@ -253,6 +280,13 @@ class Sweep:
                 # byte-identical to the pre-failure-plane output
                 summary["quarantined"] = quarantined
             writer.writeln(json.dumps(summary))
+        if self.delta_stats:
+            hits, delta = self._delta_seen
+            total = hits + delta
+            writer.writeln_err(
+                f"result-cache: {hits}/{total} docs cached, "
+                f"{delta} dispatched"
+            )
         # exit-code semantics: quarantined documents are PARTIAL
         # failure — ERROR only past --max-doc-failures (default
         # unlimited; 0 restores the historical any-doc-error-is-fatal
@@ -355,8 +389,16 @@ class Sweep:
                 )
                 _top_up()
                 err_box = [pre_err, pre_recs]
+                # incremental plane: the workers encoded the whole
+                # chunk (overlapped with device work, as before); the
+                # partition subsets the batch at dequeue so only the
+                # delta reaches dispatch
+                ctx = self._cache_lookup(data_files, rule_files)
+                delta_files, encoded = self._cache_subset(
+                    ctx, data_files, encoded
+                )
                 state = self._dispatch_tpu(
-                    data_files, rule_files, writer, err_box,
+                    delta_files, rule_files, writer, err_box,
                     encoded=encoded, vec_box={},
                 )
                 if inflight is not None:
@@ -365,7 +407,8 @@ class Sweep:
                     mf.write(json.dumps(rec) + "\n")
                     mf.flush()
                     evaluated += 1
-                inflight = (ci, sig, chunk, data_files, state, err_box)
+                inflight = (ci, sig, chunk, data_files, ctx, delta_files,
+                            state, err_box)
             if inflight is not None:
                 ci_prev, rec = self._finish_chunk(inflight, writer)
                 done[ci_prev] = rec
@@ -489,14 +532,16 @@ class Sweep:
         """Stage 3 for one chunk: collect the dispatched device work,
         run oracle fallbacks, fold the tallies and build the manifest
         record — while the NEXT chunk's device work executes."""
-        ci, sig, chunk, data_files, state, err_box = inflight
+        (ci, sig, chunk, data_files, ctx, delta_files, state,
+         err_box) = inflight
         counts = {k: 0 for k in _STATUS_NAMES}
         failed: List[dict] = []
-        per_doc: List[Dict[str, Status]] = [dict() for _ in data_files]
+        per_doc: List[Dict[str, Status]] = [dict() for _ in delta_files]
         errors = self._collect_tpu(state, per_doc, writer, err_box)
         errors += err_box[0]
         self._tally_chunk(
-            data_files, per_doc, state.get("vec_box") or {}, counts, failed
+            data_files, ctx, delta_files, per_doc,
+            state.get("vec_box") or {}, counts, failed,
         )
         rec = {
             "chunk": ci,
@@ -548,28 +593,36 @@ class Sweep:
 
         if prepared is not None:
             # read + encoded by the pipeline's prefetch (overlapped
-            # with the previous chunk's device execution)
-            data_files, encoded, pre_box = prepared
+            # with the previous chunk's device execution); the cache
+            # partition already ran there, before encode
+            data_files, ctx, delta_files, encoded, pre_box = prepared
             err_box[0] += pre_box[0]
             err_box[1].extend(pre_box[1])
         else:
             data_files = self._read_chunk(chunk, writer, err_box)
+            ctx = (
+                self._cache_lookup(data_files, rule_files)
+                if self.backend == "tpu" else None
+            )
+            delta_files, _ = self._cache_subset(ctx, data_files, None)
             encoded = None
 
-        per_doc: List[Dict[str, Status]] = [dict() for _ in data_files]
+        per_doc: List[Dict[str, Status]] = [dict() for _ in delta_files]
         vec_box: dict = {}
         if self.backend == "tpu":
             errors += self._eval_tpu(
-                data_files, rule_files, per_doc, writer, err_box,
+                delta_files, rule_files, per_doc, writer, err_box,
                 encoded=encoded, after_dispatch=prefetch, vec_box=vec_box,
             )
         else:
             errors += self._eval_oracle(
-                data_files, rule_files, None, per_doc, writer, err_box
+                delta_files, rule_files, None, per_doc, writer, err_box
             )
         errors += err_box[0]
 
-        self._tally_chunk(data_files, per_doc, vec_box, counts, failed)
+        self._tally_chunk(
+            data_files, ctx, delta_files, per_doc, vec_box, counts, failed
+        )
 
         rec = {
             "chunk": ci,
@@ -585,29 +638,157 @@ class Sweep:
             FAULT_COUNTERS["quarantined_docs"] += len(err_box[1])
         return rec
 
-    def _tally_chunk(self, data_files, per_doc, vec_box, counts,
-                     failed) -> None:
-        """Stage-3 tally for one chunk: the vectorized fold over the
-        rim blocks when active, the scalar per-doc walk otherwise.
-        Shared by the serial path and the pipeline's consumer stage."""
-        with _span("rim_reduce", {"docs": len(data_files)}):
-            if vec_box.get("active"):
-                self._tally_vectorized(
-                    data_files, vec_box, counts, failed
-                )
+    # -- incremental plane (cache/results.py) -------------------------
+    def _cache_lookup(self, data_files, rule_files):
+        """Result-cache partition for one chunk: per-doc content-
+        addressed lookups BEFORE encode. Returns None when the layer
+        is off, else a ctx dict: `cached` maps doc index -> replayed
+        outcome, `delta_idx` lists the docs that must encode+dispatch,
+        `keys` the per-doc content addresses for the store-back, and
+        `fault_snap` the failure-plane level at partition time (a chunk
+        that degraded anywhere is never written back)."""
+        from ..cache import results as rcache
+
+        if self.backend != "tpu" or not rcache.result_cache_enabled(
+            getattr(self, "result_cache", True)
+        ):
+            return None
+        from ..ops.plan import plan_digest
+
+        pdig = plan_digest(rule_files)
+        cfg = rcache.config_hash(mode="sweep")
+        cached: Dict[int, dict] = {}
+        keys: Dict[int, str] = {}
+        delta_idx: List[int] = []
+        for di, df in enumerate(data_files):
+            key = rcache.result_key(
+                pdig, rcache.doc_digest(df.content), cfg
+            )
+            keys[di] = key
+            # no name guard: sweep outcomes are name-free (manifest
+            # rows and the failed list take the name from the live
+            # file), so same-content docs share one entry
+            payload = rcache.load_entry(key)
+            out = payload.get("sweep") if payload else None
+            if (
+                isinstance(out, dict)
+                and out.get("status") in _STATUS_NAMES
+                and isinstance(out.get("fails"), list)
+            ):
+                cached[di] = out
             else:
-                for df, statuses in zip(data_files, per_doc):
-                    if getattr(df, "_pv_failed", False):
-                        continue  # unparseable doc: error counted, not tallied
-                    doc_status = Status.SKIP
-                    for st in statuses.values():
-                        doc_status = doc_status.and_(st)
-                    counts[doc_status.value.lower()] += 1
-                    fails = sorted(
-                        n for n, s in statuses.items() if s == Status.FAIL
-                    )
-                    if fails:
-                        failed.append({"data": df.name, "rules": fails})
+                delta_idx.append(di)
+        seen = getattr(self, "_delta_seen", None)
+        if seen is None:
+            seen = self._delta_seen = [0, 0]
+        seen[0] += len(cached)
+        seen[1] += len(delta_idx)
+        rcache.set_delta_gauge(seen[1], seen[0] + seen[1])
+        return {
+            "cached": cached,
+            "delta_idx": delta_idx,
+            "keys": keys,
+            "fault_snap": _fault_level(),
+        }
+
+    @staticmethod
+    def _cache_subset(ctx, data_files, encoded):
+        """Extract the delta from a chunk: the files that must
+        dispatch, and (when the ingest workers already columnarized
+        the whole chunk) the matching row subset of the encoded
+        batch."""
+        if ctx is None or not ctx["cached"]:
+            return data_files, encoded
+        delta_idx = ctx["delta_idx"]
+        if not delta_idx:
+            return [], None
+        delta_files = [data_files[i] for i in delta_idx]
+        if encoded is not None:
+            from ..ops.encoder import take_doc_subset
+
+            batch, interner = encoded
+            encoded = (take_doc_subset(batch, delta_idx), interner)
+        return delta_files, encoded
+
+    def _tally_chunk(self, data_files, ctx, delta_files, per_doc,
+                     vec_box, counts, failed) -> None:
+        """Stage-3 tally for one chunk: per-doc outcomes from the
+        vectorized rim fold (or the scalar walk), merged with the
+        chunk's result-cache hits in ORIGINAL document order — counts,
+        the failed list and manifest rows stay byte-identical to the
+        cache-off run. Freshly computed outcomes write back unless the
+        doc (or the chunk's failure plane) disqualifies them."""
+        with _span("rim_reduce", {"docs": len(delta_files)}):
+            if vec_box.get("active"):
+                outcomes = self._outcomes_vectorized(delta_files, vec_box)
+            else:
+                outcomes = self._outcomes_scalar(delta_files, per_doc)
+        if ctx is None or not ctx["cached"]:
+            store = ctx is not None and ctx["delta_idx"]
+            for pos, (df, out) in enumerate(zip(delta_files, outcomes)):
+                if store:
+                    self._cache_store(ctx, pos, df, out, vec_box)
+                if out is None:
+                    continue
+                counts[out["status"]] += 1
+                if out["fails"]:
+                    failed.append({"data": df.name, "rules": out["fails"]})
+            return
+        delta_pos = {di: k for k, di in enumerate(ctx["delta_idx"])}
+        for di, df in enumerate(data_files):
+            out = ctx["cached"].get(di)
+            if out is None:
+                pos = delta_pos[di]
+                out = outcomes[pos]
+                self._cache_store(ctx, pos, df, out, vec_box)
+            if out is None:
+                continue
+            counts[out["status"]] += 1
+            if out["fails"]:
+                failed.append({"data": df.name, "rules": out["fails"]})
+
+    def _cache_store(self, ctx, pos, df, out, vec_box) -> None:
+        """Write back one freshly computed outcome. Never cached: docs
+        that quarantined/unparsed (out is None), docs the device could
+        not cover (oversize host fallbacks, per-doc oracle errors —
+        the chunk's `nostore` set), and whole chunks during which ANY
+        fault/recovery counter moved. Deterministic unsure reruns DO
+        cache: the precision ladder yields the same statuses on every
+        run, so replaying them is bit-identical."""
+        if out is None or ctx is None:
+            return
+        if pos in (vec_box.get("nostore") or ()):
+            return
+        if _fault_level() != ctx["fault_snap"]:
+            return
+        from ..cache import results as rcache
+
+        di = ctx["delta_idx"][pos]
+        rcache.store_entry(
+            ctx["keys"][di], {"name": df.name, "sweep": out}
+        )
+
+    @staticmethod
+    def _outcomes_scalar(data_files, per_doc) -> list:
+        """Per-doc (status, fails) outcomes from the scalar per_doc
+        dicts — the old tally body, emitting values instead of
+        mutating counters so cached outcomes can interleave."""
+        outcomes = []
+        for df, statuses in zip(data_files, per_doc):
+            if getattr(df, "_pv_failed", False):
+                # unparseable doc: error counted, not tallied
+                outcomes.append(None)
+                continue
+            doc_status = Status.SKIP
+            for st in statuses.values():
+                doc_status = doc_status.and_(st)
+            fails = sorted(
+                n for n, s in statuses.items() if s == Status.FAIL
+            )
+            outcomes.append(
+                {"status": doc_status.value.lower(), "fails": fails}
+            )
+        return outcomes
 
     @staticmethod
     def _pv(df, writer, err_box):
@@ -985,6 +1166,11 @@ class Sweep:
             packed_results = {}
 
         recs: list = []
+        # incremental plane: docs the device could not cover (oversize
+        # host fallbacks, fault-degraded buckets) and docs whose oracle
+        # pass errored are never written back to the result cache;
+        # deterministic unsure reruns DO cache
+        nostore: set = set()
         D = len(data_files)
         for fi, (rf, rf_batch, compiled) in enumerate(prep):
             unsure = None
@@ -1039,9 +1225,10 @@ class Sweep:
             # so the host-rules pass below excludes them (no
             # double-evaluation / double-counted errors)
             if host_docs:
+                nostore |= {int(i) for i in host_docs}
                 errors += self._eval_oracle(
                     data_files, [rf], {"only_docs": host_docs}, target,
-                    writer, err_box,
+                    writer, err_box, bad_docs=nostore,
                 )
             # host fallback: unlowerable rules run on the oracle for
             # every other doc; unsure-flagged docs re-run all rules
@@ -1060,6 +1247,7 @@ class Sweep:
                         target,
                         writer,
                         err_box,
+                        bad_docs=nostore,
                     )
             unsure_any = None
             if unsure is not None:
@@ -1074,9 +1262,14 @@ class Sweep:
                     int(di) for di in np.nonzero(unsure_any)[0]
                 }
                 if oracle_docs:
+                    # unsure reruns are the DESIGNED precision ladder
+                    # (device flags a shape it can't decide, the pure-
+                    # Python oracle settles it deterministically), so
+                    # their outcomes cache; only reruns that ERROR
+                    # join nostore via bad_docs
                     errors += self._eval_oracle(
                         data_files, [rf], {"only_docs": oracle_docs},
-                        target, writer, err_box,
+                        target, writer, err_box, bad_docs=nostore,
                     )
             if vec_on:
                 recs.append(
@@ -1086,17 +1279,20 @@ class Sweep:
         if vec_box is not None:
             vec_box["active"] = vec_on
             vec_box["files"] = recs
+            vec_box["nostore"] = nostore
         return errors
 
     @staticmethod
-    def _tally_vectorized(data_files, vec_box, counts, failed) -> None:
-        """Chunk tallies from the per-file rim blocks: per-doc status =
-        the lattice fold over each rule name's WINNING value (dict
-        overwrite order: later files beat earlier ones, the last
-        same-name rule beats previous ones — exactly what the scalar
-        per_doc fill produced). Docs an oracle touched replay the dict
-        build (device names first, that file's oracle writes after, per
-        file in order); everything else folds as one numpy pass."""
+    def _outcomes_vectorized(data_files, vec_box) -> list:
+        """Per-doc (status, fails) outcomes from the per-file rim
+        blocks: per-doc status = the lattice fold over each rule
+        name's WINNING value (dict overwrite order: later files beat
+        earlier ones, the last same-name rule beats previous ones —
+        exactly what the scalar per_doc fill produced). Docs an oracle
+        touched replay the dict build (device names first, that file's
+        oracle writes after, per file in order); everything else folds
+        as one numpy pass. Emits outcome values (None for unparseable
+        docs) so _tally_chunk can interleave cache hits."""
         import numpy as np
 
         from ..ops.ir import FAIL
@@ -1124,9 +1320,12 @@ class Sweep:
             # PASS=0,FAIL=1,SKIP=2 -> priority SKIP<PASS<FAIL
             prio = np.array([1, 2, 0], np.int8)[M]
             doc_prio = prio.max(axis=1)
+        outcomes = []
         for di, df in enumerate(data_files):
             if getattr(df, "_pv_failed", False):
-                continue  # unparseable doc: error counted, not tallied
+                # unparseable doc: error counted, not tallied
+                outcomes.append(None)
+                continue
             if di in replay:
                 d: Dict[str, Status] = {}
                 for names, name_last, has_device, host_docs_f, owrites_f in recs:
@@ -1137,21 +1336,21 @@ class Sweep:
                 doc_status = Status.SKIP
                 for st in d.values():
                     doc_status = doc_status.and_(st)
-                counts[doc_status.value.lower()] += 1
+                status = doc_status.value.lower()
                 fails = sorted(n for n, s in d.items() if s == Status.FAIL)
             else:
                 p = int(doc_prio[di]) if doc_prio is not None else 0
-                counts[("skip", "pass", "fail")[p]] += 1
+                status = ("skip", "pass", "fail")[p]
                 fails = []
                 if p == 2:
                     fails = sorted(
                         wnames[c] for c in np.nonzero(M[di] == FAIL)[0]
                     )
-            if fails:
-                failed.append({"data": df.name, "rules": fails})
+            outcomes.append({"status": status, "fails": fails})
+        return outcomes
 
     def _eval_oracle(self, data_files, rule_files, restrict, per_doc, writer,
-                     err_box) -> int:
+                     err_box, bad_docs=None) -> int:
         from .report import rule_statuses_from_root
 
         only_docs = restrict.get("only_docs") if restrict else None
@@ -1173,6 +1372,11 @@ class Sweep:
                     except GuardError as e:
                         writer.writeln_err(f"{df.name} vs {rf.name}: {e}")
                         errors += 1
+                        # an oracle-errored doc is incomplete: its
+                        # stderr line must re-emit on every run, so it
+                        # never enters the result cache
+                        if bad_docs is not None:
+                            bad_docs.add(di)
                         continue
                     statuses = rule_statuses_from_root(
                         scope.reset_recorder().extract()

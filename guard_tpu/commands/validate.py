@@ -178,6 +178,15 @@ class Validate:
     # processes; `--no-plan-cache` / GUARD_TPU_PLAN_CACHE=0 restores
     # per-call lowering (bit-parity escape hatch)
     plan_cache: bool = True
+    # TPU backend: incremental validation plane (cache/results.py) —
+    # replay unchanged documents from the content-addressed result
+    # cache and encode+dispatch only the delta;
+    # `--no-result-cache` / GUARD_TPU_RESULT_CACHE=0 restores the
+    # full-dispatch path (bit-parity escape hatch)
+    result_cache: bool = True
+    # print the partition summary (cached vs dispatched docs) to
+    # stderr after the run — stdout stays byte-identical
+    delta_stats: bool = False
 
     # -- argument validation (validate.rs:205-232) --------------------
     def _validate_args(self) -> None:

@@ -723,6 +723,42 @@ def split_batch_by_size(
     return groups, oversize
 
 
+def take_doc_subset(batch: DocBatch, idx) -> DocBatch:
+    """Arbitrary doc-index subset of an encoded batch (the incremental
+    plane's delta extraction: a worker-encoded full chunk minus its
+    result-cache hits). Node/edge widths are kept — statuses are
+    invariant under batch composition (the plan layer's relocation
+    contract), so the narrower batch evaluates identically and
+    split_batch_by_size re-buckets it as usual. Derived per-node
+    columns pass through so __post_init__ skips the edge re-scatter."""
+    idx = np.asarray(idx, dtype=np.int64)
+    if len(idx) == batch.n_docs:
+        return batch
+    return DocBatch(
+        node_kind=batch.node_kind[idx],
+        node_parent=batch.node_parent[idx],
+        scalar_id=batch.scalar_id[idx],
+        num_hi=batch.num_hi[idx],
+        num_lo=batch.num_lo[idx],
+        child_count=batch.child_count[idx],
+        edge_parent=batch.edge_parent[idx],
+        edge_child=batch.edge_child[idx],
+        edge_key_id=batch.edge_key_id[idx],
+        edge_index=batch.edge_index[idx],
+        edge_valid=batch.edge_valid[idx],
+        n_docs=len(idx),
+        n_nodes=batch.n_nodes,
+        n_edges=batch.n_edges,
+        node_key_id=batch.node_key_id[idx],
+        node_index=batch.node_index[idx],
+        node_parent_kind=batch.node_parent_kind[idx],
+        num_exotic=batch.num_exotic[idx],
+        fn_origin=(
+            batch.fn_origin[idx] if batch.fn_origin is not None else None
+        ),
+    )
+
+
 def encode_batch(docs: List[PV], interner: Optional[Interner] = None,
                  pad_nodes: Optional[int] = None, pad_edges: Optional[int] = None,
                  fn_values=None, fn_var_order=None,

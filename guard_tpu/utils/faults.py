@@ -44,7 +44,7 @@ from .telemetry import EventedCounters
 #: serving plane's coalescing batcher, before a grouped dispatch)
 POINTS = (
     "read", "parse", "encode", "worker_crash",
-    "dispatch", "collect", "oracle", "serve_batch",
+    "dispatch", "collect", "oracle", "serve_batch", "cache",
 )
 
 #: observability beside DISPATCH_COUNTERS / PIPELINE_COUNTERS /

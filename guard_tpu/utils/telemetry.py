@@ -68,7 +68,10 @@ from typing import Dict, List, Optional
 #: counter, the shard-prefetch pipeline counters, and the serve
 #: `coalesce_window_adaptive` counter (2-D mesh plane + adaptive
 #: coalesce window).
-SCHEMA_VERSION = 3
+#: v4: the `result_cache` counter group (incremental validation plane:
+#: per-doc hit/miss/store/bytes counters, delta_docs gauges, and the
+#: cache_lookup/cache_store spans) joined the snapshot contract.
+SCHEMA_VERSION = 4
 
 # fixed log2 histogram buckets: bucket i holds durations in
 # [2^(LOG2_LO+i-1), 2^(LOG2_LO+i)) seconds — ~1µs to ~128s, plus an

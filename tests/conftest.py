@@ -33,6 +33,18 @@ os.environ.setdefault(
     "GUARD_TPU_PLAN_CACHE_DIR", tempfile.mkdtemp(prefix="guard_plans_")
 )
 
+# The incremental plane's result cache is keyed by CONTENT (not path),
+# so two tests evaluating the same small fixture docs would cross-hit
+# and silently turn full-dispatch assertions (dispatch counters, rim
+# counters, fault ladders) into replays. Default it off for the suite;
+# the dedicated result-cache tests opt in with monkeypatch + a private
+# cache dir. The throwaway dir below covers any test that re-enables
+# the flag without overriding the directory.
+os.environ.setdefault("GUARD_TPU_RESULT_CACHE", "0")
+os.environ.setdefault(
+    "GUARD_TPU_RESULT_CACHE_DIR", tempfile.mkdtemp(prefix="guard_results_")
+)
+
 # The flight recorder is armed by default in production (abnormal exits
 # dump forensics into the working directory). The suite exercises
 # hundreds of deliberate exit-5 paths — without this default-off, every

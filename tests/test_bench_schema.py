@@ -195,6 +195,48 @@ def test_checker_requires_plan_cache_keys(tmp_path):
     assert any("plan_bytes_loaded" in p for p in problems)
 
 
+def test_expected_metrics_cover_delta_rows():
+    """PR 11: the incremental-plane regime rows (cache-off cold,
+    0%-changed warm, 1%-changed) are part of the driver contract and
+    gated by the schema checker, arriving with the round-14
+    artifact."""
+    metrics = bench.expected_metrics()
+    for m in (
+        "config5b_delta_cold_templates_per_sec",
+        "config5b_delta_warm_templates_per_sec",
+        "config5b_delta_1pct_templates_per_sec",
+    ):
+        assert m in metrics
+        assert check_bench_schema.metric_since(m) == 14
+
+
+def test_checker_requires_delta_keys(tmp_path):
+    """A delta-regime row missing the result_cache counters or the
+    per-run dispatch count fails the gate."""
+    row = {
+        "metric": "config5b_delta_warm_templates_per_sec",
+        "value": 1.0,
+        "unit": "templates/sec",
+        "vs_baseline": 5.0,
+        "result_hits": 1024,
+        # dispatches_per_run + misses/stores/bytes keys missing
+    }
+    src = _newest_artifact().read_text().splitlines()
+    doctored = tmp_path / "bench_all_doctored_delta.json"
+    doctored.write_text(
+        "\n".join(
+            ln for ln in src
+            if '"config5b_delta_warm_templates_per_sec"' not in ln
+        )
+        + "\n"
+        + __import__("json").dumps(row)
+        + "\n"
+    )
+    problems = check_bench_schema.check(doctored)
+    assert any("dispatches_per_run" in p for p in problems)
+    assert any("result_bytes_loaded" in p for p in problems)
+
+
 def test_registry_stage_seconds_reconcile_with_wall_time(tmp_path):
     """The registry-derived stage decomposition bench.py reports must
     account for the run it claims to decompose: summing the top-level

@@ -448,6 +448,49 @@ def test_report_efficiency_renders_utilization(tmp_path):
     assert "doc slot fill:" in out and "node slot fill:" in out
 
 
+def test_report_efficiency_renders_result_cache_story(tmp_path):
+    """The incremental plane's face in `report --efficiency`: the
+    hit rate comes from the captured result_cache counter group, the
+    delta fraction from the session record's extra block."""
+    from guard_tpu.cache import results as rcache
+
+    os.environ["GUARD_TPU_LEDGER_DIR"] = str(tmp_path)
+    rcache.reset_result_cache_stats()
+    rcache.RESULT_COUNTERS["hits"] += 3
+    rcache.RESULT_COUNTERS["misses"] += 1
+    ledger.append_record(
+        "validate", exit_code=0,
+        extra={"delta_docs": 1, "total_docs": 4, "delta_fraction": 0.25},
+    )
+    rcache.reset_result_cache_stats()
+    rc, out, _ = _cli("report", "--efficiency")
+    assert rc == 0
+    assert "result-cache hit rate: 75.0% (3/4 lookups)" in out
+    assert "delta fraction: 25.0% (1/4 docs dispatched)" in out
+
+
+def test_session_epilogue_records_delta_fraction(tmp_path, monkeypatch):
+    """A tpu validate session that partitioned against the result
+    cache carries its delta fraction in the ledger record's extra."""
+    monkeypatch.setenv("GUARD_TPU_RESULT_CACHE", "1")
+    monkeypatch.setenv(
+        "GUARD_TPU_RESULT_CACHE_DIR", str(tmp_path / "rcache")
+    )
+    os.environ["GUARD_TPU_LEDGER_DIR"] = str(tmp_path)
+    rules, data = _mk_corpus(tmp_path, n=4, fail=())
+    args = ("validate", "-r", str(rules), "-d", str(data),
+            "--backend", "tpu")
+    rc, _out, _err = _cli(*args)
+    assert rc == 0
+    rc, _out, _err = _cli(*args)  # warm: all 4 docs replay
+    assert rc == 0
+    recs = ledger.read_ledger()
+    assert recs[-2]["extra"]["delta_fraction"] == 1.0
+    assert recs[-1]["extra"] == {
+        "delta_docs": 0, "total_docs": 4, "delta_fraction": 0.0
+    }
+
+
 def test_session_epilogue_appends_one_record_per_session(tmp_path):
     os.environ["GUARD_TPU_LEDGER_DIR"] = str(tmp_path)
     rules, data = _mk_corpus(tmp_path, n=4, fail=())

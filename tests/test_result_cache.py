@@ -1,0 +1,486 @@
+"""Incremental validation plane suite (guard_tpu/cache/results.py):
+cache-key sensitivity (doc bytes, rule content, guard_tpu version,
+output config each flip the key; file names never do), entry
+round-trips with the portable-name contract, corrupt / truncated /
+mismatched entries degrading to logged misses, and the end-to-end
+parity gates: warm-cache and --no-result-cache runs must be
+byte-identical across output modes, worker counts, pack modes and
+exit codes; quarantined and oracle-error docs never enter the cache;
+mixed 50%-hit chunks interleave cached and fresh outcomes in document
+order. The result cache buys dispatches, never bits."""
+
+import json
+
+import pytest
+
+from guard_tpu.cache import results as rcache
+from guard_tpu.cli import run
+from guard_tpu.commands.validate import RuleFile
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.ops import plan as plan_mod
+from guard_tpu.utils.io import Reader, Writer
+
+RULES_A = (
+    "let b = Resources.*[ Type == 'AWS::S3::Bucket' ]\n"
+    "rule sse when %b !empty { %b.Properties.Enc == true }\n"
+)
+RULES_B = (
+    "rule named { Resources.*.Properties.Name in ['web', 'db'] }\n"
+    "rule arnish { Resources.*.Properties.Arn == /^arn:aws:/ }\n"
+)
+# EMPTY on an int raises GuardError in the oracle: the doc's stderr
+# line must re-emit on every run, so it can never be served from cache
+RULES_ERR = "rule em { Resources.R1.Properties.X !empty }\n"
+
+
+def _rule_file(content: str, name: str = "r.guard") -> RuleFile:
+    return RuleFile(
+        name=name, full_name=name, content=content,
+        rules=parse_rules_file(content, name),
+    )
+
+
+@pytest.fixture(autouse=True)
+def _fresh_result_cache(tmp_path, monkeypatch):
+    """The suite-wide conftest defaults the layer OFF (content-keyed
+    entries would cross-hit between tests sharing fixture docs); each
+    test here opts in with a private store."""
+    monkeypatch.setenv("GUARD_TPU_RESULT_CACHE", "1")
+    monkeypatch.setenv(
+        "GUARD_TPU_RESULT_CACHE_DIR", str(tmp_path / "results")
+    )
+    rcache.reset_result_cache_stats()
+    yield
+    rcache.reset_result_cache_stats()
+
+
+def _mk_corpus(tmp_path, n=6, fail=(1, 4), extra_rules=(RULES_B,)):
+    data = tmp_path / "data"
+    data.mkdir(exist_ok=True)
+    rule_paths = []
+    for i, content in enumerate((RULES_A,) + tuple(extra_rules)):
+        p = tmp_path / f"rules{i}.guard"
+        p.write_text(content)
+        rule_paths.append(str(p))
+    for i in range(n):
+        doc = {
+            "Resources": {
+                f"b{i}": {
+                    "Type": "AWS::S3::Bucket",
+                    "Properties": {
+                        "Enc": i not in fail,
+                        "Name": "web" if i % 2 else "worker",
+                        "Arn": f"arn:aws:s3:::b{i}",
+                    },
+                }
+            }
+        }
+        (data / f"t{i:02d}.json").write_text(json.dumps(doc))
+    return rule_paths, data
+
+
+# ------------------------------------------------------ cache key
+
+
+def test_result_key_sensitive_to_every_field():
+    base = rcache.result_key("plan0", "doc0", "cfg0")
+    assert base == rcache.result_key("plan0", "doc0", "cfg0")
+    assert base != rcache.result_key("plan1", "doc0", "cfg0")
+    assert base != rcache.result_key("plan0", "doc1", "cfg0")
+    assert base != rcache.result_key("plan0", "doc0", "cfg1")
+
+
+def test_result_key_covers_schema_version(monkeypatch):
+    base = rcache.result_key("p", "d", "c")
+    monkeypatch.setattr(
+        rcache, "RESULT_SCHEMA_VERSION", rcache.RESULT_SCHEMA_VERSION + 1
+    )
+    assert rcache.result_key("p", "d", "c") != base
+
+
+def test_doc_digest_changes_with_one_byte():
+    assert rcache.doc_digest('{"a": 1}') != rcache.doc_digest('{"a": 2}')
+    # str content hashes its utf-8: same bytes, same digest
+    assert rcache.doc_digest('{"a": 1}') == rcache.doc_digest(b'{"a": 1}')
+
+
+def test_rule_content_flips_key_but_file_name_does_not():
+    """Rule sensitivity rides the plan digest: one rule byte changes
+    the result key; renaming the rules file never does."""
+    doc, cfg = rcache.doc_digest("{}"), rcache.config_hash(mode="sweep")
+    base = rcache.result_key(
+        plan_mod.plan_digest([_rule_file(RULES_A)]), doc, cfg
+    )
+    tweaked = rcache.result_key(
+        plan_mod.plan_digest(
+            [_rule_file(RULES_A.replace("true", "false"))]
+        ),
+        doc, cfg,
+    )
+    renamed = rcache.result_key(
+        plan_mod.plan_digest([_rule_file(RULES_A, name="other.guard")]),
+        doc, cfg,
+    )
+    assert base != tweaked
+    assert base == renamed
+
+
+def test_config_hash_field_order_independent_value_sensitive():
+    a = rcache.config_hash(mode="validate", fmt="json", verbose=False)
+    b = rcache.config_hash(verbose=False, fmt="json", mode="validate")
+    c = rcache.config_hash(mode="validate", fmt="yaml", verbose=False)
+    assert a == b
+    assert a != c
+
+
+# ------------------------------------------------- entry round trips
+
+
+def test_store_load_roundtrip_and_counters():
+    key = rcache.result_key("p", "d", "c")
+    assert rcache.load_entry(key) is None  # absent file: plain miss
+    assert rcache.store_entry(key, {"name": "t.json", "sweep": {}})
+    payload = rcache.load_entry(key)
+    assert payload == {"name": "t.json", "sweep": {}}
+    stats = rcache.result_cache_stats()
+    assert stats["hits"] == 1 and stats["misses"] == 1
+    assert stats["stores"] == 1 and stats["corrupt_entries"] == 0
+    assert stats["bytes_stored"] > 0 and stats["bytes_loaded"] > 0
+
+
+def test_name_mismatch_is_plain_miss_unless_portable():
+    key = rcache.result_key("p", "d", "c")
+    rcache.store_entry(key, {"name": "a.json", "files": []})
+    assert rcache.load_entry(key, name="b.json") is None
+    stats = rcache.result_cache_stats()
+    assert stats["misses"] == 1 and stats["corrupt_entries"] == 0
+    # a portable entry replays under any name (the reader substitutes
+    # its own into the report's top-level name field)
+    key2 = rcache.result_key("p", "d2", "c")
+    rcache.store_entry(key2, {"name": "a.json", "files": [],
+                              "portable": True})
+    assert rcache.load_entry(key2, name="b.json") is not None
+
+
+def test_guard_version_mismatch_is_logged_miss(monkeypatch, caplog):
+    key = rcache.result_key("p", "d", "c")
+    rcache.store_entry(key, {"name": "t.json", "sweep": {}})
+    monkeypatch.setattr(rcache, "_guard_version", lambda: "0.0.0-other")
+    with caplog.at_level("WARNING", logger="guard_tpu.result_cache"):
+        assert rcache.load_entry(key) is None
+    stats = rcache.result_cache_stats()
+    assert stats["corrupt_entries"] == 1
+    assert any("version mismatch" in r.message for r in caplog.records)
+
+
+@pytest.mark.parametrize("corruption", [
+    b"\x00 torn write, not json",
+    b'{"schema": 999, "payload": {}}',
+    b'{"schema": 1, "version": "x", "key": "wrong", "payload": {}}',
+    b'["not", "an", "object"]',
+    b"",
+])
+def test_corrupt_entries_are_logged_misses(corruption, caplog):
+    key = rcache.result_key("p", "d", "c")
+    rcache.store_entry(key, {"name": "t.json", "sweep": {}})
+    path = rcache.result_cache_dir() / f"{key}.result.json"
+    path.write_bytes(corruption)
+    with caplog.at_level("WARNING", logger="guard_tpu.result_cache"):
+        assert rcache.load_entry(key) is None
+    stats = rcache.result_cache_stats()
+    assert stats["misses"] == 1 and stats["corrupt_entries"] == 1
+    assert any("treating as a cache miss" in r.message
+               for r in caplog.records)
+
+
+def test_truncated_entry_degrades_to_recompute(caplog):
+    key = rcache.result_key("p", "d", "c")
+    rcache.store_entry(key, {"name": "t.json", "sweep": {"status": "pass"}})
+    path = rcache.result_cache_dir() / f"{key}.result.json"
+    path.write_bytes(path.read_bytes()[:20])
+    with caplog.at_level("WARNING", logger="guard_tpu.result_cache"):
+        assert rcache.load_entry(key) is None
+    # the recompute's store rewrites the entry in place
+    rcache.store_entry(key, {"name": "t.json", "sweep": {"status": "pass"}})
+    assert rcache.load_entry(key) is not None
+
+
+def test_unwritable_cache_dir_warns_and_continues(tmp_path, monkeypatch,
+                                                 caplog):
+    blocker = tmp_path / "blocked"
+    blocker.write_text("a file where the cache dir should be")
+    monkeypatch.setenv("GUARD_TPU_RESULT_CACHE_DIR", str(blocker))
+    with caplog.at_level("WARNING", logger="guard_tpu.result_cache"):
+        assert rcache.store_entry("k" * 64, {"name": "t"}) is False
+    assert rcache.result_cache_stats()["stores"] == 0
+    assert any("store failed" in r.message for r in caplog.records)
+
+
+# ------------------------------------------------------- parity gates
+
+
+def _sweep(rule_paths, data, tmp_path, tag, *extra):
+    w = Writer.buffered()
+    rc = run(
+        ["sweep", "-r", *rule_paths, "-d", str(data),
+         "-M", str(tmp_path / f"m-{tag}.jsonl"), "-c", "4",
+         "--backend", "tpu", *extra],
+        writer=w, reader=Reader(),
+    )
+    summary = json.loads(w.out.getvalue())
+    summary.pop("manifest", None)  # the only path-bearing key
+    manifest = (tmp_path / f"m-{tag}.jsonl").read_text()
+    return rc, summary, w.err.getvalue(), manifest
+
+
+@pytest.mark.parametrize("workers", [0, 2])
+@pytest.mark.parametrize("pack", [(), ("--no-pack",)])
+def test_sweep_parity_cached_vs_off(tmp_path, workers, pack):
+    """Cold, all-hits warm and --no-result-cache sweeps are identical
+    in exit code (19: failures present), summary, stderr and manifest
+    rows — per-file and packed, with and without ingest workers."""
+    rule_paths, data = _mk_corpus(tmp_path, n=8, fail=(2, 5))
+    common = ("--ingest-workers", str(workers), *pack)
+    cold = _sweep(rule_paths, data, tmp_path, f"c{workers}", *common)
+    rcache.reset_result_cache_stats()
+    warm = _sweep(rule_paths, data, tmp_path, f"w{workers}", *common)
+    stats = rcache.result_cache_stats()
+    assert stats["hits"] == 8 and stats["misses"] == 0
+    off = _sweep(
+        rule_paths, data, tmp_path, f"o{workers}", *common,
+        "--no-result-cache",
+    )
+    assert cold[0] == 19
+    assert cold == warm == off
+
+
+def _validate(rule_paths, data, *extra):
+    w = Writer.buffered()
+    rc = run(
+        ["validate", "-r", *rule_paths, "-d", str(data),
+         "--backend", "tpu", *extra],
+        writer=w, reader=Reader(),
+    )
+    return rc, w.out.getvalue(), w.err.getvalue()
+
+
+@pytest.mark.parametrize(
+    "fmt", ["single-line-summary", "json", "yaml", "junit"]
+)
+@pytest.mark.parametrize("workers", [0, 2])
+def test_validate_output_modes_parity(tmp_path, fmt, workers):
+    """Warm-cache validate replays byte-identical console / yaml /
+    structured / junit output (exit 19: failures present)."""
+    rule_paths, data = _mk_corpus(tmp_path, n=6, fail=(1, 4))
+    extra = ("-o", fmt, "--ingest-workers", str(workers)) + (
+        ("--structured", "--show-summary", "none")
+        if fmt in ("json", "yaml", "junit") else ()
+    )
+    cold = _validate(rule_paths, data, *extra)
+    rcache.reset_result_cache_stats()
+    warm = _validate(rule_paths, data, *extra)
+    stats = rcache.result_cache_stats()
+    assert stats["hits"] == 6 and stats["misses"] == 0
+    off = _validate(rule_paths, data, *extra, "--no-result-cache")
+    assert cold[0] == 19
+    assert cold == warm == off
+
+
+def test_validate_perfile_parity(tmp_path):
+    rule_paths, data = _mk_corpus(tmp_path, n=6, fail=(3,))
+    cold = _validate(rule_paths, data, "--no-pack")
+    warm = _validate(rule_paths, data, "--no-pack")
+    off = _validate(rule_paths, data, "--no-pack", "--no-result-cache")
+    assert cold == warm == off
+
+
+def test_output_config_partitions_the_key(tmp_path):
+    """A yaml-mode entry must never serve a json-mode request: the
+    second format's first run is all misses, not poisoned hits."""
+    rule_paths, data = _mk_corpus(tmp_path, n=4, fail=())
+    structured = ("--structured", "--show-summary", "none")
+    _validate(rule_paths, data, "-o", "json", *structured)
+    rcache.reset_result_cache_stats()
+    out = _validate(rule_paths, data, "-o", "yaml", *structured)
+    stats = rcache.result_cache_stats()
+    assert stats["hits"] == 0 and stats["misses"] == 4
+    # and the yaml entries now exist independently
+    rcache.reset_result_cache_stats()
+    again = _validate(rule_paths, data, "-o", "yaml", *structured)
+    assert rcache.result_cache_stats()["hits"] == 4
+    assert out == again
+
+
+def test_doc_edit_invalidates_only_that_doc(tmp_path):
+    """Structural invalidation: rewriting one doc's bytes re-dispatches
+    exactly that doc; the rest replay. Byte parity holds throughout."""
+    rule_paths, data = _mk_corpus(tmp_path, n=6, fail=(1,))
+    _sweep(rule_paths, data, tmp_path, "seed")
+    doc = json.loads((data / "t03.json").read_text())
+    doc["Touched"] = True
+    (data / "t03.json").write_text(json.dumps(doc))
+    rcache.reset_result_cache_stats()
+    touched = _sweep(rule_paths, data, tmp_path, "touched")
+    stats = rcache.result_cache_stats()
+    assert stats["hits"] == 5 and stats["misses"] == 1
+    assert stats["stores"] == 1
+    off = _sweep(
+        rule_paths, data, tmp_path, "touched-off", "--no-result-cache"
+    )
+    assert touched == off
+
+
+def test_delta_stats_flag_reports_partition(tmp_path):
+    rule_paths, data = _mk_corpus(tmp_path, n=4, fail=())
+    _sweep(rule_paths, data, tmp_path, "seed")
+    out = _sweep(rule_paths, data, tmp_path, "warm", "--delta-stats")
+    assert "result-cache: 4/4 docs cached, 0 dispatched" in out[2]
+
+
+# --------------------------------------------- never-cached outcomes
+
+
+def _stored_doc_names():
+    return {
+        json.loads(p.read_text()).get("payload", {}).get("name")
+        for p in rcache.result_cache_dir().glob("*.result.json")
+    }
+
+
+def test_quarantined_docs_never_cached(tmp_path):
+    """An unparseable doc re-evaluates (and re-reports its quarantine
+    record) on every run; it never enters the store — and neither does
+    any chunk whose snapshot saw the failure plane move (the guard is
+    conservative across pipelined in-flight chunks). Output parity
+    holds across runs."""
+    rule_paths, data = _mk_corpus(tmp_path, n=4, fail=())
+    (data / "poison.json").write_text("{ not json")
+    first = _sweep(rule_paths, data, tmp_path, "q1")
+    assert first[1]["quarantined"][0]["file"] == "poison.json"
+    stats = rcache.result_cache_stats()
+    assert 0 < stats["stores"] < 5
+    assert "poison.json" not in _stored_doc_names()
+    rcache.reset_result_cache_stats()
+    second = _sweep(rule_paths, data, tmp_path, "q2")
+    stats = rcache.result_cache_stats()
+    # the poisoned doc re-misses every run; clean stored docs replay
+    assert stats["misses"] >= 1
+    assert stats["hits"] + stats["misses"] == 5
+    assert "poison.json" not in _stored_doc_names()
+    assert first == second
+
+
+def test_oracle_error_docs_never_cached(tmp_path):
+    """A doc whose oracle pass raises GuardError (EMPTY on an int)
+    re-emits its stderr line on every run — it is uncacheable by
+    design. Clean docs in the same chunk still cache."""
+    data = tmp_path / "data"
+    data.mkdir()
+    rules = tmp_path / "err.guard"
+    rules.write_text(RULES_ERR)
+    (data / "bad.json").write_text(
+        json.dumps({"Resources": {"R1": {"Properties": {"X": 5}}}})
+    )
+    (data / "good.json").write_text(
+        json.dumps({"Resources": {"R1": {"Properties": {"X": []}}}})
+    )
+    first = _sweep([str(rules)], data, tmp_path, "e1")
+    assert first[0] == 5  # oracle error: exit ERROR
+    assert "bad.json" in first[2]
+    stats = rcache.result_cache_stats()
+    assert stats["stores"] == 1  # only good.json stored
+    rcache.reset_result_cache_stats()
+    second = _sweep([str(rules)], data, tmp_path, "e2")
+    stats = rcache.result_cache_stats()
+    assert stats["hits"] == 1 and stats["misses"] == 1
+    assert first == second  # the error line re-emitted identically
+
+
+def test_faulted_chunks_never_cached(tmp_path, monkeypatch):
+    """A chunk during which the failure plane moved (injected dispatch
+    fault -> oracle fallback) must not write back ANY of its docs."""
+    rule_paths, data = _mk_corpus(tmp_path, n=4, fail=())
+    monkeypatch.setenv("GUARD_TPU_FAULT", "dispatch:nth=1")
+    _sweep(rule_paths, data, tmp_path, "faulted")
+    assert rcache.result_cache_stats()["stores"] == 0
+    monkeypatch.delenv("GUARD_TPU_FAULT")
+    # the clean re-run recomputes (no poisoned entries to replay) and
+    # only then populates the store
+    rcache.reset_result_cache_stats()
+    _sweep(rule_paths, data, tmp_path, "clean")
+    stats = rcache.result_cache_stats()
+    assert stats["hits"] == 0 and stats["stores"] == 4
+
+
+# --------------------------------------------------- mixed-hit chunks
+
+
+def test_mixed_hit_chunk_interleaves_in_document_order(tmp_path):
+    """A chunk where every second doc is cached folds cached and fresh
+    outcomes back in ORIGINAL document order: summary tallies, failed
+    list and manifest rows are byte-identical to the cache-off run."""
+    rule_paths, data = _mk_corpus(tmp_path, n=8, fail=(1, 2, 6))
+    # seed the store with the EVEN docs only
+    seed_dir = tmp_path / "seed_data"
+    seed_dir.mkdir()
+    for p in sorted(data.glob("t*.json")):
+        if int(p.stem[1:]) % 2 == 0:
+            (seed_dir / p.name).write_text(p.read_text())
+    _sweep(rule_paths, seed_dir, tmp_path, "seed", "-c", "8")
+    # full corpus in ONE chunk: 50% hits, 50% fresh, interleaved
+    rcache.reset_result_cache_stats()
+    mixed = _sweep(rule_paths, data, tmp_path, "mixed", "-c", "8")
+    stats = rcache.result_cache_stats()
+    assert stats["hits"] == 4 and stats["misses"] == 4
+    off = _sweep(
+        rule_paths, data, tmp_path, "mixed-off", "-c", "8",
+        "--no-result-cache",
+    )
+    assert mixed == off
+    # the failed list preserved document order across the seam
+    fails = [f["data"] for f in mixed[1]["failed"]]
+    assert fails == sorted(fails)
+
+
+def test_corrupt_store_degrades_to_recompute_e2e(tmp_path, caplog):
+    """Corrupting every entry between runs degrades to logged misses
+    and a recompute whose output stays byte-identical."""
+    rule_paths, data = _mk_corpus(tmp_path, n=4, fail=(0,))
+    first = _sweep(rule_paths, data, tmp_path, "pre")
+    for ent in rcache.result_cache_dir().glob("*.result.json"):
+        ent.write_bytes(b"{ torn write")
+    rcache.reset_result_cache_stats()
+    with caplog.at_level("WARNING", logger="guard_tpu.result_cache"):
+        second = _sweep(rule_paths, data, tmp_path, "post")
+    stats = rcache.result_cache_stats()
+    assert stats["corrupt_entries"] == 4 and stats["hits"] == 0
+    assert first == second
+    # the recompute rewrote the entries: third run is all hits
+    rcache.reset_result_cache_stats()
+    third = _sweep(rule_paths, data, tmp_path, "rewrite")
+    assert rcache.result_cache_stats()["hits"] == 4
+    assert first == third
+
+
+# ------------------------------------------------------ escape hatches
+
+
+def test_env_escape_hatch_disables_layer(tmp_path, monkeypatch):
+    rule_paths, data = _mk_corpus(tmp_path, n=4, fail=(0,))
+    monkeypatch.setenv("GUARD_TPU_RESULT_CACHE", "0")
+    off = _sweep(rule_paths, data, tmp_path, "env-off")
+    stats = rcache.result_cache_stats()
+    assert stats["hits"] == stats["misses"] == stats["stores"] == 0
+    assert not list(rcache.result_cache_dir().glob("*.result.json"))
+    monkeypatch.setenv("GUARD_TPU_RESULT_CACHE", "1")
+    on = _sweep(rule_paths, data, tmp_path, "env-on")
+    assert off == on
+
+
+def test_flag_escape_hatch_never_reads_or_writes(tmp_path):
+    rule_paths, data = _mk_corpus(tmp_path, n=4, fail=())
+    _sweep(rule_paths, data, tmp_path, "seed")
+    rcache.reset_result_cache_stats()
+    _sweep(rule_paths, data, tmp_path, "off", "--no-result-cache")
+    stats = rcache.result_cache_stats()
+    assert stats["hits"] == stats["misses"] == stats["stores"] == 0

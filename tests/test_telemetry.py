@@ -198,18 +198,42 @@ def test_reset_all_stats_clears_every_plane_at_once():
 
 
 def test_metrics_snapshot_passes_schema_checker():
+    from guard_tpu.cache import results  # registers the result_cache group
     from guard_tpu.utils import faults  # registers the fault group
 
     telemetry.enable()
     telemetry.reset_trace()
     faults.FAULT_COUNTERS["retries"] += 1
+    results.RESULT_COUNTERS["hits"] += 1
     with telemetry.span("rim_reduce"):
         pass
     snap = telemetry.metrics_snapshot()
-    assert check_snapshot(snap, require_groups=("fault",)) == []
+    assert check_snapshot(
+        snap, require_groups=("fault", "result_cache")
+    ) == []
     # and the checker actually bites: a doctored histogram count fails
     snap["histograms"]["stage.rim_reduce"]["count"] += 1
     assert check_snapshot(snap)
+    results.reset_result_cache_stats()
+
+
+def test_result_cache_group_in_snapshot_contract():
+    """v4: the incremental plane's counter group is part of the
+    published snapshot shape — EXPECTED_GROUPS names it and a snapshot
+    missing it fails the gate when required."""
+    import tools.check_metrics_schema as cms
+    from guard_tpu.cache import results  # noqa: F401 — registers group
+
+    assert "result_cache" in cms.EXPECTED_GROUPS
+    assert cms.KNOWN_SCHEMA_VERSION == telemetry.SCHEMA_VERSION
+    snap = telemetry.metrics_snapshot()
+    assert "result_cache" in snap["counters"]
+    for key in ("hits", "misses", "stores", "corrupt_entries",
+                "bytes_loaded", "bytes_stored"):
+        assert key in snap["counters"]["result_cache"]
+    doctored = json.loads(json.dumps(snap))
+    del doctored["counters"]["result_cache"]
+    assert check_snapshot(doctored, require_groups=("result_cache",))
 
 
 # -------------------------------------------------- trace export face

@@ -73,6 +73,14 @@ METRIC_SINCE.update({
     "serve_c1_adaptive_p50_ratio": 14,
 })
 
+# PR 11 incremental plane: the result-cache delta regimes ride the
+# round-14 artifact alongside the mesh rows
+METRIC_SINCE.update({
+    "config5b_delta_cold_templates_per_sec": 14,
+    "config5b_delta_warm_templates_per_sec": 14,
+    "config5b_delta_1pct_templates_per_sec": 14,
+})
+
 
 def metric_since(metric: str) -> int:
     """The bench round whose driver first emitted `metric`."""
@@ -177,6 +185,22 @@ METRIC_REQUIRED_KEYS.update({
     "serve_c1_adaptive_p50_ratio": (
         "p50_on_ms", "p50_off_ms", "coalesce_window_adaptive",
     ),
+})
+
+# PR 11 incremental plane: each delta-regime row must carry the
+# result_cache hit/miss/store/bytes counters and the per-run dispatch
+# count — "did the warm sweep actually dispatch zero packs" and "did
+# the 1% sweep dispatch only the changed docs" are answerable from the
+# committed artifact alone
+DELTA_REQUIRED_KEYS = (
+    "docs_per_run", "dispatches_per_run", "result_hits",
+    "result_misses", "result_stores", "result_bytes_loaded",
+    "result_bytes_stored",
+)
+METRIC_REQUIRED_KEYS.update({
+    "config5b_delta_cold_templates_per_sec": DELTA_REQUIRED_KEYS,
+    "config5b_delta_warm_templates_per_sec": DELTA_REQUIRED_KEYS,
+    "config5b_delta_1pct_templates_per_sec": DELTA_REQUIRED_KEYS,
 })
 
 # PR 3 ingest decomposition: every *_ingest_workers* row must say how

@@ -30,7 +30,8 @@ import sys
 #: v3: per-doc-shard mesh gauges (efficiency.shard_{s}.*), the trimmed
 #: d2h byte counter, shard-prefetch pipeline counters and the serve
 #: coalesce_window_adaptive counter.
-KNOWN_SCHEMA_VERSION = 3
+#: v4: the `result_cache` counter group (incremental validation plane).
+KNOWN_SCHEMA_VERSION = 4
 
 #: top-level sections every snapshot must carry
 SECTIONS = ("schema_version", "counters", "gauges", "histograms", "spans")
@@ -41,9 +42,11 @@ SECTIONS = ("schema_version", "counters", "gauges", "histograms", "spans")
 #: absent; callers that ran the full pipeline pass these as
 #: `require_groups` (the CI trace-smoke does). plan_cache registers
 #: with ops.plan and is part of every tpu-backend run since the plan
-#: layer became the default lowering path.
+#: layer became the default lowering path; result_cache registers with
+#: cache.results, imported by every sweep/validate tpu session.
 EXPECTED_GROUPS = (
     "dispatch", "pipeline", "rim", "fault", "plan_cache", "efficiency",
+    "result_cache",
 )
 
 #: keys every histogram snapshot must carry
