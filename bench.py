@@ -1431,6 +1431,248 @@ def plan_smoke(n_docs: int = 64, chunk_size: int = 16) -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def measure_verify(corpus: str = "registry", n_docs: int = 1024,
+                   chunk_size: int = 256, reps: int = 3):
+    """Plan/IR verifier overhead contract: the analysis plane's
+    structural checks (verify_plan after lowering + on artifact load,
+    verify_relocation per chunk) must cost <= 2% of the production
+    sweep flow to stay on by default. Off/on legs run the SAME full
+    sweep (ingest + plan relocation + packed dispatch) with
+    `verify_plans` flipped, interleaved with the pair order swapped
+    each rep and best-of-reps kept (measure_telemetry idiom); the
+    result cache is disabled in both legs so every rep dispatches
+    every chunk instead of replaying the first rep's results. Returns
+    (off_docs_per_sec, on_docs_per_sec, invariants_checked_per_run)."""
+    import gc
+    import pathlib
+    import shutil
+    import tempfile
+
+    from guard_tpu.analysis import analysis_stats, reset_analysis_stats
+    from guard_tpu.commands.sweep import Sweep
+    from guard_tpu.utils.io import Reader, Writer
+
+    tmp = tempfile.mkdtemp(prefix="guard_verify_")
+    plan_dir = pathlib.Path(tmp) / "plans"
+    prev = {
+        k: os.environ.get(k)
+        for k in ("GUARD_TPU_PLAN_CACHE_DIR", "GUARD_TPU_RESULT_CACHE_DIR")
+    }
+    os.environ["GUARD_TPU_PLAN_CACHE_DIR"] = str(plan_dir)
+    os.environ["GUARD_TPU_RESULT_CACHE_DIR"] = str(
+        pathlib.Path(tmp) / "results"
+    )
+    try:
+        docdir, rules = _write_ingest_corpus(tmp, corpus, n_docs)
+
+        def one(tag: str, verify: bool) -> float:
+            gc.collect()
+            cmd = Sweep(
+                rules=[rules],
+                data=[docdir],
+                manifest=str(pathlib.Path(tmp) / f"m-{tag}.jsonl"),
+                chunk_size=chunk_size,
+                backend="tpu",
+                result_cache=False,
+                verify_plans=verify,
+            )
+            t0 = time.perf_counter()
+            cmd.execute(Writer.buffered(), Reader.from_string(""))
+            return time.perf_counter() - t0
+
+        one("pretrace", True)  # plan memo + XLA compile off the clock
+        t_off: list = []
+        t_on: list = []
+        for r in range(reps):
+            pair = [(False, t_off), (True, t_on)]
+            if r % 2:
+                pair.reverse()
+            for verify, acc in pair:
+                acc.append(one(f"{'on' if verify else 'off'}{r}", verify))
+        reset_analysis_stats()
+        one("count", True)
+        checked = analysis_stats()["invariants_checked"]
+        return n_docs / min(t_off), n_docs / min(t_on), checked
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def lint_smoke(n_docs: int = 48, chunk_size: int = 16) -> None:
+    """CI lint/analysis smoke (JAX_PLATFORMS=cpu): the static-analysis
+    plane must (1) leave validate AND sweep byte-identical with the
+    plan verifier on vs off, across the packed and per-file dispatch
+    paths, (2) degrade a seeded-corrupt plan artifact to a logged miss
+    whose warning NAMES the violated invariant (cause=verify:<name>)
+    and bumps the plan_cache corrupt_verify counter, and (3) honor the
+    `guard-tpu lint` exit-code contract: 0 clean, 19 ERROR findings,
+    5 parse error. Prints one JSON line; SystemExit(1) on violation."""
+    import json as _json
+    import logging as _logging
+    import pathlib
+    import pickle as _pickle
+    import shutil
+    import tempfile
+
+    from guard_tpu.cli import run as cli_run
+    from guard_tpu.ops.plan import clear_plan_memo, plan_stats
+    from guard_tpu.utils.io import Reader, Writer
+
+    tmp = tempfile.mkdtemp(prefix="guard_lint_smoke_")
+    plan_dir = pathlib.Path(tmp) / "plans"
+    prev = {
+        k: os.environ.get(k)
+        for k in ("GUARD_TPU_PLAN_CACHE_DIR", "GUARD_TPU_RESULT_CACHE_DIR")
+    }
+    os.environ["GUARD_TPU_PLAN_CACHE_DIR"] = str(plan_dir)
+    os.environ["GUARD_TPU_RESULT_CACHE_DIR"] = str(
+        pathlib.Path(tmp) / "results"
+    )
+    try:
+        docdir, rules_file = _write_ingest_corpus(tmp, "failheavy", n_docs)
+        # a second compatible rule file so the plan forms a real 2-file
+        # pack: the corrupt leg below mutates pack segment offsets, and
+        # a single-file registry never packs
+        rulesdir = pathlib.Path(tmp) / "rulesdir"
+        rulesdir.mkdir()
+        content = pathlib.Path(rules_file).read_text()
+        (rulesdir / "a.guard").write_text(content)
+        (rulesdir / "b.guard").write_text(
+            "rule extra_name_check {\n"
+            "    Resources.*.Properties.Name != 'forbidden'\n"
+            "}\n"
+        )
+        rules = str(rulesdir)
+
+        def run_cli(tag: str, argv: list) -> tuple:
+            w = Writer.buffered()
+            rc = cli_run(argv, writer=w, reader=Reader.from_string(""))
+            return rc, w.out.getvalue(), w.err.getvalue()
+
+        # --no-result-cache on every leg: the parity question here is
+        # the verifier's, not the incremental plane's, and the corrupt
+        # leg must actually dispatch (and therefore load the plan)
+        def sweep_leg(tag: str, *extra) -> tuple:
+            rc, out, err = run_cli(tag, [
+                "sweep", "-r", rules, "-d", docdir,
+                "-M", str(pathlib.Path(tmp) / f"m-{tag}.jsonl"),
+                "-c", str(chunk_size), "--backend", "tpu",
+                "--no-result-cache", *extra,
+            ])
+            summary = _json.loads(out.strip().splitlines()[-1])
+            summary.pop("manifest")  # the only path-bearing key
+            return rc, summary, err
+
+        def validate_leg(tag: str, *extra) -> tuple:
+            return run_cli(tag, [
+                "validate", "-r", rules, "-d", docdir,
+                "--backend", "tpu", "--no-result-cache", *extra,
+            ])
+
+        # (1) verifier-on/off byte parity, packed and per-file
+        parity = True
+        for pack_args in ((), ("--no-pack",)):
+            on = sweep_leg(f"s-on{len(pack_args)}", *pack_args)
+            off = sweep_leg(f"s-off{len(pack_args)}", "--no-verify-plans",
+                            *pack_args)
+            parity = parity and on == off
+            von = validate_leg(f"v-on{len(pack_args)}", *pack_args)
+            voff = validate_leg(f"v-off{len(pack_args)}",
+                                "--no-verify-plans", *pack_args)
+            parity = parity and von == voff
+
+        # (2) seeded-corrupt artifact -> named logged miss. The
+        # corruption (first pack offset nudged) keeps the pickle and
+        # schema/version/digest valid, so ONLY the verifier can reject
+        # it — with the expected segment_offsets_consistent name.
+        art = next(plan_dir.glob("*.plan"))
+        payload = _pickle.loads(art.read_bytes())
+        payload["plan"].packs[0][1].offsets[0] += 1
+        art.write_bytes(_pickle.dumps(payload))
+        clear_plan_memo()
+        _reset_stats()
+        warned = []
+
+        class _Catch(_logging.Handler):
+            def emit(self, record):
+                warned.append(record.getMessage())
+
+        h = _Catch(level=_logging.WARNING)
+        _logging.getLogger("guard_tpu.plan").addHandler(h)
+        try:
+            corrupt = sweep_leg("s-corrupt")
+        finally:
+            _logging.getLogger("guard_tpu.plan").removeHandler(h)
+        named_miss = any(
+            "cause=verify:segment_offsets_consistent" in m for m in warned
+        )
+        corrupt_count = plan_stats()["corrupt_verify"]
+        parity = parity and corrupt[:2] == sweep_leg("s-recheck")[:2]
+
+        # (3) lint exit-code contract
+        lintdirs = {}
+        for name, content in (
+            ("clean", "rule ok_rule { Resources.*.Properties.Enc == true }\n"),
+            ("bad", "rule unsat_rule {\n"
+                    "    Resources.*.Properties.Count > 5\n"
+                    "    Resources.*.Properties.Count < 3\n"
+                    "}\n"),
+            ("broken", "rule broken {\n  this is not(((\n"),
+        ):
+            d = pathlib.Path(tmp) / f"lint-{name}"
+            d.mkdir()
+            (d / f"{name}.guard").write_text(content)
+            lintdirs[name] = str(d)
+        rc_clean, _, _ = run_cli("l-clean", ["lint", "-r",
+                                            lintdirs["clean"]])
+        rc_bad, bad_out, _ = run_cli("l-bad", ["lint", "-r",
+                                               lintdirs["bad"]])
+        rc_broken, _, _ = run_cli("l-broken", ["lint", "-r",
+                                               lintdirs["broken"]])
+        rc_json, json_out, _ = run_cli("l-json", [
+            "lint", "-r", lintdirs["bad"], "--structured",
+            "--fail-on", "never",
+        ])
+        structured = _json.loads(json_out)
+
+        record = {
+            "metric": "lint_smoke",
+            "docs": n_docs,
+            "verify_parity": parity,
+            "corrupt_named_miss": named_miss,
+            "corrupt_verify_count": corrupt_count,
+            "lint_exit_clean": rc_clean,
+            "lint_exit_findings": rc_bad,
+            "lint_exit_parse_error": rc_broken,
+            "structured_findings": len(structured["findings"]),
+        }
+        print(_json.dumps(record), flush=True)
+        ok = (
+            parity
+            and named_miss
+            and corrupt_count >= 1
+            and rc_clean == 0
+            and rc_bad == 19
+            and "[unsat-conjunction]" in bad_out
+            and rc_broken == 5
+            and rc_json == 0
+            and structured["findings"][0]["code"] == "unsat-conjunction"
+        )
+        if not ok:
+            raise SystemExit(1)
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _drop_uncacheable_docs(docdir, stderr_text: str) -> int:
     """Delete corpus docs whose oracle pass ERRORED in a scrub run
     (`<doc> vs <rules>: <GuardError>` stderr lines). Error docs are
@@ -3038,6 +3280,8 @@ def expected_metrics() -> list:
         "config5b_telemetry_on_templates_per_sec",
         "config5b_flightrec_off_templates_per_sec",
         "config5b_flightrec_on_templates_per_sec",
+        "config5b_verify_off_templates_per_sec",
+        "config5b_verify_on_templates_per_sec",
         "config5b_ingest_workers1_templates_per_sec",
         "config5b_ingest_workers2_templates_per_sec",
         "config6_ingest_workers1_docs_per_sec",
@@ -3161,6 +3405,17 @@ def main() -> None:
 
         _honor_platform_env()
         serve_smoke()
+        return
+    if "--lint-smoke" in sys.argv:
+        # CI smoke for the static-analysis plane: verifier-on/off
+        # byte parity on validate + sweep across packed/per-file, a
+        # seeded-corrupt artifact degrading to a logged miss that
+        # NAMES the violated invariant, and the lint exit-code
+        # contract (0 clean / 19 findings / 5 parse error)
+        from guard_tpu.ops.backend import _honor_platform_env
+
+        _honor_platform_env()
+        lint_smoke()
         return
     if not _probe_tpu_responsive():
         import jax as _jax
@@ -3317,6 +3572,29 @@ def main() -> None:
             "overhead_vs_off": round(v_foff / max(v_fon, 1e-9), 4),
             "ring_records_per_run": n_ring,
             "vs_note": "vs_baseline here = recorder-armed throughput over disarmed on the same packed registry dispatch (tracing off in both legs)",
+        },
+    )
+
+    # config 5b verifier overhead: the analysis plane's plan/IR
+    # invariant checks (post-lowering, per-chunk relocation, artifact
+    # load) on the full production sweep flow, on vs off — the <=2%
+    # bar the plane must hold to stay advisory-on by default
+    v_voff, v_von, n_checked = measure_verify()
+    _emit(
+        "config5b_verify_off_templates_per_sec",
+        v_voff,
+        1.0,
+        extra={"plan_verifier": "disabled"},
+    )
+    _emit(
+        "config5b_verify_on_templates_per_sec",
+        v_von,
+        v_von / max(v_voff, 1e-9),
+        extra={
+            "plan_verifier": "enabled",
+            "overhead_vs_off": round(v_voff / max(v_von, 1e-9), 4),
+            "invariants_checked_per_run": n_checked,
+            "vs_note": "vs_baseline here = verifier-on throughput over verifier-off on the same full sweep flow (ingest + plan relocation + packed dispatch)",
         },
     )
 

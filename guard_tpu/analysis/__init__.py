@@ -1,0 +1,61 @@
+"""The static analysis plane: plan/IR verifier, rule linter, anchors.
+
+Three passes that reason about rules and compiled plans WITHOUT
+touching a document or a device:
+
+  * ``verify``     — named-invariant checks over ``ops/plan.RulePlan``
+                     structures (slot relocation, pack segments, bit
+                     tables, anchor chains, rim coverage), hooked into
+                     plan build / artifact load / per-chunk relocation;
+  * ``lint``       — abstract-domain checks over parsed Guard rules
+                     (unsatisfiable conjunctions, type conflicts,
+                     shadowed rules, always-SKIP whens, dead lets),
+                     surfaced as the ``guard-tpu lint`` subcommand;
+  * ``signatures`` — per rule-file anchor key-chains and type
+                     equalities, persisted with the plan artifact —
+                     the routing input for rule-relevance partial
+                     evaluation (ROADMAP item 2).
+
+Every pass is advisory-by-default and pure-host. `GUARD_TPU_ANALYSIS=0`
+(or the per-run `--no-verify-plans` flag) disables the verifier hooks
+entirely; validation output stays byte-identical either way — the
+verifier can only *reject* a plan (hard diagnostic on fresh lowering,
+logged miss on artifact load), never change what a healthy plan
+computes.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..utils.telemetry import REGISTRY as _TELEMETRY
+
+#: analysis-plane observability, in every --metrics-out snapshot:
+#: `invariants_checked` counts individual invariant evaluations across
+#: verify_plan/verify_relocation calls, `violations` the failures,
+#: `lint_findings` every finding any severity, `signatures_extracted`
+#: per-file anchor signatures derived during plan builds.
+ANALYSIS_COUNTERS = _TELEMETRY.counter_group(
+    "analysis",
+    {
+        "invariants_checked": 0,
+        "violations": 0,
+        "lint_findings": 0,
+        "signatures_extracted": 0,
+    },
+)
+
+
+def analysis_stats() -> dict:
+    return _TELEMETRY.group_stats("analysis")
+
+
+def reset_analysis_stats() -> None:
+    _TELEMETRY.reset_group("analysis")
+
+
+def analysis_enabled(flag: bool = True) -> bool:
+    """The verifier's on switch: the caller's --no-verify-plans flag
+    AND the `GUARD_TPU_ANALYSIS=0` env escape hatch (read at call time
+    so one process can compare both paths — the parity smoke does)."""
+    return bool(flag) and os.environ.get("GUARD_TPU_ANALYSIS", "1") != "0"

@@ -15,6 +15,7 @@ import time
 from typing import List, Optional
 
 from .commands.completions import Completions
+from .commands.lint import Lint
 from .commands.parse_tree import ParseTree
 from .commands.rulegen import Rulegen
 from .commands.test import Test
@@ -147,6 +148,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="tpu backend: print a result-cache partition summary "
         "(cached vs dispatched docs) to stderr after the run",
     )
+    v.add_argument(
+        "--no-verify-plans",
+        action="store_true",
+        help="tpu backend: skip the analysis plane's plan/IR invariant "
+        "verifier after lowering, relocation and artifact load "
+        "(advisory escape hatch — also GUARD_TPU_ANALYSIS=0)",
+    )
     _add_telemetry_flags(v)
 
     t = sub.add_parser("test", help="Test rules against expectations")
@@ -252,7 +260,47 @@ def build_parser() -> argparse.ArgumentParser:
         help="tpu backend: print a result-cache partition summary "
         "(cached vs dispatched docs) to stderr after the run",
     )
+    s.add_argument(
+        "--no-verify-plans",
+        action="store_true",
+        help="tpu backend: skip the analysis plane's plan/IR invariant "
+        "verifier after lowering, relocation and artifact load "
+        "(advisory escape hatch — also GUARD_TPU_ANALYSIS=0)",
+    )
     _add_telemetry_flags(s)
+
+    li = sub.add_parser(
+        "lint",
+        help="Statically analyze Guard rule files: unsatisfiable "
+        "conjunctions, type conflicts, dead `when` guards, shadowed "
+        "and duplicate rules, unreferenced variables — no data files "
+        "needed (exit 0 clean / 19 findings at --fail-on / 5 parse "
+        "error)",
+    )
+    li.add_argument(
+        "--rules",
+        "-r",
+        nargs="*",
+        default=[],
+        help="rule files or directories to lint (directories are "
+        "walked for .guard/.ruleset files)",
+    )
+    li.add_argument(
+        "--structured",
+        "-z",
+        action="store_true",
+        help="emit machine-readable JSON ({findings: [...], summary: "
+        "{...}}) on stdout instead of file:line:col text",
+    )
+    li.add_argument(
+        "--fail-on",
+        default="error",
+        choices=["error", "warning", "info", "never"],
+        help="weakest finding severity that makes lint exit 19 "
+        "(default error; never = report only, always exit 0 unless a "
+        "file fails to parse)",
+    )
+    li.add_argument("--last-modified", "-m", action="store_true")
 
     pt = sub.add_parser("parse-tree", help="Prints the parse tree for a rules file")
     pt.add_argument("--rules", "-r", default=None)
@@ -464,6 +512,7 @@ def _dispatch(args, writer: Writer, reader: Reader) -> int:
                 plan_cache=not args.no_plan_cache,
                 result_cache=not args.no_result_cache,
                 delta_stats=args.delta_stats,
+                verify_plans=not args.no_verify_plans,
             )
             return cmd.execute(writer, reader)
         if args.command == "test":
@@ -495,6 +544,14 @@ def _dispatch(args, writer: Writer, reader: Reader) -> int:
                 plan_cache=not args.no_plan_cache,
                 result_cache=not args.no_result_cache,
                 delta_stats=args.delta_stats,
+                verify_plans=not args.no_verify_plans,
+            ).execute(writer, reader)
+        if args.command == "lint":
+            return Lint(
+                rules=args.rules,
+                structured=args.structured,
+                fail_on=args.fail_on,
+                last_modified=args.last_modified,
             ).execute(writer, reader)
         if args.command == "parse-tree":
             return ParseTree(

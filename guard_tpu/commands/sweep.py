@@ -148,6 +148,11 @@ class Sweep:
     # --no-plan-cache / GUARD_TPU_PLAN_CACHE=0 restores per-chunk
     # lowering (bit-parity escape hatch)
     plan_cache: bool = True
+    # the static analysis plane's plan/IR verifier (analysis/verify.py)
+    # around plan build/load/relocation; --no-verify-plans /
+    # GUARD_TPU_ANALYSIS=0 skips the invariant checks (advisory layer —
+    # output is byte-identical either way on healthy plans)
+    verify_plans: bool = True
     # incremental validation plane (cache/results.py): per-doc outcomes
     # persist under GUARD_TPU_RESULT_CACHE_DIR keyed by (doc content
     # sha256, plan digest, config hash); unchanged docs replay from
@@ -1013,8 +1018,9 @@ class Sweep:
         prep = []
         plan = None
         if plan_cache_enabled(self.plan_cache):
-            plan = get_plan(rule_files)
-            relocate_batch(plan, batch, interner)
+            plan = get_plan(rule_files, verify=self.verify_plans)
+            relocate_batch(plan, batch, interner,
+                           verify=self.verify_plans)
             interner = plan.interner
             for fi, rf in enumerate(rule_files):
                 rf_batch = batch

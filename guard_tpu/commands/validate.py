@@ -178,6 +178,11 @@ class Validate:
     # processes; `--no-plan-cache` / GUARD_TPU_PLAN_CACHE=0 restores
     # per-call lowering (bit-parity escape hatch)
     plan_cache: bool = True
+    # the static analysis plane's plan/IR verifier (analysis/verify.py)
+    # around plan build/load/relocation; --no-verify-plans /
+    # GUARD_TPU_ANALYSIS=0 skips the invariant checks (advisory layer —
+    # output is byte-identical either way on healthy plans)
+    verify_plans: bool = True
     # TPU backend: incremental validation plane (cache/results.py) —
     # replay unchanged documents from the content-addressed result
     # cache and encode+dispatch only the delta;

@@ -851,8 +851,9 @@ def _lower_rules(validate, rule_files, batch, interner, data_files,
         # packed program (in-process memo or disk artifact) and move
         # the batch into its id namespace — warm calls skip
         # compile_rules_file and pack_compiled entirely
-        plan = get_plan(rule_files)
-        relocate_batch(plan, batch, interner)
+        verify = getattr(validate, "verify_plans", True)
+        plan = get_plan(rule_files, verify=verify)
+        relocate_batch(plan, batch, interner, verify=verify)
         interner = plan.interner
         for fi, rule_file in enumerate(rule_files):
             rbatch = batch

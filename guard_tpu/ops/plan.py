@@ -78,14 +78,20 @@ from .ir import (
 log = logging.getLogger("guard_tpu.plan")
 
 #: bump when the pickled artifact layout changes — old artifacts then
-#: key to different digests and age out as misses
-PLAN_SCHEMA_VERSION = 1
+#: key to different digests and age out as misses.
+#: v2: anchor signatures (analysis/signatures.PlanSignatures) ride
+#: inside the artifact, digest-versioned with it.
+PLAN_SCHEMA_VERSION = 2
 
 #: plan-cache observability, in every --metrics-out snapshot and reset
 #: by backend.reset_all_stats(): `hits` counts in-process memo AND disk
 #: loads (a warm sweep shows hits > 0 and zero lower_compile seconds),
 #: `misses` full builds, `relocations` per-chunk remap+extend passes,
-#: `artifacts_saved` / `bytes_loaded` the disk traffic.
+#: `artifacts_saved` / `bytes_loaded` the disk traffic. The three
+#: `corrupt_*` counters split load failures by CAUSE so `report` can
+#: tell torn writes (`unreadable`) from stale layouts
+#: (`version_mismatch`) from real miscompiles (`verify` — a named
+#: invariant failed on a structurally readable artifact).
 PLAN_COUNTERS = _TELEMETRY.counter_group(
     "plan_cache",
     {
@@ -94,6 +100,9 @@ PLAN_COUNTERS = _TELEMETRY.counter_group(
         "relocations": 0,
         "artifacts_saved": 0,
         "bytes_loaded": 0,
+        "corrupt_unreadable": 0,
+        "corrupt_version_mismatch": 0,
+        "corrupt_verify": 0,
     },
 )
 
@@ -200,6 +209,11 @@ class RulePlan:
         default_factory=list
     )
     digest: str = ""
+    # per-file anchor signatures (analysis/signatures.PlanSignatures):
+    # the statically derived key-chain/type-equality anchors relevance
+    # routing consumes. None on plans built with extraction disabled —
+    # never a correctness dependency.
+    signatures: Optional[object] = None
 
     def all_compiled(self) -> List[CompiledRules]:
         """Every CompiledRules whose bit tables must track the plan
@@ -254,12 +268,23 @@ def build_plan(rule_files) -> RulePlan:
             packed = pack_compiled([c for _fi, c in pack])
             spec = packed.rim_spec()
         packs.append((tuple(fi for fi, _c in pack), packed, spec))
+    try:
+        from ..analysis.signatures import extract_plan_signatures
+
+        signatures = extract_plan_signatures(rule_files)
+    except Exception as e:  # advisory: a plan without anchors still runs
+        log.warning("anchor-signature extraction failed (%s); plan "
+                    "carries no signatures", e)
+        signatures = None
     return RulePlan(
-        interner=interner, compiled=compiled, slow=slow, packs=packs
+        interner=interner, compiled=compiled, slow=slow, packs=packs,
+        signatures=signatures,
     )
 
 
-def relocate_batch(plan: RulePlan, batch, chunk_interner: Interner) -> None:
+def relocate_batch(
+    plan: RulePlan, batch, chunk_interner: Interner, verify: bool = True
+) -> None:
     """Move one chunk batch into the plan's id namespace, in place:
     intern every chunk string into the plan interner (appending the
     unseen ones), remap the batch's id columns through the resulting
@@ -269,7 +294,12 @@ def relocate_batch(plan: RulePlan, batch, chunk_interner: Interner) -> None:
     directly against the chunk interner (tests/test_plan_cache.py pins
     the parity). Serialized under PLAN_LOCK: concurrent serve requests
     share one plan object, and interner growth + bit-table extension
-    must be atomic with respect to each other."""
+    must be atomic with respect to each other.
+
+    With `verify` (and GUARD_TPU_ANALYSIS not 0) the cheap relocation
+    invariants run after the extend — a violation here is an
+    in-process relocation bug, raised as a hard PlanVerifyError rather
+    than letting a stale id gather garbage bit-table rows."""
     with PLAN_LOCK, _span("relocate", {"docs": batch.n_docs}):
         strings = chunk_interner.strings
         if strings:
@@ -281,6 +311,18 @@ def relocate_batch(plan: RulePlan, batch, chunk_interner: Interner) -> None:
             remap_interned_ids(batch, remap)
         extend_bit_tables(plan.all_compiled(), plan.interner)
         PLAN_COUNTERS["relocations"] += 1
+        if _verify_enabled(verify):
+            from ..analysis.verify import PlanVerifyError, verify_relocation
+
+            violations = verify_relocation(plan, batch)
+            if violations:
+                raise PlanVerifyError(violations)
+
+
+def _verify_enabled(flag: bool) -> bool:
+    from ..analysis import analysis_enabled
+
+    return analysis_enabled(flag)
 
 
 # -- in-process memo + on-disk artifacts ------------------------------------
@@ -359,41 +401,101 @@ def save_plan(plan: RulePlan, digest: str) -> bool:
             log.warning("plan artifact save failed (%s); continuing "
                         "without persistence", e)
             return False
+        _save_signature_sidecar(plan, digest, path)
         PLAN_COUNTERS["artifacts_saved"] += 1
         return True
 
 
-def load_plan(digest: str) -> Optional[RulePlan]:
+def _save_signature_sidecar(plan: RulePlan, digest: str, path: Path) -> None:
+    """The human/router-readable face of the artifact's anchor
+    signatures: `<digest>.sigs.json` beside the pickle (routing
+    consumers need not unpickle a whole plan to read its anchors).
+    Best-effort, like the artifact itself."""
+    if getattr(plan, "signatures", None) is None:
+        return
+    try:
+        import json
+
+        from ..analysis.signatures import signatures_payload
+
+        sidecar = path.with_name(f"{digest}.sigs.json")
+        tmp = sidecar.with_suffix(f".tmp.{os.getpid()}")
+        tmp.write_text(json.dumps(signatures_payload(plan, digest),
+                                  indent=1, sort_keys=True))
+        os.replace(tmp, sidecar)
+    except Exception as e:
+        log.warning("signature sidecar save failed (%s)", e)
+
+
+class _LoadReject(Exception):
+    """Internal: a load failure tagged with its cause label —
+    `unreadable` (IO / torn pickle), `version-mismatch` (stale
+    schema/version/digest/type) or `verify:<invariant>` (a named
+    invariant failed on an otherwise readable artifact)."""
+
+    def __init__(self, cause: str, counter: str, detail: str):
+        self.cause = cause
+        self.counter = counter
+        super().__init__(detail)
+
+
+def load_plan(digest: str, verify: bool = True) -> Optional[RulePlan]:
     """Deserialize a plan artifact, or None on ANY problem — absent
-    file, truncated pickle, schema/version/digest mismatch. A corrupt
-    artifact logs a warning and counts as a miss; it is rewritten by
-    the save after the rebuild."""
+    file, truncated pickle, schema/version/digest mismatch, or (with
+    `verify` on) a failed invariant check. A corrupt artifact logs a
+    warning NAMING the failure cause, bumps the matching `corrupt_*`
+    counter, and counts as a miss; it is rewritten by the save after
+    the rebuild."""
     path = _artifact_path(digest)
     with _span("load_plan"):
         try:
-            if not path.exists():
-                return None
-            blob = path.read_bytes()
-            payload = pickle.loads(blob)
+            try:
+                if not path.exists():
+                    return None
+                blob = path.read_bytes()
+                payload = pickle.loads(blob)
+            except Exception as e:
+                raise _LoadReject("unreadable", "corrupt_unreadable",
+                                  str(e)) from e
             if not isinstance(payload, dict):
-                raise ValueError("artifact payload is not a dict")
+                raise _LoadReject("version-mismatch",
+                                  "corrupt_version_mismatch",
+                                  "artifact payload is not a dict")
             if payload.get("schema") != PLAN_SCHEMA_VERSION:
-                raise ValueError(
+                raise _LoadReject(
+                    "version-mismatch", "corrupt_version_mismatch",
                     f"schema {payload.get('schema')!r} != "
-                    f"{PLAN_SCHEMA_VERSION}"
+                    f"{PLAN_SCHEMA_VERSION}",
                 )
             if payload.get("version") != _guard_version():
-                raise ValueError("guard_tpu version mismatch")
+                raise _LoadReject("version-mismatch",
+                                  "corrupt_version_mismatch",
+                                  "guard_tpu version mismatch")
             if payload.get("digest") != digest:
-                raise ValueError("digest mismatch")
-            plan = payload["plan"]
+                raise _LoadReject("version-mismatch",
+                                  "corrupt_version_mismatch",
+                                  "digest mismatch")
+            plan = payload.get("plan")
             if not isinstance(plan, RulePlan):
-                raise ValueError("artifact plan is not a RulePlan")
-        except Exception as e:
+                raise _LoadReject("version-mismatch",
+                                  "corrupt_version_mismatch",
+                                  "artifact plan is not a RulePlan")
+            if _verify_enabled(verify):
+                from ..analysis.verify import verify_plan
+
+                violations = verify_plan(plan)
+                if violations:
+                    raise _LoadReject(
+                        f"verify:{violations[0].invariant}",
+                        "corrupt_verify",
+                        "; ".join(str(v) for v in violations),
+                    )
+        except _LoadReject as e:
             log.warning(
-                "plan artifact %s unusable (%s); treating as a cache "
-                "miss", path.name, e,
+                "plan artifact %s unusable (cause=%s: %s); treating as "
+                "a cache miss", path.name, e.cause, e,
             )
+            PLAN_COUNTERS[e.counter] += 1
             return None
         PLAN_COUNTERS["bytes_loaded"] += len(blob)
         return plan
@@ -412,11 +514,19 @@ def _memo_store(digest: str, plan: RulePlan) -> None:
         _PLAN_MEMO.popitem(last=False)
 
 
-def get_plan(rule_files, use_disk: bool = True) -> RulePlan:
+def get_plan(
+    rule_files, use_disk: bool = True, verify: bool = True
+) -> RulePlan:
     """The layer's one entry point: in-process memo, then the disk
     artifact, then a full build (saved back when `use_disk`). Callers
     gate on `plan_cache_enabled()` BEFORE calling — a disabled plan
-    layer means the legacy per-chunk lowering path, untouched."""
+    layer means the legacy per-chunk lowering path, untouched.
+
+    `verify` (AND GUARD_TPU_ANALYSIS not 0) runs the plan/IR verifier
+    with the asymmetric policy the analysis plane documents: a disk
+    artifact failing verification is a logged miss (load_plan), but a
+    FRESH build failing is a miscompile in this process and raises
+    PlanVerifyError — a hard, named diagnostic."""
     with PLAN_LOCK:
         digest = _digest_for(rule_files)
         plan = _PLAN_MEMO.get(digest)
@@ -425,7 +535,7 @@ def get_plan(rule_files, use_disk: bool = True) -> RulePlan:
             PLAN_COUNTERS["hits"] += 1
             return plan
         if use_disk:
-            plan = load_plan(digest)
+            plan = load_plan(digest, verify=verify)
             if plan is not None:
                 plan.digest = digest
                 PLAN_COUNTERS["hits"] += 1
@@ -433,6 +543,12 @@ def get_plan(rule_files, use_disk: bool = True) -> RulePlan:
                 return plan
         plan = build_plan(rule_files)
         plan.digest = digest
+        if _verify_enabled(verify):
+            from ..analysis.verify import PlanVerifyError, verify_plan
+
+            violations = verify_plan(plan)
+            if violations:
+                raise PlanVerifyError(violations)
         PLAN_COUNTERS["misses"] += 1
         if use_disk:
             # saved BEFORE first relocation: the artifact's interner is

@@ -71,7 +71,12 @@ from typing import Dict, List, Optional
 #: v4: the `result_cache` counter group (incremental validation plane:
 #: per-doc hit/miss/store/bytes counters, delta_docs gauges, and the
 #: cache_lookup/cache_store spans) joined the snapshot contract.
-SCHEMA_VERSION = 4
+#: v5: the `analysis` counter group (static analysis plane:
+#: invariants_checked / violations / lint_findings /
+#: signatures_extracted), the verify_plan / lint spans, and the
+#: plan_cache corrupt-cause counters (corrupt_unreadable /
+#: corrupt_version_mismatch / corrupt_verify) joined the contract.
+SCHEMA_VERSION = 5
 
 # fixed log2 histogram buckets: bucket i holds durations in
 # [2^(LOG2_LO+i-1), 2^(LOG2_LO+i)) seconds — ~1µs to ~128s, plus an

@@ -237,6 +237,46 @@ def test_checker_requires_delta_keys(tmp_path):
     assert any("result_bytes_loaded" in p for p in problems)
 
 
+def test_expected_metrics_cover_verify_rows():
+    """PR 14: the plan/IR verifier on/off overhead row pair is part of
+    the driver contract and gated by the schema checker, arriving with
+    the round-15 artifact."""
+    metrics = bench.expected_metrics()
+    for m in (
+        "config5b_verify_off_templates_per_sec",
+        "config5b_verify_on_templates_per_sec",
+    ):
+        assert m in metrics
+        assert check_bench_schema.metric_since(m) == 15
+
+
+def test_checker_requires_verify_overhead_keys(tmp_path):
+    """A verifier-on row that doesn't quantify its overhead against
+    the unverified branch fails the gate."""
+    row = {
+        "metric": "config5b_verify_on_templates_per_sec",
+        "value": 1.0,
+        "unit": "templates/sec",
+        "vs_baseline": 1.0,
+        "plan_verifier": "enabled",
+        # overhead_vs_off / invariants_checked_per_run missing
+    }
+    src = _newest_artifact().read_text().splitlines()
+    doctored = tmp_path / "bench_all_doctored_verify.json"
+    doctored.write_text(
+        "\n".join(
+            ln for ln in src
+            if '"config5b_verify_on_templates_per_sec"' not in ln
+        )
+        + "\n"
+        + __import__("json").dumps(row)
+        + "\n"
+    )
+    problems = check_bench_schema.check(doctored)
+    assert any("overhead_vs_off" in p for p in problems)
+    assert any("invariants_checked_per_run" in p for p in problems)
+
+
 def test_registry_stage_seconds_reconcile_with_wall_time(tmp_path):
     """The registry-derived stage decomposition bench.py reports must
     account for the run it claims to decompose: summing the top-level

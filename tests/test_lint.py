@@ -1,0 +1,226 @@
+"""Static-analysis plane: Guard rule linter suite
+(guard_tpu/analysis/lint.py + the `guard-tpu lint` subcommand).
+
+One hand-built fixture per check proves each code fires where it
+should; the adversarial `ok.guard` fixture (bounded intervals,
+`some`-quantified contradictions, referenced variables) proves the
+conservative analysis stays silent where it must — the zero-false-
+positive bar the shipped corpora pin in test_lint_corpus.py. The CLI
+half pins the documented exit-code contract: 0 clean, 19 findings at
+or above --fail-on, 5 parse error (which takes precedence).
+"""
+
+import json
+
+import pytest
+
+from guard_tpu.analysis.lint import (
+    CHECKS,
+    lint_files,
+    max_severity,
+)
+from guard_tpu.cli import run
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.utils.io import Reader, Writer
+
+# -------------------------------------------------------- fixtures
+# one per check; names match the lint code they provoke
+
+FIXTURES = {
+    # > 5 AND < 3 on one path: the interval is empty
+    "unsat.guard": (
+        "rule unsat_rule {\n"
+        "    Resources.*.Properties.Count > 5\n"
+        "    Resources.*.Properties.Count < 3\n"
+        "}\n"
+    ),
+    # two different string equalities on one path
+    "unsat_str.guard": (
+        "rule unsat_str_rule {\n"
+        "    Resources.*.Type == 'AWS::S3::Bucket'\n"
+        "    Resources.*.Type == 'AWS::EC2::Instance'\n"
+        "}\n"
+    ),
+    # IS_STRING and IS_LIST cannot both hold
+    "typeconf.guard": (
+        "rule type_conflict_rule {\n"
+        "    Resources.*.Properties.Tags is_string\n"
+        "    Resources.*.Properties.Tags is_list\n"
+        "}\n"
+    ),
+    # the when guard itself is unsatisfiable: the body never runs
+    "deadwhen.guard": (
+        "rule dead_when_rule when Parameters.Env == 'prod'\n"
+        "                         Parameters.Env == 'dev' {\n"
+        "    Resources.*.Properties.Enc == true\n"
+        "}\n"
+    ),
+    # nested when-block inside the body, same contradiction
+    "deadwhen2.guard": (
+        "rule dead_inner_when_rule {\n"
+        "    when Parameters.Count >= 10\n"
+        "         Parameters.Count <= 2 {\n"
+        "        Resources.*.Properties.Enc == true\n"
+        "    }\n"
+        "}\n"
+    ),
+    # filter predicate selects the empty set
+    "unsatfilter.guard": (
+        "rule unsat_filter_rule {\n"
+        "    Resources.*[ Properties.Port > 100\n"
+        "                 Properties.Port < 50 ].Type == 'X'\n"
+        "}\n"
+    ),
+    # same name, different bodies: later definition shadows
+    "shadow.guard": (
+        "rule twice { Resources.*.Properties.A == 1 }\n"
+        "rule twice { Resources.*.Properties.B == 2 }\n"
+    ),
+    # same name, byte-identical bodies (modulo location): duplicate
+    "dup.guard": (
+        "rule copied { Resources.*.Properties.A == 1 }\n"
+        "rule copied { Resources.*.Properties.A == 1 }\n"
+    ),
+    # %unused is assigned, never referenced
+    "deadlet.guard": (
+        "let unused = ['a', 'b']\n"
+        "rule uses_nothing { Resources.*.Properties.C == 3 }\n"
+    ),
+    # adversarial CLEAN file: bounded interval, some-quantified
+    # "contradiction" (each element may satisfy a different branch),
+    # and a variable that IS referenced
+    "ok.guard": (
+        "let allowed = ['web', 'db']\n"
+        "rule ok_rule {\n"
+        "    Resources.*.Properties.Name in %allowed\n"
+        "    Resources.*.Properties.Count >= 3\n"
+        "    Resources.*.Properties.Count <= 5\n"
+        "    some Resources.*.Properties.Kind == 'a'\n"
+        "    some Resources.*.Properties.Kind == 'b'\n"
+        "}\n"
+    ),
+}
+
+EXPECT = {
+    "unsat.guard": ("unsat-conjunction", "ERROR"),
+    "unsat_str.guard": ("unsat-conjunction", "ERROR"),
+    "typeconf.guard": ("type-conflict", "ERROR"),
+    "deadwhen.guard": ("always-skip-when", "WARNING"),
+    "deadwhen2.guard": ("always-skip-when", "WARNING"),
+    "unsatfilter.guard": ("unsat-filter", "WARNING"),
+    "shadow.guard": ("shadowed-rule", "WARNING"),
+    "dup.guard": ("duplicate-rule", "WARNING"),
+    "deadlet.guard": ("unreferenced-variable", "WARNING"),
+}
+
+
+def _lint_one(name):
+    rf = parse_rules_file(FIXTURES[name], name)
+    return lint_files([(name, rf)])
+
+
+@pytest.mark.parametrize("name", sorted(EXPECT))
+def test_each_check_fires(name):
+    code, severity = EXPECT[name]
+    findings = _lint_one(name)
+    assert findings, f"{name} must produce at least one finding"
+    hits = [f for f in findings if f.code == code]
+    assert hits, f"{name}: expected {code}, got {[f.code for f in findings]}"
+    assert hits[0].severity == severity
+    assert hits[0].file == name
+    # every rule-scoped finding names its rule (file-scope `let`
+    # findings legitimately have no rule to name)
+    if code != "unreferenced-variable":
+        assert hits[0].rule
+
+
+def test_clean_fixture_is_silent():
+    assert _lint_one("ok.guard") == []
+
+
+def test_findings_carry_locations_and_render():
+    f = _lint_one("unsat.guard")[0]
+    assert f.line > 0
+    text = f.render()
+    assert text.startswith("unsat.guard:")
+    assert "[unsat-conjunction]" in text and "ERROR" in text
+    doc = f.to_json()
+    assert doc["code"] == "unsat-conjunction" and doc["line"] == f.line
+
+
+def test_every_emitted_code_is_catalogued():
+    parsed = [(n, parse_rules_file(c, n)) for n, c in FIXTURES.items()]
+    for f in lint_files(parsed):
+        assert f.code in CHECKS
+    assert max_severity([]) is None
+    assert max_severity(lint_files(parsed)) == "ERROR"
+
+
+def test_cross_file_duplicate_is_info():
+    parsed = [
+        (n, parse_rules_file("rule same_name { Resources.*.P == 1 }\n", n))
+        for n in ("one.guard", "two.guard")
+    ]
+    findings = lint_files(parsed)
+    assert [f.code for f in findings] == ["cross-file-duplicate",
+                                          "cross-file-duplicate"] or [
+        f.code for f in findings] == ["cross-file-duplicate"]
+    assert all(f.severity == "INFO" for f in findings)
+
+
+# ------------------------------------------------------ CLI contract
+
+
+def _write_fixtures(tmp_path, names):
+    for n in names:
+        (tmp_path / n).write_text(FIXTURES[n])
+
+
+def _run_lint(tmp_path, *extra):
+    w = Writer.buffered()
+    rc = run(["lint", "-r", str(tmp_path), *extra], writer=w,
+             reader=Reader())
+    return rc, w.out.getvalue(), w.err.getvalue()
+
+
+def test_cli_exit_0_on_clean(tmp_path):
+    _write_fixtures(tmp_path, ["ok.guard"])
+    rc, out, err = _run_lint(tmp_path)
+    assert rc == 0 and out == ""
+    assert "0 error(s)" in err
+
+
+def test_cli_exit_19_on_error_findings(tmp_path):
+    _write_fixtures(tmp_path, ["ok.guard", "unsat.guard"])
+    rc, out, _err = _run_lint(tmp_path)
+    assert rc == 19
+    assert "[unsat-conjunction]" in out
+
+
+def test_cli_fail_on_threshold(tmp_path):
+    _write_fixtures(tmp_path, ["shadow.guard"])  # WARNING only
+    assert _run_lint(tmp_path)[0] == 0  # default --fail-on error
+    assert _run_lint(tmp_path, "--fail-on", "warning")[0] == 19
+    assert _run_lint(tmp_path, "--fail-on", "never")[0] == 0
+
+
+def test_cli_exit_5_on_parse_error_takes_precedence(tmp_path):
+    _write_fixtures(tmp_path, ["unsat.guard"])
+    (tmp_path / "broken.guard").write_text("rule broken {\n  this is not(((\n")
+    rc, out, err = _run_lint(tmp_path)
+    assert rc == 5
+    assert "Parse Error" in err
+    # the parseable file was still linted
+    assert "[unsat-conjunction]" in out
+
+
+def test_cli_structured_json(tmp_path):
+    _write_fixtures(tmp_path, ["unsat.guard", "shadow.guard"])
+    rc, out, _err = _run_lint(tmp_path, "--structured", "--fail-on",
+                              "never")
+    assert rc == 0
+    doc = json.loads(out)
+    codes = {f["code"] for f in doc["findings"]}
+    assert {"unsat-conjunction", "shadowed-rule"} <= codes
+    assert doc["summary"]["files"] == 2
+    assert doc["summary"]["error"] == 1

@@ -1,0 +1,46 @@
+"""The shipped rule corpora are lint-clean: zero ERROR findings over
+corpus/rules and examples/, pinned so the linter's conservative
+analysis can never rot into false positives on real rule sets — and
+so a future corpus addition with a genuinely unsatisfiable rule fails
+CI here instead of shipping dead rules.
+
+(The synthetic corpus intentionally reuses rule names across files —
+its variant generator stamps `_v1`/`_v2` families — so INFO-level
+cross-file-duplicate findings are expected and allowed; anything
+ERROR or WARNING is not.)
+"""
+
+from pathlib import Path
+
+import pytest
+
+from guard_tpu.cli import run
+from guard_tpu.commands.lint import lint_findings
+from guard_tpu.utils.io import Reader, Writer
+
+REPO = Path(__file__).resolve().parent.parent
+
+CORPORA = [p for p in (REPO / "corpus" / "rules", REPO / "examples")
+           if p.is_dir()]
+
+
+@pytest.mark.parametrize("corpus", CORPORA, ids=lambda p: p.name)
+def test_corpus_has_no_error_or_warning_findings(corpus):
+    findings = lint_findings([str(corpus)])
+    loud = [f for f in findings if f.severity in ("ERROR", "WARNING")]
+    assert loud == [], "\n".join(f.render() for f in loud)
+
+
+def test_corpus_info_findings_are_only_cross_file_duplicates():
+    findings = lint_findings([str(p) for p in CORPORA])
+    assert all(f.code == "cross-file-duplicate" for f in findings), {
+        f.code for f in findings
+    }
+
+
+def test_cli_over_shipped_corpora_exits_clean():
+    w = Writer.buffered()
+    rc = run(["lint", "-r", *[str(p) for p in CORPORA]], writer=w,
+             reader=Reader())
+    assert rc == 0
+    assert "0 error(s), 0 warning(s)" in w.err.getvalue()

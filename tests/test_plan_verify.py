@@ -1,0 +1,349 @@
+"""Static-analysis plane: plan/IR verifier suite
+(guard_tpu/analysis/verify.py + its ops/plan.py hooks).
+
+The core of the suite is mutation testing: seed each corruption class
+the verifier promises to catch (swapped segment offsets, truncated bit
+tables, off-by-one anchor-chain slots, stale intern ids, rim spec
+drift, dangling slot references) into a healthy plan and assert the
+violation comes back under its *named* invariant — plus the other
+half of the bargain: the unmutated plan, including one lowered from
+the full shipped corpus, verifies clean before AND after relocation.
+
+Policy hooks: a corrupt artifact on *load* degrades to a cache miss
+whose warning names the violated invariant (cause=verify:<name>) and
+bumps the plan_cache corrupt_verify counter; the same corruption on a
+*fresh* lowering raises PlanVerifyError (exit-5 hard diagnostic).
+GUARD_TPU_ANALYSIS=0 and verify=False both skip the checks.
+"""
+
+import pickle
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from guard_tpu.analysis import analysis_stats, reset_analysis_stats
+from guard_tpu.analysis.verify import (
+    INVARIANTS,
+    PlanVerifyError,
+    first_violation_name,
+    verify_plan,
+    verify_relocation,
+)
+from guard_tpu.commands.validate import RuleFile
+from guard_tpu.core.parser import parse_rules_file
+from guard_tpu.core.values import from_plain
+from guard_tpu.ops import plan as plan_mod
+from guard_tpu.ops.encoder import Interner, encode_batch
+from guard_tpu.ops.ir import StepKeyChain
+
+REPO = Path(__file__).resolve().parent.parent
+
+# the nested literal path Properties.Enc folds into a StepKeyChain, so
+# the chain invariants are live on this tiny registry
+RULES_A = (
+    "let b = Resources.*[ Type == 'AWS::S3::Bucket' ]\n"
+    "rule sse when %b !empty { %b.Properties.Enc == true }\n"
+)
+RULES_B = (
+    "rule named { Resources.*.Properties.Name in ['web', 'db'] }\n"
+    "rule arnish { Resources.*.Properties.Arn == /^arn:aws:/ }\n"
+)
+
+
+def _rule_file(content: str, name: str = "r.guard") -> RuleFile:
+    return RuleFile(
+        name=name, full_name=name, content=content,
+        rules=parse_rules_file(content, name),
+    )
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan_state(tmp_path, monkeypatch):
+    monkeypatch.setenv("GUARD_TPU_PLAN_CACHE_DIR", str(tmp_path / "plans"))
+    plan_mod.clear_plan_memo()
+    plan_mod.reset_plan_stats()
+    reset_analysis_stats()
+    yield
+    plan_mod.clear_plan_memo()
+    plan_mod.reset_plan_stats()
+    reset_analysis_stats()
+
+
+def _build():
+    return plan_mod.get_plan([_rule_file(RULES_A, "a.guard"),
+                              _rule_file(RULES_B, "b.guard")])
+
+
+def _relocate(plan, doc=None):
+    doc = doc or {
+        "Resources": {"x": {"Type": "AWS::S3::Bucket",
+                            "Properties": {"Enc": True, "Name": "web"}}}
+    }
+    chunk = Interner()
+    batch, _ = encode_batch([from_plain(doc)], chunk)
+    plan_mod.relocate_batch(plan, batch, chunk)
+    return batch
+
+
+def _names(violations):
+    return {v.invariant for v in violations}
+
+
+def _pack_chain(plan):
+    """First folded StepKeyChain in the plan's pack (the fixture rules
+    guarantee one exists)."""
+    _pos, packed, _spec = plan.packs[0]
+    found = []
+
+    def visit(s):
+        if isinstance(s, StepKeyChain):
+            found.append(s)
+
+    from guard_tpu.analysis.verify import _walk_compiled
+    _walk_compiled(packed.compiled, visit, lambda n: None)
+    assert found, "fixture rules must fold at least one key chain"
+    return found[0]
+
+
+# ------------------------------------------------------ healthy plans
+
+
+def test_fresh_plan_verifies_clean():
+    plan = _build()
+    assert verify_plan(plan) == []
+    stats = analysis_stats()
+    assert stats["invariants_checked"] > 0
+    assert stats["violations"] == 0
+
+
+def test_relocated_plan_verifies_clean():
+    plan = _build()
+    batch = _relocate(plan)
+    assert verify_plan(plan) == []
+    assert verify_relocation(plan, batch) == []
+
+
+def test_full_corpus_plan_verifies_clean():
+    """The whole shipped corpus lowers to a plan with zero violations,
+    before and after a relocation — the no-false-positive half of the
+    mutation bargain."""
+    rule_files = []
+    for p in sorted((REPO / "corpus" / "rules").glob("*.guard")):
+        rf = parse_rules_file(p.read_text(), p.name)
+        if rf is not None:
+            rule_files.append(RuleFile(name=p.name, full_name=str(p),
+                                       content=p.read_text(), rules=rf))
+    assert len(rule_files) > 100
+    plan = plan_mod.build_plan(rule_files)
+    assert verify_plan(plan) == []
+    batch = _relocate(plan)
+    assert verify_plan(plan) == []
+    assert verify_relocation(plan, batch) == []
+
+
+# --------------------------------------------------- seeded mutations
+
+
+def test_mutation_swapped_segment_offsets():
+    plan = _build()
+    _pos, packed, _spec = plan.packs[0]
+    assert len(packed.offsets) >= 2
+    packed.offsets[0], packed.offsets[1] = (packed.offsets[1],
+                                            packed.offsets[0])
+    assert "segment_offsets_consistent" in _names(verify_plan(plan))
+
+
+def test_mutation_truncated_bit_table():
+    plan = _build()
+    batch = _relocate(plan)  # grow the tables past zero width first
+    part = plan.packs[0][1].compiled
+    assert part.bit_tables and len(part.bit_tables[0][0]) > 0
+    table, target = part.bit_tables[0]
+    part.bit_tables[0] = (table[:-1], target)
+    violations = verify_plan(plan)
+    assert "bit_table_width" in _names(violations)
+    # the cheap per-chunk subset catches it too
+    assert "bit_table_width" in _names(verify_relocation(plan, batch))
+
+
+def test_mutation_off_by_one_chain_slot():
+    plan = _build()
+    chain = _pack_chain(plan)
+    chain.chain_slot += 1
+    assert "anchor_chain_domains" in _names(verify_plan(plan))
+
+
+def test_mutation_chain_spec_drift():
+    """chain_slot still in range, but the bound spec no longer matches
+    the folded steps — the anchor columns would be computed for the
+    wrong keys."""
+    plan = _build()
+    chain = _pack_chain(plan)
+    comp = plan.packs[0][1].compiled
+    spec = comp.chain_tables[chain.chain_slot]
+    comp.chain_tables[chain.chain_slot] = (
+        (("NotTheKey",), spec[0][1]),) + tuple(spec[1:])
+    assert "anchor_chain_domains" in _names(verify_plan(plan))
+
+
+def test_mutation_stale_intern_ids():
+    plan = _build()
+    batch = _relocate(plan)
+    batch.scalar_id = batch.scalar_id.copy()
+    batch.scalar_id.flat[0] = len(plan.interner.strings) + 7
+    violations = verify_relocation(plan, batch)
+    assert _names(violations) == {"intern_id_domain"}
+
+
+def test_mutation_rim_spec_drift():
+    plan = _build()
+    _pos, _packed, spec = plan.packs[0]
+    spec.group_ids = np.roll(spec.group_ids, 1)
+    assert "rim_name_group_coverage" in _names(verify_plan(plan))
+
+
+def test_mutation_dangling_slot_reference():
+    plan = _build()
+    part = plan.packs[0][1].compiled
+    # orphan every bit-table slot reference by dropping the tables
+    part.bit_tables = []
+    part.bit_specs = []
+    assert "slot_relocation_bijective" in _names(verify_plan(plan))
+
+
+def test_every_emitted_name_is_catalogued():
+    """Whatever the mutations above produce must come from the
+    published INVARIANTS tuple (docs enumerate against it)."""
+    plan = _build()
+    plan.packs[0][1].offsets[0] += 1
+    for v in verify_plan(plan):
+        assert v.invariant in INVARIANTS
+    assert first_violation_name([]) is None
+
+
+# ------------------------------------------------------- policy hooks
+
+
+def _corrupt_saved_artifact(plan):
+    """Rewrite the on-disk artifact with a seeded chain-slot
+    corruption, keeping schema/version/digest valid so only the
+    verifier can reject it."""
+    art = plan_mod._artifact_path(plan.digest)
+    payload = pickle.loads(art.read_bytes())
+    from guard_tpu.analysis.verify import _walk_compiled
+
+    found = []
+
+    def visit(s):
+        if isinstance(s, StepKeyChain):
+            found.append(s)
+
+    _walk_compiled(payload["plan"].packs[0][1].compiled, visit,
+                   lambda n: None)
+    found[0].chain_slot += 1
+    art.write_bytes(pickle.dumps(payload))
+
+
+def test_corrupt_artifact_load_is_named_miss(caplog):
+    plan = _build()
+    _corrupt_saved_artifact(plan)
+    plan_mod.clear_plan_memo()
+    plan_mod.reset_plan_stats()
+    with caplog.at_level("WARNING", logger="guard_tpu.plan"):
+        assert plan_mod.load_plan(plan.digest) is None
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any("cause=verify:anchor_chain_domains" in m for m in msgs)
+    assert plan_mod.plan_stats()["corrupt_verify"] == 1
+    # ... and get_plan rebuilds + rewrites a healthy artifact over it
+    rebuilt = plan_mod.get_plan([_rule_file(RULES_A, "a.guard"),
+                                 _rule_file(RULES_B, "b.guard")])
+    assert verify_plan(rebuilt) == []
+    assert plan_mod.plan_stats()["misses"] == 1
+
+
+def test_corrupt_artifact_load_skipped_when_disabled(monkeypatch):
+    plan = _build()
+    _corrupt_saved_artifact(plan)
+    plan_mod.clear_plan_memo()
+    monkeypatch.setenv("GUARD_TPU_ANALYSIS", "0")
+    # escape hatch: the (structurally loadable) artifact is accepted
+    assert plan_mod.load_plan(plan.digest) is not None
+    monkeypatch.delenv("GUARD_TPU_ANALYSIS")
+    assert plan_mod.load_plan(plan.digest) is None  # verifier back on
+    assert plan_mod.load_plan(plan.digest, verify=False) is not None
+
+
+def test_fresh_lowering_violation_is_hard_error(monkeypatch):
+    """A plan that fails verification straight out of build_plan is a
+    miscompile in THIS process: get_plan must raise, not cache it."""
+    real_build = plan_mod.build_plan
+
+    def sabotaged(rule_files):
+        plan = real_build(rule_files)
+        plan.packs[0][1].offsets[0] += 1
+        return plan
+
+    monkeypatch.setattr(plan_mod, "build_plan", sabotaged)
+    with pytest.raises(PlanVerifyError) as ei:
+        _build()
+    assert "segment_offsets_consistent" in str(ei.value)
+    assert ei.value.violations
+
+
+def test_relocation_violation_is_hard_error():
+    """The exact bug class the per-chunk verify exists for: a batch
+    whose id columns belong to a different interner than the one the
+    caller passed. The remap is a no-op, the chunk-local ids leak into
+    the plan namespace, and the gather would read garbage bit-table
+    rows — relocate_batch must raise instead."""
+    plan = _build()
+    chunk = Interner()
+    batch, _ = encode_batch(
+        [from_plain({"Resources": {"x": {"Properties": {"Name": "web"}}}})],
+        chunk,
+    )
+    with pytest.raises(PlanVerifyError) as ei:
+        plan_mod.relocate_batch(plan, batch, Interner())  # wrong interner
+    assert "intern_id_domain" in str(ei.value)
+
+
+# ------------------------------------------- signatures in the artifact
+
+
+RULES_T = (
+    "rule typed {\n"
+    "    Resources.*.Type == 'AWS::S3::Bucket'\n"
+    "    Resources.*.Properties.Enc == true\n"
+    "}\n"
+)
+
+
+def test_signatures_round_trip_through_artifact():
+    rfs = [_rule_file(RULES_T, "t.guard"), _rule_file(RULES_B, "b.guard")]
+    plan = plan_mod.get_plan(rfs)
+    assert plan.signatures is not None
+    sigs = plan.signatures
+    assert len(sigs.files) == 2
+    # t.guard anchors on the S3 bucket type equality; both files on
+    # the Resources key chain
+    assert "AWS::S3::Bucket" in sigs.files[0].type_equalities
+    assert ("Resources",) in sigs.files[1].key_chains
+    assert sigs.files[0].unanchored_rules == 0
+
+    plan_mod.clear_plan_memo()
+    reloaded = plan_mod.get_plan([_rule_file(RULES_T, "t.guard"),
+                                  _rule_file(RULES_B, "b.guard")])
+    assert plan_mod.plan_stats()["hits"] == 1
+    assert reloaded.signatures is not None
+    assert reloaded.signatures.files[0].to_json() == sigs.files[0].to_json()
+
+    # the digest-versioned sidecar rides beside the pickle with the
+    # pack inverted index
+    import json
+
+    sidecar = plan_mod.plan_cache_dir() / f"{plan.digest}.sigs.json"
+    doc = json.loads(sidecar.read_text())
+    assert doc["digest"] == plan.digest
+    assert doc["packs"] and "members" in doc["packs"][0]
+    assert doc["packs"][0]["type_equalities"]

@@ -236,6 +236,74 @@ def test_result_cache_group_in_snapshot_contract():
     assert check_snapshot(doctored, require_groups=("result_cache",))
 
 
+def test_analysis_group_in_snapshot_contract():
+    """v5: the static-analysis plane's counter group joined the
+    published snapshot shape, alongside the plan_cache corrupt-cause
+    split."""
+    import tools.check_metrics_schema as cms
+    from guard_tpu import analysis  # noqa: F401 — registers group
+    from guard_tpu.ops import plan as plan_mod
+
+    assert "analysis" in cms.EXPECTED_GROUPS
+    assert cms.KNOWN_SCHEMA_VERSION == telemetry.SCHEMA_VERSION == 5
+    snap = telemetry.metrics_snapshot()
+    assert "analysis" in snap["counters"]
+    for key in ("invariants_checked", "violations", "lint_findings",
+                "signatures_extracted"):
+        assert key in snap["counters"]["analysis"]
+    for key in ("corrupt_unreadable", "corrupt_version_mismatch",
+                "corrupt_verify"):
+        assert key in snap["counters"]["plan_cache"]
+    assert plan_mod.plan_stats().keys() >= {"corrupt_verify"}
+    doctored = json.loads(json.dumps(snap))
+    del doctored["counters"]["analysis"]
+    assert check_snapshot(doctored, require_groups=("analysis",))
+
+
+def test_verify_and_lint_spans_roll_up():
+    from guard_tpu.analysis.lint import lint_files
+    from guard_tpu.analysis.verify import verify_plan
+    from guard_tpu.commands.validate import RuleFile
+    from guard_tpu.core.parser import parse_rules_file
+    from guard_tpu.ops import plan as plan_mod
+
+    telemetry.enable()
+    rf = RuleFile(name="r.guard", full_name="r.guard", content=RULES,
+                  rules=parse_rules_file(RULES, "r.guard"))
+    plan = plan_mod.build_plan([rf])
+    assert verify_plan(plan) == []
+    lint_files([("r.guard", rf.rules)])
+    rollups = telemetry.REGISTRY.span_rollups()
+    assert rollups["verify_plan"]["count"] == 1
+    assert rollups["lint"]["count"] == 1
+
+
+def test_disabled_analysis_costs_one_branch(monkeypatch):
+    """GUARD_TPU_ANALYSIS=0 (or verify=False) must short-circuit
+    before any structure walk: verify hooks reduce to the enablement
+    check, never touching the violation machinery."""
+    from guard_tpu import analysis
+    from guard_tpu.ops import plan as plan_mod
+
+    monkeypatch.setenv("GUARD_TPU_ANALYSIS", "0")
+    assert analysis.analysis_enabled(True) is False
+    assert analysis.analysis_enabled(False) is False
+    calls = []
+    monkeypatch.setattr(
+        "guard_tpu.analysis.verify.verify_plan",
+        lambda plan: calls.append(plan) or [],
+    )
+    assert plan_mod._verify_enabled(True) is False
+    assert calls == []  # the walk never ran
+    monkeypatch.delenv("GUARD_TPU_ANALYSIS")
+    assert plan_mod._verify_enabled(True) is True
+    assert plan_mod._verify_enabled(False) is False  # flag alone gates too
+    # spans stay the shared no-op singleton while tracing is off
+    s1 = telemetry.span("verify_plan")
+    s2 = telemetry.span("lint")
+    assert s1 is s2
+
+
 # -------------------------------------------------- trace export face
 
 

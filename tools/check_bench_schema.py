@@ -81,6 +81,13 @@ METRIC_SINCE.update({
     "config5b_delta_1pct_templates_per_sec": 14,
 })
 
+# PR 14 static-analysis plane: the plan/IR verifier overhead pair
+# arrived with round 15
+METRIC_SINCE.update({
+    "config5b_verify_off_templates_per_sec": 15,
+    "config5b_verify_on_templates_per_sec": 15,
+})
+
 
 def metric_since(metric: str) -> int:
     """The bench round whose driver first emitted `metric`."""
@@ -133,6 +140,14 @@ METRIC_REQUIRED_KEYS = {
     "config5b_flightrec_off_templates_per_sec": ("flight_recorder",),
     "config5b_flightrec_on_templates_per_sec": (
         "flight_recorder", "overhead_vs_off", "ring_records_per_run",
+    ),
+    # PR 14 static-analysis plane: the on row must quantify what the
+    # plan/IR verifier costs against the unverified branch on the same
+    # full sweep flow (the <=2% advisory-on bar), and say how many
+    # invariants one verified run checks
+    "config5b_verify_off_templates_per_sec": ("plan_verifier",),
+    "config5b_verify_on_templates_per_sec": (
+        "plan_verifier", "overhead_vs_off", "invariants_checked_per_run",
     ),
     # PR 5 failure plane: the clean row must quantify the always-on
     # quarantine plumbing's cost against fail-fast semantics, and the

@@ -31,7 +31,11 @@ import sys
 #: d2h byte counter, shard-prefetch pipeline counters and the serve
 #: coalesce_window_adaptive counter.
 #: v4: the `result_cache` counter group (incremental validation plane).
-KNOWN_SCHEMA_VERSION = 4
+#: v5: the `analysis` counter group (static analysis plane: plan/IR
+#: verifier, rule linter, anchor-signature extraction), the
+#: verify_plan / lint spans, and the plan_cache corrupt-cause
+#: counters.
+KNOWN_SCHEMA_VERSION = 5
 
 #: top-level sections every snapshot must carry
 SECTIONS = ("schema_version", "counters", "gauges", "histograms", "spans")
@@ -43,10 +47,12 @@ SECTIONS = ("schema_version", "counters", "gauges", "histograms", "spans")
 #: `require_groups` (the CI trace-smoke does). plan_cache registers
 #: with ops.plan and is part of every tpu-backend run since the plan
 #: layer became the default lowering path; result_cache registers with
-#: cache.results, imported by every sweep/validate tpu session.
+#: cache.results, imported by every sweep/validate tpu session;
+#: analysis registers with the analysis package, imported by the plan
+#: layer's verifier hooks on every tpu-backend lowering.
 EXPECTED_GROUPS = (
     "dispatch", "pipeline", "rim", "fault", "plan_cache", "efficiency",
-    "result_cache",
+    "result_cache", "analysis",
 )
 
 #: keys every histogram snapshot must carry
