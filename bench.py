@@ -2905,12 +2905,240 @@ def measure_serve_latency(rounds: int = 8, wait_ms: float = 10.0):
     return out
 
 
+def _patched_env(overrides: dict):
+    """Set (or, with value None, unset) env vars; returns a restore
+    closure. The serve front-door legs flip several knobs per leg, so
+    the save/restore boilerplate lives here once."""
+    prev = {k: os.environ.get(k) for k in overrides}
+    for k, v in overrides.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+    def restore():
+        for k, pv in prev.items():
+            if pv is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = pv
+
+    return restore
+
+
+def measure_serve_overload(rounds: int = 6, concurrency: int = 4):
+    """The front door's overload story as a measurement: the SAME
+    stalled batch path with the SLO circuit breaker off vs on. The
+    stall is deterministic — a long formation window plus an injected
+    `serve_batch` fault on every group, so each batch degrades to the
+    serialized solo-refire path (the PR 5 isolation leg): every
+    batched request pays the window AND queues behind its peers'
+    refires, with no packed-shape XLA compiles muddying the tail.
+    Off, that stall IS the p99. On, the breaker watches
+    formation+dispatch latency against GUARD_TPU_SERVE_SLO_MS, trips
+    during the untimed warmup wave, and sheds every timed request to
+    immediate solo dispatch — bounded latency, byte-identical output
+    (the solo path is the sequential path). Returns
+    (p99_off_ms, p99_on_ms, extras)."""
+    from guard_tpu.commands.serve import Serve
+    from guard_tpu.utils.telemetry import ADMISSION_COUNTERS
+
+    rng = np.random.default_rng(29)
+    lines = _serve_workload(rng, 16)
+    # warm every template shape once so XLA compiles don't pollute
+    # either leg (same discipline as measure_serve_latency)
+    warm = Serve(stdio=True, coalesce=False)
+    for ln in lines:
+        warm.handle_line(ln)
+
+    stall = {
+        "GUARD_TPU_COALESCE_WAIT_MS": "250",
+        "GUARD_TPU_COALESCE_MAX_BATCH": "64",
+        "GUARD_TPU_FAULT": "serve_batch:rate=1.0",
+    }
+    restore = _patched_env({**stall, "GUARD_TPU_SERVE_SLO_MS": None})
+    try:
+        _p50_off, p99_off, dpr_off = _serve_leg(
+            lines, concurrency, True, rounds
+        )
+    finally:
+        restore()
+    t0 = ADMISSION_COUNTERS["breaker_trips"]
+    s0 = ADMISSION_COUNTERS["shed_solo"]
+    restore = _patched_env({
+        **stall,
+        "GUARD_TPU_SERVE_SLO_MS": "50",
+        "GUARD_TPU_BREAKER_MIN_SAMPLES": "4",
+        # no half-open probe mid-measurement: a probe request pays the
+        # stalled window and would masquerade as the shed leg's p99
+        "GUARD_TPU_BREAKER_COOLDOWN_MS": "60000",
+    })
+    try:
+        _p50_on, p99_on, dpr_on = _serve_leg(
+            lines, concurrency, True, rounds
+        )
+    finally:
+        restore()
+    extras = {
+        "breaker_trips": ADMISSION_COUNTERS["breaker_trips"] - t0,
+        "shed_solo": ADMISSION_COUNTERS["shed_solo"] - s0,
+        "dispatches_per_request_off": round(dpr_off, 3),
+        "dispatches_per_request_on": round(dpr_on, 3),
+        "slo_ms": 50,
+        "stall_window_ms": 250,
+        "concurrency": concurrency,
+    }
+    return p99_off, p99_on, extras
+
+
+def measure_quota_isolation(n_quiet: int = 24, hot_threads: int = 6,
+                            max_inflight: int = 2):
+    """Per-tenant isolation as a measurement: a hot tenant hammers a
+    warm session from `hot_threads` client threads while a quiet
+    tenant issues sequential requests. The UNCAPPED leg (in-flight
+    ceiling lifted) is the baseline: every hot request is admitted
+    and the quiet tenant queues behind the whole flood. The CAPPED
+    leg bounds every tenant at GUARD_TPU_TENANT_MAX_INFLIGHT — the
+    hot tenant saturates ITS OWN ceiling (rejections answer the
+    structured 429-class envelope immediately; the client here backs
+    off ~5ms, honoring the retry hint) and the quiet tenant queues
+    behind at most `max_inflight` hot peers. Coalescing is pinned to
+    solo dispatch (max batch 1) for the whole measurement so the row
+    isolates ADMISSION — mixed hot/quiet device packs would charge
+    pack-shape XLA compiles and formation windows to the quiet
+    tenant. Envelope parity vs an unloaded pass certifies the quiet
+    tenant's bytes were untouched. Returns
+    (quiet_p50_capped_ms, quiet_p50_uncapped_ms, extras)."""
+    import threading
+
+    from guard_tpu.commands.serve import Serve
+    from guard_tpu.utils.telemetry import ADMISSION_COUNTERS
+
+    rng = np.random.default_rng(31)
+    lines = _serve_workload(rng, 8)
+
+    def envelope(resp):
+        return (
+            resp.get("code"), resp.get("output"), resp.get("error"),
+            resp.get("error_class"),
+        )
+
+    def tagged(line, tenant):
+        req = json.loads(line)
+        req["tenant"] = tenant
+        return json.dumps(req)
+
+    # tag once, outside any timed section: re-encoding the multi-KB
+    # payload per hot iteration would charge client-side JSON work
+    # (and its GIL share) to the quiet tenant's latency
+    quiet_lines = [tagged(lines[i % 8], "quiet") for i in range(n_quiet)]
+    hot_lines = [tagged(ln, "hot") for ln in lines]
+
+    def loaded_leg(srv):
+        """Quiet tenant's sequential pass under the hot flood; returns
+        (sorted latencies ms, envelopes, hot admitted, hot rejected)."""
+        stop = threading.Event()
+        admitted = [0] * hot_threads
+        rejected = [0] * hot_threads
+
+        def hot(k):
+            i = k
+            while not stop.is_set():
+                resp = srv.handle_line(hot_lines[i % len(hot_lines)])
+                if resp.get("error_class") in (
+                    "QuotaExceeded", "QueueFull"
+                ):
+                    rejected[k] += 1
+                    time.sleep(0.005)
+                else:
+                    admitted[k] += 1
+                i += 1
+
+        threads = [
+            threading.Thread(target=hot, args=(k,))
+            for k in range(hot_threads)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # settle: hot load at steady state first
+        lat, envs = [], []
+        for ln in quiet_lines:
+            t0 = time.perf_counter()
+            resp = srv.handle_line(ln)
+            lat.append((time.perf_counter() - t0) * 1000.0)
+            envs.append(envelope(resp))
+        stop.set()
+        for t in threads:
+            t.join()
+        lat.sort()
+        return lat, envs, sum(admitted), sum(rejected)
+
+    solo = {"GUARD_TPU_COALESCE_MAX_BATCH": "1"}
+    # baseline leg: quotas lifted — the flood is fully admitted
+    restore = _patched_env({
+        **solo, "GUARD_TPU_TENANT_MAX_INFLIGHT": "0",
+    })
+    try:
+        srv = Serve(stdio=True, coalesce=True)
+        for ln in lines:
+            srv.handle_line(ln)  # warm every shape before timing
+        lat_unc, _envs, unc_admitted, _r = loaded_leg(srv)
+    finally:
+        restore()
+    # capped leg: same flood, every tenant bounded at max_inflight
+    # (the ceiling is read once per session, so a fresh session)
+    restore = _patched_env({
+        **solo, "GUARD_TPU_TENANT_MAX_INFLIGHT": str(max_inflight),
+    })
+    try:
+        srv = Serve(stdio=True, coalesce=True)
+        for ln in lines:
+            srv.handle_line(ln)
+        # unloaded pass: the envelope-parity reference
+        alone_lat, alone_env = [], []
+        for ln in quiet_lines:
+            t0 = time.perf_counter()
+            resp = srv.handle_line(ln)
+            alone_lat.append((time.perf_counter() - t0) * 1000.0)
+            alone_env.append(envelope(resp))
+        r0 = ADMISSION_COUNTERS["rejected_inflight"]
+        lat_cap, cap_env, cap_admitted, cap_rejected = loaded_leg(srv)
+        quota_rejections = (
+            ADMISSION_COUNTERS["rejected_inflight"] - r0
+        )
+    finally:
+        restore()
+    alone_lat.sort()
+    p50_alone = alone_lat[len(alone_lat) // 2]
+    p50_unc = lat_unc[len(lat_unc) // 2]
+    p50_cap = lat_cap[len(lat_cap) // 2]
+    extras = {
+        "p50_alone_ms": round(p50_alone, 2),
+        "p50_uncapped_ms": round(p50_unc, 2),
+        "hot_admitted": cap_admitted,
+        "hot_rejected": cap_rejected,
+        "hot_admitted_uncapped": unc_admitted,
+        "quota_rejections": quota_rejections,
+        "envelope_parity": cap_env == alone_env,
+        "tenant_max_inflight": max_inflight,
+        "hot_threads": hot_threads,
+    }
+    return p50_cap, p50_unc, extras
+
+
 def serve_smoke(n_requests: int = 16) -> None:
     """CI smoke for the serving plane (JAX_PLATFORMS=cpu): 16
     concurrent requests against ONE rule digest must coalesce into
     >= 4x fewer device dispatches than the sequential baseline, with
     byte-identical response envelopes and a nonzero coalesced-batch
-    counter. Prints one JSON line; raises SystemExit(1) on violation."""
+    counter. A second, overload/chaos leg replays the same load
+    against a 4-slot admission queue with injected admission/shed
+    faults and a per-tenant in-flight ceiling: EVERY request must
+    still answer — clean envelopes byte-identical to the sequential
+    baseline, disciplined rejections and injected faults as
+    structured error envelopes — with the breaker-trip, shed and
+    quota counters all nonzero. Prints one JSON line; raises
+    SystemExit(1) on violation."""
     import threading
 
     from guard_tpu.commands.serve import Serve
@@ -2965,6 +3193,73 @@ def serve_smoke(n_requests: int = 16) -> None:
             os.environ["GUARD_TPU_COALESCE_WAIT_MS"] = prev
 
     parity = results == seq
+
+    # --- overload/chaos leg: the front door under 4x queue pressure.
+    # Queue capacity 4 against 16 concurrent clients, a formation
+    # window (150ms) that outlives the bounded admission wait (20ms),
+    # a per-tenant in-flight ceiling of 8, and injected admission +
+    # shed faults. Every request must answer: queued members ride one
+    # coalesced batch, blocked members trip the breaker via QueueFull
+    # and shed to solo dispatch, over-ceiling members answer the
+    # structured 429-class envelope, injected faults answer structured
+    # errors — nothing hangs, nothing drops.
+    from guard_tpu.utils.faults import FAULT_COUNTERS, reset_faults
+    from guard_tpu.utils.telemetry import ADMISSION_COUNTERS
+
+    restore = _patched_env({
+        "GUARD_TPU_SERVE_QUEUE_MAX": "4",
+        "GUARD_TPU_SERVE_QUEUE_WAIT_MS": "20",
+        "GUARD_TPU_COALESCE_WAIT_MS": "150",
+        "GUARD_TPU_TENANT_MAX_INFLIGHT": "8",
+        # an SLO generous enough that only queue SATURATION trips the
+        # breaker (on_queue_full is the no-quorum trip; a disabled
+        # breaker — no SLO — would never trip at all), and a cooldown
+        # long enough that no half-open probe fires mid-leg
+        "GUARD_TPU_SERVE_SLO_MS": "5000",
+        "GUARD_TPU_BREAKER_COOLDOWN_MS": "60000",
+        "GUARD_TPU_FAULT": "admission:nth=3,shed:nth=2",
+    })
+    reset_faults()  # fresh nth= sequencing for this leg's clauses
+    b0 = ADMISSION_COUNTERS["breaker_trips"]
+    s0 = ADMISSION_COUNTERS["shed_solo"]
+    q0 = ADMISSION_COUNTERS["rejected_inflight"]
+    try:
+        chaos_srv = Serve(stdio=True, coalesce=True)
+        chaos = [None] * n_requests
+        barrier2 = threading.Barrier(n_requests)
+
+        def chaos_worker(i):
+            barrier2.wait()
+            chaos[i] = envelope(chaos_srv.handle_line(lines[i]))
+
+        threads = [
+            threading.Thread(target=chaos_worker, args=(i,))
+            for i in range(n_requests)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        injected_admission = FAULT_COUNTERS["injected_admission"]
+        injected_shed = FAULT_COUNTERS["injected_shed"]
+    finally:
+        restore()
+        reset_faults()
+    disciplined = ("QuotaExceeded", "QueueFull", "InjectedFault")
+    answered = all(c is not None and c[0] in (0, 5, 19) for c in chaos)
+    clean = [i for i, c in enumerate(chaos) if c[3] not in disciplined]
+    chaos_parity = all(chaos[i] == seq[i] for i in clean)
+    overload = {
+        "answered": answered,
+        "clean_requests": len(clean),
+        "chaos_parity": chaos_parity,
+        "breaker_trips": ADMISSION_COUNTERS["breaker_trips"] - b0,
+        "shed_solo": ADMISSION_COUNTERS["shed_solo"] - s0,
+        "quota_rejections": ADMISSION_COUNTERS["rejected_inflight"] - q0,
+        "injected_admission": injected_admission,
+        "injected_shed": injected_shed,
+    }
+
     record = {
         "metric": "serve_smoke",
         "requests": n_requests,
@@ -2976,6 +3271,7 @@ def serve_smoke(n_requests: int = 16) -> None:
         "coalesced_batches": coalesced_batches,
         "coalesced_requests": coalesced_requests,
         "parity": parity,
+        "overload": overload,
     }
     print(json.dumps(record), flush=True)
     ok = (
@@ -2984,6 +3280,14 @@ def serve_smoke(n_requests: int = 16) -> None:
         and seq_dispatches >= n_requests
         and con_dispatches * 4 <= seq_dispatches
         and coalesced_batches >= 1
+        and answered
+        and chaos_parity
+        and len(clean) >= 1
+        and overload["breaker_trips"] >= 1
+        and overload["shed_solo"] >= 1
+        and overload["quota_rejections"] >= 1
+        and overload["injected_admission"] >= 1
+        and overload["injected_shed"] >= 1
     )
     if not ok:
         raise SystemExit(1)
@@ -3302,6 +3606,9 @@ def expected_metrics() -> list:
         for leg in ("off", "on"):
             out.append(f"serve_c{c}_coalesce_{leg}_p50_ms")
     out.append("serve_c1_adaptive_p50_ratio")
+    out.append("serve_overload_shed_off_p99_ms")
+    out.append("serve_overload_shed_on_p99_ms")
+    out.append("serve_quota_isolation_quiet_p50_ms")
     for tag in ("50pct", "allfail"):
         for flow in ("full", "python_rerun", "statuses_only"):
             out.append(f"config6_fail_{tag}_{flow}_docs_per_sec")
@@ -3843,6 +4150,57 @@ def main() -> None:
             "p50_off_ms": round(p50_off_c1, 2),
             "coalesce_window_adaptive": serve_cells.get((1, "adaptive"), 0),
             "vs_note": "value = c=1 coalesce-on p50 over coalesce-off p50 (lower is better, ~1.0 means the adaptive window erased the formation-wait cost on lone arrivals)",
+        },
+    )
+
+    # front-door overload rows: the same stalled batcher with the SLO
+    # circuit breaker off vs on — "what does shedding buy under a
+    # stall" is the on row's vs_baseline (off-leg p99 over on-leg p99)
+    p99_off, p99_on, x_over = measure_serve_overload()
+    _emit(
+        "serve_overload_shed_off_p99_ms",
+        p99_off,
+        1.0,
+        unit="ms",
+        extra={
+            "dispatches_per_request": x_over[
+                "dispatches_per_request_off"
+            ],
+            "stall_window_ms": x_over["stall_window_ms"],
+            "concurrency": x_over["concurrency"],
+        },
+    )
+    _emit(
+        "serve_overload_shed_on_p99_ms",
+        p99_on,
+        p99_off / max(p99_on, 1e-9),
+        unit="ms",
+        extra={
+            "dispatches_per_request": x_over["dispatches_per_request_on"],
+            "stall_window_ms": x_over["stall_window_ms"],
+            "concurrency": x_over["concurrency"],
+            "slo_ms": x_over["slo_ms"],
+            "breaker_trips": x_over["breaker_trips"],
+            "shed_solo": x_over["shed_solo"],
+            "vs_note": "vs_baseline here = shed-off p99 over shed-on p99 under the same stalled formation window (> 1 means the breaker's shed path bounded tail latency); value rows are milliseconds, lower is better",
+        },
+    )
+
+    # front-door isolation row: the quiet tenant's p50 while a hot
+    # tenant floods the session — vs_baseline divides the UNCAPPED
+    # p50 (quotas lifted, the flood fully admitted) by the capped one
+    # (> 1 means per-tenant admission bought the quiet tenant its
+    # latency back), and envelope_parity certifies its bytes were
+    # untouched
+    p50_cap, p50_unc, x_quota = measure_quota_isolation()
+    _emit(
+        "serve_quota_isolation_quiet_p50_ms",
+        p50_cap,
+        p50_unc / max(p50_cap, 1e-9),
+        unit="ms",
+        extra={
+            **x_quota,
+            "vs_note": "vs_baseline here = quiet-tenant p50 under an UNCAPPED hot flood over its p50 with per-tenant in-flight ceilings enforced (> 1 means admission quotas isolated the quiet tenant); value rows are milliseconds, lower is better",
         },
     )
 

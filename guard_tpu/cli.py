@@ -267,6 +267,15 @@ def build_parser() -> argparse.ArgumentParser:
         "verifier after lowering, relocation and artifact load "
         "(advisory escape hatch — also GUARD_TPU_ANALYSIS=0)",
     )
+    s.add_argument(
+        "--follow",
+        action="store_true",
+        help="streaming CI mode: validate JSONL documents from stdin "
+        "as they arrive (micro-batch dispatch against the precompiled "
+        "plan, one result line per input line, summary + sweep exit "
+        "code at EOF; GUARD_TPU_FOLLOW_WAIT_MS bounds formation "
+        "latency)",
+    )
     _add_telemetry_flags(s)
 
     li = sub.add_parser(
@@ -339,6 +348,26 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="disable cross-request batch coalescing (same as "
         "GUARD_TPU_COALESCE=0): every request dispatches alone",
+    )
+    sv.add_argument(
+        "--rules",
+        "-r",
+        nargs="*",
+        default=None,
+        metavar="FILE",
+        help="rule files preloaded as the session registry for the "
+        "POST /webhook face (AdmissionReview objects validate against "
+        "these; without it the webhook answers allowed with a "
+        "'no rules configured' message)",
+    )
+    sv.add_argument(
+        "--tenant",
+        default=None,
+        metavar="ID",
+        help="connection-default tenant id for the front door's "
+        "per-tenant admission quotas (requests may override via their "
+        "\"tenant\" field or the X-Guard-Tenant header; also "
+        "GUARD_TPU_TENANT_DEFAULT)",
     )
     _add_telemetry_flags(sv)
 
@@ -545,6 +574,7 @@ def _dispatch(args, writer: Writer, reader: Reader) -> int:
                 result_cache=not args.no_result_cache,
                 delta_stats=args.delta_stats,
                 verify_plans=not args.no_verify_plans,
+                follow=args.follow,
             ).execute(writer, reader)
         if args.command == "lint":
             return Lint(
@@ -580,6 +610,8 @@ def _dispatch(args, writer: Writer, reader: Reader) -> int:
                 stdio=args.stdio,
                 listen=args.listen,
                 coalesce=coalesce,
+                rules=args.rules,
+                default_tenant=args.tenant,
             ).execute(writer, reader)
         if args.command == "report":
             from .commands.ops_report import OpsReport

@@ -59,6 +59,26 @@ most recently completed validate request. Counters are never reset
 per request (a global reset would race in-flight peers under
 concurrency — diffs are computed, not destructive).
 
+**Traffic discipline** (the front door, serve/frontdoor.py): every
+validate request resolves a tenant id — the envelope's `"tenant"`
+field, else the connection default (`X-Guard-Tenant` header on the
+HTTP face, `--tenant` on the CLI, `GUARD_TPU_TENANT_DEFAULT` in the
+env) — and passes per-tenant admission (token-bucket rate + in-flight
+ceiling). Over-quota requests answer a structured 429-class envelope:
+code 5, `error_class` `QuotaExceeded`/`QueueFull`, plus a
+`retry_after_ms` hint (the HTTP face maps these to status 429); they
+never hang and never arm the flight-recorder fault latch — a quota
+rejection is discipline, not a failure. With
+`GUARD_TPU_SERVE_SLO_MS` set, a per-digest circuit breaker watches
+formation+dispatch latency and sheds breached digests to immediate
+solo dispatch (byte-identical output — the solo path IS the
+sequential path) until a half-open probe meets the SLO again; a
+saturated admission queue trips it immediately. `POST /webhook`
+(serve/server.py) is a Kubernetes ValidatingWebhook face over the
+same handler: AdmissionReview in, allowed/denied + per-rule messages
+out, validated against the `--rules` registry preloaded at session
+start.
+
 An empty line or EOF ends the session with exit code 0. Request
 isolation (the failure plane's serve leg): a malformed or poisoned
 request produces a structured error response — code 5 plus an
@@ -86,9 +106,11 @@ from typing import Optional
 from ..core.errors import ParseError
 from ..core.parser import parse_rules_file
 from ..ops.plan import plan_digest
+from ..serve import frontdoor
 from ..utils import telemetry
+from ..utils.faults import maybe_fail
 from ..utils.io import Reader, Writer
-from ..utils.telemetry import SERVE_COUNTERS
+from ..utils.telemetry import ADMISSION_COUNTERS, SERVE_COUNTERS
 
 log = logging.getLogger("guard_tpu.serve")
 
@@ -146,6 +168,13 @@ class Serve:
     listen: Optional[str] = None
     #: None = GUARD_TPU_COALESCE env default; False = --no-coalesce
     coalesce: Optional[bool] = None
+    #: rule-registry file paths preloaded for the POST /webhook face
+    #: (`serve --rules`); None = webhook answers fail-open with a
+    #: "no rules configured" message
+    rules: Optional[list] = None
+    #: connection-default tenant id (`serve --tenant`); the request
+    #: envelope's "tenant" and the X-Guard-Tenant header override it
+    default_tenant: Optional[str] = None
     # parsed RuleFile lists keyed by the exact rules-text tuple;
     # instance-scoped so sessions never share stale registries
     _rules_cache: "OrderedDict[tuple, list]" = field(
@@ -165,6 +194,12 @@ class Serve:
     )
     _batcher: Optional[object] = field(default=None, repr=False)
     _last_request: Optional[dict] = field(default=None, repr=False)
+    _frontdoor_lock: object = field(
+        default_factory=threading.Lock, repr=False
+    )
+    _frontdoor: Optional[object] = field(default=None, repr=False)
+    # webhook registry texts, read once per session from self.rules
+    _webhook_rules: Optional[list] = field(default=None, repr=False)
 
     # -- shared caches ------------------------------------------------
     def _prepared_rules(self, rules_strs):
@@ -224,6 +259,26 @@ class Serve:
                 self._batcher = CoalescingBatcher()
             return self._batcher
 
+    def _get_frontdoor(self):
+        # one FrontDoor per session, limits resolved from the env at
+        # first use (same lifecycle as the batcher)
+        with self._frontdoor_lock:
+            if self._frontdoor is None:
+                self._frontdoor = frontdoor.FrontDoor()
+            return self._frontdoor
+
+    def _tenant(self, req: dict, default_tenant: Optional[str]) -> str:
+        """Resolve one request's tenant id: envelope field, then the
+        transport's connection default (X-Guard-Tenant header), then
+        the session default (--tenant), then the env default."""
+        t = req.get("tenant")
+        if isinstance(t, str) and t.strip():
+            return t.strip()
+        for cand in (default_tenant, self.default_tenant):
+            if cand:
+                return cand
+        return frontdoor.default_tenant()
+
     # -- bounded execution --------------------------------------------
     def _run_bounded(self, cmd, buf, payload):
         """Run one request under GUARD_TPU_SERVE_TIMEOUT. The
@@ -280,11 +335,14 @@ class Serve:
             return req.get("id")
         return None
 
-    def handle_line(self, line: str) -> dict:
+    def handle_line(self, line: str,
+                    default_tenant: Optional[str] = None) -> dict:
         """Answer ONE request line with its response envelope (no id
         handling — callers echo ids). Every transport lands here: the
-        stdio loop, the TCP/HTTP listener, and the bench/parity
-        harnesses driving a session in-process."""
+        stdio loop, the TCP/HTTP listener, the webhook and lambda
+        faces, and the bench/parity harnesses driving a session
+        in-process. `default_tenant` is the transport's connection
+        default (e.g. the X-Guard-Tenant header)."""
         import time
 
         t0 = time.perf_counter()
@@ -293,7 +351,20 @@ class Serve:
             req = json.loads(line)
             if not isinstance(req, dict):
                 raise ValueError("request must be a JSON object")
-            resp = self._handle_request(req, sp)
+            resp = self._handle_request(req, sp, default_tenant)
+        except frontdoor.AdmissionRejected as e:
+            # traffic discipline, not a failure: the structured
+            # 429-class envelope carries a retry hint and does NOT arm
+            # the flight-recorder fault latch (an over-quota tenant
+            # would otherwise turn every clean exit into a ring dump)
+            sp.set("error_class", type(e).__name__)
+            resp = {
+                "code": 5,
+                "output": "",
+                "error": str(e),
+                "error_class": type(e).__name__,
+                "retry_after_ms": e.retry_after_ms,
+            }
         except Exception as e:  # poisoned request: keep serving
             sp.set("error_class", type(e).__name__)
             # arm the flight recorder: a timed-out or poisoned
@@ -318,9 +389,8 @@ class Serve:
         ).observe(time.perf_counter() - t0)
         return resp
 
-    def _handle_request(self, req: dict, sp) -> dict:
-        from ..serve.batcher import BatchTimeout
-
+    def _handle_request(self, req: dict, sp,
+                        default_tenant: Optional[str] = None) -> dict:
         if req.get("metrics"):
             # live observability face: `metrics` is the cumulative
             # snapshot --metrics-out writes; `last_request` the
@@ -335,9 +405,25 @@ class Serve:
                 "metrics": telemetry.metrics_snapshot(),
                 "last_request": last or {},
             }
+        SERVE_COUNTERS["requests"] += 1
+        # the front door: per-tenant admission BEFORE any evaluation
+        # work — over-quota raises QuotaExceeded (structured 429-class
+        # envelope upstream), never blocks
+        fd = self._get_frontdoor()
+        tenant = self._tenant(req, default_tenant)
+        sp.set("tenant", tenant)
+        fd.admission.admit(tenant)
+        try:
+            return self._handle_admitted(req, sp, fd)
+        finally:
+            fd.admission.release(tenant)
+
+    def _handle_admitted(self, req: dict, sp, fd) -> dict:
+        import time
+
+        from ..serve.batcher import BatchTimeout
         from .validate import Validate
 
-        SERVE_COUNTERS["requests"] += 1
         rules_strs = req.get("rules", [])
         payload = json.dumps(
             {
@@ -370,13 +456,51 @@ class Serve:
             and prepared is not None
         ):
             SERVE_COUNTERS["coalesce_eligible"] += 1
-            try:
-                code = self._get_batcher().submit(
-                    cmd, payload, plan_digest(prepared), buf,
-                    timeout=_serve_timeout(),
-                )
-            except BatchTimeout as e:
-                raise RequestTimeout(str(e))
+            digest = plan_digest(prepared)
+            # the circuit breaker routes this digest: "batch" rides
+            # the coalescing batcher, "shed" (breaker OPEN) goes
+            # straight to solo dispatch — byte-identical output, the
+            # solo path IS the sequential path — and "probe" is the
+            # half-open trial whose verdict re-closes or re-opens
+            route = fd.breaker.decide(digest)
+            if route == "shed":
+                code = self._shed_solo(cmd, buf, payload, digest)
+            else:
+                t0 = time.perf_counter()
+                try:
+                    code = self._get_batcher().submit(
+                        cmd, payload, digest, buf,
+                        timeout=_serve_timeout(),
+                        queue_wait=frontdoor.queue_wait_s(),
+                    )
+                except frontdoor.QueueFull:
+                    # a saturated queue trips the breaker immediately;
+                    # this request sheds to solo (shedding on) or
+                    # answers the structured 429 (shedding off) — the
+                    # accept loop never wedges either way
+                    fd.breaker.on_queue_full(digest)
+                    if route == "probe":
+                        fd.breaker.observe(
+                            digest, time.perf_counter() - t0, probe=True
+                        )
+                    if not frontdoor.shed_enabled():
+                        ADMISSION_COUNTERS["rejected_queue_full"] += 1
+                        raise
+                    code = self._shed_solo(cmd, buf, payload, digest)
+                except BatchTimeout as e:
+                    if route == "probe":
+                        # the probe's verdict must land even on
+                        # timeout, or the half-open machine wedges
+                        # with its probe slot forever taken
+                        fd.breaker.observe(
+                            digest, time.perf_counter() - t0, probe=True
+                        )
+                    raise RequestTimeout(str(e))
+                else:
+                    fd.breaker.observe(
+                        digest, time.perf_counter() - t0,
+                        probe=(route == "probe"),
+                    )
         else:
             SERVE_COUNTERS["coalesce_bypass"] += 1
             code = self._run_bounded(cmd, buf, payload)
@@ -388,6 +512,128 @@ class Serve:
             "output": buf.out.getvalue(),
             "error": buf.err.getvalue(),
         }
+
+    def _shed_solo(self, cmd, buf, payload, digest: str) -> int:
+        """Overload shed: immediate solo dispatch, skipping the
+        batcher entirely. The output is byte-identical to coalesced
+        dispatch (the batch demux contract) — shedding trades batching
+        efficiency for bounded latency, never correctness."""
+        # the failure plane's shed leg: an injected shed fault still
+        # answers a structured error envelope upstream
+        maybe_fail("shed", key=digest)
+        ADMISSION_COUNTERS["shed_solo"] += 1
+        return self._run_bounded(cmd, buf, payload)
+
+    # -- the webhook face ---------------------------------------------
+    def handle_webhook(self, body: str,
+                       default_tenant: Optional[str] = None):
+        """Kubernetes ValidatingWebhook face: one AdmissionReview
+        document in, the same AdmissionReview echoed back with a
+        `response` verdict — `allowed` plus per-rule denial messages
+        harvested from the SARIF results. Routes through
+        `_handle_request`, so tenant quotas, the circuit breaker, and
+        the coalescing batcher all apply. Returns
+        `(http_status, response_doc)`; a malformed review is a 422,
+        quota rejections are 429 (mapped by the transport)."""
+        try:
+            review = json.loads(body)
+        except ValueError as e:
+            return 422, {
+                "error": f"unparseable AdmissionReview: {e}",
+                "error_class": "ValueError",
+            }
+        if not isinstance(review, dict) or "request" not in review:
+            return 422, {
+                "error": "AdmissionReview must carry a `request` object",
+                "error_class": "ValueError",
+            }
+        areq = review.get("request") or {}
+        uid = areq.get("uid", "")
+        obj = areq.get("object")
+        base = {
+            "apiVersion": review.get("apiVersion",
+                                     "admission.k8s.io/v1"),
+            "kind": review.get("kind", "AdmissionReview"),
+        }
+        texts = self._webhook_registry()
+        if not texts:
+            # fail-open, like a webhook with failurePolicy: Ignore —
+            # an unconfigured registry must not brick a cluster
+            base["response"] = {
+                "uid": uid, "allowed": True,
+                "status": {"message": "no rules configured "
+                                      "(serve --rules)"},
+            }
+            return 200, base
+        sp = telemetry.span_begin("serve_request")
+        sp.set("kind", "webhook")
+        try:
+            resp = self._handle_request(
+                {
+                    "rules": texts,
+                    "data": [json.dumps(obj if obj is not None else {})],
+                    "backend": "tpu",
+                    "output_format": "sarif",
+                    "tenant": areq.get("tenant"),
+                },
+                sp, default_tenant,
+            )
+        except frontdoor.AdmissionRejected as e:
+            telemetry.span_end(sp)
+            return 429, {
+                **base,
+                "response": {
+                    "uid": uid, "allowed": False,
+                    "status": {"code": 429, "message": str(e)},
+                },
+                "retry_after_ms": e.retry_after_ms,
+            }
+        except Exception as e:  # noqa: BLE001 — webhook keeps serving
+            sp.set("error_class", type(e).__name__)
+            telemetry.span_end(sp)
+            return 200, {
+                **base,
+                "response": {
+                    "uid": uid, "allowed": True,
+                    "status": {"message": f"evaluation error "
+                                          f"(fail-open): {e}"},
+                },
+            }
+        telemetry.span_end(sp)
+        messages = []
+        if resp["code"] != 0:
+            try:
+                sarif = json.loads(resp["output"])
+                for res in sarif["runs"][0]["results"]:
+                    rid = res.get("ruleId") or "RULE"
+                    text = (res.get("message") or {}).get("text", "")
+                    messages.append(f"{rid}: {text.strip()}".strip())
+            except (ValueError, LookupError, TypeError):
+                messages.append(resp.get("error") or
+                                f"validation failed (code {resp['code']})")
+        allowed = resp["code"] == 0
+        base["response"] = {
+            "uid": uid,
+            "allowed": allowed,
+            "status": {
+                "code": 200 if allowed else 403,
+                "message": "; ".join(messages) if messages else "ok",
+            },
+        }
+        return 200, base
+
+    def _webhook_registry(self) -> list:
+        """Rule texts for the webhook face, read ONCE per session from
+        the --rules paths (a serving registry is pinned at start, like
+        the reference's compiled-artifact model)."""
+        if self._webhook_rules is None:
+            texts = []
+            for path in self.rules or []:
+                texts.append(
+                    open(path, encoding="utf-8").read()
+                )
+            self._webhook_rules = texts
+        return self._webhook_rules
 
     # -- session loops ------------------------------------------------
     def execute(self, writer: Writer, reader: Reader) -> int:

@@ -12,6 +12,24 @@ interrupted sweep resumes where it stopped.
 
 Exit codes follow `validate` (0 pass / 19 fail / 5 error,
 reference commands/mod.rs:69-71).
+
+**Streaming CI mode** (`sweep --follow`): instead of a file corpus,
+documents arrive as JSONL on stdin — one line per document, either a
+bare JSON document or an `{"name": ..., "content": ...}` envelope —
+and validate AS THEY ARRIVE via single-doc/micro-batch dispatch
+against the precompiled plan (warmed once before the stream opens, so
+mid-stream latency is relocation + dispatch, never a lowering stall).
+Formation latency is bounded by `GUARD_TPU_FOLLOW_WAIT_MS` (default
+10ms — the streaming SLO: a document never waits longer for peers;
+0 dispatches every arrival immediately) and micro-batches cap at
+`GUARD_TPU_FOLLOW_MAX_BATCH` (default 32). One JSONL result line
+answers every input line, in order — `{"name", "status", "fails"}`
+for evaluated docs, `{"name", "quarantined": {...}}` for documents
+the PR 5 quarantine plane rejected (malformed line, unparseable
+content) — followed by one summary line at EOF with the standard
+sweep exit semantics (`--max-doc-failures` honored). The
+`admission.follow_docs` / `admission.follow_batches` counters ride
+the serving front door's telemetry group.
 """
 
 from __future__ import annotations
@@ -35,6 +53,7 @@ from ..utils.faults import (
     quarantine_record,
 )
 from ..utils.io import Reader, Writer
+from ..utils.telemetry import ADMISSION_COUNTERS
 from ..utils.telemetry import ingest_worker_spans as _ingest_worker_spans
 from ..utils.telemetry import span as _span
 from .files import DATA_FILE_EXTENSIONS, RULE_FILE_EXTENSIONS, gather
@@ -78,6 +97,33 @@ def _retry_backoff() -> float:
         return float(raw) if raw else 0.05
     except ValueError:
         return 0.05
+
+
+def _follow_wait_s() -> float:
+    """Micro-batch formation window for --follow, in seconds
+    (GUARD_TPU_FOLLOW_WAIT_MS, default 10ms): the streaming mode's
+    bounded-latency SLO — a document never waits longer than this for
+    peers before dispatching; 0 dispatches every arrival solo."""
+    import os
+
+    raw = os.environ.get("GUARD_TPU_FOLLOW_WAIT_MS", "").strip()
+    try:
+        return max(0.0, float(raw) if raw else 10.0) / 1000.0
+    except ValueError:
+        return 0.01
+
+
+def _follow_max_batch() -> int:
+    """Micro-batch size cap for --follow
+    (GUARD_TPU_FOLLOW_MAX_BATCH, default 32)."""
+    import os
+
+    raw = os.environ.get("GUARD_TPU_FOLLOW_MAX_BATCH", "").strip()
+    try:
+        n = int(raw) if raw else 32
+    except ValueError:
+        n = 32
+    return max(1, n)
 
 
 def _chunk_signature(paths: List[Path]) -> str:
@@ -164,10 +210,16 @@ class Sweep:
     # --delta-stats: one stderr summary line with the run's hit/delta
     # split (stdout stays byte-identical either way)
     delta_stats: bool = False
+    # --follow: streaming CI mode — documents arrive as JSONL on
+    # stdin and validate as they arrive (micro-batch dispatch against
+    # the precompiled plan, one result line per input line)
+    follow: bool = False
 
     def execute(self, writer: Writer, reader: Reader) -> int:
         if not self.rules:
             raise GuardError("must specify rules")
+        if self.follow:
+            return self._run_follow(writer, reader)
         if not self.data:
             raise GuardError("must specify data")
         if self.chunk_size < 1:
@@ -307,6 +359,232 @@ class Sweep:
         if totals["fail"]:
             return FAILURE_STATUS_CODE
         return SUCCESS_STATUS_CODE
+
+    # -- streaming CI mode (--follow) ---------------------------------
+    def _run_follow(self, writer: Writer, reader: Reader) -> int:
+        """Validate documents AS THEY ARRIVE on stdin: a feeder thread
+        drains the JSONL stream into a bounded formation buffer, the
+        main loop dispatches micro-batches (window-bounded — the
+        streaming SLO — and size-capped) against the plan warmed once
+        up front, and one result line answers every input line in
+        order. EOF emits the summary line and the standard sweep exit
+        code; quarantine semantics (PR 5) apply per document."""
+        import threading
+        import time
+
+        rule_files, parse_errors = self._parse_rules(writer)
+        if not rule_files:
+            writer.writeln_err("no parseable rule files")
+            return ERROR_STATUS_CODE
+        # warm the plan BEFORE the stream opens: mid-stream latency is
+        # relocation + dispatch against the precompiled artifact,
+        # never a lowering stall against the SLO window
+        if self.backend == "tpu":
+            from ..ops.plan import get_plan, plan_cache_enabled
+
+            if plan_cache_enabled(self.plan_cache):
+                with _span("lower_compile", {"mode": "follow_warm"}):
+                    get_plan(rule_files, verify=self.verify_plans)
+
+        window = _follow_wait_s()
+        max_batch = _follow_max_batch()
+        from collections import deque
+
+        buf: deque = deque()
+        cv = threading.Condition()
+        eof = [False]
+
+        def _feed() -> None:
+            # blank lines are ignored (CI pipes hiccup); only EOF ends
+            # the stream — unlike serve's blank-line session end, a
+            # follow stream has no interactive client to hand back to
+            try:
+                for raw in reader.stream():
+                    raw = raw.strip()
+                    if not raw:
+                        continue
+                    with cv:
+                        buf.append(raw)
+                        cv.notify_all()
+            finally:
+                with cv:
+                    eof[0] = True
+                    cv.notify_all()
+
+        threading.Thread(
+            target=_feed, daemon=True, name="guard-tpu-follow"
+        ).start()
+
+        self._delta_seen = [0, 0]
+        totals = {k: 0 for k in _STATUS_NAMES}
+        failed: List[dict] = []
+        quarantined: List[dict] = []
+        errors = parse_errors
+        n_docs = 0
+        seq = [0]
+        while True:
+            with cv:
+                while not buf and not eof[0]:
+                    cv.wait()
+                if not buf and eof[0]:
+                    break
+                if window > 0 and len(buf) < max_batch and not eof[0]:
+                    # formation: wait up to the SLO window for peers
+                    # to micro-batch with — never longer
+                    deadline = time.monotonic() + window
+                    while len(buf) < max_batch and not eof[0]:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        cv.wait(remaining)
+                lines = [
+                    buf.popleft()
+                    for _ in range(min(len(buf), max_batch))
+                ]
+            err_box = [0, []]
+            entries = self._follow_docs(lines, seq, writer, err_box)
+            data_files = [df for _, df, _rec in entries if df is not None]
+            outcomes = self._follow_eval(
+                data_files, rule_files, writer, err_box
+            )
+            ADMISSION_COUNTERS["follow_batches"] += 1
+            ADMISSION_COUNTERS["follow_docs"] += len(lines)
+            errors += err_box[0]
+            if err_box[1]:
+                quarantined.extend(err_box[1])
+                FAULT_COUNTERS["quarantined_docs"] += len(err_box[1])
+            by_name = {rec["file"]: rec for rec in err_box[1]}
+            n_docs += len(lines)
+            oi = 0
+            for name, df, rec in entries:
+                if df is not None:
+                    out = outcomes[oi]
+                    oi += 1
+                else:
+                    out = None
+                if out is None:
+                    writer.writeln(json.dumps({
+                        "name": name,
+                        "quarantined": rec or by_name.get(name)
+                        or {"file": name},
+                    }))
+                    continue
+                totals[out["status"]] += 1
+                if out["fails"]:
+                    failed.append({"data": name, "rules": out["fails"]})
+                writer.writeln(json.dumps({
+                    "name": name,
+                    "status": out["status"],
+                    "fails": out["fails"],
+                }))
+            writer.flush()
+
+        summary = {
+            "follow": True,
+            "documents": n_docs,
+            "counts": totals,
+            "failed": failed,
+            "errors": errors,
+        }
+        if quarantined:
+            summary["quarantined"] = quarantined
+        writer.writeln(json.dumps(summary))
+        if self.delta_stats:
+            hits, delta = self._delta_seen
+            writer.writeln_err(
+                f"result-cache: {hits}/{hits + delta} docs cached, "
+                f"{delta} dispatched"
+            )
+        doc_failures = len(quarantined)
+        hard_errors = max(0, errors - doc_failures)
+        if hard_errors:
+            return ERROR_STATUS_CODE
+        limit = self.max_doc_failures
+        if limit is not None and limit >= 0 and doc_failures > limit:
+            return ERROR_STATUS_CODE
+        if totals["fail"]:
+            return FAILURE_STATUS_CODE
+        return SUCCESS_STATUS_CODE
+
+    def _follow_docs(self, lines, seq, writer, err_box):
+        """Decode one micro-batch of stream lines into DataFiles.
+        Returns [(name, DataFile | None, quarantine_rec | None)] in
+        input order — a line that fails to decode quarantines at the
+        `read` stage (same plane as a file the batch sweep couldn't
+        read) and still gets its result line."""
+        entries = []
+        for raw in lines:
+            seq[0] += 1
+            name = f"stream[{seq[0]}]"
+            try:
+                maybe_fail("read", key=name)
+                env = json.loads(raw)
+                if isinstance(env, dict) and "content" in env:
+                    name = str(env.get("name") or name)
+                    content = env["content"]
+                    if not isinstance(content, str):
+                        # inline document object: its canonical text
+                        content = json.dumps(content)
+                else:
+                    # a bare JSON document is its own content
+                    content = raw
+                entries.append(
+                    (name, DataFile(name=name, content=content, _pv=None),
+                     None)
+                )
+            except Exception as e:  # noqa: BLE001 — quarantine, serve on
+                writer.writeln_err(f"skipping {name}: {e}")
+                rec = quarantine_record(name, "read", e)
+                err_box[0] += 1
+                err_box[1].append(rec)
+                entries.append((name, None, rec))
+        return entries
+
+    def _follow_eval(self, data_files, rule_files, writer, err_box):
+        """One micro-batch through the same planes as a sweep chunk —
+        result-cache partition, packed dispatch, vectorized rim,
+        oracle ladder — emitting per-doc outcomes (None = quarantined)
+        aligned with `data_files`."""
+        if not data_files:
+            return []
+        ctx = (
+            self._cache_lookup(data_files, rule_files)
+            if self.backend == "tpu" else None
+        )
+        delta_files, _ = self._cache_subset(ctx, data_files, None)
+        per_doc: List[Dict[str, Status]] = [dict() for _ in delta_files]
+        vec_box: dict = {}
+        if self.backend == "tpu":
+            err_box[0] += self._eval_tpu(
+                delta_files, rule_files, per_doc, writer, err_box,
+                vec_box=vec_box,
+            )
+        else:
+            err_box[0] += self._eval_oracle(
+                delta_files, rule_files, None, per_doc, writer, err_box
+            )
+        with _span("rim_reduce", {"docs": len(delta_files)}):
+            if vec_box.get("active"):
+                outcomes = self._outcomes_vectorized(delta_files, vec_box)
+            else:
+                outcomes = self._outcomes_scalar(delta_files, per_doc)
+        if ctx is None or not ctx["cached"]:
+            if ctx is not None and ctx["delta_idx"]:
+                for pos, (df, out) in enumerate(
+                    zip(delta_files, outcomes)
+                ):
+                    self._cache_store(ctx, pos, df, out, vec_box)
+            return outcomes
+        delta_pos = {di: k for k, di in enumerate(ctx["delta_idx"])}
+        merged = []
+        for di, df in enumerate(data_files):
+            out = ctx["cached"].get(di)
+            if out is None:
+                pos = delta_pos[di]
+                out = outcomes[pos]
+                self._cache_store(ctx, pos, df, out, vec_box)
+            merged.append(out)
+        return merged
 
     def _parse_rules(self, writer: Writer):
         with _span("rule_parse"):

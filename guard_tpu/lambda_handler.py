@@ -1,22 +1,57 @@
-"""AWS-Lambda-style handler.
+"""AWS-Lambda-style handler: the serving plane's third deployment face.
 
-Equivalent of `/root/reference/guard-lambda/src/main.rs:41-66`: the
-event carries `{"data": "<doc string>", "rules": ["<rules string>", ...],
-"verbose": bool}`; each rules string is validated against the data via
-`run_checks` and the parsed JSON results are returned as
-`{"message": [...]}`.
+Two event shapes, discriminated by key:
+
+* **Legacy** (`{"data": "<doc string>", "rules": [...], "verbose":
+  bool}`) — the reference contract
+  (/root/reference/guard-lambda/src/main.rs:41-66): each rules string
+  validates against the data via `run_checks`, parsed JSON results
+  return as `{"message": [...]}`. Byte-identical to the pre-front-door
+  handler.
+
+* **Front door** (`{"documents": [...], "rules": [...]}`) — the event
+  routes through a module-global `Serve` session: the SAME handler the
+  stdio loop, the TCP/HTTP listener and the webhook face share, so a
+  warm Lambda container reuses the prepared-rules cache, the plan
+  memo, the coalescing batcher AND the traffic discipline (per-tenant
+  quotas via `"tenant"`, the SLO circuit breaker, overload shedding).
+  Optional keys: `backend` (default "tpu" — concurrent invocations in
+  one container coalesce into packed dispatches), `output_format`
+  (default "sarif"), `tenant`, `verbose`. Returns the serve response
+  envelope: `{"code": 0|19|5, "output": ..., "error": ...}` plus
+  `error_class`/`retry_after_ms` on structured rejections — an
+  over-quota invocation gets the 429-class envelope, never a hang.
 """
 
 from __future__ import annotations
 
 import json
+import threading
 from typing import Any, Dict, List
 
 from .api import run_checks
 from .core.errors import GuardError
 
+# one warm Serve session per container (Lambda freezes/thaws the
+# process between invocations — module globals persist, so the plan
+# memo and batcher amortize across invocations like any serve session)
+_SESSION = None
+_SESSION_LOCK = threading.Lock()
+
+
+def _session():
+    global _SESSION
+    with _SESSION_LOCK:
+        if _SESSION is None:
+            from .commands.serve import Serve
+
+            _SESSION = Serve(stdio=False)
+        return _SESSION
+
 
 def handler(event: Dict[str, Any], context: Any = None) -> Dict[str, List]:
+    if isinstance(event, dict) and "documents" in event:
+        return _handle_frontdoor(event)
     data = event.get("data", "")
     rules = event.get("rules", [])
     verbose = bool(event.get("verbose", False))
@@ -28,3 +63,23 @@ def handler(event: Dict[str, Any], context: Any = None) -> Dict[str, List]:
             raise ValueError(str(e))
         results.append(json.loads(out) if out else None)
     return {"message": results}
+
+
+def _handle_frontdoor(event: Dict[str, Any]) -> Dict[str, Any]:
+    """One invocation through the shared serve handler. Documents may
+    be strings (raw JSON/YAML text) or objects (serialized here)."""
+    docs = [
+        d if isinstance(d, str) else json.dumps(d)
+        for d in event.get("documents", [])
+    ]
+    req: Dict[str, Any] = {
+        "rules": event.get("rules", []),
+        "data": docs,
+        "backend": event.get("backend", "tpu"),
+        "output_format": event.get("output_format", "sarif"),
+    }
+    if event.get("verbose"):
+        req["verbose"] = True
+    if event.get("tenant"):
+        req["tenant"] = event["tenant"]
+    return _session().handle_line(json.dumps(req))

@@ -172,6 +172,20 @@ def reset_fault_stats() -> None:
     reset_fault_counters()
 
 
+def admission_stats() -> dict:
+    """Snapshot of the serving front door's traffic-discipline
+    counters (utils.telemetry.ADMISSION_COUNTERS): per-tenant quota
+    admissions/rejections, SLO circuit-breaker trips/probes/closes,
+    overload sheds and streaming follow-mode micro-batches. The group
+    registers with utils.telemetry itself, so the accessor — unlike
+    dispatch/pipeline — needs no mesh import and stays jax-free."""
+    return _TELEMETRY.group_stats("admission")
+
+
+def reset_admission_stats() -> None:
+    _TELEMETRY.reset_group("admission")
+
+
 def reset_all_stats() -> None:
     """Reset EVERY observability plane atomically: dispatch, pipeline,
     rim and fault counter groups plus the telemetry gauges, stage
@@ -1794,10 +1808,13 @@ def tpu_validate_multi(requests) -> list:
     (encode/lower/dispatch) propagate to the caller, which re-fires
     each request solo.
     """
+    import time
+
     _honor_platform_env()
     from ..commands.validate import ERROR_STATUS_CODE, SUCCESS_STATUS_CODE
     from ..parallel.mesh import ShardedBatchEvaluator
 
+    t_dispatch = time.perf_counter()
     base_validate, rule_files, _bd, base_writer = requests[0]
 
     all_data = []
@@ -1842,6 +1859,13 @@ def tpu_validate_multi(requests) -> list:
         file_results.append(
             (rule_file, compiled, statuses, unsure, host_docs, rim)
         )
+    # shared-phase (encode -> lower -> dispatch) latency per coalesced
+    # batch: persistent so a registry reset never erases the serving
+    # story; the front door's circuit breaker watches the same span
+    # end-to-end (queue wait + formation + this) per digest
+    _TELEMETRY.histogram(
+        "serve_dispatch_seconds", persistent=True
+    ).observe(time.perf_counter() - t_dispatch)
 
     for ri, (validate, _rf, data_files, writer) in enumerate(requests):
         start, end = segments[ri]

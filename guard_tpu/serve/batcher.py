@@ -19,7 +19,11 @@ peers to join, and never packs more than
 `GUARD_TPU_COALESCE_MAX_BATCH` (default 16) requests into one batch.
 The admission queue holds at most `GUARD_TPU_SERVE_QUEUE_MAX`
 (default 64) requests; a full queue blocks admission (backpressure,
-never silent drops). `GUARD_TPU_COALESCE=0` disables coalescing
+never silent drops) — unless the caller passes a bounded `queue_wait`,
+in which case admission past the deadline raises
+`frontdoor.QueueFull` so the front door can shed the request to solo
+dispatch or answer a structured 429 (the accept loop never wedges
+behind a saturated queue). `GUARD_TPU_COALESCE=0` disables coalescing
 entirely — every request runs the sequential path.
 
 Failure isolation (the PR 5 plane, scoped to batches): the
@@ -133,16 +137,38 @@ class CoalescingBatcher:
 
     # -- admission ----------------------------------------------------
     def submit(self, cmd, payload: str, digest: str, writer,
-               timeout: float = 0.0) -> int:
+               timeout: float = 0.0,
+               queue_wait: Optional[float] = None) -> int:
         """Admit one request and block until it is answered. Raises
         BatchTimeout when `timeout` (seconds, 0 = unbounded) expires
         first — the batch keeps running, the result is discarded — and
-        re-raises whatever per-request exception the run captured."""
+        re-raises whatever per-request exception the run captured.
+
+        `queue_wait` bounds the ADMISSION wait on a full queue:
+        None keeps the legacy infinite backpressure; a number of
+        seconds raises `frontdoor.QueueFull` past the deadline so the
+        front door can shed or 429 instead of wedging the caller."""
         item = _Item(cmd, payload, digest, writer)
         with self._cv:
-            while len(self._q) >= self._limit and not self._closed:
-                # bounded admission: backpressure, not drops
-                self._cv.wait(0.05)
+            if queue_wait is None:
+                while len(self._q) >= self._limit and not self._closed:
+                    # bounded admission: backpressure, not drops
+                    self._cv.wait(0.05)
+            else:
+                deadline = time.monotonic() + max(0.0, queue_wait)
+                while len(self._q) >= self._limit and not self._closed:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        from .frontdoor import QueueFull
+
+                        raise QueueFull(
+                            f"admission queue full ({self._limit}) "
+                            f"past {queue_wait * 1000:g}ms wait",
+                            retry_after_ms=max(
+                                1, int(queue_wait * 1000) or 100
+                            ),
+                        )
+                    self._cv.wait(min(remaining, 0.05))
             if self._closed:
                 raise RuntimeError("serve batcher is closed")
             item.arrived_alone = not self._q

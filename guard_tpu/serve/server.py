@@ -11,8 +11,20 @@ bytes —
   pipelined requests exactly as the multiplexed stdio session does).
 * **HTTP/1.1** (curl-able face): `POST /validate` with a JSON request
   body returns the response envelope as `application/json`;
-  `GET /metrics` returns the live telemetry snapshot. Minimal by
+  `GET /metrics` returns the live telemetry snapshot;
+  `POST /webhook` is the Kubernetes ValidatingWebhook face
+  (AdmissionReview in, allowed/denied + per-rule messages out,
+  evaluated against the session's `--rules` registry). Minimal by
   design — one request per connection, no keep-alive.
+
+Input discipline (the front door's transport leg): bodies and JSONL
+lines are capped at `GUARD_TPU_SERVE_MAX_BODY` bytes — an oversized
+HTTP body answers a structured 413 WITHOUT reading the payload, an
+oversized JSONL line answers a structured error envelope; per-tenant
+quota rejections and a saturated admission queue map to HTTP 429
+(with a Retry-After hint) or the same structured JSONL envelope —
+the accept loop never blocks on traffic it will not serve. The
+connection-default tenant comes from the `X-Guard-Tenant` header.
 
 Every connection shares the session's `Serve` instance, so the
 prepared-rules cache, the process-global plan memo and the coalescing
@@ -28,6 +40,7 @@ import threading
 from typing import Optional
 
 from ..utils.io import Writer
+from . import frontdoor
 
 
 def _parse_hostport(listen: str) -> tuple:
@@ -119,7 +132,22 @@ class ServeServer:
         tagged ones may coalesce with peers from other connections."""
         wlock = threading.Lock()
         pending = []
+        cap = frontdoor.max_body_bytes()
         for raw in f:
+            if cap and len(raw) > cap:
+                # oversized line: structured 413-class envelope, no
+                # parse attempt (the line is already drained off the
+                # socket — a line protocol cannot refuse mid-line)
+                frontdoor.ADMISSION_COUNTERS["rejected_body_size"] += 1
+                with wlock:
+                    f.write((json.dumps({
+                        "code": 5, "output": "",
+                        "error": f"request line exceeds "
+                                 f"GUARD_TPU_SERVE_MAX_BODY ({cap}B)",
+                        "error_class": "BodyTooLarge",
+                    }) + "\n").encode())
+                    f.flush()
+                continue
             line = raw.decode("utf-8", "replace").strip()
             if not line:
                 break
@@ -149,36 +177,83 @@ class ServeServer:
             return
         method, path = parts[0], parts[1]
         clen = 0
+        headers = {}
         while True:
             h = f.readline().decode("latin-1").strip()
             if not h:
                 break
             k, _, v = h.partition(":")
-            if k.strip().lower() == "content-length":
-                try:
-                    clen = int(v.strip())
-                except ValueError:
-                    clen = 0
+            headers[k.strip().lower()] = v.strip()
+        try:
+            clen = int(headers.get("content-length", "0"))
+        except ValueError:
+            clen = 0
+        # connection-default tenant: the header names it; the request
+        # envelope's own "tenant" field still wins
+        tenant = headers.get("x-guard-tenant") or None
+        cap = frontdoor.max_body_bytes()
+        if method == "POST" and cap and clen > cap:
+            # 413 BEFORE reading the body — an oversized payload never
+            # ties up the handler thread
+            frontdoor.ADMISSION_COUNTERS["rejected_body_size"] += 1
+            self._http_reply(f, 413, json.dumps({
+                "code": 5, "output": "",
+                "error": f"body of {clen}B exceeds "
+                         f"GUARD_TPU_SERVE_MAX_BODY ({cap}B)",
+                "error_class": "BodyTooLarge",
+            }))
+            return
         if method == "GET" and path == "/metrics":
             body = json.dumps(self.serve.handle_line('{"metrics": true}'))
             self._http_reply(f, 200, body)
             return
+        if method == "POST" and path == "/webhook":
+            payload = f.read(clen).decode("utf-8", "replace") if clen else ""
+            status, doc = self.serve.handle_webhook(payload, tenant)
+            extra = {}
+            if status == 429:
+                extra["Retry-After"] = str(
+                    max(1, doc.get("retry_after_ms", 1000) // 1000)
+                )
+            self._http_reply(f, status, json.dumps(doc), extra)
+            return
         if method == "POST":
             payload = f.read(clen).decode("utf-8", "replace") if clen else ""
-            resp = self.serve.handle_line(payload.strip() or "{}")
-            code = 200 if "error_class" not in resp else 422
+            resp = self.serve.handle_line(
+                payload.strip() or "{}", default_tenant=tenant
+            )
+            err_class = resp.get("error_class")
+            if err_class in ("QuotaExceeded", "QueueFull"):
+                # traffic discipline speaks HTTP: quota and saturation
+                # are 429s with a Retry-After hint, not generic 422s
+                self._http_reply(
+                    f, 429, json.dumps(resp),
+                    {"Retry-After": str(
+                        max(1, resp.get("retry_after_ms", 1000) // 1000)
+                    )},
+                )
+                return
+            code = 200 if err_class is None else 422
             self._http_reply(f, code, json.dumps(resp))
             return
         self._http_reply(f, 404, json.dumps({"error": "not found"}))
 
     @staticmethod
-    def _http_reply(f, status: int, body: str) -> None:
-        reason = {200: "OK", 404: "Not Found", 422: "Unprocessable Entity"}
+    def _http_reply(f, status: int, body: str,
+                    extra_headers: Optional[dict] = None) -> None:
+        reason = {
+            200: "OK", 404: "Not Found", 413: "Payload Too Large",
+            422: "Unprocessable Entity", 429: "Too Many Requests",
+        }
         data = body.encode()
+        extras = "".join(
+            f"{k}: {v}\r\n" for k, v in (extra_headers or {}).items()
+        )
         head = (
             f"HTTP/1.1 {status} {reason.get(status, 'OK')}\r\n"
             f"Content-Type: application/json\r\n"
             f"Content-Length: {len(data)}\r\n"
+            f"{extras}"
             f"Connection: close\r\n\r\n"
         )
         f.write(head.encode("latin-1") + data)

@@ -41,10 +41,14 @@ from .telemetry import REGISTRY as _TELEMETRY
 from .telemetry import EventedCounters
 
 #: named injection points, in pipeline order (serve_batch fires in the
-#: serving plane's coalescing batcher, before a grouped dispatch)
+#: serving plane's coalescing batcher, before a grouped dispatch;
+#: admission fires in the front door's per-tenant quota check, shed in
+#: the circuit breaker's solo-dispatch shed path — both must always
+#: produce a structured response, never a hang or a lost request)
 POINTS = (
     "read", "parse", "encode", "worker_crash",
     "dispatch", "collect", "oracle", "serve_batch", "cache",
+    "admission", "shed",
 )
 
 #: observability beside DISPATCH_COUNTERS / PIPELINE_COUNTERS /

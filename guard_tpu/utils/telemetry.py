@@ -76,7 +76,11 @@ from typing import Dict, List, Optional
 #: signatures_extracted), the verify_plan / lint spans, and the
 #: plan_cache corrupt-cause counters (corrupt_unreadable /
 #: corrupt_version_mismatch / corrupt_verify) joined the contract.
-SCHEMA_VERSION = 5
+#: v6: the `admission` counter group (serving front door: per-tenant
+#: quota admissions/rejections, SLO circuit-breaker trips/probes/
+#: closes, overload sheds, streaming follow-mode docs/batches) and
+#: the breaker-state / admission-inflight gauges joined the contract.
+SCHEMA_VERSION = 6
 
 # fixed log2 histogram buckets: bucket i holds durations in
 # [2^(LOG2_LO+i-1), 2^(LOG2_LO+i)) seconds — ~1µs to ~128s, plus an
@@ -329,6 +333,34 @@ SERVE_COUNTERS = REGISTRY.counter_group("serve", EventedCounters("serve", {
     # coalesce-off)
     "coalesce_window_adaptive": 0,
 }))
+
+#: front-door observability (serve/frontdoor.py): per-tenant admission
+#: quota decisions, the latency-SLO circuit breaker's state
+#: transitions, overload sheds to solo dispatch, and the streaming
+#: follow-mode micro-batches. Lives here — like SERVE_COUNTERS — so
+#: the group registers exactly once however traffic arrives (stdio,
+#: TCP/HTTP listener, webhook, lambda face, or `sweep --follow`).
+#: EventedCounters makes every quota rejection, breaker trip and shed
+#: an instant trace event, so the flight recorder's ring captures the
+#: whole overload episode. Gauges set beside it: admission_inflight
+#: (total in-flight admitted requests), admission_tenants (distinct
+#: tenants seen), breaker_state.<digest> (0 closed / 1 open / 2
+#: half-open per plan digest).
+ADMISSION_COUNTERS = REGISTRY.counter_group(
+    "admission", EventedCounters("admission", {
+        "admitted": 0,
+        "rejected_rate": 0,
+        "rejected_inflight": 0,
+        "rejected_queue_full": 0,
+        "rejected_body_size": 0,
+        "shed_solo": 0,
+        "breaker_trips": 0,
+        "breaker_probes": 0,
+        "breaker_closes": 0,
+        "follow_docs": 0,
+        "follow_batches": 0,
+    })
+)
 
 
 # ---------------------------------------------------------------- spans

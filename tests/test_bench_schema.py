@@ -347,3 +347,61 @@ def test_registry_stage_seconds_reconcile_with_wall_time(tmp_path):
     # and the instrumented pipeline accounts for most of the run
     assert total <= wall * 1.05, (total, wall, stage)
     assert total >= wall * 0.35, (total, wall, stage)
+
+
+def test_expected_metrics_cover_front_door_rows():
+    """PR 16: the serving front door's overload row pair (shed off/on
+    p99 under a stalled coalesce window) and the quota-isolation quiet
+    p50 are part of the driver contract, arriving with the round-16
+    artifact."""
+    metrics = bench.expected_metrics()
+    for m in (
+        "serve_overload_shed_off_p99_ms",
+        "serve_overload_shed_on_p99_ms",
+        "serve_quota_isolation_quiet_p50_ms",
+    ):
+        assert m in metrics
+        assert check_bench_schema.metric_since(m) == 16
+
+
+def test_checker_requires_front_door_keys(tmp_path):
+    """A shed-on row that doesn't carry its breaker/shed evidence, or
+    a quota row without its isolation context, fails the gate."""
+    import json
+
+    rows = [
+        {
+            "metric": "serve_overload_shed_on_p99_ms",
+            "value": 1.0,
+            "unit": "ms",
+            "vs_baseline": 1.0,
+            "dispatches_per_request": 1.0,
+            "stall_window_ms": 250,
+            "concurrency": 4,
+            # slo_ms / breaker_trips / shed_solo missing
+        },
+        {
+            "metric": "serve_quota_isolation_quiet_p50_ms",
+            "value": 1.0,
+            "unit": "ms",
+            "vs_baseline": 1.0,
+            # p50_alone_ms / hot_rejected / quota_rejections /
+            # envelope_parity / tenant_max_inflight missing
+        },
+    ]
+    src = _newest_artifact().read_text().splitlines()
+    doctored = tmp_path / "bench_all_doctored_frontdoor.json"
+    doctored.write_text(
+        "\n".join(
+            ln for ln in src
+            if '"serve_overload_shed_on_p99_ms"' not in ln
+            and '"serve_quota_isolation_quiet_p50_ms"' not in ln
+        )
+        + "\n"
+        + "\n".join(json.dumps(r) for r in rows)
+        + "\n"
+    )
+    problems = check_bench_schema.check(doctored)
+    for needle in ("slo_ms", "breaker_trips", "shed_solo",
+                   "envelope_parity", "quota_rejections"):
+        assert any(needle in p for p in problems), needle

@@ -1,0 +1,634 @@
+"""The serving front door (guard_tpu/serve/frontdoor.py): per-tenant
+admission quotas, the latency-SLO circuit breaker, overload shedding,
+transport input bounds, and the two new traffic faces (POST /webhook,
+sweep --follow) plus the Lambda front door.
+
+Breaker and quota machines run on an INJECTED clock throughout — no
+wall-time in any assertion, same discipline as the faults plane."""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from guard_tpu.cli import run
+from guard_tpu.commands.serve import Serve
+from guard_tpu.serve import frontdoor
+from guard_tpu.serve.frontdoor import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    AdmissionController,
+    CircuitBreaker,
+    QuotaExceeded,
+)
+from guard_tpu.utils.faults import POINTS, reset_faults
+from guard_tpu.utils.io import Reader, Writer
+from guard_tpu.utils.telemetry import ADMISSION_COUNTERS
+
+RULES = "rule has_a { a exists }"
+
+
+class Clock:
+    """Deterministic monotonic clock for the front-door machines."""
+
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+def _req(backend="cpu", doc='{"a": 1}', **extra):
+    return json.dumps({
+        "rules": [RULES], "data": [doc], "backend": backend, **extra,
+    })
+
+
+# -- circuit breaker state machine ---------------------------------------
+
+def test_breaker_full_cycle_closed_open_half_open_closed():
+    clk = Clock()
+    br = CircuitBreaker(slo_s=0.05, cooldown_s=1.0, min_samples=4,
+                        clock=clk)
+    assert br.enabled
+    assert br.state("d") == CLOSED
+    assert br.decide("d") == "batch"
+    # below the sample quorum a breach cannot trip
+    for _ in range(3):
+        br.observe("d", 0.2)
+    assert br.state("d") == CLOSED
+    br.observe("d", 0.2)  # quorum reached, p99 over SLO
+    assert br.state("d") == OPEN
+    assert br.decide("d") == "shed"
+    # cooldown not yet elapsed: keep shedding
+    clk.advance(0.5)
+    assert br.decide("d") == "shed"
+    # past cooldown: ONE probe rides the batcher, peers keep shedding
+    clk.advance(0.6)
+    assert br.decide("d") == "probe"
+    assert br.state("d") == HALF_OPEN
+    assert br.decide("d") == "shed"
+    # probe meets the SLO: re-close, sample window cleared
+    br.observe("d", 0.01, probe=True)
+    assert br.state("d") == CLOSED
+    assert br.decide("d") == "batch"
+    # cleared window means a fresh quorum is needed to re-trip
+    for _ in range(3):
+        br.observe("d", 0.2)
+    assert br.state("d") == CLOSED
+
+
+def test_breaker_probe_miss_reopens():
+    clk = Clock()
+    br = CircuitBreaker(slo_s=0.05, cooldown_s=1.0, min_samples=2,
+                        clock=clk)
+    br.observe("d", 0.2)
+    br.observe("d", 0.2)
+    assert br.state("d") == OPEN
+    clk.advance(1.0)
+    assert br.decide("d") == "probe"
+    br.observe("d", 0.2, probe=True)  # probe missed the SLO
+    assert br.state("d") == OPEN
+    assert br.decide("d") == "shed"
+    clk.advance(1.0)
+    assert br.decide("d") == "probe"  # cooldown grants another probe
+
+
+def test_breaker_fast_samples_never_trip():
+    clk = Clock()
+    br = CircuitBreaker(slo_s=0.05, cooldown_s=1.0, min_samples=2,
+                        clock=clk)
+    for _ in range(20):
+        br.observe("d", 0.001)
+    assert br.state("d") == CLOSED
+    assert br.decide("d") == "batch"
+
+
+def test_breaker_queue_saturation_trips_immediately():
+    clk = Clock()
+    br = CircuitBreaker(slo_s=0.05, cooldown_s=1.0, min_samples=8,
+                        clock=clk)
+    b0 = ADMISSION_COUNTERS["breaker_trips"]
+    br.on_queue_full("d")  # no sample quorum needed
+    assert br.state("d") == OPEN
+    assert br.decide("d") == "shed"
+    assert ADMISSION_COUNTERS["breaker_trips"] - b0 == 1
+
+
+def test_breaker_disabled_is_inert():
+    clk = Clock()
+    br = CircuitBreaker(slo_s=0.0, cooldown_s=1.0, min_samples=1,
+                        clock=clk)
+    assert not br.enabled
+    for _ in range(10):
+        br.observe("d", 99.0)
+    br.on_queue_full("d")
+    assert br.state("d") == CLOSED
+    assert br.decide("d") == "batch"
+
+
+def test_breaker_isolates_digests():
+    clk = Clock()
+    br = CircuitBreaker(slo_s=0.05, cooldown_s=1.0, min_samples=1,
+                        clock=clk)
+    br.observe("hot", 0.2)
+    assert br.state("hot") == OPEN
+    assert br.decide("hot") == "shed"
+    # a different digest's machine is untouched
+    assert br.state("cold") == CLOSED
+    assert br.decide("cold") == "batch"
+
+
+# -- admission controller -------------------------------------------------
+
+def test_admission_rate_bucket_refills_on_clock():
+    clk = Clock()
+    ac = AdmissionController(rate=2.0, burst=2.0, max_inflight=0,
+                             clock=clk)
+    ac.admit("t")
+    ac.admit("t")
+    with pytest.raises(QuotaExceeded) as ei:
+        ac.admit("t")
+    assert ei.value.retry_after_ms == 500  # 1000 / rate
+    # half a second refills exactly one token
+    clk.advance(0.5)
+    ac.admit("t")
+    with pytest.raises(QuotaExceeded):
+        ac.admit("t")
+
+
+def test_admission_inflight_ceiling_and_release():
+    clk = Clock()
+    ac = AdmissionController(rate=0.0, burst=1.0, max_inflight=2,
+                             clock=clk)
+    ac.admit("t")
+    ac.admit("t")
+    with pytest.raises(QuotaExceeded) as ei:
+        ac.admit("t")
+    assert ei.value.retry_after_ms == 100
+    ac.release("t")
+    ac.admit("t")  # slot freed
+
+
+def test_admission_buckets_are_per_tenant():
+    clk = Clock()
+    ac = AdmissionController(rate=1.0, burst=1.0, max_inflight=0,
+                             clock=clk)
+    ac.admit("hot")
+    with pytest.raises(QuotaExceeded):
+        ac.admit("hot")
+    # the quiet tenant's bucket is its own
+    ac.admit("quiet")
+
+
+def test_admission_unlimited_is_inert():
+    clk = Clock()
+    ac = AdmissionController(rate=0.0, burst=1.0, max_inflight=0,
+                             clock=clk)
+    for _ in range(100):
+        ac.admit("t")
+
+
+# -- serve-level quota isolation (the satellite contract) -----------------
+
+def test_serve_quota_rejection_envelope_and_quiet_parity():
+    """A hot tenant over its bucket gets the structured 429-class
+    envelope; a quiet tenant's envelope stays byte-identical to an
+    unthrottled session."""
+    clk = Clock()
+    quiet_line = _req(tenant="quiet")
+    hot_line = _req(tenant="hot")
+    baseline = Serve(stdio=True).handle_line(quiet_line)
+    assert baseline["code"] == 0
+
+    srv = Serve(stdio=True)
+    srv._get_frontdoor().admission = AdmissionController(
+        rate=1.0, burst=1.0, max_inflight=0, clock=clk
+    )
+    r0 = ADMISSION_COUNTERS["rejected_rate"]
+    assert srv.handle_line(hot_line)["code"] == 0
+    for _ in range(3):  # hot tenant floods past its bucket
+        rej = srv.handle_line(hot_line)
+        assert rej["code"] == 5
+        assert rej["error_class"] == "QuotaExceeded"
+        assert rej["retry_after_ms"] == 1000
+        assert "hot" in rej["error"]
+    assert ADMISSION_COUNTERS["rejected_rate"] - r0 == 3
+    # the quiet tenant rides through, envelope byte-identical
+    assert srv.handle_line(quiet_line) == baseline
+    # the hot tenant recovers once its bucket refills
+    clk.advance(1.0)
+    assert srv.handle_line(hot_line)["code"] == 0
+
+
+# -- queue-full: shed vs structured 429 -----------------------------------
+
+class _AlwaysFull:
+    """Batcher stub whose admission queue never drains."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def submit(self, *a, **kw):
+        self.calls += 1
+        raise frontdoor.QueueFull("admission queue full (stub)",
+                                  retry_after_ms=25)
+
+
+def test_queue_full_sheds_to_solo_byte_identical(monkeypatch):
+    line = _req(backend="tpu")
+    solo = Serve(stdio=True, coalesce=False).handle_line(line)
+    assert solo["code"] == 0
+
+    srv = Serve(stdio=True, coalesce=True)
+    srv._batcher = _AlwaysFull()
+    s0 = ADMISSION_COUNTERS["shed_solo"]
+    assert srv.handle_line(line) == solo
+    assert ADMISSION_COUNTERS["shed_solo"] - s0 == 1
+
+
+def test_queue_full_rejects_when_shed_disabled(monkeypatch):
+    monkeypatch.setenv("GUARD_TPU_SERVE_SHED", "0")
+    srv = Serve(stdio=True, coalesce=True)
+    srv._batcher = _AlwaysFull()
+    q0 = ADMISSION_COUNTERS["rejected_queue_full"]
+    resp = srv.handle_line(_req(backend="tpu"))
+    assert resp["code"] == 5
+    assert resp["error_class"] == "QueueFull"
+    assert resp["retry_after_ms"] == 25
+    assert ADMISSION_COUNTERS["rejected_queue_full"] - q0 == 1
+
+
+def test_queue_full_trips_breaker_and_opens_shed_route(monkeypatch):
+    """With an SLO set, one saturated-queue event trips the breaker;
+    the NEXT same-digest request routes straight to solo dispatch
+    without ever touching the batcher."""
+    monkeypatch.setenv("GUARD_TPU_SERVE_SLO_MS", "5000")
+    monkeypatch.setenv("GUARD_TPU_BREAKER_COOLDOWN_MS", "3600000")
+    line = _req(backend="tpu")
+    solo = Serve(stdio=True, coalesce=False).handle_line(line)
+
+    srv = Serve(stdio=True, coalesce=True)
+    stub = srv._batcher = _AlwaysFull()
+    b0 = ADMISSION_COUNTERS["breaker_trips"]
+    assert srv.handle_line(line) == solo  # shed on the saturation
+    assert ADMISSION_COUNTERS["breaker_trips"] - b0 == 1
+    assert stub.calls == 1
+    assert srv.handle_line(line) == solo  # breaker OPEN: pre-emptive shed
+    assert stub.calls == 1  # batcher never consulted again
+
+
+def test_serve_breaker_sheds_after_latency_trip(monkeypatch):
+    """The real batcher path: a 1ns SLO means the first observed
+    formation+dispatch latency trips the breaker, and the second
+    request sheds — byte-identical to the sequential session."""
+    monkeypatch.setenv("GUARD_TPU_COALESCE_WAIT_MS", "0")
+    clk = Clock()
+    line = _req(backend="tpu")
+    solo = Serve(stdio=True, coalesce=False).handle_line(line)
+
+    srv = Serve(stdio=True, coalesce=True)
+    srv._get_frontdoor().breaker = CircuitBreaker(
+        slo_s=1e-9, cooldown_s=3600.0, min_samples=1, clock=clk
+    )
+    assert srv.handle_line(line) == solo  # rides the batcher, trips
+    from guard_tpu.ops.plan import plan_digest
+
+    digest = plan_digest(srv._prepared_rules((RULES,)))
+    assert srv._get_frontdoor().breaker.state(digest) == OPEN
+    s0 = ADMISSION_COUNTERS["shed_solo"]
+    assert srv.handle_line(line) == solo
+    assert ADMISSION_COUNTERS["shed_solo"] - s0 == 1
+
+
+def test_batcher_bounded_queue_wait_raises_queue_full():
+    """CoalescingBatcher.submit(queue_wait=...) never wedges on a full
+    admission queue: past the bounded wait it raises QueueFull for the
+    front door to shed or 429."""
+    from guard_tpu.serve.batcher import CoalescingBatcher
+
+    ev = threading.Event()
+    started = threading.Event()
+
+    class _Slow:
+        def execute(self, writer, reader):
+            started.set()
+            ev.wait(30)
+            return 0
+
+    b = CoalescingBatcher(wait_s=5.0, max_batch=8, queue_limit=1)
+    try:
+        t1 = threading.Thread(
+            target=b.submit, args=(_Slow(), "{}", "d1", Writer.buffered())
+        )
+        t1.start()
+        assert started.wait(10)  # dispatcher is now wedged in t1
+        t2 = threading.Thread(
+            target=b.submit, args=(_Slow(), "{}", "d2", Writer.buffered())
+        )
+        t2.start()
+        deadline = time.monotonic() + 10
+        while len(b._q) < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert len(b._q) == 1  # queue at its limit, dispatcher busy
+        with pytest.raises(frontdoor.QueueFull):
+            b.submit(_Slow(), "{}", "d3", Writer.buffered(),
+                     queue_wait=0.05)
+        ev.set()
+        t1.join(30)
+        t2.join(30)
+    finally:
+        ev.set()
+        b.close()
+
+
+# -- transport input bounds ----------------------------------------------
+
+def test_http_body_cap_answers_413(monkeypatch):
+    from guard_tpu.serve.server import ServeServer
+    import http.client
+
+    monkeypatch.setenv("GUARD_TPU_SERVE_MAX_BODY", "200")
+    srv = Serve(stdio=False)
+    server = ServeServer(srv, "127.0.0.1:0").start()
+    try:
+        s0 = ADMISSION_COUNTERS["rejected_body_size"]
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=30)
+        conn.request("POST", "/validate", body="x" * 1000)
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 413
+        assert body["error_class"] == "BodyTooLarge"
+        assert ADMISSION_COUNTERS["rejected_body_size"] - s0 == 1
+        conn.close()
+        # an in-bounds request on a fresh connection still answers
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=30)
+        conn.request("POST", "/validate", body=_req())
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read())["code"] == 0
+        conn.close()
+    finally:
+        server.stop()
+
+
+def test_jsonl_line_cap_keeps_session_alive(monkeypatch):
+    from guard_tpu.serve.server import ServeServer
+
+    monkeypatch.setenv("GUARD_TPU_SERVE_MAX_BODY", "200")
+    srv = Serve(stdio=False)
+    server = ServeServer(srv, "127.0.0.1:0").start()
+    try:
+        with socket.create_connection(("127.0.0.1", server.port),
+                                      timeout=30) as s:
+            f = s.makefile("rwb")
+            f.write((json.dumps({"junk": "y" * 500}) + "\n").encode())
+            f.write((_req() + "\n").encode())
+            f.flush()
+            s.shutdown(socket.SHUT_WR)
+            first = json.loads(f.readline())
+            second = json.loads(f.readline())
+        assert first["code"] == 5
+        assert first["error_class"] == "BodyTooLarge"
+        assert second["code"] == 0  # the oversized line did not end it
+    finally:
+        server.stop()
+
+
+def test_http_quota_rejection_maps_to_429(monkeypatch):
+    from guard_tpu.serve.server import ServeServer
+    import http.client
+
+    monkeypatch.setenv("GUARD_TPU_TENANT_RATE", "1")
+    srv = Serve(stdio=False)
+    server = ServeServer(srv, "127.0.0.1:0").start()
+    try:
+        def post():
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=30)
+            conn.request("POST", "/validate", body=_req())
+            resp = conn.getresponse()
+            out = (resp.status, resp.getheader("Retry-After"),
+                   json.loads(resp.read()))
+            conn.close()
+            return out
+
+        status, _, body = post()
+        assert status == 200 and body["code"] == 0
+        status, retry_after, body = post()  # bucket (burst 1) is empty
+        assert status == 429
+        assert body["error_class"] == "QuotaExceeded"
+        assert int(retry_after) >= 1
+    finally:
+        server.stop()
+
+
+# -- the webhook face -----------------------------------------------------
+
+def _review(obj, uid="uid-1"):
+    return json.dumps({
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {"uid": uid, "object": obj},
+    })
+
+
+@pytest.fixture
+def webhook_serve(tmp_path):
+    reg = tmp_path / "registry.guard"
+    reg.write_text(RULES)
+    return Serve(stdio=True, rules=[str(reg)])
+
+
+def test_webhook_allows_compliant_object(webhook_serve):
+    status, doc = webhook_serve.handle_webhook(_review({"a": 1}))
+    assert status == 200
+    r = doc["response"]
+    assert r["uid"] == "uid-1"
+    assert r["allowed"] is True
+    assert r["status"]["code"] == 200
+    assert doc["kind"] == "AdmissionReview"
+
+
+def test_webhook_denies_with_rule_messages(webhook_serve):
+    status, doc = webhook_serve.handle_webhook(
+        _review({"b": 2}, uid="uid-2")
+    )
+    assert status == 200  # the HTTP exchange succeeded; the VERDICT denies
+    r = doc["response"]
+    assert r["uid"] == "uid-2"
+    assert r["allowed"] is False
+    assert r["status"]["code"] == 403
+    assert "has_a" in r["status"]["message"].lower()
+
+
+def test_webhook_malformed_review_is_422(webhook_serve):
+    status, doc = webhook_serve.handle_webhook("{not json")
+    assert status == 422
+    assert doc["error_class"] == "ValueError"
+    status, doc = webhook_serve.handle_webhook(json.dumps({"kind": "X"}))
+    assert status == 422  # no `request` object
+
+
+def test_webhook_without_registry_fails_open():
+    status, doc = Serve(stdio=True).handle_webhook(_review({"b": 2}))
+    assert status == 200
+    assert doc["response"]["allowed"] is True
+    assert "no rules configured" in doc["response"]["status"]["message"]
+
+
+# -- streaming CI mode (sweep --follow) -----------------------------------
+
+def _follow(tmp_path, lines, *extra):
+    rules = tmp_path / "r.guard"
+    rules.write_text(RULES)
+    w = Writer.buffered()
+    rc = run(
+        ["sweep", "--follow", "-r", str(rules), "--backend", "cpu",
+         *extra],
+        writer=w,
+        reader=Reader.from_string("\n".join(lines) + "\n"),
+    )
+    out = [json.loads(l) for l in w.out.getvalue().splitlines()
+           if l.strip()]
+    return rc, out[:-1], out[-1]
+
+
+def test_follow_answers_every_line_in_order(tmp_path):
+    rc, results, summary = _follow(tmp_path, [
+        json.dumps({"name": "good", "content": '{"a": 1}'}),
+        '{"a": 2}',            # a bare JSON document is its own content
+        json.dumps({"name": "bad", "content": '{"b": 2}'}),
+        "::not json::",        # quarantined, still answered in order
+    ])
+    assert [r["name"] for r in results] == [
+        "good", "stream[2]", "bad", "stream[4]",
+    ]
+    assert results[0]["status"] == "pass" and results[0]["fails"] == []
+    assert results[1]["status"] == "pass"
+    assert results[2]["status"] == "fail" and results[2]["fails"]
+    assert "quarantined" in results[3]
+    assert summary["follow"] is True
+    assert summary["documents"] == 4
+    assert summary["counts"]["pass"] == 2
+    assert summary["counts"]["fail"] == 1
+    assert summary["errors"] == 1
+    assert len(summary["quarantined"]) == 1
+    assert rc == 19  # a failing doc is the sweep FAIL exit
+
+
+def test_follow_clean_stream_exits_zero(tmp_path):
+    rc, results, summary = _follow(tmp_path, ['{"a": 1}', '{"a": 2}'])
+    assert rc == 0
+    assert [r["status"] for r in results] == ["pass", "pass"]
+    assert summary["counts"]["fail"] == 0
+    assert "quarantined" not in summary
+
+
+def test_follow_quarantine_budget_is_enforced(tmp_path):
+    rc, results, summary = _follow(
+        tmp_path, ['{"a": 1}', "::not json::"],
+        "--max-doc-failures", "0",
+    )
+    assert rc == 5  # past the budget the stream exits ERROR
+    assert summary["documents"] == 2
+
+
+def test_follow_counters_ride_the_admission_group(tmp_path):
+    d0 = ADMISSION_COUNTERS["follow_docs"]
+    b0 = ADMISSION_COUNTERS["follow_batches"]
+    _follow(tmp_path, ['{"a": 1}', '{"a": 2}', '{"a": 3}'])
+    assert ADMISSION_COUNTERS["follow_docs"] - d0 == 3
+    assert ADMISSION_COUNTERS["follow_batches"] - b0 >= 1
+
+
+# -- the Lambda front door ------------------------------------------------
+
+def test_lambda_legacy_event_shape_is_preserved(monkeypatch):
+    from guard_tpu import lambda_handler
+
+    monkeypatch.setattr(lambda_handler, "_SESSION", None)
+    out = lambda_handler.handler({
+        "data": '{"a": 1}', "rules": [RULES], "verbose": False,
+    })
+    assert set(out) == {"message"}
+    assert len(out["message"]) == 1
+
+
+def test_lambda_frontdoor_event_routes_through_serve(monkeypatch):
+    from guard_tpu import lambda_handler
+
+    monkeypatch.setattr(lambda_handler, "_SESSION", None)
+    ok = lambda_handler.handler({
+        "documents": [{"a": 1}], "rules": [RULES], "backend": "cpu",
+    })
+    assert ok["code"] == 0
+    assert json.loads(ok["output"])["version"] == "2.1.0"
+    fail = lambda_handler.handler({
+        "documents": [{"b": 2}], "rules": [RULES], "backend": "cpu",
+    })
+    assert fail["code"] == 19
+
+
+def test_lambda_frontdoor_quota_rejection_is_structured(monkeypatch):
+    from guard_tpu import lambda_handler
+
+    monkeypatch.setattr(lambda_handler, "_SESSION", None)
+    clk = Clock()
+    ev = {"documents": [{"a": 1}], "rules": [RULES], "backend": "cpu",
+          "tenant": "burst-caller"}
+    assert lambda_handler.handler(ev)["code"] == 0
+    lambda_handler._SESSION._get_frontdoor().admission = (
+        AdmissionController(rate=1.0, burst=1.0, max_inflight=0,
+                            clock=clk)
+    )
+    assert lambda_handler.handler(ev)["code"] == 0  # first token
+    rej = lambda_handler.handler(ev)
+    assert rej["code"] == 5
+    assert rej["error_class"] == "QuotaExceeded"
+    assert rej["retry_after_ms"] == 1000
+    monkeypatch.setattr(lambda_handler, "_SESSION", None)
+
+
+# -- front-door fault points ----------------------------------------------
+
+def test_front_door_fault_points_registered():
+    assert "admission" in POINTS
+    assert "shed" in POINTS
+
+
+def test_injected_admission_fault_answers_structured(monkeypatch):
+    monkeypatch.setenv("GUARD_TPU_FAULT", "admission:nth=1")
+    reset_faults()
+    try:
+        srv = Serve(stdio=True)
+        r1 = srv.handle_line(_req())
+        assert r1["code"] == 5
+        assert r1["error_class"] == "InjectedFault"
+        r2 = srv.handle_line(_req())  # nth=1 fired once; session alive
+        assert r2["code"] == 0
+    finally:
+        monkeypatch.delenv("GUARD_TPU_FAULT")
+        reset_faults()
+
+
+def test_injected_shed_fault_answers_structured(monkeypatch):
+    monkeypatch.setenv("GUARD_TPU_FAULT", "shed:nth=1")
+    reset_faults()
+    try:
+        srv = Serve(stdio=True, coalesce=True)
+        srv._batcher = _AlwaysFull()  # force the shed path
+        resp = srv.handle_line(_req(backend="tpu"))
+        assert resp["code"] == 5
+        assert resp["error_class"] == "InjectedFault"
+    finally:
+        monkeypatch.delenv("GUARD_TPU_FAULT")
+        reset_faults()

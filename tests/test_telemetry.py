@@ -245,7 +245,7 @@ def test_analysis_group_in_snapshot_contract():
     from guard_tpu.ops import plan as plan_mod
 
     assert "analysis" in cms.EXPECTED_GROUPS
-    assert cms.KNOWN_SCHEMA_VERSION == telemetry.SCHEMA_VERSION == 5
+    assert cms.KNOWN_SCHEMA_VERSION == telemetry.SCHEMA_VERSION >= 5
     snap = telemetry.metrics_snapshot()
     assert "analysis" in snap["counters"]
     for key in ("invariants_checked", "violations", "lint_findings",
@@ -258,6 +258,26 @@ def test_analysis_group_in_snapshot_contract():
     doctored = json.loads(json.dumps(snap))
     del doctored["counters"]["analysis"]
     assert check_snapshot(doctored, require_groups=("analysis",))
+
+
+def test_admission_group_in_snapshot_contract():
+    """v6: the serving front door's counter group joined the published
+    snapshot shape — quota admissions/rejections, breaker
+    trips/probes/closes, sheds, transport bounds, follow stream."""
+    import tools.check_metrics_schema as cms
+
+    assert "admission" in cms.EXPECTED_GROUPS
+    assert cms.KNOWN_SCHEMA_VERSION == telemetry.SCHEMA_VERSION == 6
+    snap = telemetry.metrics_snapshot()
+    assert "admission" in snap["counters"]
+    for key in ("admitted", "rejected_rate", "rejected_inflight",
+                "rejected_queue_full", "rejected_body_size",
+                "shed_solo", "breaker_trips", "breaker_probes",
+                "breaker_closes", "follow_docs", "follow_batches"):
+        assert key in snap["counters"]["admission"]
+    doctored = json.loads(json.dumps(snap))
+    del doctored["counters"]["admission"]
+    assert check_snapshot(doctored, require_groups=("admission",))
 
 
 def test_verify_and_lint_spans_roll_up():

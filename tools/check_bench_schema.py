@@ -88,6 +88,14 @@ METRIC_SINCE.update({
     "config5b_verify_on_templates_per_sec": 15,
 })
 
+# PR 16 serving front door: the overload shed pair and the per-tenant
+# isolation row arrived with round 16
+METRIC_SINCE.update({
+    "serve_overload_shed_off_p99_ms": 16,
+    "serve_overload_shed_on_p99_ms": 16,
+    "serve_quota_isolation_quiet_p50_ms": 16,
+})
+
 
 def metric_since(metric: str) -> int:
     """The bench round whose driver first emitted `metric`."""
@@ -199,6 +207,26 @@ METRIC_REQUIRED_KEYS.update({
     ),
     "serve_c1_adaptive_p50_ratio": (
         "p50_on_ms", "p50_off_ms", "coalesce_window_adaptive",
+    ),
+})
+
+# PR 16 serving front door: the shed-on row must carry the breaker
+# evidence (how many trips, how many requests shed solo, against what
+# SLO) and the isolation row must carry the hot tenant's rejection
+# counts plus the quiet tenant's byte-parity verdict — "did the
+# breaker actually engage" and "did quota isolation actually hold"
+# are answerable from the committed artifact alone
+METRIC_REQUIRED_KEYS.update({
+    "serve_overload_shed_off_p99_ms": (
+        "dispatches_per_request", "stall_window_ms", "concurrency",
+    ),
+    "serve_overload_shed_on_p99_ms": (
+        "dispatches_per_request", "stall_window_ms", "concurrency",
+        "slo_ms", "breaker_trips", "shed_solo",
+    ),
+    "serve_quota_isolation_quiet_p50_ms": (
+        "p50_alone_ms", "hot_rejected", "quota_rejections",
+        "envelope_parity", "tenant_max_inflight",
     ),
 })
 

@@ -35,7 +35,11 @@ import sys
 #: verifier, rule linter, anchor-signature extraction), the
 #: verify_plan / lint spans, and the plan_cache corrupt-cause
 #: counters.
-KNOWN_SCHEMA_VERSION = 5
+#: v6: the `admission` counter group (serving front door: per-tenant
+#: quota admissions/rejections, SLO circuit-breaker trips/probes/
+#: closes, overload sheds, follow-mode micro-batches) and the
+#: breaker-state / admission-inflight gauges.
+KNOWN_SCHEMA_VERSION = 6
 
 #: top-level sections every snapshot must carry
 SECTIONS = ("schema_version", "counters", "gauges", "histograms", "spans")
@@ -49,10 +53,12 @@ SECTIONS = ("schema_version", "counters", "gauges", "histograms", "spans")
 #: layer became the default lowering path; result_cache registers with
 #: cache.results, imported by every sweep/validate tpu session;
 #: analysis registers with the analysis package, imported by the plan
-#: layer's verifier hooks on every tpu-backend lowering.
+#: layer's verifier hooks on every tpu-backend lowering; admission
+#: registers with utils.telemetry itself (like serve), so it is
+#: present in every snapshot.
 EXPECTED_GROUPS = (
     "dispatch", "pipeline", "rim", "fault", "plan_cache", "efficiency",
-    "result_cache", "analysis",
+    "result_cache", "analysis", "admission",
 )
 
 #: keys every histogram snapshot must carry
