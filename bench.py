@@ -1975,6 +1975,318 @@ def delta_smoke(n_docs: int = 64, chunk_size: int = 16) -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def measure_journal(corpus: str = "registry", n_docs: int = 1024,
+                    chunk_size: int = 256, reps: int = 3):
+    """Checkpoint overhead contract (the durability plane): the sweep
+    journal's per-chunk append (run-key hash + record write + fsync +
+    stderr buffering) must cost <= 2% of the production sweep flow to
+    stay on by default. Off/on legs run the SAME full sweep with the
+    `journal` flag flipped, interleaved with the pair order swapped
+    each rep and best-of-reps kept (measure_verify idiom); the result
+    cache is disabled in both legs so every rep dispatches every chunk.
+    Returns (off_docs_per_sec, on_docs_per_sec, chunks_journaled)."""
+    import gc
+    import pathlib
+    import shutil
+    import tempfile
+
+    from guard_tpu.commands.sweep import Sweep
+    from guard_tpu.utils import telemetry
+    from guard_tpu.utils.io import Reader, Writer
+
+    tmp = tempfile.mkdtemp(prefix="guard_journal_")
+    prev = {
+        k: os.environ.get(k)
+        for k in ("GUARD_TPU_PLAN_CACHE_DIR", "GUARD_TPU_RESULT_CACHE_DIR",
+                  "GUARD_TPU_JOURNAL_DIR")
+    }
+    os.environ["GUARD_TPU_PLAN_CACHE_DIR"] = str(
+        pathlib.Path(tmp) / "plans"
+    )
+    os.environ["GUARD_TPU_RESULT_CACHE_DIR"] = str(
+        pathlib.Path(tmp) / "results"
+    )
+    os.environ["GUARD_TPU_JOURNAL_DIR"] = str(
+        pathlib.Path(tmp) / "journal"
+    )
+    try:
+        docdir, rules = _write_ingest_corpus(tmp, corpus, n_docs)
+
+        def one(tag: str, journal: bool) -> float:
+            gc.collect()
+            cmd = Sweep(
+                rules=[rules],
+                data=[docdir],
+                manifest=str(pathlib.Path(tmp) / f"m-{tag}.jsonl"),
+                chunk_size=chunk_size,
+                backend="tpu",
+                result_cache=False,
+                journal=journal,
+            )
+            t0 = time.perf_counter()
+            cmd.execute(Writer.buffered(), Reader.from_string(""))
+            return time.perf_counter() - t0
+
+        one("pretrace", True)  # plan memo + XLA compile off the clock
+        t_off: list = []
+        t_on: list = []
+        for r in range(reps):
+            pair = [(False, t_off), (True, t_on)]
+            if r % 2:
+                pair.reverse()
+            for journal, acc in pair:
+                acc.append(one(f"{'on' if journal else 'off'}{r}", journal))
+        _reset_stats()
+        one("count", True)
+        journaled = telemetry.REGISTRY.group_stats(
+            "resume"
+        )["chunks_journaled"]
+        return n_docs / min(t_off), n_docs / min(t_on), journaled
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def measure_resume(corpus: str = "registry", n_docs: int = 1024,
+                   chunk_size: int = 64, reps: int = 2):
+    """The durability plane's payoff row: a sweep resumed from a
+    journal that checkpointed ~50% of its chunks before the process
+    died. Per rep, an uninterrupted crash leg runs OFF the clock with
+    an injected `journal` fault killing it at the half-way checkpoint;
+    the timed leg replays the journaled half (zero encode/dispatch)
+    and computes the rest. The dispatches_per_run extra is the
+    evidence: the resumed run dispatches only the unjournaled tail.
+    Returns (resume_docs_per_sec, full_docs_per_sec, extras)."""
+    import gc
+    import pathlib
+    import shutil
+    import tempfile
+
+    from guard_tpu.commands.sweep import Sweep
+    from guard_tpu.ops.backend import dispatch_stats
+    from guard_tpu.utils import telemetry
+    from guard_tpu.utils.faults import InjectedFault, reset_faults
+    from guard_tpu.utils.io import Reader, Writer
+
+    tmp = tempfile.mkdtemp(prefix="guard_resume_")
+    prev = {
+        k: os.environ.get(k)
+        for k in ("GUARD_TPU_PLAN_CACHE_DIR", "GUARD_TPU_RESULT_CACHE_DIR",
+                  "GUARD_TPU_JOURNAL_DIR", "GUARD_TPU_FAULT")
+    }
+    os.environ["GUARD_TPU_PLAN_CACHE_DIR"] = str(
+        pathlib.Path(tmp) / "plans"
+    )
+    os.environ["GUARD_TPU_RESULT_CACHE_DIR"] = str(
+        pathlib.Path(tmp) / "results"
+    )
+    os.environ["GUARD_TPU_JOURNAL_DIR"] = str(
+        pathlib.Path(tmp) / "journal"
+    )
+    os.environ.pop("GUARD_TPU_FAULT", None)
+    reset_faults()
+    try:
+        docdir, rules = _write_ingest_corpus(tmp, corpus, n_docs)
+        n_chunks = (n_docs + chunk_size - 1) // chunk_size
+
+        def one(tag: str, resume: bool) -> float:
+            gc.collect()
+            cmd = Sweep(
+                rules=[rules],
+                data=[docdir],
+                manifest=str(pathlib.Path(tmp) / f"m-{tag}.jsonl"),
+                chunk_size=chunk_size,
+                backend="tpu",
+                result_cache=False,
+                resume=resume,
+            )
+            t0 = time.perf_counter()
+            cmd.execute(Writer.buffered(), Reader.from_string(""))
+            return time.perf_counter() - t0
+
+        # plan memo + XLA compile, and the full-run baseline the row
+        # divides by (an uninterrupted journal-on sweep)
+        one("pretrace", False)
+        t_full = min(one(f"full{r}", False) for r in range(reps))
+
+        # crash legs (off the clock): each rep's run key is distinct
+        # (the manifest path is part of the config hash), so every rep
+        # resumes its own half-journaled run
+        half = n_chunks // 2 + 1
+        os.environ["GUARD_TPU_FAULT"] = f"journal:nth={half}"
+        reset_faults()
+        for r in range(reps):
+            try:
+                one(f"res{r}", False)
+            except InjectedFault:
+                pass  # the simulated mid-run crash
+        os.environ.pop("GUARD_TPU_FAULT", None)
+        reset_faults()
+
+        _reset_stats()
+        t_res = []
+        for r in range(reps):
+            t_res.append(one(f"res{r}", True))
+        disp = dispatch_stats()
+        stats = telemetry.REGISTRY.group_stats("resume")
+        extras = {
+            "chunks_replayed": stats["chunks_replayed"] // reps,
+            "chunks_total": n_chunks,
+            "dispatches_per_run": disp["dispatches"] // reps,
+            "runs_resumed": stats["runs_resumed"],
+        }
+        return n_docs / min(t_res), n_docs / t_full, extras
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        reset_faults()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def resume_smoke(n_docs: int = 64, chunk_size: int = 16) -> None:
+    """CI resume-smoke (JAX_PLATFORMS=cpu): the durability plane's
+    acceptance gate, end to end on real plumbing. (1) A sweep killed
+    mid-run by an injected `journal` fault and then resumed must
+    reproduce the uninterrupted run BYTE-IDENTICALLY (summary JSON,
+    manifest rows, stderr, exit code); (2) resuming a fully-journaled
+    run must replay every chunk with ZERO device dispatches; (3) after
+    touching ONE doc the journal key changes, so resume logs a stale
+    cold start and re-dispatches everything. Prints one JSON line;
+    SystemExit(1) on violation."""
+    import json as _json
+    import pathlib
+    import shutil
+    import tempfile
+
+    from guard_tpu.commands.sweep import Sweep
+    from guard_tpu.ops.backend import dispatch_stats
+    from guard_tpu.utils import telemetry
+    from guard_tpu.utils.faults import InjectedFault, reset_faults
+    from guard_tpu.utils.io import Reader, Writer
+
+    tmp = tempfile.mkdtemp(prefix="guard_resume_smoke_")
+    prev = {
+        k: os.environ.get(k)
+        for k in ("GUARD_TPU_JOURNAL_DIR", "GUARD_TPU_RESULT_CACHE",
+                  "GUARD_TPU_FAULT")
+    }
+    os.environ["GUARD_TPU_RESULT_CACHE"] = "0"
+    os.environ.pop("GUARD_TPU_FAULT", None)
+    reset_faults()
+    try:
+        docdir, rules = _write_ingest_corpus(tmp, "registry", n_docs)
+        n_chunks = (n_docs + chunk_size - 1) // chunk_size
+        # one manifest path for EVERY leg: the summary line embeds it,
+        # so byte parity requires the same path string (the file is
+        # deleted between legs; each journal leg gets its own dir)
+        mpath = pathlib.Path(tmp) / "m.jsonl"
+
+        def run_sweep(tag: str, resume: bool = False):
+            os.environ["GUARD_TPU_JOURNAL_DIR"] = str(
+                pathlib.Path(tmp) / f"journal-{tag}"
+            )
+            if mpath.exists():
+                mpath.unlink()
+            w = Writer.buffered()
+            cmd = Sweep(
+                rules=[rules],
+                data=[docdir],
+                manifest=str(mpath),
+                chunk_size=chunk_size,
+                backend="tpu",
+                resume=resume,
+            )
+            rc = cmd.execute(w, Reader.from_string(""))
+            return rc, w.out.getvalue(), w.err.getvalue(), mpath.read_text()
+
+        # leg A: the uninterrupted baseline
+        _reset_stats()
+        base = run_sweep("base")
+        d_base = dispatch_stats()
+
+        # leg B: killed at the second checkpoint (one chunk journaled),
+        # then resumed — the resumed run must reproduce leg A exactly
+        os.environ["GUARD_TPU_FAULT"] = "journal:nth=2"
+        reset_faults()
+        crashed = False
+        try:
+            run_sweep("crash")
+        except InjectedFault:
+            crashed = True
+        os.environ.pop("GUARD_TPU_FAULT", None)
+        reset_faults()
+        _reset_stats()
+        resumed = run_sweep("crash", resume=True)
+        d_res = dispatch_stats()
+        s_res = telemetry.REGISTRY.group_stats("resume")
+
+        # leg C: resume of the now fully-journaled run — every chunk
+        # replays, the device is never touched
+        _reset_stats()
+        replay = run_sweep("crash", resume=True)
+        d_rep = dispatch_stats()
+        s_rep = telemetry.REGISTRY.group_stats("resume")
+
+        # leg D: one touched doc changes the run key — stale journal,
+        # logged cold start, full dispatch
+        p0 = sorted(pathlib.Path(docdir).glob("d*.json"))[0]
+        d0 = _json.loads(p0.read_text())
+        d0["__bench_touch"] = "resume-smoke"
+        p0.write_text(_json.dumps(d0))
+        _reset_stats()
+        run_sweep("crash", resume=True)
+        d_stale = dispatch_stats()
+        s_stale = telemetry.REGISTRY.group_stats("resume")
+
+        parity = base == resumed == replay
+        record = {
+            "metric": "resume_smoke",
+            "docs": n_docs,
+            "chunks": n_chunks,
+            "crashed_mid_run": crashed,
+            "parity": parity,
+            "base_dispatches": d_base["dispatches"],
+            "resume_chunks_replayed": s_res["chunks_replayed"],
+            "resume_dispatches": d_res["dispatches"],
+            "replay_chunks_replayed": s_rep["chunks_replayed"],
+            "replay_dispatches": d_rep["dispatches"],
+            "stale_cold_starts": s_stale["stale_cold_starts"],
+            "stale_dispatches": d_stale["dispatches"],
+        }
+        print(_json.dumps(record), flush=True)
+        ok = (
+            crashed
+            and parity
+            and s_res["runs_resumed"] == 1
+            and s_res["chunks_replayed"] == 1
+            # the resumed run pays dispatch only for the unjournaled
+            # tail; the full replay never touches the device
+            and 0 < d_res["dispatches"] < d_base["dispatches"]
+            and s_rep["chunks_replayed"] == n_chunks
+            and d_rep["dispatches"] == 0
+            and s_stale["stale_cold_starts"] >= 1
+            and s_stale["chunks_replayed"] == 0
+            and d_stale["dispatches"] == d_base["dispatches"]
+        )
+        if not ok:
+            raise SystemExit(1)
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        reset_faults()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def measure_quarantine(n_docs: int = 1024, chunk_size: int = 256,
                        reps: int = 3, n_poison: int = 8):
     """The failure plane's overhead contract: the always-on quarantine
@@ -3600,6 +3912,9 @@ def expected_metrics() -> list:
         "config5b_delta_cold_templates_per_sec",
         "config5b_delta_warm_templates_per_sec",
         "config5b_delta_1pct_templates_per_sec",
+        "config5b_journal_off_templates_per_sec",
+        "config5b_journal_on_templates_per_sec",
+        "config5b_resume_50pct_templates_per_sec",
         "config5c_rule_sharded_templates_per_sec",
     ]
     for c in (1, 4, 16):
@@ -3684,6 +3999,17 @@ def main() -> None:
 
         _honor_platform_env()
         delta_smoke()
+        return
+    if "--resume-smoke" in sys.argv:
+        # CI smoke for the durability plane: a sweep killed mid-run by
+        # an injected journal fault and resumed must be byte-identical
+        # to the uninterrupted run, a full replay must make zero device
+        # dispatches, and a one-doc touch must force a logged stale
+        # cold start
+        from guard_tpu.ops.backend import _honor_platform_env
+
+        _honor_platform_env()
+        resume_smoke()
         return
     if "--chaos-smoke" in sys.argv:
         # CI smoke for the failure plane: injected worker crash +
@@ -4079,6 +4405,40 @@ def main() -> None:
         extra={
             **x_dp,
             "vs_note": "vs_baseline here = 1%-of-docs-rewritten-between-runs sweep over the --no-result-cache full-dispatch sweep; only the touched docs encode/dispatch/store, the other 99% replay from the store",
+        },
+    )
+
+    # config 5b durability plane: the checkpoint-overhead contract
+    # (journal off vs on, interleaved best-of pairs — on must stay
+    # within 2% of off) and the resume payoff row (a run resumed from
+    # a half-journaled crash replays the journaled chunks with zero
+    # encode/dispatch and pays device time only for the tail)
+    v_joff, v_jon, n_journaled = measure_journal()
+    _emit(
+        "config5b_journal_off_templates_per_sec",
+        v_joff,
+        1.0,
+        extra={"journal": "off"},
+    )
+    _emit(
+        "config5b_journal_on_templates_per_sec",
+        v_jon,
+        v_jon / max(v_joff, 1e-9),
+        extra={
+            "journal": "on",
+            "overhead_vs_off": round(1.0 - v_jon / max(v_joff, 1e-9), 4),
+            "chunks_journaled_per_run": n_journaled,
+            "vs_note": "vs_baseline here = journal-on sweep over the journal-off sweep on the same on-disk registry corpus (interleaved best-of pairs); the <=2% checkpoint-overhead contract reads off overhead_vs_off",
+        },
+    )
+    v_res, v_resfull, x_res = measure_resume()
+    _emit(
+        "config5b_resume_50pct_templates_per_sec",
+        v_res,
+        v_res / max(v_resfull, 1e-9),
+        extra={
+            **x_res,
+            "vs_note": "vs_baseline here = sweep resumed from a journal holding ~50% of its chunks over the uninterrupted journal-on sweep; dispatches_per_run counts only the unjournaled tail",
         },
     )
 

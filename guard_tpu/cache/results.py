@@ -142,6 +142,10 @@ def store_entry(key: str, payload: dict) -> bool:
     with _span("cache_store"):
         try:
             maybe_fail("cache", key)
+            # store_write: the durability plane's shared persistence-
+            # seam fault point (plan artifacts, result entries) — a
+            # full disk degrades to cache-off, never a failed run
+            maybe_fail("store_write", key)
             doc = {
                 "schema": RESULT_SCHEMA_VERSION,
                 "version": _guard_version(),

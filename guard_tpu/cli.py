@@ -276,6 +276,23 @@ def build_parser() -> argparse.ArgumentParser:
         "code at EOF; GUARD_TPU_FOLLOW_WAIT_MS bounds formation "
         "latency)",
     )
+    s.add_argument(
+        "--resume",
+        action="store_true",
+        help="durability plane: replay this run's chunk journal — "
+        "completed chunks replay with zero encode and zero device "
+        "dispatches, the sweep continues from the first incomplete "
+        "chunk, and stdout/stderr/manifest/exit code are byte-"
+        "identical to an uninterrupted run (stale journal = logged "
+        "cold start; also GUARD_TPU_SWEEP_RESUME=auto)",
+    )
+    s.add_argument(
+        "--no-journal",
+        action="store_true",
+        help="disable the per-run chunk journal (no checkpointing — "
+        "a killed run cannot --resume; bit-parity escape hatch, also "
+        "GUARD_TPU_SWEEP_JOURNAL=0)",
+    )
     _add_telemetry_flags(s)
 
     li = sub.add_parser(
@@ -413,6 +430,29 @@ def build_parser() -> argparse.ArgumentParser:
         "baseline)",
     )
 
+    g = sub.add_parser(
+        "gc",
+        help="Store hygiene: size-capped LRU eviction over the plan "
+        "cache, result cache and sweep journal dir "
+        "(GUARD_TPU_CACHE_MAX_BYTES / --max-bytes, mtime-ordered) "
+        "plus orphan-tmp reaping; crash-safe and always exit 0",
+    )
+    g.add_argument(
+        "--max-bytes",
+        type=int,
+        default=None,
+        metavar="N",
+        help="evict least-recently-used store entries until each "
+        "store is under this many bytes (default "
+        "GUARD_TPU_CACHE_MAX_BYTES, else 1 GiB; 0 = empty the stores)",
+    )
+    g.add_argument(
+        "--dry-run",
+        action="store_true",
+        help="report what would be evicted/reaped without deleting "
+        "anything",
+    )
+
     return p
 
 
@@ -496,6 +536,22 @@ def _session_epilogue(args, rc: Optional[int], dt: float) -> None:
             telemetry.REGISTRY.set_gauge("result_cache.total_docs", 0)
     except Exception:
         extra = None
+    # durability plane: a resumed sweep's record carries which run it
+    # resumed and how many chunks replayed (same read-then-clear
+    # handoff as the delta gauges); a drained session is recorded
+    # distinctly — its exit code is DRAIN_EXIT_CODE (75), never the
+    # error ladder's 5, and the extra names it so `report` can surface
+    # the drain/resume story without exit-code archaeology
+    try:
+        from .utils import journal as _journal
+
+        info = _journal.pop_resume_info()
+        if info:
+            extra = {**(extra or {}), **info}
+        if rc == _journal.DRAIN_EXIT_CODE:
+            extra = {**(extra or {}), "drained": True}
+    except Exception:
+        pass
     try:
         ledger.append_record(
             kind=args.command,
@@ -575,6 +631,8 @@ def _dispatch(args, writer: Writer, reader: Reader) -> int:
                 delta_stats=args.delta_stats,
                 verify_plans=not args.no_verify_plans,
                 follow=args.follow,
+                journal=not args.no_journal,
+                resume=args.resume,
             ).execute(writer, reader)
         if args.command == "lint":
             return Lint(
@@ -612,6 +670,13 @@ def _dispatch(args, writer: Writer, reader: Reader) -> int:
                 coalesce=coalesce,
                 rules=args.rules,
                 default_tenant=args.tenant,
+            ).execute(writer, reader)
+        if args.command == "gc":
+            from .commands.gc import Gc
+
+            return Gc(
+                max_bytes=args.max_bytes,
+                dry_run=args.dry_run,
             ).execute(writer, reader)
         if args.command == "report":
             from .commands.ops_report import OpsReport

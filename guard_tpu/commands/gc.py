@@ -1,0 +1,167 @@
+"""The `gc` command: crash-safe store hygiene for the persistent caches.
+
+The warm-path stores — compiled-plan artifacts (`ops/plan.py`), the
+content-addressed result cache (`cache/results.py`) and the sweep chunk
+journal (`utils/journal.py`) — are all append-forever by design: every
+writer treats the store as an optimization and never deletes. Under a
+CI fleet that means unbounded growth and, eventually, the ENOSPC
+degradation path on every run. `guard-tpu gc` is the other half of the
+durability plane's contract:
+
+* **Size-capped LRU eviction**: each store is independently capped at
+  `--max-bytes` / `GUARD_TPU_CACHE_MAX_BYTES` (default 1 GiB).
+  Eviction is mtime-ordered — oldest entry first, and every cache here
+  refreshes nothing on read, so mtime order IS insertion order, the
+  right order for content-addressed entries that are never updated in
+  place. Deletion is naturally crash-safe: entries are whole files
+  written via tmp+`os.replace`, so a gc killed mid-evict leaves every
+  survivor intact and the next gc simply continues.
+
+* **Orphan-tmp reaping**: a writer killed between `tmp.write_bytes`
+  and `os.replace` leaves a `*.tmp.<pid>` orphan that no load path
+  will ever read. Reaped unconditionally — a LIVE tmp file is in the
+  window between write and rename, so only orphans older than a grace
+  period (`_TMP_GRACE_S`) are touched.
+
+* **Always exit 0**: like every persistence seam in the tree, hygiene
+  is advisory. A file that vanishes mid-evict (concurrent gc, a
+  parallel run re-writing an entry) is skipped, counted in
+  `gc.evict_errors`, and never fails the command.
+
+One JSON summary line reports per-store bytes before/after and the
+eviction/reap counts; `--dry-run` reports without deleting.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+from ..utils.io import Reader, Writer
+from ..utils.telemetry import GC_COUNTERS
+from ..utils.telemetry import span as _span
+
+#: default per-store size cap when neither --max-bytes nor
+#: GUARD_TPU_CACHE_MAX_BYTES is given
+_DEFAULT_MAX_BYTES = 1 << 30
+
+#: a *.tmp.<pid> younger than this may belong to a live writer mid
+#: rename — leave it alone (tests age orphans with os.utime)
+_TMP_GRACE_S = 300.0
+
+
+def cache_max_bytes(flag: Optional[int] = None) -> int:
+    """The per-store byte cap: explicit flag, else
+    GUARD_TPU_CACHE_MAX_BYTES, else 1 GiB."""
+    if flag is not None:
+        return max(0, int(flag))
+    raw = os.environ.get("GUARD_TPU_CACHE_MAX_BYTES", "").strip()
+    try:
+        return max(0, int(raw)) if raw else _DEFAULT_MAX_BYTES
+    except ValueError:
+        return _DEFAULT_MAX_BYTES
+
+
+def _store_dirs() -> List[Tuple[str, Path, Tuple[str, ...]]]:
+    """(name, directory, entry glob patterns) for every persistent
+    store the hygiene pass owns. Globs are explicit — gc must never
+    eat a file some other tool parked in a shared cache dir."""
+    from ..cache.results import result_cache_dir
+    from ..ops.plan import plan_cache_dir
+    from ..utils.journal import journal_dir
+
+    return [
+        ("plan", plan_cache_dir(), ("*.plan", "*.sigs.json")),
+        ("result", result_cache_dir(), ("*.result.json",)),
+        ("journal", journal_dir(), ("*.journal.jsonl",)),
+    ]
+
+
+def _entries(root: Path, patterns: Tuple[str, ...]) -> List[Tuple[float, int, Path]]:
+    """(mtime, size, path) per store entry — stat failures (an entry
+    vanishing under a concurrent writer) are simply not entries."""
+    out: List[Tuple[float, int, Path]] = []
+    for pat in patterns:
+        for p in root.glob(pat):
+            try:
+                st = p.stat()
+            except OSError:
+                continue
+            out.append((st.st_mtime, st.st_size, p))
+    return out
+
+
+@dataclass
+class Gc:
+    max_bytes: Optional[int] = None
+    dry_run: bool = False
+
+    def execute(self, writer: Writer, reader: Reader) -> int:
+        cap = cache_max_bytes(self.max_bytes)
+        GC_COUNTERS["runs"] += 1
+        stores = {}
+        with _span("gc", {"cap": cap}):
+            for name, root, patterns in _store_dirs():
+                stores[name] = self._sweep_store(root, patterns, cap)
+        writer.writeln(json.dumps({
+            "gc": stores,
+            "max_bytes": cap,
+            "dry_run": self.dry_run,
+        }))
+        return 0
+
+    def _sweep_store(self, root: Path, patterns: Tuple[str, ...],
+                     cap: int) -> dict:
+        report = {
+            "dir": str(root),
+            "bytes_before": 0,
+            "bytes_after": 0,
+            "evicted": 0,
+            "tmps_reaped": 0,
+        }
+        if not root.is_dir():
+            return report
+        self._reap_orphans(root, report)
+        entries = _entries(root, patterns)
+        total = sum(size for _, size, _ in entries)
+        report["bytes_before"] = total
+        # LRU = oldest mtime first; ties break on path for determinism
+        entries.sort(key=lambda e: (e[0], str(e[2])))
+        for _mtime, size, path in entries:
+            if total <= cap:
+                break
+            if not self.dry_run:
+                try:
+                    path.unlink()
+                except FileNotFoundError:
+                    # crash-mid-evict / concurrent gc already took it:
+                    # the bytes are gone either way
+                    pass
+                except OSError:
+                    GC_COUNTERS["evict_errors"] += 1
+                    continue  # undeletable entry: skip, stay exit 0
+            total -= size
+            report["evicted"] += 1
+            if not self.dry_run:
+                GC_COUNTERS["files_evicted"] += 1
+                GC_COUNTERS["bytes_evicted"] += size
+        report["bytes_after"] = total
+        return report
+
+    def _reap_orphans(self, root: Path, report: dict) -> None:
+        now = time.time()
+        for p in root.glob("*.tmp.*"):
+            try:
+                if now - p.stat().st_mtime < _TMP_GRACE_S:
+                    continue  # possibly a live writer mid-rename
+                if not self.dry_run:
+                    p.unlink()
+            except OSError:
+                continue
+            report["tmps_reaped"] += 1
+            if not self.dry_run:
+                GC_COUNTERS["orphan_tmps_reaped"] += 1

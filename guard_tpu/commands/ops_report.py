@@ -141,7 +141,39 @@ class OpsReport:
             )
         if not diff["counters"]:
             writer.writeln("  (no counter deltas)")
+        self._durability(writer, records)
         return 0
+
+    @staticmethod
+    def _durability(writer: Writer, records: list) -> None:
+        """Durability-plane roll-up over the whole ledger: what share
+        of sweep sessions resumed from a journal (vs cold-started) and
+        how many sessions exited via graceful drain — the operator's
+        answer to "is checkpoint/resume actually carrying the fleet, or
+        are we cold-starting every retry?"."""
+        sweeps = [r for r in records if r.get("kind") == "sweep"]
+        if not sweeps:
+            return
+        resumed = [
+            r for r in sweeps
+            if (r.get("extra") or {}).get("resumed_from")
+        ]
+        drained = [
+            r for r in records if (r.get("extra") or {}).get("drained")
+        ]
+        if not resumed and not drained:
+            return
+        writer.writeln(
+            f"resume rate: {len(resumed) / len(sweeps):.1%} "
+            f"({len(resumed)}/{len(sweeps)} sweep sessions resumed, "
+            f"{sum((r.get('extra') or {}).get('chunks_replayed', 0) for r in resumed):,}"
+            " chunks replayed)"
+        )
+        if drained:
+            writer.writeln(
+                f"drained sessions: {len(drained)} "
+                "(graceful SIGTERM/SIGINT exits)"
+            )
 
     def _efficiency(self, writer: Writer, rec: dict) -> int:
         metrics = rec.get("metrics") or {}

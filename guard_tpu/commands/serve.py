@@ -200,6 +200,12 @@ class Serve:
     _frontdoor: Optional[object] = field(default=None, repr=False)
     # webhook registry texts, read once per session from self.rules
     _webhook_rules: Optional[list] = field(default=None, repr=False)
+    #: graceful-drain latch (utils/journal.DrainLatch): SIGTERM/SIGINT
+    #: trips it — the session stops accepting, finishes in-flight
+    #: batches bounded by GUARD_TPU_DRAIN_TIMEOUT_MS, answers queued
+    #: requests with a structured Draining envelope and exits
+    #: DRAIN_EXIT_CODE. Injectable so tests trip it without signals.
+    drain_latch: Optional[object] = None
 
     # -- shared caches ------------------------------------------------
     def _prepared_rules(self, rules_strs):
@@ -345,6 +351,11 @@ class Serve:
         default (e.g. the X-Guard-Tenant header)."""
         import time
 
+        if self._draining():
+            # drain contract: stop accepting — a queued or late request
+            # answers the structured Draining envelope instead of
+            # evaluating (never a hang, never a lost request)
+            return self.draining_envelope()
         t0 = time.perf_counter()
         sp = telemetry.span_begin("serve_request")
         try:
@@ -635,8 +646,105 @@ class Serve:
             self._webhook_rules = texts
         return self._webhook_rules
 
+    # -- graceful drain (the durability plane's serve leg) ------------
+    def _draining(self) -> bool:
+        latch = self.drain_latch
+        return latch is not None and latch.tripped()
+
+    @staticmethod
+    def draining_envelope() -> dict:
+        """The structured shutdown answer — the AdmissionRejected
+        envelope shape, because a drain is traffic discipline, not a
+        failure: the client should retry against the replacement
+        process after the hinted backoff."""
+        from ..utils.journal import drain_timeout_s
+
+        return {
+            "code": 5,
+            "output": "",
+            "error": "session draining (shutdown in progress)",
+            "error_class": "Draining",
+            "retry_after_ms": int(drain_timeout_s() * 1000),
+        }
+
+    def _drain_batcher(self) -> None:
+        """Finish in-flight coalesced batches, bounded by the drain
+        window; admitted work completes, nothing new is admitted."""
+        with self._batcher_lock:
+            b = self._batcher
+        if b is None:
+            return
+        from ..utils.journal import drain_timeout_s
+
+        try:
+            if not b.drain(drain_timeout_s()):
+                log.warning(
+                    "drain timeout: abandoning in-flight batches"
+                )
+        except Exception:  # noqa: BLE001 — shutdown is best-effort
+            pass
+
+    def _finish_pending(self, pending, writer, wlock) -> None:
+        """Settle the multiplexed in-flight requests at session end.
+        Clean EOF: wait for everything (the historical behavior). On
+        drain: never-started requests cancel and answer the Draining
+        envelope; started ones get the bounded window to finish, then
+        are abandoned (their threads are replaced with the process)."""
+        if not self._draining():
+            for fut, _rid in pending:
+                fut.result()
+            return
+        import time as _time
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        from ..utils.journal import drain_timeout_s
+
+        deadline = _time.monotonic() + drain_timeout_s()
+        for fut, rid in pending:
+            if fut.cancel():
+                resp = self.draining_envelope()
+                if rid is not None:
+                    resp["id"] = rid
+                with wlock:
+                    writer.writeln(json.dumps(resp))
+                    writer.flush()
+                continue
+            try:
+                fut.result(
+                    timeout=max(0.0, deadline - _time.monotonic())
+                )
+            except _FutTimeout:
+                log.warning(
+                    "drain timeout: abandoning in-flight request"
+                )
+                break
+            except Exception:  # noqa: BLE001
+                pass  # _answer wrote its own error envelope
+
     # -- session loops ------------------------------------------------
     def execute(self, writer: Writer, reader: Reader) -> int:
+        """Drain-latch lifecycle around the session body: SIGTERM/
+        SIGINT handlers point at the latch (restored on exit), the
+        coalescing batcher finishes its admitted work on the way out,
+        and a tripped latch maps to the distinct drain exit code."""
+        from ..utils import journal as jn
+
+        if self.drain_latch is None:
+            self.drain_latch = jn.DrainLatch()
+        restore = jn.install_signal_drain(self.drain_latch)
+        try:
+            rc = self._execute(writer, reader)
+        finally:
+            restore()
+            self._drain_batcher()
+        if self.drain_latch.tripped():
+            from ..utils.telemetry import RESUME_COUNTERS
+
+            RESUME_COUNTERS["drained_sessions"] += 1
+            return jn.DRAIN_EXIT_CODE
+        return rc
+
+    def _execute(self, writer: Writer, reader: Reader) -> int:
         server = None
         if self.listen:
             from ..serve.server import ServeServer, run_listener
@@ -658,6 +766,17 @@ class Serve:
             for line in stream:
                 line = line.strip()
                 if not line:
+                    break
+                if self._draining():
+                    # stop accepting: the line already read answers
+                    # the Draining envelope, then the session ends
+                    resp = self.draining_envelope()
+                    rid = self.request_id(line)
+                    if rid is not None:
+                        resp["id"] = rid
+                    with wlock:
+                        writer.writeln(json.dumps(resp))
+                        writer.flush()
                     break
                 rid = self.request_id(line)
                 if rid is None:
@@ -687,12 +806,11 @@ class Serve:
                         writer.writeln(json.dumps(resp))
                         writer.flush()
 
-                pending.append(pool.submit(_answer))
+                pending.append((pool.submit(_answer), rid))
         finally:
-            for fut in pending:
-                fut.result()
+            self._finish_pending(pending, writer, wlock)
             if pool is not None:
-                pool.shutdown(wait=True)
+                pool.shutdown(wait=not self._draining())
             if server is not None:
                 server.stop()
         return 0

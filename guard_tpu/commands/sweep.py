@@ -152,6 +152,22 @@ def _read_manifest(path: Path) -> Dict[int, dict]:
     return done
 
 
+class _ErrCapture:
+    """File-like err sink buffering one chunk's stderr for the journal
+    (utils/journal.py). Flushed to the real stream at the chunk's
+    checkpoint, so a live journal-on run and a resumed replay emit the
+    same bytes in the same order."""
+
+    def __init__(self, buf: list):
+        self._buf = buf
+
+    def write(self, s: str) -> None:
+        self._buf.append(s)
+
+    def flush(self) -> None:
+        pass
+
+
 def _fault_level() -> int:
     """Sum of every failure-plane counter: the incremental plane's
     did-anything-degrade probe, compared around one chunk's compute —
@@ -214,8 +230,56 @@ class Sweep:
     # stdin and validate as they arrive (micro-batch dispatch against
     # the precompiled plan, one result line per input line)
     follow: bool = False
+    # durability plane (utils/journal.py): per-run append-only chunk
+    # journal checkpointed at every chunk boundary, so a killed run
+    # resumes from its last completed chunk. --no-journal /
+    # GUARD_TPU_SWEEP_JOURNAL=0 disables checkpointing (bit-parity
+    # escape hatch, and the overhead bench's off leg)
+    journal: bool = True
+    # --resume (or GUARD_TPU_SWEEP_RESUME=auto): replay journaled
+    # chunks — zero encode, zero device dispatches — and continue from
+    # the first incomplete chunk; stdout/stderr/manifest/exit code are
+    # byte-identical to an uninterrupted run. A stale journal (rules/
+    # docs/config changed -> different run key) is a logged cold start.
+    resume: bool = False
+    # graceful-drain latch: SIGTERM/SIGINT trips it (handlers installed
+    # by execute when on the main thread); tests inject a tripped or
+    # self-tripping latch directly. A tripped latch lets the in-flight
+    # chunk finish, syncs the journal, and exits DRAIN_EXIT_CODE.
+    drain_latch: Optional[object] = None
 
     def execute(self, writer: Writer, reader: Reader) -> int:
+        """Latch + journal lifecycle around the sweep body: install the
+        SIGTERM/SIGINT drain handlers (restored on exit), make sure the
+        journal is synced and closed however the body exits, and map a
+        tripped latch to the distinct drain exit code."""
+        from ..utils import journal as jn
+        from ..utils.telemetry import RESUME_COUNTERS
+
+        self._drain = self.drain_latch if self.drain_latch is not None \
+            else jn.DrainLatch()
+        self._journal = None
+        self._replay: Dict[int, dict] = {}
+        self._err_bufs: Dict[int, list] = {}
+        restore = jn.install_signal_drain(self._drain)
+        try:
+            rc = self._execute(writer, reader)
+        finally:
+            restore()
+            if self._journal is not None:
+                self._journal.sync()
+                self._journal.close()
+        if self._drain.tripped():
+            # drained, not failed: every completed chunk is journaled
+            # and `--resume` finishes the rest — the distinct exit code
+            # is the contract CI wrappers key their re-exec on (the
+            # flight recorder dumps with reason "drain" in the session
+            # epilogue, cli._session_epilogue)
+            RESUME_COUNTERS["drained_sessions"] += 1
+            return jn.DRAIN_EXIT_CODE
+        return rc
+
+    def _execute(self, writer: Writer, reader: Reader) -> int:
         if not self.rules:
             raise GuardError("must specify rules")
         if self.follow:
@@ -245,14 +309,44 @@ class Sweep:
         # across every chunk this run partitioned (--delta-stats and
         # the run-ledger delta fraction read them)
         self._delta_seen = [0, 0]
+        self._journal_setup(rule_files, paths, chunks)
         todo = []
+        replay_rows: List[dict] = []
         for ci, chunk in enumerate(chunks):
+            jrec = self._replay.get(ci)
+            if jrec is not None:
+                # durability plane replay: the journaled record IS the
+                # chunk's outcome — no read, no encode, no dispatch.
+                # Replay outranks the mtime signature skip below (the
+                # run key already pinned content) and counts as
+                # `evaluated`, so summary/exit bytes match the
+                # uninterrupted run.
+                rec = jrec["rec"]
+                if done.get(ci) != rec:
+                    # a crash between journal append and manifest
+                    # write (append is first) left this row missing —
+                    # repair in chunk order below so the manifest ends
+                    # byte-identical to an uninterrupted run's
+                    replay_rows.append(rec)
+                done[ci] = rec
+                evaluated += 1
+                stderr_text = jrec.get("stderr") or ""
+                if stderr_text:
+                    writer.write_err(stderr_text)
+                for k, v in (jrec.get("faults") or {}).items():
+                    if k in FAULT_COUNTERS:
+                        FAULT_COUNTERS[k] += int(v)
+                continue
             sig = _chunk_signature(chunk)
             prev = done.get(ci)
             if prev is not None and prev.get("sig") == sig:
                 skipped += 1
                 continue
             todo.append((ci, sig, chunk))
+        if replay_rows:
+            with manifest_path.open("a") as mf:
+                for rec in replay_rows:
+                    mf.write(json.dumps(rec) + "\n")
 
         # three-stage ingest/dispatch/consume pipeline (tpu backend,
         # parallel/ingest.py): worker processes read+parse+encode
@@ -287,25 +381,27 @@ class Sweep:
                 if ci2 in prepared:
                     return
                 err_box2 = [0, []]
-                dfs = self._read_chunk(chunk2, writer, err_box2)
+                w2 = self._chunk_writer(writer, ci2)
+                dfs = self._read_chunk(chunk2, w2, err_box2)
                 # incremental plane: partition BEFORE encode — cached
                 # docs never columnarize, only the delta pays encode
                 ctx2 = self._cache_lookup(dfs, rule_files)
                 delta2, _ = self._cache_subset(ctx2, dfs, None)
-                enc = self._encode_chunk(delta2, writer, err_box2)
+                enc = self._encode_chunk(delta2, w2, err_box2)
                 prepared[ci2] = (dfs, ctx2, delta2, enc, err_box2)
 
             with manifest_path.open("a") as mf:
                 for j, (ci, sig, chunk) in enumerate(todo):
+                    if self._drain is not None and self._drain.tripped():
+                        break  # graceful drain: stop between chunks
                     _prepare(j)
                     rec = self._evaluate_chunk(
-                        ci, sig, chunk, rule_files, writer,
+                        ci, sig, chunk, rule_files,
+                        self._chunk_writer(writer, ci),
                         prepared=prepared.pop(ci, None),
                         prefetch=(lambda j=j: _prepare(j + 1)),
                     )
-                    done[ci] = rec
-                    mf.write(json.dumps(rec) + "\n")
-                    mf.flush()
+                    self._checkpoint(ci, rec, writer, mf, done)
                     evaluated += 1
 
         with _span("report", {"chunks": len(chunks)}):
@@ -359,6 +455,87 @@ class Sweep:
         if totals["fail"]:
             return FAILURE_STATUS_CODE
         return SUCCESS_STATUS_CODE
+
+    # -- durability plane (utils/journal.py) --------------------------
+    def _journal_setup(self, rule_files, paths, chunks) -> None:
+        """Derive the run key, arm the journal, and load the replay map
+        when resuming. Key derivation reads every doc's bytes — the
+        price of content-addressed staleness (a stale journal keys to a
+        file that does not exist); the overhead bench holds the whole
+        plane to the ≤2% advisory bar."""
+        from ..cache.results import config_hash
+        from ..utils import journal as jn
+        from ..utils.telemetry import RESUME_COUNTERS
+
+        if not jn.journal_enabled(self.journal):
+            return
+        with _span("journal_key", {"docs": len(paths)}):
+            cfg = config_hash(
+                mode="sweep",
+                chunk_size=self.chunk_size,
+                backend=self.backend,
+                rule_shards=self.rule_shards,
+                pack_rules=self.pack_rules,
+                vector_rim=self.vector_rim,
+                max_doc_failures=self.max_doc_failures,
+                plan_cache=self.plan_cache,
+                verify_plans=self.verify_plans,
+                result_cache=self.result_cache,
+                manifest=str(self.manifest),
+            )
+            key = jn.run_key(
+                jn.rules_digest(rule_files),
+                jn.doc_manifest_digest(paths),
+                cfg,
+            )
+        self._run_key = key
+        self._fault_prev = {k: int(v) for k, v in FAULT_COUNTERS.items()}
+        if self.resume or jn.resume_auto():
+            self._replay = jn.load_journal(key, n_chunks=len(chunks))
+            if self._replay:
+                jn.note_resume(key, len(self._replay))
+            else:
+                # absent journal IS the stale case under a content-
+                # addressed key (rules/docs/config changed -> different
+                # key -> no file): logged cold start, never a wrong
+                # replay
+                RESUME_COUNTERS["stale_cold_starts"] += 1
+                jn.log.info(
+                    "no journal for run %s; cold start", key[:16]
+                )
+        self._journal = jn.SweepJournal(key, len(chunks))
+
+    def _chunk_writer(self, writer: Writer, ci: int) -> Writer:
+        """Journal-on: a Writer whose err channel buffers into chunk
+        ci's capture list, flushed in chunk order at the checkpoint —
+        exactly what the journal records and replay re-emits.
+        Journal-off: the writer itself (the historical interleaved
+        emission, byte-for-byte)."""
+        if self._journal is None:
+            return writer
+        buf = self._err_bufs.setdefault(ci, [])
+        return Writer(out=writer.out, err=_ErrCapture(buf))
+
+    def _checkpoint(self, ci, rec, writer, mf, done) -> None:
+        """One chunk's completion boundary: flush its captured stderr
+        to the real stream, append the journal record (journal BEFORE
+        manifest — a crash between the two leaves a journaled chunk
+        whose missing manifest row replay repairs, never a manifest
+        row the journal has not sealed), then the manifest row."""
+        if self._journal is not None:
+            stderr_text = "".join(self._err_bufs.pop(ci, ()))
+            if stderr_text:
+                writer.write_err(stderr_text)
+            cur = {k: int(v) for k, v in FAULT_COUNTERS.items()}
+            delta = {
+                k: cur[k] - self._fault_prev.get(k, 0)
+                for k in cur if cur[k] != self._fault_prev.get(k, 0)
+            }
+            self._fault_prev = cur
+            self._journal.append_chunk(ci, rec, stderr_text, delta)
+        done[ci] = rec
+        mf.write(json.dumps(rec) + "\n")
+        mf.flush()
 
     # -- streaming CI mode (--follow) ---------------------------------
     def _run_follow(self, writer: Writer, reader: Reader) -> int:
@@ -423,6 +600,8 @@ class Sweep:
         n_docs = 0
         seq = [0]
         while True:
+            if self._drain is not None and self._drain.tripped():
+                break  # graceful drain: summary + DRAIN_EXIT_CODE
             with cv:
                 while not buf and not eof[0]:
                     cv.wait()
@@ -665,8 +844,15 @@ class Sweep:
         with manifest_path.open("a") as mf:
             _top_up()
             for j, (ci, sig, chunk) in enumerate(todo):
+                if self._drain is not None and self._drain.tripped():
+                    # graceful drain: stop feeding the pipeline; the
+                    # in-flight chunk below still finishes and
+                    # checkpoints (queued worker jobs drain harmlessly,
+                    # as on any exit)
+                    break
+                cw = self._chunk_writer(writer, ci)
                 data_files, encoded, pre_err, pre_recs = self._take_ingest(
-                    j, chunk, queue, pool_box, writer,
+                    j, chunk, queue, pool_box, cw,
                     busy=inflight is not None,
                     workers=workers, nxt=nxt, restarts=restarts,
                 )
@@ -681,22 +867,22 @@ class Sweep:
                     ctx, data_files, encoded
                 )
                 state = self._dispatch_tpu(
-                    delta_files, rule_files, writer, err_box,
+                    delta_files, rule_files, cw, err_box,
                     encoded=encoded, vec_box={},
                 )
                 if inflight is not None:
-                    ci_prev, rec = self._finish_chunk(inflight, writer)
-                    done[ci_prev] = rec
-                    mf.write(json.dumps(rec) + "\n")
-                    mf.flush()
+                    ci_prev, rec = self._finish_chunk(
+                        inflight, self._chunk_writer(writer, inflight[0])
+                    )
+                    self._checkpoint(ci_prev, rec, writer, mf, done)
                     evaluated += 1
                 inflight = (ci, sig, chunk, data_files, ctx, delta_files,
                             state, err_box)
             if inflight is not None:
-                ci_prev, rec = self._finish_chunk(inflight, writer)
-                done[ci_prev] = rec
-                mf.write(json.dumps(rec) + "\n")
-                mf.flush()
+                ci_prev, rec = self._finish_chunk(
+                    inflight, self._chunk_writer(writer, inflight[0])
+                )
+                self._checkpoint(ci_prev, rec, writer, mf, done)
                 evaluated += 1
         return evaluated
 

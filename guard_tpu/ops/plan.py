@@ -63,6 +63,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..utils.faults import maybe_fail
 from ..utils.telemetry import REGISTRY as _TELEMETRY
 from ..utils.telemetry import span as _span
 from .encoder import Interner, remap_interned_ids
@@ -384,6 +385,11 @@ def save_plan(plan: RulePlan, digest: str) -> bool:
     optimization, never a correctness dependency."""
     with _span("save_plan"):
         try:
+            # durability plane's persistence-seam probe: an injected
+            # store_write fault exercises this degradation path (a full
+            # or unwritable store downgrades to a cache-off warning,
+            # never a failed run) exactly like a real ENOSPC would
+            maybe_fail("store_write", key=digest)
             payload = {
                 "schema": PLAN_SCHEMA_VERSION,
                 "version": _guard_version(),

@@ -187,6 +187,17 @@ class CoalescingBatcher:
             self._closed = True
             self._cv.notify_all()
 
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown (the durability plane's serve leg): stop
+        admitting — `submit` raises once closed — let the dispatcher
+        finish every already-admitted batch, and join it bounded by
+        `timeout` seconds. Returns True when the queue fully drained
+        inside the bound (False = in-flight work abandoned to the
+        daemonic dispatcher, same as any process exit)."""
+        self.close()
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
     # -- dispatcher ---------------------------------------------------
     def _loop(self) -> None:
         while True:

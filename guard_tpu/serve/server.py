@@ -262,9 +262,22 @@ class ServeServer:
 
 def run_listener(serve, listen: str, writer: Writer) -> int:
     """CLI entry: bind, announce the bound address on stderr (port 0
-    resolves here), then serve until interrupted."""
+    resolves here), then serve until interrupted. With a drain latch on
+    the session (commands/serve.py installs one), a SIGTERM/SIGINT trip
+    stops the accept loop; the caller finishes in-flight batches and
+    maps the trip to the drain exit code."""
     server = ServeServer(serve, listen).start()
     writer.writeln_err(
         f"guard-tpu serve: listening on {server.host}:{server.port}"
     )
-    return server.serve_forever()
+    latch = getattr(serve, "drain_latch", None)
+    if latch is None:
+        return server.serve_forever()
+    try:
+        while not server._stopped.is_set() and not latch.tripped():
+            latch.wait(0.1)
+    except KeyboardInterrupt:
+        latch.trip("SIGINT")
+    finally:
+        server.stop()
+    return 0

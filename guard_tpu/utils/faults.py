@@ -44,11 +44,16 @@ from .telemetry import EventedCounters
 #: serving plane's coalescing batcher, before a grouped dispatch;
 #: admission fires in the front door's per-tenant quota check, shed in
 #: the circuit breaker's solo-dispatch shed path — both must always
-#: produce a structured response, never a hang or a lost request)
+#: produce a structured response, never a hang or a lost request;
+#: journal fires at the sweep journal's chunk-append boundary — an
+#: injected fault there simulates a mid-run crash for the resume
+#: smoke, while a REAL journal write failure degrades to journaling-
+#: off; store_write fires inside the plan/result persistence seams,
+#: where any failure must downgrade to a cache-off warning)
 POINTS = (
     "read", "parse", "encode", "worker_crash",
     "dispatch", "collect", "oracle", "serve_batch", "cache",
-    "admission", "shed",
+    "admission", "shed", "journal", "store_write",
 )
 
 #: observability beside DISPATCH_COUNTERS / PIPELINE_COUNTERS /

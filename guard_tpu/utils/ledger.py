@@ -25,12 +25,15 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import os
 import sys
 import time
 from typing import List, Optional
 
 from .telemetry import metrics_snapshot
+
+log = logging.getLogger("guard_tpu.ledger")
 
 #: ledger-record schema version (bump on breaking record-shape changes)
 LEDGER_SCHEMA_VERSION = 1
@@ -127,15 +130,24 @@ def append_record(kind: str, headline: Optional[dict] = None,
     rec = build_record(kind, headline=headline, config=config,
                        exit_code=exit_code, extra=extra, ts=ts,
                        capture_metrics=capture_metrics)
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    # NO sort_keys: the embedded metrics snapshot's histogram-bucket
-    # order is schema-relevant (ascending exponents; lexical sorting
-    # scrambles "le_2^-7s" vs "le_2^-10s"); record-level canonicality
-    # is config_hash's job, not the storage line's
-    with open(path, "a") as f:
-        f.write(json.dumps(rec) + "\n")
+    try:
+        from .faults import maybe_fail
+
+        maybe_fail("store_write", key=os.path.basename(path))
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        # NO sort_keys: the embedded metrics snapshot's histogram-
+        # bucket order is schema-relevant (ascending exponents; lexical
+        # sorting scrambles "le_2^-7s" vs "le_2^-10s"); record-level
+        # canonicality is config_hash's job, not the storage line's
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except Exception as e:  # noqa: BLE001 — ENOSPC/unwritable store:
+        # cross-run memory is advisory; losing one record must never
+        # change the session's exit code
+        log.warning("ledger append failed (%s); record dropped", e)
+        return None
     return rec
 
 

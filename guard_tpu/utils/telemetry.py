@@ -80,7 +80,12 @@ from typing import Dict, List, Optional
 #: quota admissions/rejections, SLO circuit-breaker trips/probes/
 #: closes, overload sheds, streaming follow-mode docs/batches) and
 #: the breaker-state / admission-inflight gauges joined the contract.
-SCHEMA_VERSION = 6
+#: v7: the `resume` counter group (durability plane: journaled /
+#: replayed chunks, stale-journal cold starts, torn tail records,
+#: journal store degradations, drained sessions) and the `gc` counter
+#: group (store hygiene: gc runs, evicted files/bytes, reaped orphan
+#: tmps) joined the contract.
+SCHEMA_VERSION = 7
 
 # fixed log2 histogram buckets: bucket i holds durations in
 # [2^(LOG2_LO+i-1), 2^(LOG2_LO+i)) seconds — ~1µs to ~128s, plus an
@@ -361,6 +366,39 @@ ADMISSION_COUNTERS = REGISTRY.counter_group(
         "follow_batches": 0,
     })
 )
+
+#: durability-plane observability (utils/journal.py + the sweep resume
+#: path): chunks checkpointed to the per-run journal, chunks replayed
+#: without touching encode or the device on `sweep --resume` (the
+#: zero-dispatch proof `bench.py --resume-smoke` reads), stale-journal
+#: cold starts, torn tail records truncated at load, journal writes
+#: degraded by a full/unwritable store, and sessions that exited via
+#: the graceful-drain latch. Lives here — like SERVE_COUNTERS — so the
+#: group registers exactly once however a run starts and is present in
+#: every gated metrics snapshot (tools/check_metrics_schema.py).
+RESUME_COUNTERS = REGISTRY.counter_group("resume", EventedCounters(
+    "resume", {
+        "chunks_journaled": 0,
+        "chunks_replayed": 0,
+        "runs_resumed": 0,
+        "stale_cold_starts": 0,
+        "torn_records_dropped": 0,
+        "journal_degraded": 0,
+        "drained_sessions": 0,
+    }
+))
+
+#: store-hygiene observability (`guard-tpu gc`): size-capped LRU
+#: eviction over the plan/result caches and the journal dir, plus
+#: orphan-tmp reaping. Registered here for the same
+#: every-snapshot-carries-the-group reason as RESUME_COUNTERS.
+GC_COUNTERS = REGISTRY.counter_group("gc", EventedCounters("gc", {
+    "runs": 0,
+    "files_evicted": 0,
+    "bytes_evicted": 0,
+    "orphan_tmps_reaped": 0,
+    "evict_errors": 0,
+}))
 
 
 # ---------------------------------------------------------------- spans
@@ -687,15 +725,21 @@ def flightrec_dump(reason: str, path: Optional[str] = None) -> Optional[str]:
 def flightrec_on_exit(exit_code: Optional[int]) -> Optional[str]:
     """Session epilogue hook (cli.run): dump when the run ended
     abnormally — exit code 5 (hard errors, --max-doc-failures trips),
-    an unhandled exception (exit_code None), or fault activity latched
-    during an otherwise-clean run (dispatch-ladder fallbacks, serve
-    request timeouts). Returns the dump path or None."""
+    an unhandled exception (exit_code None), a graceful drain (the
+    SIGTERM/SIGINT latch's distinct exit code — the dump is the drain's
+    forensics record), or fault activity latched during an
+    otherwise-clean run (dispatch-ladder fallbacks, serve request
+    timeouts). Returns the dump path or None."""
     if not _FR_ON:
         return None
     if exit_code == 5:
         return flightrec_dump("exit_code_5")
     if exit_code is None:
         return flightrec_dump("unhandled_exception")
+    from .journal import DRAIN_EXIT_CODE  # lazy: journal imports us
+
+    if exit_code == DRAIN_EXIT_CODE:
+        return flightrec_dump("drain")
     if _FLIGHTREC.fault_seen:
         return flightrec_dump("fault_activity")
     return None

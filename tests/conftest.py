@@ -45,6 +45,16 @@ os.environ.setdefault(
     "GUARD_TPU_RESULT_CACHE_DIR", tempfile.mkdtemp(prefix="guard_results_")
 )
 
+# The durability plane's sweep journal persists per-run chunk records
+# under ~/.cache/guard_tpu/journal by default, keyed by (rules, docs,
+# config) content — two suite runs over the same fixtures would replay
+# each other's journals and turn dispatch-count assertions into
+# no-dispatch replays. Point the suite at a throwaway dir; durability
+# tests override per-test with monkeypatch.
+os.environ.setdefault(
+    "GUARD_TPU_JOURNAL_DIR", tempfile.mkdtemp(prefix="guard_journal_")
+)
+
 # The flight recorder is armed by default in production (abnormal exits
 # dump forensics into the working directory). The suite exercises
 # hundreds of deliberate exit-5 paths — without this default-off, every

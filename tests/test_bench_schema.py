@@ -277,6 +277,63 @@ def test_checker_requires_verify_overhead_keys(tmp_path):
     assert any("invariants_checked_per_run" in p for p in problems)
 
 
+def test_expected_metrics_cover_journal_rows():
+    """PR 20: the durability plane's checkpoint-overhead pair and the
+    half-journaled resume row are part of the driver contract, gated
+    by the schema checker and arriving with the round-17 artifact."""
+    metrics = bench.expected_metrics()
+    for m in (
+        "config5b_journal_off_templates_per_sec",
+        "config5b_journal_on_templates_per_sec",
+        "config5b_resume_50pct_templates_per_sec",
+    ):
+        assert m in metrics
+        assert check_bench_schema.metric_since(m) == 17
+
+
+def test_checker_requires_journal_keys(tmp_path):
+    """A journal-on row that doesn't quantify its checkpoint overhead,
+    or a resume row without its replayed/dispatched evidence, fails
+    the gate."""
+    import json
+
+    rows = [
+        {
+            "metric": "config5b_journal_on_templates_per_sec",
+            "value": 1.0,
+            "unit": "templates/sec",
+            "vs_baseline": 1.0,
+            "journal": "on",
+            # overhead_vs_off / chunks_journaled_per_run missing
+        },
+        {
+            "metric": "config5b_resume_50pct_templates_per_sec",
+            "value": 1.0,
+            "unit": "templates/sec",
+            "vs_baseline": 1.0,
+            # chunks_replayed / chunks_total / dispatches_per_run
+            # missing
+        },
+    ]
+    src = _newest_artifact().read_text().splitlines()
+    doctored = tmp_path / "bench_all_doctored_journal.json"
+    doctored.write_text(
+        "\n".join(
+            ln for ln in src
+            if '"config5b_journal_on_templates_per_sec"' not in ln
+            and '"config5b_resume_50pct_templates_per_sec"' not in ln
+        )
+        + "\n"
+        + "\n".join(json.dumps(r) for r in rows)
+        + "\n"
+    )
+    problems = check_bench_schema.check(doctored)
+    for needle in ("overhead_vs_off", "chunks_journaled_per_run",
+                   "chunks_replayed", "chunks_total",
+                   "dispatches_per_run"):
+        assert any(needle in p for p in problems), needle
+
+
 def test_registry_stage_seconds_reconcile_with_wall_time(tmp_path):
     """The registry-derived stage decomposition bench.py reports must
     account for the run it claims to decompose: summing the top-level

@@ -1,0 +1,735 @@
+"""Durability plane suite (guard_tpu/utils/journal.py,
+guard_tpu/commands/gc.py): journal round-trips with torn-tail
+truncation and stale-key cold starts, crash+resume byte parity with
+zero device dispatches for journaled chunks, graceful SIGTERM/SIGINT
+drain on sweep AND serve (injectable latches, no wall-clock asserts),
+size-capped LRU store hygiene, and the ENOSPC degradation contract at
+every persistence seam — a full disk turns checkpointing off, it
+never changes a run's bytes or exit code."""
+
+import json
+import logging
+import os
+import signal
+
+import pytest
+
+from guard_tpu.commands.gc import Gc
+from guard_tpu.commands.serve import Serve
+from guard_tpu.commands.sweep import Sweep
+from guard_tpu.ops.backend import dispatch_stats, reset_all_stats
+from guard_tpu.utils import journal as jn
+from guard_tpu.utils import telemetry
+from guard_tpu.utils.faults import InjectedFault, reset_faults
+from guard_tpu.utils.io import Reader, Writer
+
+RULES = (
+    "let b = Resources.*[ Type == 'AWS::S3::Bucket' ]\n"
+    "rule sse when %b !empty { %b.Properties.Enc == true }\n"
+)
+# EMPTY on an int raises GuardError in the oracle: the doc's stderr
+# line re-emits on every run, so replay must reproduce it from the
+# journaled stderr, byte for byte
+RULES_ERR = "rule em { Resources.R1.Properties.X !empty }\n"
+
+
+def _resume_stats() -> dict:
+    return telemetry.REGISTRY.group_stats("resume")
+
+
+def _gc_stats() -> dict:
+    return telemetry.REGISTRY.group_stats("gc")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_durability(tmp_path, monkeypatch):
+    """Private journal dir + clean counters/faults per test — journal
+    keys are content-addressed, so shared fixture corpora would
+    otherwise cross-replay between tests."""
+    monkeypatch.setenv("GUARD_TPU_JOURNAL_DIR", str(tmp_path / "journal"))
+    monkeypatch.delenv("GUARD_TPU_FAULT", raising=False)
+    monkeypatch.delenv("GUARD_TPU_SWEEP_RESUME", raising=False)
+    monkeypatch.delenv("GUARD_TPU_SWEEP_JOURNAL", raising=False)
+    monkeypatch.delenv("GUARD_TPU_CACHE_MAX_BYTES", raising=False)
+    reset_faults()
+    reset_all_stats()
+    yield
+    reset_faults()
+    reset_all_stats()
+
+
+def _mk_corpus(tmp_path, n=12, fail=(3,), err=()):
+    data = tmp_path / "data"
+    data.mkdir(exist_ok=True)
+    rp = tmp_path / "rules.guard"
+    rp.write_text(RULES)
+    for i in range(n):
+        doc = {
+            "Resources": {
+                f"b{i}": {
+                    "Type": "AWS::S3::Bucket",
+                    "Properties": {"Enc": i not in fail},
+                }
+            }
+        }
+        if i in err:
+            doc["Resources"]["R1"] = {"Properties": {"X": 7}}
+        (data / f"d{i:02d}.json").write_text(json.dumps(doc))
+    return [str(rp)], data
+
+
+def _sweep(rules, data, manifest, **kw):
+    kw.setdefault("chunk_size", 4)
+    kw.setdefault("backend", "tpu")
+    kw.setdefault("result_cache", False)
+    w = Writer.buffered()
+    cmd = Sweep(rules=rules, data=[str(data)], manifest=str(manifest), **kw)
+    rc = cmd.execute(w, Reader.from_string(""))
+    return rc, w.out.getvalue(), w.err.getvalue()
+
+
+# ------------------------------------------------------ journal units
+
+
+def test_run_key_sensitive_to_every_part(tmp_path):
+    ra = tmp_path / "a.guard"
+    rb = tmp_path / "b.guard"
+    ra.write_text(RULES)
+    rb.write_text(RULES + "\n# changed\n")
+    d0 = tmp_path / "d0.json"
+    d1 = tmp_path / "d1.json"
+    d0.write_text("{}")
+    d1.write_text('{"x": 1}')
+
+    class _RF:
+        def __init__(self, p):
+            self.full_name = str(p)
+            self.content = p.read_text()
+
+    base = jn.run_key(
+        jn.rules_digest([_RF(ra)]),
+        jn.doc_manifest_digest([d0, d1]),
+        "cfg0",
+    )
+    assert base == jn.run_key(
+        jn.rules_digest([_RF(ra)]),
+        jn.doc_manifest_digest([d0, d1]),
+        "cfg0",
+    )
+    # rule content, doc content, doc ORDER and config each flip the key
+    assert base != jn.run_key(
+        jn.rules_digest([_RF(rb)]),
+        jn.doc_manifest_digest([d0, d1]), "cfg0",
+    )
+    d1.write_text('{"x": 2}')
+    assert base != jn.run_key(
+        jn.rules_digest([_RF(ra)]),
+        jn.doc_manifest_digest([d0, d1]), "cfg0",
+    )
+    d1.write_text('{"x": 1}')
+    assert base != jn.run_key(
+        jn.rules_digest([_RF(ra)]),
+        jn.doc_manifest_digest([d1, d0]), "cfg0",
+    )
+    assert base != jn.run_key(
+        jn.rules_digest([_RF(ra)]),
+        jn.doc_manifest_digest([d0, d1]), "cfg1",
+    )
+
+
+def test_journal_round_trip():
+    key = "k" * 64
+    j = jn.SweepJournal(key, 3)
+    recs = [
+        {"chunk": i, "sig": f"s{i}", "counts": {"pass": i}}
+        for i in range(3)
+    ]
+    j.append_chunk(0, recs[0], "", {})
+    j.append_chunk(1, recs[1], "warned\n", {"injected_read": 1})
+    j.append_chunk(2, recs[2], "", {})
+    j.close()
+    replay = jn.load_journal(key, n_chunks=3)
+    assert sorted(replay) == [0, 1, 2]
+    assert replay[1]["rec"] == recs[1]
+    assert replay[1]["stderr"] == "warned\n"
+    assert replay[1]["faults"] == {"injected_read": 1}
+    assert _resume_stats()["chunks_journaled"] == 3
+
+
+def test_journal_torn_tail_truncated():
+    key = "t" * 64
+    j = jn.SweepJournal(key, 4)
+    j.append_chunk(0, {"chunk": 0}, "", {})
+    j.append_chunk(1, {"chunk": 1}, "", {})
+    j.close()
+    path = jn.journal_path(key)
+    # a torn append: half a record, no trailing newline
+    with path.open("a") as f:
+        f.write('{"kind": "chunk", "chunk": 2, "rec')
+    before = _resume_stats()["torn_records_dropped"]
+    replay = jn.load_journal(key, n_chunks=4)
+    assert sorted(replay) == [0, 1]
+    assert _resume_stats()["torn_records_dropped"] == before + 1
+
+
+def test_journal_garbage_mid_file_truncates_rest():
+    key = "g" * 64
+    j = jn.SweepJournal(key, 4)
+    j.append_chunk(0, {"chunk": 0}, "", {})
+    j.close()
+    path = jn.journal_path(key)
+    with path.open("a") as f:
+        f.write("NOT JSON AT ALL\n")
+        f.write(json.dumps({
+            "kind": "chunk", "chunk": 3, "rec": {"chunk": 3},
+        }) + "\n")
+    # everything after the torn line is untrusted by construction
+    replay = jn.load_journal(key, n_chunks=4)
+    assert sorted(replay) == [0]
+
+
+def test_journal_header_mismatch_is_cold_start():
+    key = "h" * 64
+    j = jn.SweepJournal(key, 3)
+    j.append_chunk(0, {"chunk": 0}, "", {})
+    j.close()
+    # a different chunk count means a different run shape: cold start
+    assert jn.load_journal(key, n_chunks=5) == {}
+    # absent journal is the stale-key case: {} without error
+    assert jn.load_journal("n" * 64, n_chunks=3) == {}
+
+
+def test_journal_last_record_wins():
+    key = "w" * 64
+    j = jn.SweepJournal(key, 2)
+    j.append_chunk(0, {"v": 1}, "", {})
+    j.append_chunk(0, {"v": 2}, "", {})
+    j.close()
+    replay = jn.load_journal(key, n_chunks=2)
+    assert replay[0]["rec"] == {"v": 2}
+
+
+# --------------------------------------------- crash + resume parity
+
+
+def test_crash_resume_byte_identical(tmp_path, monkeypatch):
+    rules, data = _mk_corpus(tmp_path, n=12, fail=(3,), err=())
+    mpath = tmp_path / "m.jsonl"
+
+    # leg A: uninterrupted baseline (its own journal dir — the same
+    # run key must not leak into the crash leg's journal)
+    monkeypatch.setenv("GUARD_TPU_JOURNAL_DIR", str(tmp_path / "jA"))
+    reset_all_stats()
+    base = _sweep(rules, data, mpath)
+    d_base = dispatch_stats()
+    base_manifest = mpath.read_text()
+    assert base[0] == 19  # the seeded failing doc
+
+    # leg B: killed at the second checkpoint, then resumed
+    monkeypatch.setenv("GUARD_TPU_JOURNAL_DIR", str(tmp_path / "jB"))
+    monkeypatch.setenv("GUARD_TPU_FAULT", "journal:nth=2")
+    reset_faults()
+    mpath.unlink()
+    with pytest.raises(InjectedFault):
+        _sweep(rules, data, mpath)
+    monkeypatch.delenv("GUARD_TPU_FAULT")
+    reset_faults()
+    reset_all_stats()
+    mpath.unlink()
+    resumed = _sweep(rules, data, mpath, resume=True)
+    d_res = dispatch_stats()
+    s = _resume_stats()
+
+    assert resumed == base
+    assert mpath.read_text() == base_manifest
+    assert s["runs_resumed"] == 1
+    assert s["chunks_replayed"] == 1
+    # the replayed chunk never touches the device
+    assert 0 < d_res["dispatches"] < d_base["dispatches"]
+
+
+def test_full_replay_zero_dispatches(tmp_path):
+    rules, data = _mk_corpus(tmp_path, n=8)
+    mpath = tmp_path / "m.jsonl"
+    base = _sweep(rules, data, mpath)
+    base_manifest = mpath.read_text()
+    mpath.unlink()
+    reset_all_stats()
+    replay = _sweep(rules, data, mpath, resume=True)
+    assert replay == base
+    assert mpath.read_text() == base_manifest
+    assert dispatch_stats()["dispatches"] == 0
+    assert _resume_stats()["chunks_replayed"] == 2
+
+
+def test_resume_replays_journaled_stderr(tmp_path, monkeypatch):
+    """Oracle-error docs write stderr every run; a replayed chunk must
+    re-emit the journaled bytes, not silence them."""
+    rp = tmp_path / "rules.guard"
+    rp.write_text(RULES_ERR)
+    data = tmp_path / "data"
+    data.mkdir()
+    for i in range(4):
+        (data / f"d{i}.json").write_text(
+            json.dumps({"Resources": {"R1": {"Properties": {"X": 7}}}})
+        )
+    mpath = tmp_path / "m.jsonl"
+    base = _sweep([str(rp)], data, mpath, chunk_size=2)
+    assert base[2]  # the oracle errors hit stderr
+    mpath.unlink()
+    reset_all_stats()
+    replay = _sweep([str(rp)], data, mpath, chunk_size=2, resume=True)
+    assert replay == base
+    assert dispatch_stats()["dispatches"] == 0
+
+
+def test_stale_journal_is_logged_cold_start(tmp_path):
+    rules, data = _mk_corpus(tmp_path, n=8)
+    mpath = tmp_path / "m.jsonl"
+    _sweep(rules, data, mpath)
+    # touching one doc changes the run key: resume finds no journal
+    p0 = sorted(data.glob("d*.json"))[0]
+    doc = json.loads(p0.read_text())
+    doc["__touch"] = 1
+    p0.write_text(json.dumps(doc))
+    mpath.unlink()
+    reset_all_stats()
+    _sweep(rules, data, mpath, resume=True)
+    s = _resume_stats()
+    assert s["stale_cold_starts"] == 1
+    assert s["chunks_replayed"] == 0
+    assert dispatch_stats()["dispatches"] > 0
+
+
+def test_no_journal_flag_writes_nothing(tmp_path):
+    rules, data = _mk_corpus(tmp_path, n=4)
+    _sweep(rules, data, tmp_path / "m.jsonl", journal=False)
+    assert not list(jn.journal_dir().glob("*.journal.jsonl"))
+    assert _resume_stats()["chunks_journaled"] == 0
+
+
+def test_journal_env_escape_hatch(tmp_path, monkeypatch):
+    monkeypatch.setenv("GUARD_TPU_SWEEP_JOURNAL", "0")
+    rules, data = _mk_corpus(tmp_path, n=4)
+    _sweep(rules, data, tmp_path / "m.jsonl")
+    assert not list(jn.journal_dir().glob("*.journal.jsonl"))
+
+
+def test_resume_auto_env(tmp_path, monkeypatch):
+    rules, data = _mk_corpus(tmp_path, n=8)
+    mpath = tmp_path / "m.jsonl"
+    base = _sweep(rules, data, mpath)
+    mpath.unlink()
+    monkeypatch.setenv("GUARD_TPU_SWEEP_RESUME", "auto")
+    reset_all_stats()
+    replay = _sweep(rules, data, mpath)  # no --resume flag needed
+    assert replay == base
+    assert dispatch_stats()["dispatches"] == 0
+
+
+# ------------------------------------------------------ graceful drain
+
+
+class _TripAfter(jn.DrainLatch):
+    """Injectable latch: trips itself after N `tripped()` polls — the
+    deterministic stand-in for a SIGTERM landing mid-run (no sleeps,
+    no wall-clock)."""
+
+    def __init__(self, polls: int):
+        super().__init__()
+        self._polls = polls
+
+    def tripped(self) -> bool:
+        if not super().tripped():
+            self._polls -= 1
+            if self._polls <= 0:
+                self.trip("test")
+        return super().tripped()
+
+
+def test_sweep_drain_finishes_chunk_then_exits_75(tmp_path):
+    rules, data = _mk_corpus(tmp_path, n=12)
+    mpath = tmp_path / "m.jsonl"
+    # trip on the second poll: chunk 0 completes, the loop-top check
+    # fires before chunk 1
+    rc, out, _err = _sweep(
+        rules, data, mpath, drain_latch=_TripAfter(2)
+    )
+    assert rc == jn.DRAIN_EXIT_CODE == 75
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert 0 < summary["evaluated"] < summary["chunks"]
+    assert _resume_stats()["drained_sessions"] == 1
+    # every completed chunk is journaled: resume finishes the rest
+    # and reproduces an uninterrupted run's manifest exactly
+    base_m = tmp_path / "base.jsonl"
+    rc_base, _, _ = _sweep(rules, data, base_m)
+    mpath.unlink()
+    reset_all_stats()
+    rc2, _out2, _err2 = _sweep(rules, data, mpath, resume=True)
+    assert rc2 == rc_base == 19  # the seeded failing doc, not a drain
+    assert _resume_stats()["chunks_replayed"] >= 1
+    assert mpath.read_text() == base_m.read_text()
+
+
+def test_sweep_sigterm_handler_trips_latch(tmp_path):
+    """A real SIGTERM delivered mid-run drains instead of dying: the
+    handler installed by execute trips the latch, the in-flight chunk
+    finishes, and the run exits 75 with a synced journal."""
+    rules, data = _mk_corpus(tmp_path, n=12)
+
+    class _SignalOnPoll(jn.DrainLatch):
+        def __init__(self):
+            super().__init__()
+            self._sent = False
+
+        def tripped(self) -> bool:
+            if not self._sent:
+                self._sent = True
+                os.kill(os.getpid(), signal.SIGTERM)
+            return super().tripped()
+
+    rc, out, _err = _sweep(
+        rules, data, tmp_path / "m.jsonl",
+        drain_latch=_SignalOnPoll(),
+    )
+    assert rc == jn.DRAIN_EXIT_CODE
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert summary["evaluated"] < summary["chunks"]
+    # the pre-existing handler is restored after execute
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+
+
+def test_serve_draining_envelope_and_exit_code():
+    latch = jn.DrainLatch()
+    latch.trip("test")
+    srv = Serve(stdio=True, drain_latch=latch)
+    env = srv.handle_line(json.dumps({
+        "rules": ["rule ok { a exists }"], "data": ['{"a": 1}'],
+    }))
+    assert env["code"] == 5
+    assert env["error_class"] == "Draining"
+    assert isinstance(env["retry_after_ms"], int)
+    # a stdio session with a tripped latch answers the pending line
+    # with the Draining envelope, then exits the drain code
+    w = Writer.buffered()
+    rc = srv.execute(w, Reader.from_string(
+        json.dumps({"rules": ["rule ok { a exists }"],
+                    "data": ['{"a": 1}']}) + "\n"
+    ))
+    assert rc == jn.DRAIN_EXIT_CODE
+    resps = [json.loads(l) for l in w.out.getvalue().splitlines()]
+    assert resps and all(
+        r["error_class"] == "Draining" for r in resps
+    )
+    assert _resume_stats()["drained_sessions"] >= 1
+
+
+def test_serve_drains_after_answering_in_flight():
+    """The latch trips between requests: answered lines keep their
+    real envelopes, the next read answers Draining, exit is 75."""
+    latch = jn.DrainLatch()
+    srv = Serve(stdio=True, drain_latch=latch)
+    first = srv.handle_line(json.dumps({
+        "rules": ["rule ok { a exists }"], "data": ['{"a": 1}'],
+    }))
+    assert first["code"] == 0
+    latch.trip("test")
+    second = srv.handle_line(json.dumps({
+        "rules": ["rule ok { a exists }"], "data": ['{"a": 1}'],
+    }))
+    assert second["error_class"] == "Draining"
+
+
+def test_install_signal_drain_restores_handlers():
+    latch = jn.DrainLatch()
+    prev_term = signal.getsignal(signal.SIGTERM)
+    restore = jn.install_signal_drain(latch)
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert latch.tripped()
+        assert latch.reason == "SIGTERM"
+    finally:
+        restore()
+    assert signal.getsignal(signal.SIGTERM) == prev_term
+
+
+# -------------------------------------------------------- gc hygiene
+
+
+def _gc_run(**kw):
+    w = Writer.buffered()
+    rc = Gc(**kw).execute(w, Reader.from_string(""))
+    return rc, json.loads(w.out.getvalue().strip())
+
+
+def _seed_store(d, n=4, size=100, suffix=".journal.jsonl"):
+    d.mkdir(parents=True, exist_ok=True)
+    paths = []
+    for i in range(n):
+        p = d / f"e{i}{suffix}"
+        p.write_bytes(b"x" * size)
+        # deterministic LRU order: e0 oldest ... e{n-1} newest
+        os.utime(p, (1000.0 + i, 1000.0 + i))
+        paths.append(p)
+    return paths
+
+
+def test_gc_evicts_oldest_first_to_cap(tmp_path, monkeypatch):
+    jd = tmp_path / "journal"
+    paths = _seed_store(jd, n=4, size=100)
+    monkeypatch.setenv("GUARD_TPU_JOURNAL_DIR", str(jd))
+    monkeypatch.setenv("GUARD_TPU_PLAN_CACHE_DIR", str(tmp_path / "p"))
+    monkeypatch.setenv(
+        "GUARD_TPU_RESULT_CACHE_DIR", str(tmp_path / "r")
+    )
+    rc, doc = _gc_run(max_bytes=250)
+    assert rc == 0
+    st = doc["gc"]["journal"]
+    assert st["bytes_before"] == 400
+    assert st["evicted"] == 2
+    assert st["bytes_after"] == 200
+    # LRU: the two OLDEST entries went, the newest two survive
+    assert not paths[0].exists() and not paths[1].exists()
+    assert paths[2].exists() and paths[3].exists()
+    assert _gc_stats()["files_evicted"] == 2
+    assert _gc_stats()["bytes_evicted"] == 200
+
+
+def test_gc_dry_run_reports_without_deleting(tmp_path, monkeypatch):
+    jd = tmp_path / "journal"
+    paths = _seed_store(jd, n=3, size=100)
+    monkeypatch.setenv("GUARD_TPU_JOURNAL_DIR", str(jd))
+    monkeypatch.setenv("GUARD_TPU_PLAN_CACHE_DIR", str(tmp_path / "p"))
+    monkeypatch.setenv(
+        "GUARD_TPU_RESULT_CACHE_DIR", str(tmp_path / "r")
+    )
+    rc, doc = _gc_run(max_bytes=100, dry_run=True)
+    assert rc == 0
+    assert doc["dry_run"] is True
+    assert doc["gc"]["journal"]["evicted"] == 2
+    assert all(p.exists() for p in paths)
+    assert _gc_stats()["files_evicted"] == 0
+
+
+def test_gc_undeletable_entry_skipped_exit_0(tmp_path, monkeypatch):
+    jd = tmp_path / "journal"
+    paths = _seed_store(jd, n=3, size=100)
+    monkeypatch.setenv("GUARD_TPU_JOURNAL_DIR", str(jd))
+    monkeypatch.setenv("GUARD_TPU_PLAN_CACHE_DIR", str(tmp_path / "p"))
+    monkeypatch.setenv(
+        "GUARD_TPU_RESULT_CACHE_DIR", str(tmp_path / "r")
+    )
+    from pathlib import Path
+
+    real_unlink = Path.unlink
+    victim = str(paths[0])
+
+    def flaky_unlink(self, *a, **kw):
+        if str(self) == victim:
+            raise PermissionError("synthetic EPERM")
+        return real_unlink(self, *a, **kw)
+
+    monkeypatch.setattr(Path, "unlink", flaky_unlink)
+    rc, doc = _gc_run(max_bytes=100)
+    assert rc == 0  # hygiene is advisory: never a failed command
+    assert _gc_stats()["evict_errors"] == 1
+    # the undeletable oldest was skipped; the next-oldest made room
+    assert paths[0].exists() and not paths[1].exists()
+
+
+def test_gc_vanished_entry_counts_bytes(tmp_path, monkeypatch):
+    """Crash-mid-evict / concurrent gc: a file already gone when the
+    unlink lands is not an error — the bytes are gone either way."""
+    jd = tmp_path / "journal"
+    paths = _seed_store(jd, n=3, size=100)
+    monkeypatch.setenv("GUARD_TPU_JOURNAL_DIR", str(jd))
+    monkeypatch.setenv("GUARD_TPU_PLAN_CACHE_DIR", str(tmp_path / "p"))
+    monkeypatch.setenv(
+        "GUARD_TPU_RESULT_CACHE_DIR", str(tmp_path / "r")
+    )
+    from pathlib import Path
+
+    real_unlink = Path.unlink
+    victim = str(paths[0])
+
+    def racing_unlink(self, *a, **kw):
+        if str(self) == victim:
+            real_unlink(self)  # the "concurrent gc" got there first
+        return real_unlink(self, *a, **kw)
+
+    monkeypatch.setattr(Path, "unlink", racing_unlink)
+    rc, doc = _gc_run(max_bytes=200)
+    assert rc == 0
+    assert doc["gc"]["journal"]["evicted"] == 1
+    assert _gc_stats()["evict_errors"] == 0
+
+
+def test_gc_reaps_only_aged_orphan_tmps(tmp_path, monkeypatch):
+    jd = tmp_path / "journal"
+    jd.mkdir(parents=True)
+    old = jd / "e.result.json.tmp.1234"
+    old.write_bytes(b"orphan")
+    os.utime(old, (1000.0, 1000.0))
+    fresh = jd / "f.result.json.tmp.5678"
+    fresh.write_bytes(b"live writer mid-rename")
+    monkeypatch.setenv("GUARD_TPU_JOURNAL_DIR", str(jd))
+    monkeypatch.setenv("GUARD_TPU_PLAN_CACHE_DIR", str(tmp_path / "p"))
+    monkeypatch.setenv(
+        "GUARD_TPU_RESULT_CACHE_DIR", str(tmp_path / "r")
+    )
+    rc, doc = _gc_run()
+    assert rc == 0
+    assert doc["gc"]["journal"]["tmps_reaped"] == 1
+    assert not old.exists()
+    assert fresh.exists()
+    assert _gc_stats()["orphan_tmps_reaped"] == 1
+
+
+def test_gc_env_cap(tmp_path, monkeypatch):
+    jd = tmp_path / "journal"
+    _seed_store(jd, n=4, size=100)
+    monkeypatch.setenv("GUARD_TPU_JOURNAL_DIR", str(jd))
+    monkeypatch.setenv("GUARD_TPU_PLAN_CACHE_DIR", str(tmp_path / "p"))
+    monkeypatch.setenv(
+        "GUARD_TPU_RESULT_CACHE_DIR", str(tmp_path / "r")
+    )
+    monkeypatch.setenv("GUARD_TPU_CACHE_MAX_BYTES", "300")
+    rc, doc = _gc_run()
+    assert rc == 0
+    assert doc["max_bytes"] == 300
+    assert doc["gc"]["journal"]["evicted"] == 1
+
+
+# -------------------------------------- ENOSPC degradation contract
+
+
+@pytest.mark.parametrize("workers", [0, 2])
+@pytest.mark.parametrize("pack", [True, False])
+def test_journal_enospc_degrades_to_journal_off_parity(
+    tmp_path, monkeypatch, workers, pack
+):
+    """A full disk at the journal seam turns checkpointing off with
+    ONE warning — the run's stdout/stderr/manifest/exit code stay
+    byte-identical to an explicit --no-journal run, across worker
+    counts and pack modes."""
+    rules, data = _mk_corpus(tmp_path, n=12, fail=(3,))
+    mpath = tmp_path / "m.jsonl"
+    off = _sweep(
+        rules, data, mpath, journal=False,
+        ingest_workers=workers, pack_rules=pack,
+    )
+    off_manifest = mpath.read_text()
+
+    def broken_write(self, rec):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(jn.SweepJournal, "_write_line", broken_write)
+    warned = []
+
+    class _Catch(logging.Handler):
+        def emit(self, record):
+            warned.append(record.getMessage())
+
+    h = _Catch(level=logging.WARNING)
+    logging.getLogger("guard_tpu.journal").addHandler(h)
+    mpath.unlink()
+    reset_all_stats()
+    try:
+        on = _sweep(
+            rules, data, mpath,
+            ingest_workers=workers, pack_rules=pack,
+        )
+    finally:
+        logging.getLogger("guard_tpu.journal").removeHandler(h)
+    assert on == off
+    assert mpath.read_text() == off_manifest
+    assert _resume_stats()["journal_degraded"] == 1
+    assert len(warned) == 1  # one warning, not one per chunk
+
+
+def test_store_write_fault_degrades_result_store(tmp_path, monkeypatch):
+    from guard_tpu.cache import results as rcache
+
+    monkeypatch.setenv(
+        "GUARD_TPU_RESULT_CACHE_DIR", str(tmp_path / "results")
+    )
+    monkeypatch.setenv("GUARD_TPU_FAULT", "store_write:rate=1.0:seed=1")
+    reset_faults()
+    assert rcache.store_entry("k" * 64, {"name": "d"}) is False
+    assert not list((tmp_path / "results").glob("*.result.json"))
+
+
+def test_store_write_fault_degrades_ledger(tmp_path, monkeypatch):
+    from guard_tpu.utils import ledger
+
+    monkeypatch.setenv("GUARD_TPU_LEDGER_DIR", str(tmp_path / "ledger"))
+    monkeypatch.setenv("GUARD_TPU_FAULT", "store_write:rate=1.0:seed=1")
+    reset_faults()
+    warned = []
+
+    class _Catch(logging.Handler):
+        def emit(self, record):
+            warned.append(record.getMessage())
+
+    h = _Catch(level=logging.WARNING)
+    logging.getLogger("guard_tpu.ledger").addHandler(h)
+    try:
+        rec = ledger.append_record("sweep", exit_code=0)
+    finally:
+        logging.getLogger("guard_tpu.ledger").removeHandler(h)
+    assert rec is None
+    assert warned
+    assert not (tmp_path / "ledger" / "ledger.jsonl").exists()
+
+
+def test_store_write_fault_degrades_plan_store(tmp_path, monkeypatch):
+    from guard_tpu.commands.validate import RuleFile
+    from guard_tpu.core.parser import parse_rules_file
+    from guard_tpu.ops import plan as plan_mod
+
+    monkeypatch.setenv("GUARD_TPU_PLAN_CACHE_DIR", str(tmp_path / "plans"))
+    rf = RuleFile(
+        name="r.guard", full_name="r.guard", content=RULES,
+        rules=parse_rules_file(RULES, "r.guard"),
+    )
+    plan = plan_mod.build_plan([rf])
+    digest = plan_mod.plan_digest([rf])
+    monkeypatch.setenv("GUARD_TPU_FAULT", "store_write:rate=1.0:seed=1")
+    reset_faults()
+    assert plan_mod.save_plan(plan, digest) is False
+    assert not list((tmp_path / "plans").glob("*.plan"))
+
+
+# --------------------------------------------- ledger resume records
+
+
+def test_resumed_session_pops_resume_info(tmp_path):
+    rules, data = _mk_corpus(tmp_path, n=8)
+    mpath = tmp_path / "m.jsonl"
+    _sweep(rules, data, mpath)
+    mpath.unlink()
+    _sweep(rules, data, mpath, resume=True)
+    info = jn.pop_resume_info()
+    assert info is not None
+    assert info["chunks_replayed"] == 2
+    assert isinstance(info["resumed_from"], str)
+    # read-then-clear: the epilogue consumes it exactly once
+    assert jn.pop_resume_info() is None
+
+
+def test_report_surfaces_resume_rate(tmp_path, monkeypatch):
+    from guard_tpu.commands.ops_report import OpsReport
+    from guard_tpu.utils import ledger
+
+    monkeypatch.setenv("GUARD_TPU_LEDGER_DIR", str(tmp_path))
+    ledger.append_record("sweep", exit_code=0)
+    ledger.append_record(
+        "sweep", exit_code=0,
+        extra={"resumed_from": "k" * 64, "chunks_replayed": 5},
+    )
+    w = Writer.buffered()
+    rc = OpsReport().execute(w, Reader.from_string(""))
+    assert rc == 0
+    out = w.out.getvalue()
+    assert "resume rate: 50.0%" in out
+    assert "5 chunks replayed" in out

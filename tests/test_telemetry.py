@@ -267,7 +267,7 @@ def test_admission_group_in_snapshot_contract():
     import tools.check_metrics_schema as cms
 
     assert "admission" in cms.EXPECTED_GROUPS
-    assert cms.KNOWN_SCHEMA_VERSION == telemetry.SCHEMA_VERSION == 6
+    assert cms.KNOWN_SCHEMA_VERSION == telemetry.SCHEMA_VERSION >= 6
     snap = telemetry.metrics_snapshot()
     assert "admission" in snap["counters"]
     for key in ("admitted", "rejected_rate", "rejected_inflight",
@@ -278,6 +278,31 @@ def test_admission_group_in_snapshot_contract():
     doctored = json.loads(json.dumps(snap))
     del doctored["counters"]["admission"]
     assert check_snapshot(doctored, require_groups=("admission",))
+
+
+def test_resume_and_gc_groups_in_snapshot_contract():
+    """v7: the durability plane's counter groups joined the published
+    snapshot shape — journal checkpoints/replays, drain sessions, and
+    store-hygiene eviction stats. Both register with utils.telemetry
+    itself, so every snapshot carries them."""
+    import tools.check_metrics_schema as cms
+
+    assert "resume" in cms.EXPECTED_GROUPS
+    assert "gc" in cms.EXPECTED_GROUPS
+    assert cms.KNOWN_SCHEMA_VERSION == telemetry.SCHEMA_VERSION == 7
+    snap = telemetry.metrics_snapshot()
+    assert "resume" in snap["counters"]
+    for key in ("chunks_journaled", "chunks_replayed", "runs_resumed",
+                "stale_cold_starts", "torn_records_dropped",
+                "journal_degraded", "drained_sessions"):
+        assert key in snap["counters"]["resume"]
+    assert "gc" in snap["counters"]
+    for key in ("runs", "files_evicted", "bytes_evicted",
+                "orphan_tmps_reaped", "evict_errors"):
+        assert key in snap["counters"]["gc"]
+    doctored = json.loads(json.dumps(snap))
+    del doctored["counters"]["resume"]
+    assert check_snapshot(doctored, require_groups=("resume",))
 
 
 def test_verify_and_lint_spans_roll_up():

@@ -96,6 +96,14 @@ METRIC_SINCE.update({
     "serve_quota_isolation_quiet_p50_ms": 16,
 })
 
+# PR 20 durability plane: the checkpoint-overhead pair and the
+# half-journaled resume row arrived with round 17
+METRIC_SINCE.update({
+    "config5b_journal_off_templates_per_sec": 17,
+    "config5b_journal_on_templates_per_sec": 17,
+    "config5b_resume_50pct_templates_per_sec": 17,
+})
+
 
 def metric_since(metric: str) -> int:
     """The bench round whose driver first emitted `metric`."""
@@ -244,6 +252,21 @@ METRIC_REQUIRED_KEYS.update({
     "config5b_delta_cold_templates_per_sec": DELTA_REQUIRED_KEYS,
     "config5b_delta_warm_templates_per_sec": DELTA_REQUIRED_KEYS,
     "config5b_delta_1pct_templates_per_sec": DELTA_REQUIRED_KEYS,
+})
+
+# PR 20 durability plane: the journal-on row must carry the measured
+# checkpoint overhead (the <=2% contract reads off the artifact alone)
+# and the per-run journaled-chunk count; the resume row must prove its
+# claim with the replayed/total chunk split and the per-run dispatch
+# count (only the unjournaled tail may dispatch)
+METRIC_REQUIRED_KEYS.update({
+    "config5b_journal_off_templates_per_sec": ("journal",),
+    "config5b_journal_on_templates_per_sec": (
+        "journal", "overhead_vs_off", "chunks_journaled_per_run",
+    ),
+    "config5b_resume_50pct_templates_per_sec": (
+        "chunks_replayed", "chunks_total", "dispatches_per_run",
+    ),
 })
 
 # PR 3 ingest decomposition: every *_ingest_workers* row must say how

@@ -39,7 +39,11 @@ import sys
 #: quota admissions/rejections, SLO circuit-breaker trips/probes/
 #: closes, overload sheds, follow-mode micro-batches) and the
 #: breaker-state / admission-inflight gauges.
-KNOWN_SCHEMA_VERSION = 6
+#: v7: the `resume` and `gc` counter groups (durability plane: sweep
+#: journal checkpoints/replays, graceful-drain sessions, store
+#: hygiene eviction stats) — both register with utils.telemetry
+#: itself, so they are present in every snapshot.
+KNOWN_SCHEMA_VERSION = 7
 
 #: top-level sections every snapshot must carry
 SECTIONS = ("schema_version", "counters", "gauges", "histograms", "spans")
@@ -58,7 +62,7 @@ SECTIONS = ("schema_version", "counters", "gauges", "histograms", "spans")
 #: present in every snapshot.
 EXPECTED_GROUPS = (
     "dispatch", "pipeline", "rim", "fault", "plan_cache", "efficiency",
-    "result_cache", "analysis", "admission",
+    "result_cache", "analysis", "admission", "resume", "gc",
 )
 
 #: keys every histogram snapshot must carry
